@@ -1,0 +1,11 @@
+"""sitewhere_tpu — a TPU-native IoT event-processing framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capability set of SiteWhere
+(KevinXu816/sitewhere): multi-protocol telemetry ingestion, device registry and
+auto-registration, batched event persistence, windowed per-device state
+aggregation and presence, command routing, outbound connectors, batch
+operations, scheduling, and a multi-tenant REST API — with the hot pipeline as
+fused XLA programs over HBM-resident state (see SURVEY.md).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,178 @@
+"""EventBatch: fixed-width structure-of-arrays device-event records.
+
+The reference moves events between pipeline stages as per-message protobuf
+payloads on Kafka topics (GDecodedEventPayload / GPreprocessedEventPayload /
+GProcessedEventPayload marshaled by EventModelMarshaler; see
+service-event-management/.../processing/OutboundPayloadEnrichmentLogic.java:48-50).
+Here a *batch* of decoded events is one pytree of flat arrays so the whole
+pipeline stage is a single XLA program over vector lanes — the TPU-native
+replacement for the per-message JVM hot loop
+(service-inbound-processing/.../kafka/DeviceLookupMapper.java:50-93).
+
+Timestamps are int32 milliseconds relative to a host-held epoch base
+(`EpochBase`), keeping all device arithmetic in 32-bit (TPU-friendly, no x64).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.core.types import AUX_LANES, DEFAULT_VALUE_CHANNELS, NULL_ID
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EventBatch:
+    """A padded batch of decoded device events (structure-of-arrays).
+
+    Shapes use B = batch capacity, C = value channels. Padding rows have
+    ``valid == False`` and id lanes set to NULL_ID.
+    """
+
+    valid: jax.Array        # bool[B]    slot holds a real event
+    etype: jax.Array        # int32[B]   EventType ordinal
+    token_id: jax.Array     # int32[B]   interned device-token id (host interner)
+    tenant_id: jax.Array    # int32[B]
+    ts_ms: jax.Array        # int32[B]   event time, ms since EpochBase
+    received_ms: jax.Array  # int32[B]   receive time, ms since EpochBase
+    values: jax.Array       # float32[B, C] payload values (layout per EventType)
+    vmask: jax.Array        # bool[B, C] which value channels are populated
+    aux: jax.Array          # int32[B, AUX_LANES] interned discriminator ids
+    seq: jax.Array          # int32[B]   per-batch sequence for stable ordering
+
+    @property
+    def capacity(self) -> int:
+        return self.valid.shape[0]
+
+    @property
+    def channels(self) -> int:
+        return self.values.shape[1]
+
+    def count(self) -> jax.Array:
+        return jnp.sum(self.valid.astype(jnp.int32))
+
+    @staticmethod
+    def zeros(capacity: int, channels: int = DEFAULT_VALUE_CHANNELS) -> "EventBatch":
+        return EventBatch(
+            valid=jnp.zeros((capacity,), jnp.bool_),
+            etype=jnp.zeros((capacity,), jnp.int32),
+            token_id=jnp.full((capacity,), NULL_ID, jnp.int32),
+            tenant_id=jnp.full((capacity,), NULL_ID, jnp.int32),
+            ts_ms=jnp.zeros((capacity,), jnp.int32),
+            received_ms=jnp.zeros((capacity,), jnp.int32),
+            values=jnp.zeros((capacity, channels), jnp.float32),
+            vmask=jnp.zeros((capacity, channels), jnp.bool_),
+            aux=jnp.full((capacity, AUX_LANES), NULL_ID, jnp.int32),
+            seq=jnp.zeros((capacity,), jnp.int32),
+        )
+
+
+class EpochBase:
+    """Host-side epoch base for int32 millisecond timestamps.
+
+    int32 ms wraps at ~24.8 days; the base is refreshed by the ingest host at
+    checkpoint boundaries. All device-side comparisons are within one epoch.
+    """
+
+    def __init__(self, base_unix_s: float | None = None):
+        self.base_unix_s = float(base_unix_s if base_unix_s is not None else time.time())
+
+    def to_ms(self, unix_s: float) -> int:
+        return int((unix_s - self.base_unix_s) * 1000.0)
+
+    def now_ms(self) -> int:
+        return self.to_ms(time.time())
+
+    def to_unix_s(self, ms: int) -> float:
+        return self.base_unix_s + ms / 1000.0
+
+
+class HostEventBuffer:
+    """Host-side staging buffer that accumulates decoded events into numpy
+    arrays and emits padded ``EventBatch`` pytrees.
+
+    This is the boundary between the variable-rate protocol edge (ingest
+    receivers, reference §2.1) and the fixed-shape XLA pipeline. Batches are
+    always emitted at full ``capacity`` with a valid-mask — a fixed shape means
+    one compiled program, no recompiles (SURVEY.md §7 "hard parts").
+    """
+
+    def __init__(self, capacity: int, channels: int = DEFAULT_VALUE_CHANNELS):
+        self.capacity = capacity
+        self.channels = channels
+        self._n = 0
+        self._alloc()
+
+    def _alloc(self) -> None:
+        cap, ch = self.capacity, self.channels
+        self.etype = np.zeros(cap, np.int32)
+        self.token_id = np.full(cap, NULL_ID, np.int32)
+        self.tenant_id = np.full(cap, NULL_ID, np.int32)
+        self.ts_ms = np.zeros(cap, np.int32)
+        self.received_ms = np.zeros(cap, np.int32)
+        self.values = np.zeros((cap, ch), np.float32)
+        self.vmask = np.zeros((cap, ch), np.bool_)
+        self.aux = np.full((cap, AUX_LANES), NULL_ID, np.int32)
+
+    def __len__(self) -> int:
+        return self._n
+
+    @property
+    def full(self) -> bool:
+        return self._n >= self.capacity
+
+    def append(
+        self,
+        etype: int,
+        token_id: int,
+        tenant_id: int,
+        ts_ms: int,
+        received_ms: int,
+        values: Any = (),
+        aux0: int = NULL_ID,
+        aux1: int = NULL_ID,
+    ) -> bool:
+        """Append one decoded event; returns False when the buffer is full."""
+        i = self._n
+        if i >= self.capacity:
+            return False
+        self.etype[i] = etype
+        self.token_id[i] = token_id
+        self.tenant_id[i] = tenant_id
+        self.ts_ms[i] = ts_ms
+        self.received_ms[i] = received_ms
+        nvals = min(len(values), self.channels)
+        if nvals:
+            self.values[i, :nvals] = values[:nvals]
+            self.vmask[i, :nvals] = True
+        self.aux[i, 0] = aux0
+        self.aux[i, 1] = aux1
+        self._n = i + 1
+        return True
+
+    def emit(self) -> EventBatch:
+        """Produce an EventBatch from the staged rows and reset the buffer."""
+        n = self._n
+        valid = np.zeros(self.capacity, np.bool_)
+        valid[:n] = True
+        batch = EventBatch(
+            valid=jnp.asarray(valid),
+            etype=jnp.asarray(self.etype),
+            token_id=jnp.asarray(self.token_id),
+            tenant_id=jnp.asarray(self.tenant_id),
+            ts_ms=jnp.asarray(self.ts_ms),
+            received_ms=jnp.asarray(self.received_ms),
+            values=jnp.asarray(self.values),
+            vmask=jnp.asarray(self.vmask),
+            aux=jnp.asarray(self.aux),
+            seq=jnp.arange(self.capacity, dtype=jnp.int32),
+        )
+        self._n = 0
+        self._alloc()
+        return batch

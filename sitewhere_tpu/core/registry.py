@@ -1,0 +1,317 @@
+"""Device registry: integer-indexed tables + host-side token interner.
+
+The reference's device registry is a JPA/RDB CRUD service
+(service-device-management/.../persistence/rdb/RdbDeviceManagement.java, 2,243
+LoC; entities in device/persistence/rdb/entity/) queried per message over gRPC
+by the inbound pipeline (DeviceLookupMapper.java:50-93). Here the registry is a
+set of device-resident int32 tables so the per-message RPC becomes a batched
+gather on TPU (ops/lookup.py), and the string token -> id mapping — the one
+unavoidable host hot path (SURVEY.md §7) — is a host interner mirroring
+CachedDeviceManagementApiChannel's cache role.
+
+Capacities are static (XLA static shapes); growing capacity is a host-side
+re-allocation + state copy, amortized like a hash-table rehash.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.core.types import NULL_ID, DeviceAssignmentStatus
+
+# Max simultaneously-active assignments tracked per device on-device. The
+# reference allows a device to hold multiple active assignments
+# (DeviceAssignmentsLookupMapper expands one event per active assignment);
+# a small static cap keeps the expansion a fixed-shape flatMap.
+MAX_ACTIVE_ASSIGNMENTS = 4
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RegistryTables:
+    """Device-resident registry state. N = device capacity, T = token capacity,
+    A = MAX_ACTIVE_ASSIGNMENTS, G = assignment capacity."""
+
+    # token_id -> device row (NULL_ID = unregistered). This gather replaces the
+    # reference's per-event getDeviceByToken gRPC call.
+    token_to_device: jax.Array      # int32[T]
+    # device rows
+    device_active: jax.Array        # bool[N]
+    device_type: jax.Array          # int32[N]
+    device_tenant: jax.Array        # int32[N]
+    device_area: jax.Array          # int32[N]
+    device_customer: jax.Array      # int32[N]
+    device_parent: jax.Array        # int32[N]  gateway/composite parent (NestedDeviceSupport)
+    # per-device active-assignment slots (NULL_ID = empty)
+    device_assignments: jax.Array   # int32[N, A]
+    # assignment rows
+    assignment_active: jax.Array    # bool[G]
+    assignment_status: jax.Array    # int32[G]  DeviceAssignmentStatus
+    assignment_device: jax.Array    # int32[G]
+    assignment_asset: jax.Array     # int32[G]
+    assignment_area: jax.Array      # int32[G]
+    assignment_customer: jax.Array  # int32[G]
+
+    @property
+    def device_capacity(self) -> int:
+        return self.device_active.shape[0]
+
+    @property
+    def token_capacity(self) -> int:
+        return self.token_to_device.shape[0]
+
+    @property
+    def assignment_capacity(self) -> int:
+        return self.assignment_active.shape[0]
+
+    @staticmethod
+    def zeros(device_capacity: int, token_capacity: int, assignment_capacity: int) -> "RegistryTables":
+        n, t, g = device_capacity, token_capacity, assignment_capacity
+        a = MAX_ACTIVE_ASSIGNMENTS
+        i32 = jnp.int32
+        return RegistryTables(
+            token_to_device=jnp.full((t,), NULL_ID, i32),
+            device_active=jnp.zeros((n,), jnp.bool_),
+            device_type=jnp.full((n,), NULL_ID, i32),
+            device_tenant=jnp.full((n,), NULL_ID, i32),
+            device_area=jnp.full((n,), NULL_ID, i32),
+            device_customer=jnp.full((n,), NULL_ID, i32),
+            device_parent=jnp.full((n,), NULL_ID, i32),
+            device_assignments=jnp.full((n, a), NULL_ID, i32),
+            assignment_active=jnp.zeros((g,), jnp.bool_),
+            assignment_status=jnp.full((g,), DeviceAssignmentStatus.RELEASED, i32),
+            assignment_device=jnp.full((g,), NULL_ID, i32),
+            assignment_asset=jnp.full((g,), NULL_ID, i32),
+            assignment_area=jnp.full((g,), NULL_ID, i32),
+            assignment_customer=jnp.full((g,), NULL_ID, i32),
+        )
+
+
+class TokenInterner:
+    """Thread-safe host-side string -> dense int id map.
+
+    Mirrors the role of the reference's token-keyed device cache
+    (CachedDeviceManagementApiChannel used at
+    InboundProcessingMicroservice.java:159-167): ingest threads intern device
+    tokens once; the hot path afterwards is dict lookup + int arrays.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._by_token: dict[str, int] = {}
+        self._tokens: list[str] = []
+
+    def __len__(self) -> int:
+        return len(self._tokens)
+
+    def intern(self, token: str) -> int:
+        tid = self._by_token.get(token)
+        if tid is not None:
+            return tid
+        with self._lock:
+            tid = self._by_token.get(token)
+            if tid is None:
+                tid = len(self._tokens)
+                if tid >= self.capacity:
+                    raise RuntimeError(f"token capacity {self.capacity} exhausted")
+                self._tokens.append(token)
+                self._by_token[token] = tid
+            return tid
+
+    def lookup(self, token: str) -> int:
+        return self._by_token.get(token, NULL_ID)
+
+    def token(self, tid: int) -> str:
+        return self._tokens[tid]
+
+    def items(self) -> Iterator[tuple[str, int]]:
+        return iter(self._by_token.items())
+
+
+@dataclasses.dataclass
+class DeviceRecord:
+    """Host-side metadata for one device (strings, free-form metadata) — the
+    device-side tables carry only the hot integer columns."""
+
+    token: str
+    device_type_id: int
+    tenant_id: int
+    area_id: int = NULL_ID
+    customer_id: int = NULL_ID
+    parent_id: int = NULL_ID
+    comments: str = ""
+    status: str = ""
+    metadata: dict | None = None
+
+
+class RegistryHost:
+    """Host mirror of the registry: owns numpy copies, string metadata, and
+    produces device-resident ``RegistryTables``.
+
+    CRUD surface mirrors RdbDeviceManagement's device/assignment operations
+    (create/get/update/delete device; create/release assignment). Mutations
+    update the numpy mirror; ``snapshot()`` uploads to device. The engine
+    applies batched registration updates through ops/registration.py instead
+    when running steady-state.
+    """
+
+    def __init__(self, device_capacity: int, token_capacity: int, assignment_capacity: int):
+        self.device_capacity = device_capacity
+        self.assignment_capacity = assignment_capacity
+        self.tokens = TokenInterner(token_capacity)
+        self._lock = threading.Lock()
+        self._next_device = 0
+        self._next_assignment = 0
+        self.records: dict[int, DeviceRecord] = {}
+
+        n, t, g = device_capacity, token_capacity, assignment_capacity
+        a = MAX_ACTIVE_ASSIGNMENTS
+        self.np_token_to_device = np.full(t, NULL_ID, np.int32)
+        self.np_device_active = np.zeros(n, np.bool_)
+        self.np_device_type = np.full(n, NULL_ID, np.int32)
+        self.np_device_tenant = np.full(n, NULL_ID, np.int32)
+        self.np_device_area = np.full(n, NULL_ID, np.int32)
+        self.np_device_customer = np.full(n, NULL_ID, np.int32)
+        self.np_device_parent = np.full(n, NULL_ID, np.int32)
+        self.np_device_assignments = np.full((n, a), NULL_ID, np.int32)
+        self.np_assignment_active = np.zeros(g, np.bool_)
+        self.np_assignment_status = np.full(g, DeviceAssignmentStatus.RELEASED, np.int32)
+        self.np_assignment_device = np.full(g, NULL_ID, np.int32)
+        self.np_assignment_asset = np.full(g, NULL_ID, np.int32)
+        self.np_assignment_area = np.full(g, NULL_ID, np.int32)
+        self.np_assignment_customer = np.full(g, NULL_ID, np.int32)
+
+    # ---- device CRUD -----------------------------------------------------
+
+    def create_device(self, record: DeviceRecord) -> int:
+        """Register a device; returns its dense device id.
+
+        Reference behavior: RdbDeviceManagement.createDevice +
+        DeviceRegistrationManager.handleDeviceRegistration get-or-create
+        (registration/DeviceRegistrationManager.java:108-164).
+        """
+        with self._lock:
+            tid = self.tokens.intern(record.token)
+            existing = int(self.np_token_to_device[tid])
+            if existing != NULL_ID:
+                if not self.np_device_active[existing]:
+                    # re-creating a deleted device reactivates its row with
+                    # the new record's fields (get-or-create semantics)
+                    self.np_device_active[existing] = True
+                    self.np_device_type[existing] = record.device_type_id
+                    self.np_device_tenant[existing] = record.tenant_id
+                    self.np_device_area[existing] = record.area_id
+                    self.np_device_customer[existing] = record.customer_id
+                    self.np_device_parent[existing] = record.parent_id
+                    self.records[existing] = record
+                return existing
+            did = self._next_device
+            if did >= self.device_capacity:
+                raise RuntimeError(f"device capacity {self.device_capacity} exhausted")
+            self._next_device = did + 1
+            self.np_token_to_device[tid] = did
+            self.np_device_active[did] = True
+            self.np_device_type[did] = record.device_type_id
+            self.np_device_tenant[did] = record.tenant_id
+            self.np_device_area[did] = record.area_id
+            self.np_device_customer[did] = record.customer_id
+            self.np_device_parent[did] = record.parent_id
+            self.records[did] = record
+            return did
+
+    def get_device_by_token(self, token: str) -> int:
+        tid = self.tokens.lookup(token)
+        if tid == NULL_ID:
+            return NULL_ID
+        return int(self.np_token_to_device[tid])
+
+    def delete_device(self, device_id: int) -> None:
+        with self._lock:
+            self.np_device_active[device_id] = False
+            for slot in range(MAX_ACTIVE_ASSIGNMENTS):
+                aid = int(self.np_device_assignments[device_id, slot])
+                if aid != NULL_ID:
+                    self._release_assignment_locked(aid)
+
+    # ---- assignment CRUD -------------------------------------------------
+
+    def create_assignment(
+        self,
+        device_id: int,
+        asset_id: int = NULL_ID,
+        area_id: int = NULL_ID,
+        customer_id: int = NULL_ID,
+    ) -> int:
+        """Create an ACTIVE assignment and attach it to a free device slot.
+
+        Reference behavior: RdbDeviceManagement.createDeviceAssignment; the
+        per-device slot list feeds the event expansion of
+        DeviceAssignmentsLookupMapper (one payload per active assignment).
+        """
+        with self._lock:
+            slots = self.np_device_assignments[device_id]
+            free = np.where(slots == NULL_ID)[0]
+            if free.size == 0:
+                raise RuntimeError(
+                    f"device {device_id} already has {MAX_ACTIVE_ASSIGNMENTS} active assignments"
+                )
+            gid = self._next_assignment
+            if gid >= self.assignment_capacity:
+                raise RuntimeError(f"assignment capacity {self.assignment_capacity} exhausted")
+            self._next_assignment = gid + 1
+            self.np_assignment_active[gid] = True
+            self.np_assignment_status[gid] = DeviceAssignmentStatus.ACTIVE
+            self.np_assignment_device[gid] = device_id
+            self.np_assignment_asset[gid] = asset_id
+            self.np_assignment_area[gid] = (
+                area_id if area_id != NULL_ID else int(self.np_device_area[device_id])
+            )
+            self.np_assignment_customer[gid] = (
+                customer_id if customer_id != NULL_ID else int(self.np_device_customer[device_id])
+            )
+            self.np_device_assignments[device_id, free[0]] = gid
+            return gid
+
+    def _release_assignment_locked(self, assignment_id: int) -> None:
+        self.np_assignment_active[assignment_id] = False
+        self.np_assignment_status[assignment_id] = DeviceAssignmentStatus.RELEASED
+        did = int(self.np_assignment_device[assignment_id])
+        if did != NULL_ID:
+            slots = self.np_device_assignments[did]
+            slots[slots == assignment_id] = NULL_ID
+
+    def release_assignment(self, assignment_id: int) -> None:
+        with self._lock:
+            self._release_assignment_locked(assignment_id)
+
+    def active_assignments(self, device_id: int) -> list[int]:
+        slots = self.np_device_assignments[device_id]
+        return [int(a) for a in slots if a != NULL_ID]
+
+    # ---- device snapshot -------------------------------------------------
+
+    def snapshot(self) -> RegistryTables:
+        """Upload the current registry to device-resident tables."""
+        return RegistryTables(
+            token_to_device=jnp.asarray(self.np_token_to_device),
+            device_active=jnp.asarray(self.np_device_active),
+            device_type=jnp.asarray(self.np_device_type),
+            device_tenant=jnp.asarray(self.np_device_tenant),
+            device_area=jnp.asarray(self.np_device_area),
+            device_customer=jnp.asarray(self.np_device_customer),
+            device_parent=jnp.asarray(self.np_device_parent),
+            device_assignments=jnp.asarray(self.np_device_assignments),
+            assignment_active=jnp.asarray(self.np_assignment_active),
+            assignment_status=jnp.asarray(self.np_assignment_status),
+            assignment_device=jnp.asarray(self.np_assignment_device),
+            assignment_asset=jnp.asarray(self.np_assignment_asset),
+            assignment_area=jnp.asarray(self.np_assignment_area),
+            assignment_customer=jnp.asarray(self.np_assignment_customer),
+        )

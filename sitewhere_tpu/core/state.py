@@ -1,0 +1,101 @@
+"""Per-device aggregated state tensors.
+
+The reference aggregates outbound events into per-device state with a 5s
+tumbling window (service-device-state/.../kafka/DeviceStatePipeline.java:30-88,
+DeviceStateAggregator.java:29-68) and merges each window into an RDB row
+keeping the latest value plus the 3 most recent events per event class
+(persistence/rdb/RdbDeviceStateMergeStrategy.java:41-120, N=3 at line 47).
+Presence is tracked via lastInteractionDate scans
+(presence/DevicePresenceManager.java:45-160).
+
+Here the whole state DB is a set of HBM-resident arrays indexed by dense
+device id; the window merge is a batched sort/segment kernel (ops/window.py)
+and presence is a vectorized compare over last_interaction_ms.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.types import (
+    DEFAULT_VALUE_CHANNELS,
+    NUM_EVENT_TYPES,
+    PresenceState,
+)
+
+# Recent-event ring depth per event class, matching the reference's
+# RdbDeviceStateMergeStrategy MAX_RECENT = 3.
+RECENT_DEPTH = 3
+
+# Location payload lanes: lat, lon, elevation.
+LOC_LANES = 3
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class DeviceStateStore:
+    """Aggregated device state. N = device capacity, R = RECENT_DEPTH,
+    C = measurement channels.
+
+    "Recent" rings are kept sorted most-recent-first (slot 0 = newest), so the
+    latest-known state is always slot 0 — the reference keeps the same
+    "latest + recent list" shape in RdbDeviceState + RdbRecent*Event rows.
+    """
+
+    # presence / interaction (DevicePresenceManager analog)
+    last_interaction_ms: jax.Array   # int32[N]  (INT32_MIN = never)
+    presence: jax.Array              # int32[N]  PresenceState
+
+    # latest + recent measurements: per-channel last value...
+    meas_last: jax.Array             # float32[N, C] latest value per channel
+    meas_last_ms: jax.Array          # int32[N, C]   ts of that value
+    # ...and the recent-measurement-event ring (vector per event)
+    recent_meas: jax.Array           # float32[N, R, C]
+    recent_meas_mask: jax.Array      # bool[N, R, C]
+    recent_meas_ms: jax.Array        # int32[N, R]
+    recent_meas_valid: jax.Array     # bool[N, R]
+
+    # locations
+    recent_loc: jax.Array            # float32[N, R, LOC_LANES]
+    recent_loc_ms: jax.Array         # int32[N, R]
+    recent_loc_valid: jax.Array      # bool[N, R]
+
+    # alerts
+    recent_alert_level: jax.Array    # int32[N, R]
+    recent_alert_type: jax.Array     # int32[N, R]  interned alert-type id
+    recent_alert_ms: jax.Array       # int32[N, R]
+    recent_alert_valid: jax.Array    # bool[N, R]
+
+    # counters (Prometheus-analog per-device tallies)
+    event_counts: jax.Array          # int32[N, NUM_EVENT_TYPES=6]
+
+    @property
+    def device_capacity(self) -> int:
+        return self.last_interaction_ms.shape[0]
+
+    @staticmethod
+    def zeros(device_capacity: int, channels: int = DEFAULT_VALUE_CHANNELS) -> "DeviceStateStore":
+        n, r, c = device_capacity, RECENT_DEPTH, channels
+        i32 = jnp.int32
+        tmin = jnp.iinfo(jnp.int32).min
+        return DeviceStateStore(
+            last_interaction_ms=jnp.full((n,), tmin, i32),
+            presence=jnp.full((n,), PresenceState.UNKNOWN, i32),
+            meas_last=jnp.zeros((n, c), jnp.float32),
+            meas_last_ms=jnp.full((n, c), tmin, i32),
+            recent_meas=jnp.zeros((n, r, c), jnp.float32),
+            recent_meas_mask=jnp.zeros((n, r, c), jnp.bool_),
+            recent_meas_ms=jnp.full((n, r), tmin, i32),
+            recent_meas_valid=jnp.zeros((n, r), jnp.bool_),
+            recent_loc=jnp.zeros((n, r, LOC_LANES), jnp.float32),
+            recent_loc_ms=jnp.full((n, r), tmin, i32),
+            recent_loc_valid=jnp.zeros((n, r), jnp.bool_),
+            recent_alert_level=jnp.zeros((n, r), i32),
+            recent_alert_type=jnp.zeros((n, r), i32),
+            recent_alert_ms=jnp.full((n, r), tmin, i32),
+            recent_alert_valid=jnp.zeros((n, r), jnp.bool_),
+            event_counts=jnp.zeros((n, NUM_EVENT_TYPES), i32),
+        )

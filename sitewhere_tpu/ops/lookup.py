@@ -1,0 +1,105 @@
+"""Batched device lookup + assignment expansion.
+
+Replaces the reference's inbound-processing hot loop — a blocking gRPC
+``getDeviceByToken`` per message followed by an active-assignments RPC and a
+flatMap to one payload per assignment
+(service-inbound-processing/.../kafka/DecodedEventsPipeline.java:87-115,
+DeviceLookupMapper.java:50-93, DeviceAssignmentsLookupMapper /
+PreprocessedEventMapper) — with two gathers over device-resident registry
+tables. The not-found branch (DecodedEventsPipeline.java:96-106, which feeds
+the unregistered-device-events topic) becomes the returned ``miss`` mask.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.registry import MAX_ACTIVE_ASSIGNMENTS, RegistryTables
+from sitewhere_tpu.core.types import NULL_ID
+
+
+class LookupResult(NamedTuple):
+    device: jax.Array       # int32[B] dense device id (NULL_ID on miss)
+    found: jax.Array        # bool[B]  valid event and device registered+active
+    miss: jax.Array         # bool[B]  valid event but unregistered/inactive
+    tenant_ok: jax.Array    # bool[B]  event tenant matches device tenant
+    assignments: jax.Array  # int32[B, A] active assignment ids (NULL_ID pads)
+    n_assignments: jax.Array  # int32[B]
+
+
+def lookup_devices(
+    reg: RegistryTables,
+    token_id: jax.Array,
+    tenant_id: jax.Array,
+    valid: jax.Array,
+) -> LookupResult:
+    """Vectorized device/assignment lookup for one event batch."""
+    # out-of-range token ids must miss, not alias into clipped slots
+    in_range = (token_id >= 0) & (token_id < reg.token_capacity)
+    safe_tok = jnp.clip(token_id, 0, reg.token_capacity - 1)
+    device = jnp.where(valid & in_range, reg.token_to_device[safe_tok], NULL_ID)
+    has_row = device != NULL_ID
+    safe_dev = jnp.clip(device, 0, reg.device_capacity - 1)
+    active = jnp.where(has_row, reg.device_active[safe_dev], False)
+    dev_tenant = jnp.where(has_row, reg.device_tenant[safe_dev], NULL_ID)
+    tenant_ok = has_row & ((tenant_id == NULL_ID) | (dev_tenant == tenant_id))
+    found = valid & has_row & active & tenant_ok
+    miss = valid & ~found
+    assignments = jnp.where(
+        found[:, None], reg.device_assignments[safe_dev], NULL_ID
+    )
+    # only ACTIVE assignment slots expand into events
+    safe_asn = jnp.clip(assignments, 0, reg.assignment_capacity - 1)
+    asn_live = (assignments != NULL_ID) & reg.assignment_active[safe_asn]
+    assignments = jnp.where(asn_live, assignments, NULL_ID)
+    n_assignments = jnp.sum(asn_live.astype(jnp.int32), axis=1)
+    return LookupResult(
+        device=jnp.where(found, device, NULL_ID),
+        found=found,
+        miss=miss,
+        tenant_ok=tenant_ok,
+        assignments=assignments,
+        n_assignments=n_assignments,
+    )
+
+
+class ExpandedEvents(NamedTuple):
+    """Per-assignment expansion of an event batch, flattened to B*A rows —
+    the TPU analog of PreprocessedEventMapper's one-payload-per-assignment
+    flatMap."""
+
+    valid: jax.Array       # bool[B*A]
+    device: jax.Array      # int32[B*A]
+    assignment: jax.Array  # int32[B*A]
+    area: jax.Array        # int32[B*A]
+    asset: jax.Array       # int32[B*A]
+    source_row: jax.Array  # int32[B*A] row in the original batch
+
+
+def expand_assignments(reg: RegistryTables, res: LookupResult) -> ExpandedEvents:
+    b, a = res.assignments.shape
+    asn = res.assignments.reshape(-1)
+    live = asn != NULL_ID
+    safe = jnp.clip(asn, 0, reg.assignment_capacity - 1)
+    device = jnp.repeat(res.device, a)
+    source_row = jnp.repeat(jnp.arange(b, dtype=jnp.int32), a)
+    return ExpandedEvents(
+        valid=live,
+        device=jnp.where(live, device, NULL_ID),
+        assignment=jnp.where(live, asn, NULL_ID),
+        area=jnp.where(live, reg.assignment_area[safe], NULL_ID),
+        asset=jnp.where(live, reg.assignment_asset[safe], NULL_ID),
+        source_row=source_row,
+    )
+
+
+__all__ = [
+    "LookupResult",
+    "ExpandedEvents",
+    "lookup_devices",
+    "expand_assignments",
+    "MAX_ACTIVE_ASSIGNMENTS",
+]

@@ -1,0 +1,91 @@
+"""Batched event persistence into the HBM ring store.
+
+Replaces the reference's per-event time-series writes
+(service-event-management/.../kafka/EventPersistenceMapper.java:61-120 →
+InfluxDbDeviceEventManagement.java:63-161 point builds) with one compaction
+sort + one masked scatter per batch. Invalid (padding / unexpanded) rows are
+compacted to the back and scattered out-of-bounds with ``mode='drop'`` so
+they cost no ring capacity.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.store import EventStore
+from sitewhere_tpu.ops.segment import compact_valid_front
+
+
+class PersistResult(NamedTuple):
+    store: EventStore
+    appended: jax.Array  # int32[] events written this batch
+
+
+def append_events(
+    store: EventStore,
+    valid: jax.Array,       # bool[E]
+    etype: jax.Array,       # int32[E]
+    device: jax.Array,      # int32[E]
+    assignment: jax.Array,  # int32[E]
+    tenant: jax.Array,      # int32[E]
+    area: jax.Array,        # int32[E]
+    asset: jax.Array,       # int32[E]
+    ts_ms: jax.Array,       # int32[E]
+    received_ms: jax.Array, # int32[E]
+    values: jax.Array,      # float32[E, C]
+    vmask: jax.Array,       # bool[E, C]
+    aux: jax.Array,         # int32[E, AUX]
+) -> PersistResult:
+    """Append up to E events at the ring cursor. E may exceed remaining ring
+    space; the ring wraps (oldest rows overwritten), mirroring retention-policy
+    expiry in the reference's InfluxDB backend (INFLUX_RETENTION_POLICY
+    override, InfluxDbDeviceEventManagement.java)."""
+    s = store.capacity
+    e = valid.shape[0]
+    # With e <= s the positions (cursor+rank) % s are distinct, so the single
+    # scatter below is well-defined. A batch larger than the whole ring would
+    # alias slots inside one scatter (order-undefined in XLA); sizes are
+    # static, so reject that configuration at trace time.
+    if e > s:
+        raise ValueError(
+            f"expanded batch ({e} rows) exceeds event-store capacity ({s}); "
+            "allocate store_capacity >= batch_capacity * MAX_ACTIVE_ASSIGNMENTS"
+        )
+
+    # Stable-compact valid rows to the front so padding never lands in the ring.
+    n, perm = compact_valid_front(valid)
+    c_valid = valid[perm]
+    c_etype = etype[perm]
+    c_device = device[perm]
+    c_assignment = assignment[perm]
+    c_tenant = tenant[perm]
+    c_area = area[perm]
+    c_asset = asset[perm]
+    c_ts = ts_ms[perm]
+    c_recv = received_ms[perm]
+    c_values = values[perm]
+    c_vmask = vmask[perm]
+    c_aux = aux[perm]
+    rank = jnp.arange(e, dtype=jnp.int32)
+    pos = jnp.where(c_valid, (store.cursor + rank) % s, s)  # s = out of bounds -> dropped
+
+    new = EventStore(
+        cursor=(store.cursor + n) % jnp.int32(s),
+        epoch=store.epoch + (store.cursor + n) // jnp.int32(s),
+        etype=store.etype.at[pos].set(c_etype, mode="drop"),
+        device=store.device.at[pos].set(c_device, mode="drop"),
+        assignment=store.assignment.at[pos].set(c_assignment, mode="drop"),
+        tenant=store.tenant.at[pos].set(c_tenant, mode="drop"),
+        area=store.area.at[pos].set(c_area, mode="drop"),
+        asset=store.asset.at[pos].set(c_asset, mode="drop"),
+        ts_ms=store.ts_ms.at[pos].set(c_ts, mode="drop"),
+        received_ms=store.received_ms.at[pos].set(c_recv, mode="drop"),
+        values=store.values.at[pos].set(c_values, mode="drop"),
+        vmask=store.vmask.at[pos].set(c_vmask, mode="drop"),
+        aux=store.aux.at[pos].set(c_aux, mode="drop"),
+        valid=store.valid.at[pos].set(True, mode="drop"),
+    )
+    return PersistResult(store=new, appended=n)

@@ -1,0 +1,106 @@
+"""Batched device auto-registration.
+
+The reference routes events for unknown devices to an unregistered-device
+topic; service-device-registration consumes it and get-or-creates the device
+with a default device type / customer / area, ensures an assignment, and acks
+(registration/DeviceRegistrationManager.java:44-164, single-thread executor at
+line 66). Here registration is a batched kernel over the miss-set produced by
+ops/lookup.py: unknown tokens are deduplicated in-batch, allocated dense
+device + assignment rows from device-resident counters, and written into the
+registry tables in one shot — the host mirrors the allocation deterministically
+(same order, same ids) from the returned new-token list.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.registry import RegistryTables
+from sitewhere_tpu.core.types import NULL_ID, DeviceAssignmentStatus
+from sitewhere_tpu.ops.segment import INT32_MAX, compact_valid_front
+
+
+class RegistrationResult(NamedTuple):
+    registry: RegistryTables
+    next_device: jax.Array       # int32[] updated allocation counter
+    next_assignment: jax.Array   # int32[]
+    n_registered: jax.Array      # int32[] new devices this batch
+    # compacted [B] list of newly registered token ids (NULL_ID padded) so the
+    # host can mirror metadata + fire RegistrationAck system commands
+    new_tokens: jax.Array        # int32[B]
+    overflow: jax.Array          # bool[] capacity exhausted (dead-letter)
+
+
+def register_misses(
+    reg: RegistryTables,
+    next_device: jax.Array,
+    next_assignment: jax.Array,
+    token_id: jax.Array,    # int32[B]
+    tenant_id: jax.Array,   # int32[B]
+    miss: jax.Array,        # bool[B] unregistered-device rows from lookup
+    default_type: jax.Array,      # int32[] default device type id
+    default_area: jax.Array,      # int32[]
+    default_customer: jax.Array,  # int32[]
+) -> RegistrationResult:
+    """Register every distinct missed token: device row + ACTIVE assignment."""
+    b = token_id.shape[0]
+    t = reg.token_capacity
+    n = reg.device_capacity
+    g = reg.assignment_capacity
+
+    safe_tok = jnp.clip(token_id, 0, t - 1)
+    known = reg.token_to_device[safe_tok] != NULL_ID
+    want = miss & ~known & (token_id >= 0) & (token_id < t)
+
+    # dedup within batch: first occurrence of each token wins
+    seq = jnp.arange(b, dtype=jnp.int32)
+    tok_w = jnp.where(want, token_id, t)
+    first = jnp.full((t,), INT32_MAX, jnp.int32).at[tok_w].min(seq, mode="drop")
+    winner = want & (seq == first.at[safe_tok].get(mode="fill", fill_value=INT32_MAX))
+
+    # dense rank among winners -> allocated ids
+    rank = jnp.cumsum(winner.astype(jnp.int32)) - 1
+    n_new = jnp.sum(winner.astype(jnp.int32))
+    new_dev = next_device + rank
+    new_asn = next_assignment + rank
+    fits = winner & (new_dev < n) & (new_asn < g)
+    overflow = n_new > jnp.sum(fits.astype(jnp.int32))
+
+    dev_w = jnp.where(fits, new_dev, n)
+    asn_w = jnp.where(fits, new_asn, g)
+    tok_ww = jnp.where(fits, token_id, t)
+
+    registry = dataclasses.replace(
+        reg,
+        token_to_device=reg.token_to_device.at[tok_ww].set(new_dev, mode="drop"),
+        device_active=reg.device_active.at[dev_w].set(True, mode="drop"),
+        device_type=reg.device_type.at[dev_w].set(default_type, mode="drop"),
+        device_tenant=reg.device_tenant.at[dev_w].set(tenant_id, mode="drop"),
+        device_area=reg.device_area.at[dev_w].set(default_area, mode="drop"),
+        device_customer=reg.device_customer.at[dev_w].set(default_customer, mode="drop"),
+        device_assignments=reg.device_assignments.at[dev_w, 0].set(new_asn, mode="drop"),
+        assignment_active=reg.assignment_active.at[asn_w].set(True, mode="drop"),
+        assignment_status=reg.assignment_status.at[asn_w].set(
+            jnp.int32(DeviceAssignmentStatus.ACTIVE), mode="drop"
+        ),
+        assignment_device=reg.assignment_device.at[asn_w].set(new_dev, mode="drop"),
+        assignment_area=reg.assignment_area.at[asn_w].set(default_area, mode="drop"),
+        assignment_customer=reg.assignment_customer.at[asn_w].set(default_customer, mode="drop"),
+    )
+
+    n_fit = jnp.sum(fits.astype(jnp.int32))
+    _, perm = compact_valid_front(fits)
+    new_tokens = jnp.where(jnp.arange(b) < n_fit, token_id[perm], NULL_ID)
+
+    return RegistrationResult(
+        registry=registry,
+        next_device=next_device + n_fit,
+        next_assignment=next_assignment + n_fit,
+        n_registered=n_fit,
+        new_tokens=new_tokens,
+        overflow=overflow,
+    )

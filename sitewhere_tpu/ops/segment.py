@@ -1,0 +1,83 @@
+"""Sort/segment primitives used by the batched pipeline kernels.
+
+These replace the reference's per-key Kafka Streams grouping
+(``groupByKey().windowedBy(...).aggregate(...)`` in
+service-device-state/.../kafka/DeviceStatePipeline.java:80-88) with
+fully-vectorized XLA patterns: lexicographic sorts via ``lax.sort`` with
+multiple keys, run-length ranks computed with cumulative max/min scans, and
+"argmax scatter" (find the winning event per key without data-dependent
+control flow). Everything is static-shape and jit-safe.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INT32_MIN = jnp.iinfo(jnp.int32).min
+INT32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def lex_argsort(keys: list[jax.Array]) -> tuple[list[jax.Array], jax.Array]:
+    """Stable lexicographic argsort of equal-length 1-D keys (ascending,
+    keys[0] primary). Returns (sorted_keys, permutation); apply ``perm`` to
+    gather arbitrary (possibly multi-dimensional) payload rows."""
+    n = keys[0].shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    out = lax.sort(list(keys) + [iota], num_keys=len(keys), is_stable=True)
+    return list(out[: len(keys)]), out[-1]
+
+
+def segment_ranks(sorted_ids: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Given segment ids already sorted ascending, return
+    ``(rank_from_start, rank_from_end)`` within each run of equal ids.
+
+    rank_from_end == 0 marks the last (e.g. most recent, if secondary-sorted
+    by time) element of each segment.
+    """
+    n = sorted_ids.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    is_start = jnp.concatenate([jnp.ones((1,), jnp.bool_), sorted_ids[1:] != sorted_ids[:-1]])
+    # index of the start of each run, propagated forward
+    start_idx = lax.associative_scan(jnp.maximum, jnp.where(is_start, idx, INT32_MIN))
+    rank_from_start = idx - start_idx
+    is_end = jnp.concatenate([sorted_ids[1:] != sorted_ids[:-1], jnp.ones((1,), jnp.bool_)])
+    # index of the end of each run, propagated backward
+    end_idx = lax.associative_scan(jnp.minimum, jnp.where(is_end, idx, INT32_MAX), reverse=True)
+    rank_from_end = end_idx - idx
+    return rank_from_start, rank_from_end
+
+
+def scatter_argmax_mask(
+    seg: jax.Array,
+    key1: jax.Array,
+    key2: jax.Array,
+    valid: jax.Array,
+    num_segments: int,
+) -> jax.Array:
+    """Return a bool mask selecting, for every segment id, the single element
+    with the lexicographically largest ``(key1, key2)`` among ``valid`` rows.
+
+    ``key2`` must be unique per row within a segment (e.g. batch sequence
+    number) so the winner is unique. Three scatters + two gathers; no sort.
+    """
+    seg_c = jnp.where(valid, seg, num_segments)  # invalid rows -> dropped slot
+    k1 = jnp.where(valid, key1, INT32_MIN)
+    max1 = jnp.full((num_segments,), INT32_MIN, key1.dtype).at[seg_c].max(k1, mode="drop")
+    on_max1 = valid & (key1 == max1.at[seg_c].get(mode="fill", fill_value=INT32_MIN))
+    k2 = jnp.where(on_max1, key2, INT32_MIN)
+    max2 = jnp.full((num_segments,), INT32_MIN, key2.dtype).at[seg_c].max(k2, mode="drop")
+    winner = on_max1 & (key2 == max2.at[seg_c].get(mode="fill", fill_value=INT32_MIN))
+    return winner
+
+
+def compact_valid_front(valid: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Stable permutation moving ``valid`` rows to the front.
+
+    Returns (n_valid, perm). Used to densify assignment-expanded events before
+    the ring-buffer append (ops/persist.py) so padding never costs capacity.
+    """
+    _, perm = lex_argsort([(~valid).astype(jnp.int32)])
+    n_valid = jnp.sum(valid.astype(jnp.int32))
+    return n_valid, perm

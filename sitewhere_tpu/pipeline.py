@@ -1,0 +1,253 @@
+"""The fused TPU event pipeline: one jit-compiled step per event batch.
+
+The reference spreads this flow over four microservices connected by Kafka
+topics (SURVEY.md §1-L2): event-sources decode -> inbound-processing lookup ->
+event-management persistence + outbound fork -> device-state aggregation.
+Each stage there is a per-message JVM loop with a blocking RPC or DB write
+inside (SURVEY.md §3.2 hot loops 1-3). Here the whole chain is ONE XLA
+program over a batch, with all stores HBM-resident and donated between steps:
+
+    lookup (gather)                 ~ DeviceLookupMapper gRPC per message
+    auto-register (batched scatter) ~ service-device-registration round trip
+    assignment expansion            ~ DeviceAssignmentsLookupMapper flatMap
+    ring-store append               ~ InfluxDB/Cassandra per-event writes
+    windowed state merge            ~ Kafka Streams 5s window + JPA merge
+
+Outbound consumers (device-state queries, connectors, command delivery) read
+the ring store / state store by cursor — the at-least-once consumer-group
+analog of the reference's outbound-events topic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.events import EventBatch
+from sitewhere_tpu.core.registry import RegistryTables
+from sitewhere_tpu.core.state import DeviceStateStore
+from sitewhere_tpu.core.store import EventStore
+from sitewhere_tpu.core.types import NULL_ID, EventType
+from sitewhere_tpu.ops.lookup import expand_assignments, lookup_devices
+from sitewhere_tpu.ops.persist import append_events
+from sitewhere_tpu.ops.registration import register_misses
+from sitewhere_tpu.ops.segment import compact_valid_front
+from sitewhere_tpu.ops.window import merge_batch_state, presence_sweep
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PipelineMetrics:
+    """Device-side counters mirroring the reference's Prometheus metrics
+    (e.g. InboundEventSource.java:50-59 decode counters,
+    EventPersistenceMapper.java:46-47 processed-event counters)."""
+
+    processed: jax.Array    # int32[] valid events seen
+    found: jax.Array        # int32[] events matched to a registered device
+    missed: jax.Array       # int32[] unregistered-device events (post-registration)
+    registered: jax.Array   # int32[] devices auto-registered
+    persisted: jax.Array    # int32[] event rows appended to the store
+    reg_overflow: jax.Array # int32[] batches that hit registry capacity
+
+    @staticmethod
+    def zeros() -> "PipelineMetrics":
+        # distinct arrays: aliased buffers break donation in jitted steps
+        return PipelineMetrics(*(jnp.zeros((), jnp.int32) for _ in range(6)))
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PipelineState:
+    """All device-resident engine state, donated through every step."""
+
+    registry: RegistryTables
+    device_state: DeviceStateStore
+    store: EventStore
+    next_device: jax.Array      # int32[] device-row allocation counter
+    next_assignment: jax.Array  # int32[]
+    metrics: PipelineMetrics
+
+    @staticmethod
+    def create(
+        device_capacity: int,
+        token_capacity: int,
+        assignment_capacity: int,
+        store_capacity: int,
+        channels: int = 8,
+        bootstrap: RegistryTables | None = None,
+        next_device: int = 0,
+        next_assignment: int = 0,
+    ) -> "PipelineState":
+        return PipelineState(
+            registry=bootstrap
+            if bootstrap is not None
+            else RegistryTables.zeros(device_capacity, token_capacity, assignment_capacity),
+            device_state=DeviceStateStore.zeros(device_capacity, channels),
+            store=EventStore.zeros(store_capacity, channels),
+            next_device=jnp.asarray(next_device, jnp.int32),
+            next_assignment=jnp.asarray(next_assignment, jnp.int32),
+            metrics=PipelineMetrics.zeros(),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Static (compile-time) pipeline configuration — the analog of the
+    reference's per-tenant JSON component config (SURVEY.md §5.6)."""
+
+    auto_register: bool = True
+    default_device_type: int = 0
+    default_area: int = NULL_ID
+    default_customer: int = NULL_ID
+
+
+class StepOutput(NamedTuple):
+    """Host-visible per-step results. Token lists are compacted, NULL_ID
+    padded."""
+
+    n_found: jax.Array        # int32[]
+    n_missed: jax.Array       # int32[]
+    n_registered: jax.Array   # int32[]
+    n_persisted: jax.Array    # int32[]
+    new_tokens: jax.Array     # int32[B] tokens auto-registered this step
+    dead_tokens: jax.Array    # int32[B] unregistered tokens (DLQ analog of the
+                              #          unregistered-device-events topic)
+    store_cursor: jax.Array   # int32[] ring cursor after append
+    store_epoch: jax.Array    # int32[]
+
+
+def pipeline_step(
+    state: PipelineState, batch: EventBatch, config: PipelineConfig
+) -> tuple[PipelineState, StepOutput]:
+    """Process one decoded-event batch end to end (pure function; jit with
+    ``donate_argnums=0`` via :func:`make_pipeline_step`)."""
+    reg = state.registry
+    b = batch.capacity
+
+    # 1. device lookup (inbound-processing analog)
+    res = lookup_devices(reg, batch.token_id, batch.tenant_id, batch.valid)
+
+    # 2. auto-registration of the miss set (device-registration analog)
+    if config.auto_register:
+        regres = register_misses(
+            reg,
+            state.next_device,
+            state.next_assignment,
+            batch.token_id,
+            batch.tenant_id,
+            res.miss,
+            jnp.int32(config.default_device_type),
+            jnp.int32(config.default_area),
+            jnp.int32(config.default_customer),
+        )
+        reg = regres.registry
+        next_device = regres.next_device
+        next_assignment = regres.next_assignment
+        n_registered = regres.n_registered
+        new_tokens = regres.new_tokens
+        reg_overflow = regres.overflow.astype(jnp.int32)
+        # re-lookup so this batch's events flow through for just-registered
+        # devices (the reference re-injects events after registration)
+        res = lookup_devices(reg, batch.token_id, batch.tenant_id, batch.valid)
+    else:
+        next_device = state.next_device
+        next_assignment = state.next_assignment
+        n_registered = jnp.zeros((), jnp.int32)
+        new_tokens = jnp.full((b,), NULL_ID, jnp.int32)
+        reg_overflow = jnp.zeros((), jnp.int32)
+
+    # remaining misses -> dead-letter list (unregistered-device-events analog)
+    n_miss, perm = compact_valid_front(res.miss)
+    dead_tokens = jnp.where(jnp.arange(b) < n_miss, batch.token_id[perm], NULL_ID)
+
+    # 3. per-assignment expansion (PreprocessedEventMapper flatMap analog)
+    exp = expand_assignments(reg, res)
+
+    # 4. persistence append (event-management analog)
+    src = exp.source_row
+    persist = append_events(
+        state.store,
+        valid=exp.valid,
+        etype=batch.etype[src],
+        device=exp.device,
+        assignment=exp.assignment,
+        tenant=batch.tenant_id[src],
+        area=exp.area,
+        asset=exp.asset,
+        ts_ms=batch.ts_ms[src],
+        received_ms=batch.received_ms[src],
+        values=batch.values[src],
+        vmask=batch.vmask[src],
+        aux=batch.aux[src],
+    )
+
+    # 5. windowed device-state merge (device-state analog)
+    new_device_state = merge_batch_state(
+        state.device_state,
+        dev=res.device,
+        found=res.found,
+        etype=batch.etype,
+        ts_ms=batch.ts_ms,
+        seq=batch.seq,
+        values=batch.values,
+        vmask=batch.vmask,
+        aux=batch.aux,
+    )
+
+    n_found = jnp.sum(res.found.astype(jnp.int32))
+    m = state.metrics
+    metrics = PipelineMetrics(
+        processed=m.processed + batch.count(),
+        found=m.found + n_found,
+        missed=m.missed + n_miss,
+        registered=m.registered + n_registered,
+        persisted=m.persisted + persist.appended,
+        reg_overflow=m.reg_overflow + reg_overflow,
+    )
+
+    new_state = PipelineState(
+        registry=reg,
+        device_state=new_device_state,
+        store=persist.store,
+        next_device=next_device,
+        next_assignment=next_assignment,
+        metrics=metrics,
+    )
+    out = StepOutput(
+        n_found=n_found,
+        n_missed=n_miss,
+        n_registered=n_registered,
+        n_persisted=persist.appended,
+        new_tokens=new_tokens,
+        dead_tokens=dead_tokens,
+        store_cursor=persist.store.cursor,
+        store_epoch=persist.store.epoch,
+    )
+    return new_state, out
+
+
+@functools.cache
+def make_pipeline_step(config: PipelineConfig):
+    """Compile the pipeline step with state donation (no HBM copies between
+    steps — the state stays resident, the analog of Kafka Streams' local
+    state stores without the serialization)."""
+    return jax.jit(
+        functools.partial(pipeline_step, config=config), donate_argnums=(0,)
+    )
+
+
+@functools.cache
+def make_presence_sweep():
+    """Compiled presence sweep (DevicePresenceManager analog)."""
+
+    def sweep(state: PipelineState, now_ms: jax.Array, missing_ms: jax.Array):
+        ds, newly_missing = presence_sweep(
+            state.device_state, state.registry.device_active, now_ms, missing_ms
+        )
+        return dataclasses.replace(state, device_state=ds), newly_missing
+
+    return jax.jit(sweep, donate_argnums=(0,))

@@ -1,0 +1,98 @@
+"""Pure-numpy oracle for the pipeline semantics, used by property tests.
+
+Implements the reference behavior directly (per-event loops, like the JVM
+implementation) so the batched TPU kernels can be checked against it:
+  * device lookup + active-assignment expansion
+    (DeviceLookupMapper / DeviceAssignmentsLookupMapper semantics)
+  * device-state merge keeping latest + 3 most recent per event class
+    (RdbDeviceStateMergeStrategy semantics, most-recent-first)
+  * auto-registration get-or-create (DeviceRegistrationManager semantics)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+
+import numpy as np
+
+RECENT = 3
+
+
+@dataclasses.dataclass
+class OracleDeviceState:
+    last_interaction: int | None = None
+    meas_last: dict = dataclasses.field(default_factory=dict)      # ch -> (ts, val)
+    recent_meas: list = dataclasses.field(default_factory=list)    # [(ts, seq, {ch: val})]
+    recent_loc: list = dataclasses.field(default_factory=list)     # [(ts, seq, (lat,lon,elev))]
+    recent_alert: list = dataclasses.field(default_factory=list)   # [(ts, seq, level, type)]
+    counts: dict = dataclasses.field(default_factory=lambda: defaultdict(int))
+
+
+class OracleEngine:
+    """Reference-faithful per-event implementation."""
+
+    def __init__(self, auto_register: bool = True, default_type: int = 0):
+        self.auto_register = auto_register
+        self.default_type = default_type
+        self.token_to_device: dict[int, int] = {}
+        self.device_tenant: dict[int, int] = {}
+        self.device_assignments: dict[int, list[int]] = {}
+        self.next_device = 0
+        self.next_assignment = 0
+        self.states: dict[int, OracleDeviceState] = defaultdict(OracleDeviceState)
+        self.persisted: list = []  # (etype, device, assignment, tenant, ts)
+        self.dead: list = []
+
+    def register(self, token: int, tenant: int) -> int:
+        dev = self.next_device
+        self.next_device += 1
+        self.token_to_device[token] = dev
+        self.device_tenant[dev] = tenant
+        aid = self.next_assignment
+        self.next_assignment += 1
+        self.device_assignments[dev] = [aid]
+        return dev
+
+    def process(self, events: list[dict]) -> None:
+        """events: dicts with token, tenant, etype, ts, seq, values (dict ch->val),
+        aux0."""
+        for ev in events:
+            tok, tenant = ev["token"], ev["tenant"]
+            dev = self.token_to_device.get(tok)
+            if dev is not None and self.device_tenant[dev] != tenant and tenant != -1:
+                self.dead.append(tok)
+                continue
+            if dev is None:
+                if self.auto_register:
+                    dev = self.register(tok, tenant)
+                else:
+                    self.dead.append(tok)
+                    continue
+            st = self.states[dev]
+            ts, seq, et = ev["ts"], ev["seq"], ev["etype"]
+            st.last_interaction = ts if st.last_interaction is None else max(st.last_interaction, ts)
+            st.counts[et] += 1
+            for aid in self.device_assignments[dev]:
+                self.persisted.append((et, dev, aid, tenant, ts))
+            # Tie-breaking on equal timestamps: the later *arrival* wins
+            # (matches the kernel's replace-on-merge semantics and the
+            # reference's last-write-wins DB merge). Events are processed in
+            # arrival order here, so inserting at the front + stable sort by
+            # -ts keeps newest-arrival-first among equal timestamps.
+            if et == 0:  # measurement
+                for ch, val in ev.get("values", {}).items():
+                    prev = st.meas_last.get(ch)
+                    if prev is None or ts >= prev[0]:
+                        st.meas_last[ch] = (ts, seq, val)
+                st.recent_meas.insert(0, (ts, seq, dict(ev.get("values", {}))))
+                st.recent_meas.sort(key=lambda x: -x[0])
+                del st.recent_meas[RECENT:]
+            elif et == 1:  # location
+                st.recent_loc.insert(0, (ts, seq, tuple(ev.get("loc", (0, 0, 0)))))
+                st.recent_loc.sort(key=lambda x: -x[0])
+                del st.recent_loc[RECENT:]
+            elif et == 2:  # alert
+                st.recent_alert.insert(0, (ts, seq, int(ev.get("level", 0)), int(ev.get("atype", 0))))
+                st.recent_alert.sort(key=lambda x: -x[0])
+                del st.recent_alert[RECENT:]

@@ -1,0 +1,239 @@
+"""End-to-end pipeline tests against the numpy oracle (tests/oracle.py).
+
+Covers the full fused step: lookup, auto-registration, assignment expansion,
+ring-store persistence, and windowed state merge — including correctness
+across arbitrary batch boundaries (a split stream must produce the same state
+as a single batch, since the reference's 5s windows don't align with our
+batch boundaries either).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.core.events import HostEventBuffer
+from sitewhere_tpu.core.state import RECENT_DEPTH
+from sitewhere_tpu.core.types import NULL_ID, EventType
+from sitewhere_tpu.pipeline import PipelineConfig, PipelineState, make_pipeline_step
+
+from tests.oracle import OracleEngine
+
+CHANNELS = 4
+
+
+def _random_events(rng, n, n_tokens=12, n_tenants=1, types=(0, 0, 0, 1, 2)):
+    events = []
+    for i in range(n):
+        et = int(rng.choice(types))
+        ev = {
+            "token": int(rng.integers(0, n_tokens)),
+            "tenant": int(rng.integers(0, n_tenants)),
+            "etype": et,
+            "ts": int(rng.integers(0, 50)),  # few distinct ts -> many ties
+            "seq": i,
+        }
+        if et == EventType.MEASUREMENT:
+            chans = rng.choice(CHANNELS, size=int(rng.integers(1, CHANNELS + 1)), replace=False)
+            ev["values"] = {int(c): float(np.round(rng.random(), 3)) for c in chans}
+        elif et == EventType.LOCATION:
+            ev["loc"] = tuple(float(np.round(x, 3)) for x in rng.random(3))
+        elif et == EventType.ALERT:
+            ev["level"] = int(rng.integers(0, 4))
+            ev["atype"] = int(rng.integers(0, 5))
+        events.append(ev)
+    return events
+
+
+def _feed(step, state, events, capacity):
+    """Push events through the pipeline in batches of ``capacity``."""
+    outs = []
+    for lo in range(0, len(events), capacity):
+        buf = HostEventBuffer(capacity, CHANNELS)
+        for ev in events[lo:lo + capacity]:
+            vals = np.zeros(CHANNELS, np.float32)
+            mask_ch = []
+            if ev["etype"] == EventType.MEASUREMENT:
+                for c, v in ev["values"].items():
+                    vals[c] = v
+                    mask_ch.append(c)
+            elif ev["etype"] == EventType.LOCATION:
+                vals[:3] = ev["loc"]
+                mask_ch = [0, 1, 2]
+            elif ev["etype"] == EventType.ALERT:
+                vals[0] = ev["level"]
+                mask_ch = [0]
+            buf.append(
+                etype=ev["etype"], token_id=ev["token"], tenant_id=ev["tenant"],
+                ts_ms=ev["ts"], received_ms=ev["ts"],
+                aux0=ev.get("atype", NULL_ID),
+            )
+            # HostEventBuffer.append sets a prefix mask; patch per-channel mask
+            i = len(buf) - 1
+            buf.values[i] = vals
+            buf.vmask[i] = False
+            buf.vmask[i, mask_ch] = True
+        batch = buf.emit()
+        state, out = step(state, batch)
+        outs.append(out)
+    return state, outs
+
+
+def _make_state():
+    return PipelineState.create(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=1024, channels=CHANNELS,
+    )
+
+
+def _check_against_oracle(state, oracle):
+    """Compare kernel state against oracle state for every registered device."""
+    ds = state.device_state
+    for tok, dev in oracle.token_to_device.items():
+        st = oracle.states[dev]
+        kdev = int(state.registry.token_to_device[tok])
+        assert kdev == dev, f"token {tok}: device id {kdev} != oracle {dev}"
+        if st.last_interaction is not None:
+            assert int(ds.last_interaction_ms[dev]) == st.last_interaction
+        # measurements: latest per channel
+        for ch, (ts, _seq, val) in st.meas_last.items():
+            assert int(ds.meas_last_ms[dev, ch]) == ts
+            np.testing.assert_allclose(float(ds.meas_last[dev, ch]), val, rtol=1e-6)
+        # recent rings: compare (ts, payload) most-recent-first
+        got_n = int(ds.recent_meas_valid[dev].sum())
+        assert got_n == len(st.recent_meas)
+        for r, (ts, _seq, values) in enumerate(st.recent_meas):
+            assert int(ds.recent_meas_ms[dev, r]) == ts
+            for c in range(CHANNELS):
+                if c in values:
+                    assert bool(ds.recent_meas_mask[dev, r, c])
+                    np.testing.assert_allclose(float(ds.recent_meas[dev, r, c]), values[c], rtol=1e-6)
+                else:
+                    assert not bool(ds.recent_meas_mask[dev, r, c])
+        got_n = int(ds.recent_loc_valid[dev].sum())
+        assert got_n == len(st.recent_loc)
+        for r, (ts, _seq, loc) in enumerate(st.recent_loc):
+            assert int(ds.recent_loc_ms[dev, r]) == ts
+            np.testing.assert_allclose(np.asarray(ds.recent_loc[dev, r]), loc, rtol=1e-6)
+        got_n = int(ds.recent_alert_valid[dev].sum())
+        assert got_n == len(st.recent_alert)
+        for r, (ts, _seq, level, atype) in enumerate(st.recent_alert):
+            assert int(ds.recent_alert_ms[dev, r]) == ts
+            assert int(ds.recent_alert_level[dev, r]) == level
+            assert int(ds.recent_alert_type[dev, r]) == atype
+        for et, cnt in st.counts.items():
+            assert int(ds.event_counts[dev, et]) == cnt
+
+
+def test_pipeline_matches_oracle_single_batch(rng):
+    events = _random_events(rng, 64)
+    step = make_pipeline_step(PipelineConfig(auto_register=True))
+    state, _ = _feed(step, _make_state(), events, capacity=64)
+    oracle = OracleEngine()
+    oracle.process(events)
+    _check_against_oracle(state, oracle)
+
+
+def test_pipeline_batch_split_invariance(rng):
+    """Splitting the stream across batches must not change final state."""
+    events = _random_events(rng, 96)
+    oracle = OracleEngine()
+    oracle.process(events)
+    for cap in (96, 32, 16, 7):
+        step = make_pipeline_step(PipelineConfig(auto_register=True))
+        state, _ = _feed(step, _make_state(), events, capacity=cap)
+        _check_against_oracle(state, oracle)
+
+
+def test_pipeline_persistence_counts(rng):
+    events = _random_events(rng, 50)
+    step = make_pipeline_step(PipelineConfig(auto_register=True))
+    state, outs = _feed(step, _make_state(), events, capacity=25)
+    oracle = OracleEngine()
+    oracle.process(events)
+    total = sum(int(o.n_persisted) for o in outs)
+    assert total == len(oracle.persisted)
+    assert int(state.metrics.persisted) == total
+    assert int(state.metrics.processed) == len(events)
+    # every persisted row is in the ring (capacity not exceeded here)
+    store = state.store
+    assert int(store.valid.sum()) == total
+
+
+def test_pipeline_no_autoregister_dead_letters(rng):
+    events = _random_events(rng, 40)
+    step = make_pipeline_step(PipelineConfig(auto_register=False))
+    state, outs = _feed(step, _make_state(), events, capacity=40)
+    # nothing registered -> every event dead-letters
+    assert int(state.metrics.found) == 0
+    assert int(state.metrics.missed) == len(events)
+    dead = [int(t) for o in outs for t in np.asarray(o.dead_tokens) if t != NULL_ID]
+    assert len(dead) == len(events)
+
+
+def test_pipeline_tenant_isolation(rng):
+    """A device registered under tenant 0 must not accept tenant-1 events
+    under the same token (the reference's per-tenant pipeline isolation)."""
+    events = [
+        {"token": 1, "tenant": 0, "etype": 0, "ts": 1, "seq": 0, "values": {0: 1.0}},
+        {"token": 1, "tenant": 1, "etype": 0, "ts": 2, "seq": 1, "values": {0: 2.0}},
+    ]
+    step = make_pipeline_step(PipelineConfig(auto_register=True))
+    state, outs = _feed(step, _make_state(), events, capacity=2)
+    oracle = OracleEngine()
+    oracle.process(events)
+    _check_against_oracle(state, oracle)
+    # second event is a tenant mismatch -> miss, and must NOT update state
+    dev = int(state.registry.token_to_device[1])
+    assert float(state.device_state.meas_last[dev, 0]) == 1.0
+
+
+def test_store_ring_wraps(rng):
+    events = _random_events(rng, 160, n_tokens=4)
+    state = PipelineState.create(
+        device_capacity=16, token_capacity=16, assignment_capacity=16,
+        store_capacity=64, channels=CHANNELS,
+    )
+    step = make_pipeline_step(PipelineConfig(auto_register=True))
+    state, outs = _feed(step, state, events, capacity=16)
+    store = state.store
+    assert int(store.valid.sum()) == 64  # full ring after wrap
+    total = sum(int(o.n_persisted) for o in outs)
+    assert total > 64  # actually wrapped
+    assert int(store.epoch) * 64 + int(store.cursor) == total
+
+
+def test_store_rejects_oversized_batch():
+    """A batch whose expansion exceeds the whole ring is a static config
+    error (slot aliasing within one scatter would be order-undefined)."""
+    import pytest
+
+    state = PipelineState.create(
+        device_capacity=16, token_capacity=16, assignment_capacity=16,
+        store_capacity=32, channels=CHANNELS,
+    )
+    step = make_pipeline_step(PipelineConfig(auto_register=True))
+    buf = HostEventBuffer(16, CHANNELS)  # expands to 64 rows > 32 capacity
+    buf.append(0, 0, 0, 1, 1, values=[1.0])
+    with pytest.raises(ValueError, match="exceeds event-store capacity"):
+        step(state, buf.emit())
+
+
+def test_out_of_range_tokens_dead_letter():
+    """Garbage token ids (negative / beyond capacity) must miss and
+    dead-letter, never alias into clipped registry slots."""
+    import dataclasses
+
+    from sitewhere_tpu.core.events import EventBatch
+
+    b = EventBatch.zeros(6, CHANNELS)
+    b = dataclasses.replace(
+        b,
+        valid=jnp.ones(6, bool),
+        token_id=jnp.asarray([-5, 999999, 0, 1, 64, 2**30], jnp.int32),
+        tenant_id=jnp.zeros(6, jnp.int32),
+    )
+    step = make_pipeline_step(PipelineConfig(auto_register=True))
+    state, out = step(_make_state(), b)
+    assert int(out.n_registered) == 2  # tokens 0 and 1 only (capacity 64)
+    assert int(out.n_missed) == 4
+    dead = sorted(int(t) for t in np.asarray(out.dead_tokens) if t != NULL_ID)
+    assert dead == sorted([-5, 999999, 64, 2**30])

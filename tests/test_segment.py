@@ -1,0 +1,67 @@
+"""Unit tests for the sort/segment primitives (ops/segment.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.ops.segment import (
+    compact_valid_front,
+    lex_argsort,
+    scatter_argmax_mask,
+    segment_ranks,
+)
+
+
+def test_lex_argsort_stable(rng):
+    a = rng.integers(0, 5, 64).astype(np.int32)
+    b = rng.integers(0, 5, 64).astype(np.int32)
+    keys, perm = lex_argsort([jnp.asarray(a), jnp.asarray(b)])
+    perm = np.asarray(perm)
+    expect = np.lexsort((np.arange(64), b, a))
+    np.testing.assert_array_equal(perm, expect)
+    np.testing.assert_array_equal(np.asarray(keys[0]), a[expect])
+
+
+def test_segment_ranks():
+    ids = jnp.asarray(np.array([0, 0, 0, 2, 2, 5], np.int32))
+    start, end = segment_ranks(ids)
+    np.testing.assert_array_equal(np.asarray(start), [0, 1, 2, 0, 1, 0])
+    np.testing.assert_array_equal(np.asarray(end), [2, 1, 0, 1, 0, 0])
+
+
+def test_segment_ranks_single_run():
+    ids = jnp.zeros(8, jnp.int32)
+    start, end = segment_ranks(ids)
+    np.testing.assert_array_equal(np.asarray(start), np.arange(8))
+    np.testing.assert_array_equal(np.asarray(end), np.arange(8)[::-1])
+
+
+def test_scatter_argmax_mask(rng):
+    n, b = 10, 200
+    seg = rng.integers(0, n, b).astype(np.int32)
+    key = rng.integers(0, 4, b).astype(np.int32)  # many ties
+    valid = rng.random(b) < 0.8
+    seq = np.arange(b, dtype=np.int32)
+    winner = np.asarray(
+        scatter_argmax_mask(jnp.asarray(seg), jnp.asarray(key), jnp.asarray(seq),
+                            jnp.asarray(valid), n)
+    )
+    for s in range(n):
+        rows = [i for i in range(b) if seg[i] == s and valid[i]]
+        if not rows:
+            assert not winner[seg == s].any()
+            continue
+        best = max(rows, key=lambda i: (key[i], seq[i]))
+        chosen = np.where(winner & (seg == s))[0]
+        assert list(chosen) == [best]
+
+
+def test_compact_valid_front(rng):
+    valid = rng.random(50) < 0.5
+    vals = np.arange(50, dtype=np.int32)
+    n, perm = compact_valid_front(jnp.asarray(valid))
+    perm = np.asarray(perm)
+    n = int(n)
+    assert n == valid.sum()
+    # valid rows first, in stable (original) order
+    np.testing.assert_array_equal(vals[perm][:n], vals[valid])
+    np.testing.assert_array_equal(vals[perm][n:], vals[~valid])
