@@ -1,0 +1,120 @@
+"""Headline benchmark: decoded device events/sec/chip through the full fused
+pipeline (lookup -> registration -> expansion -> persistence -> windowed
+state merge) on real TPU hardware.
+
+Baseline (BASELINE.md): north-star 1,000,000 decoded events/sec sustained
+inbound -> device-state on a v5e-8 pod => 125,000 events/sec/chip.
+``vs_baseline`` = measured events/sec/chip / 125,000.
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from sitewhere_tpu.core.events import EventBatch
+    from sitewhere_tpu.core.types import EventType, NULL_ID
+    from sitewhere_tpu.pipeline import PipelineConfig, PipelineState, make_pipeline_step
+
+    BATCH = 32768
+    CHANNELS = 8
+    N_DEVICES = 131072
+    STEPS = 30
+    WARMUP = 5
+
+    log(f"devices: {jax.devices()}")
+    state = PipelineState.create(
+        device_capacity=N_DEVICES,
+        token_capacity=2 * N_DEVICES,
+        assignment_capacity=2 * N_DEVICES,
+        store_capacity=1 << 18,
+        channels=CHANNELS,
+    )
+    step = make_pipeline_step(PipelineConfig(auto_register=True))
+
+    # Realistic single-tenant telemetry mix (BASELINE config #1-3): 70%
+    # measurements, 20% locations, 10% alerts over N_DEVICES devices.
+    rng = np.random.default_rng(0)
+
+    def make_batch(i: int) -> EventBatch:
+        tok = rng.integers(0, N_DEVICES, BATCH).astype(np.int32)
+        ety = rng.choice(
+            [EventType.MEASUREMENT] * 7 + [EventType.LOCATION] * 2 + [EventType.ALERT],
+            BATCH,
+        ).astype(np.int32)
+        ts = (i * 1000 + rng.integers(0, 1000, BATCH)).astype(np.int32)
+        values = rng.random((BATCH, CHANNELS), dtype=np.float32)
+        vmask = np.ones((BATCH, CHANNELS), bool)
+        aux = np.full((BATCH, 2), NULL_ID, np.int32)
+        return EventBatch(
+            valid=jnp.ones((BATCH,), bool),
+            etype=jnp.asarray(ety),
+            token_id=jnp.asarray(tok),
+            tenant_id=jnp.zeros((BATCH,), jnp.int32),
+            ts_ms=jnp.asarray(ts),
+            received_ms=jnp.asarray(ts),
+            values=jnp.asarray(values),
+            vmask=jnp.asarray(vmask),
+            aux=jnp.asarray(aux),
+            seq=jnp.arange(BATCH, dtype=jnp.int32),
+        )
+
+    # Pre-stage batches on device so we measure the pipeline, not host RNG.
+    batches = [jax.block_until_ready(make_batch(i)) for i in range(8)]
+
+    t0 = time.perf_counter()
+    for i in range(WARMUP):
+        state, out = step(state, batches[i % len(batches)])
+    jax.block_until_ready(out)
+    log(f"warmup+compile: {time.perf_counter() - t0:.1f}s")
+
+    lat = []
+    t_start = time.perf_counter()
+    for i in range(STEPS):
+        t1 = time.perf_counter()
+        state, out = step(state, batches[i % len(batches)])
+        jax.block_until_ready(out)
+        lat.append(time.perf_counter() - t1)
+    elapsed = time.perf_counter() - t_start
+
+    events = STEPS * BATCH
+    eps = events / elapsed
+    lat_ms = sorted(1000 * l for l in lat)
+    p50 = lat_ms[len(lat_ms) // 2]
+    p99 = lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))]
+    m = state.metrics
+    log(
+        f"{events} events in {elapsed:.3f}s  -> {eps:,.0f} ev/s/chip; "
+        f"step p50={p50:.2f}ms p99={p99:.2f}ms; "
+        f"found={int(m.found)} registered={int(m.registered)} persisted={int(m.persisted)}"
+    )
+
+    baseline_per_chip = 1_000_000 / 8
+    print(
+        json.dumps(
+            {
+                "metric": "decoded device events/sec/chip (inbound->device-state)",
+                "value": round(eps),
+                "unit": "events/s/chip",
+                "vs_baseline": round(eps / baseline_per_chip, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Anomaly / forecast models over telemetry windows — the `service-tpu-analytics`
+capability of BASELINE.json (config #4: "LSTM/autoencoder anomaly score on
+100-sensor telemetry windows").
+
+The reference has no ML service; its closest capability is the Siddhi CEP
+jars shipped (unused) with service-outbound-connectors (SURVEY.md §2.7
+"vestigial") and raw-Solr event search. The TPU build's analytics service is
+first-class: models run directly on the HBM-resident windows
+(models/windows.py) and scores fan out through the outbound-connector path.
+
+Design notes (TPU-first):
+  * bfloat16 matmuls sized for the MXU (hidden dims multiples of 128);
+    float32 accumulation for losses/scores.
+  * the LSTM runs as a single ``flax.linen.scan`` over time with fused gate
+    projections (one [C+H -> 4H] matmul per step).
+  * training/inference shard over a (dp, tp) mesh: batch on dp, hidden on tp
+    (see shardings() and tests/test_models.py / __graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyConfig:
+    sensors: int = 100        # C — sensor channels per device window
+    window: int = 128         # W — timesteps per window
+    latent: int = 64
+    hidden: int = 512         # MXU-friendly (multiple of 128)
+    lstm_hidden: int = 512
+    dtype: Any = jnp.bfloat16
+
+
+class WindowAutoencoder(nn.Module):
+    """Dense autoencoder over a flattened telemetry window; the anomaly score
+    is per-window reconstruction error."""
+
+    cfg: AnomalyConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:  # [B, W, C] -> [B, W, C]
+        cfg = self.cfg
+        b = x.shape[0]
+        h = x.reshape(b, -1).astype(cfg.dtype)
+        h = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="enc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden // 2, dtype=cfg.dtype, name="enc2")(h)
+        h = nn.gelu(h)
+        z = nn.Dense(cfg.latent, dtype=cfg.dtype, name="latent")(h)
+        h = nn.Dense(cfg.hidden // 2, dtype=cfg.dtype, name="dec1")(z)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.hidden, dtype=cfg.dtype, name="dec2")(h)
+        h = nn.gelu(h)
+        out = nn.Dense(cfg.window * cfg.sensors, dtype=cfg.dtype, name="out")(h)
+        return out.reshape(b, cfg.window, cfg.sensors)
+
+
+class LSTMForecaster(nn.Module):
+    """Single-layer LSTM forecaster: predicts x[t+1] from x[<=t]; the anomaly
+    score is next-step prediction error. Gates are fused into one matmul per
+    step; the time loop is a compiled ``nn.scan`` (no Python unrolling)."""
+
+    cfg: AnomalyConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:  # [B, W, C] -> [B, W-1, C]
+        cfg = self.cfg
+        b, w, c = x.shape
+        xt = x.astype(cfg.dtype)
+
+        scan = nn.scan(
+            nn.OptimizedLSTMCell,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=1,
+            out_axes=1,
+        )(cfg.lstm_hidden, dtype=cfg.dtype)
+        carry = scan.initialize_carry(jax.random.key(0), (b, c))
+        carry, hs = scan(carry, xt)               # hs: [B, W, H]
+        preds = nn.Dense(c, dtype=cfg.dtype, name="readout")(hs[:, :-1])
+        return preds
+
+
+class AnomalyModel(nn.Module):
+    """Combined scorer: 0.5 * AE reconstruction error + 0.5 * LSTM forecast
+    error, both normalized per channel. Returns per-device scores [B]."""
+
+    cfg: AnomalyConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        recon = WindowAutoencoder(self.cfg, name="ae")(x)
+        preds = LSTMForecaster(self.cfg, name="lstm")(x)
+        ae_err = jnp.mean(jnp.square(recon.astype(jnp.float32) - x), axis=(1, 2))
+        fc_err = jnp.mean(
+            jnp.square(preds.astype(jnp.float32) - x[:, 1:]), axis=(1, 2)
+        )
+        return 0.5 * ae_err + 0.5 * fc_err
+
+
+def loss_fn(model: AnomalyModel, params, x: jax.Array) -> jax.Array:
+    """Self-supervised training objective = mean anomaly score on normal
+    traffic (reconstruction + forecast)."""
+    return jnp.mean(model.apply(params, x))
+
+
+def make_train_step(model: AnomalyModel, tx: optax.GradientTransformation):
+    def train_step(params, opt_state, x):
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(model, p, x))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+def param_shardings(params, mesh, tp_axis: str = "tp"):
+    """Tensor-parallel placement: shard the widest axis of every large kernel
+    over ``tp_axis``; replicate small tensors. XLA inserts the all-gathers /
+    reduce-scatters (scaling-book recipe: annotate, let the compiler place
+    collectives)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def spec(leaf):
+        if leaf.ndim >= 2 and leaf.shape[-1] % mesh.shape[tp_axis] == 0 and leaf.size >= 1 << 16:
+            return NamedSharding(mesh, P(*([None] * (leaf.ndim - 1) + [tp_axis])))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(spec, params)

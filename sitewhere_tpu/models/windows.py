@@ -1,0 +1,91 @@
+"""HBM-resident per-device telemetry windows feeding the analytics models.
+
+The reference's device-state service keeps only a 5s in-memory window and 3
+recent events; long telemetry history lives in external time-series DBs and is
+re-fetched for any analysis. Here, per-device sliding windows of measurement
+vectors stay resident in HBM as a [M, W, C] ring — the north-star design of
+BASELINE.json ("per-tenant telemetry windows live as HBM-resident tensors") —
+so anomaly/forecast models (models/anomaly.py) consume them with zero
+host↔device traffic.
+
+M = analytics device capacity (a dense prefix of the device-id space), W =
+window length (timesteps), C = sensor channels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.types import EventType
+from sitewhere_tpu.ops.segment import lex_argsort, segment_ranks
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class TelemetryWindows:
+    """Sliding measurement windows. Ring position ``cursor[d]`` is the next
+    write slot for device d; ``filled[d]`` counts total writes (saturating
+    view via ``count``)."""
+
+    data: jax.Array     # float32[M, W, C]
+    cursor: jax.Array   # int32[M]
+    filled: jax.Array   # int32[M] total writes (not wrapped)
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def window(self) -> int:
+        return self.data.shape[1]
+
+    @staticmethod
+    def zeros(m: int, w: int, c: int) -> "TelemetryWindows":
+        return TelemetryWindows(
+            data=jnp.zeros((m, w, c), jnp.float32),
+            cursor=jnp.zeros((m,), jnp.int32),
+            filled=jnp.zeros((m,), jnp.int32),
+        )
+
+
+def append_measurements(
+    wins: TelemetryWindows,
+    dev: jax.Array,      # int32[B] dense device ids
+    found: jax.Array,    # bool[B]
+    etype: jax.Array,    # int32[B]
+    ts_ms: jax.Array,    # int32[B]
+    seq: jax.Array,      # int32[B]
+    values: jax.Array,   # float32[B, C]
+) -> TelemetryWindows:
+    """Append this batch's measurement vectors into each device's ring, in
+    (ts, seq) order — a segmented scatter with in-batch rank offsets."""
+    m, w, _ = wins.data.shape
+    take = found & (etype == EventType.MEASUREMENT) & (dev >= 0) & (dev < m)
+    dev_key = jnp.where(take, dev, m)
+    sorted_keys, perm = lex_argsort([dev_key, ts_ms, seq])
+    s_dev = sorted_keys[0]
+    s_vals = values[perm]
+    rank, _ = segment_ranks(s_dev)
+    live = s_dev < m
+    d_w = jnp.where(live, s_dev, m)  # OOB rows dropped
+    base = wins.cursor.at[d_w].get(mode="fill", fill_value=0)
+    slot = (base + rank) % w
+    data = wins.data.at[d_w, slot].set(s_vals, mode="drop")
+    ones = live.astype(jnp.int32)
+    counts = jnp.zeros((m,), jnp.int32).at[d_w].add(ones, mode="drop")
+    return TelemetryWindows(
+        data=data,
+        cursor=(wins.cursor + counts) % w,
+        filled=wins.filled + counts,
+    )
+
+
+def snapshot_windows(wins: TelemetryWindows) -> jax.Array:
+    """Return time-ordered windows [M, W, C] (oldest first), unrolling each
+    ring at its cursor — the model-facing view."""
+    m, w, _ = wins.data.shape
+    idx = (wins.cursor[:, None] + jnp.arange(w)[None, :]) % w  # oldest..newest
+    return jnp.take_along_axis(wins.data, idx[:, :, None], axis=1)

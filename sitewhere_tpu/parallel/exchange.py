@@ -1,0 +1,102 @@
+"""On-device event routing across shards via ICI all-to-all.
+
+The reference relies on Kafka partitioners to deliver each event to the
+Streams task that owns its key (device token). When ingest hosts cannot
+pre-route (multi-host fan-in, BASELINE.json config #5), the TPU engine routes
+on device instead: each shard buckets its raw batch by owning shard (token
+slice), then one ``lax.all_to_all`` over the ICI mesh delivers every event to
+its owner — the collective replacement for the broker hop (SURVEY.md §2.9
+"distributed communication backend").
+
+Buckets are fixed-capacity (static shapes): capacity_factor * B/n per
+destination. Overflow events are counted and dropped to the host dead-letter
+path, mirroring Kafka's bounded-queue backpressure semantics.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.events import EventBatch
+from sitewhere_tpu.core.types import NULL_ID
+from sitewhere_tpu.ops.segment import lex_argsort
+from sitewhere_tpu.parallel.mesh import SHARD_AXIS
+
+
+class ExchangeResult(NamedTuple):
+    batch: EventBatch      # locally-owned events after the exchange
+    n_overflow: jax.Array  # int32[] events dropped for bucket overflow
+
+
+def _bucket_events(
+    batch: EventBatch, n_shards: int, tokens_per_shard: int, bucket: int
+) -> tuple[EventBatch, jax.Array]:
+    """Sort local events into [n_shards * bucket] rows grouped by owner."""
+    target = jnp.where(batch.valid, batch.token_id // tokens_per_shard, n_shards)
+    target = jnp.clip(target, 0, n_shards)  # garbage tokens -> padding group
+    _, perm = lex_argsort([target, batch.seq])
+    s_target = target[perm]
+    # rank within destination group
+    from sitewhere_tpu.ops.segment import segment_ranks
+
+    rank, _ = segment_ranks(s_target)
+    fits = (s_target < n_shards) & (rank < bucket)
+    n_overflow = jnp.sum((s_target < n_shards) & (rank >= bucket))
+    slot = jnp.where(fits, s_target * bucket + rank, n_shards * bucket)
+
+    def scatter(lane, fill):
+        shape = (n_shards * bucket,) + lane.shape[1:]
+        return jnp.full(shape, fill, lane.dtype).at[slot].set(lane[perm], mode="drop")
+
+    out = EventBatch(
+        valid=scatter(batch.valid, False),
+        etype=scatter(batch.etype, 0),
+        token_id=scatter(batch.token_id, NULL_ID),
+        tenant_id=scatter(batch.tenant_id, NULL_ID),
+        ts_ms=scatter(batch.ts_ms, 0),
+        received_ms=scatter(batch.received_ms, 0),
+        values=scatter(batch.values, 0.0),
+        vmask=scatter(batch.vmask, False),
+        aux=scatter(batch.aux, NULL_ID),
+        seq=jnp.arange(n_shards * bucket, dtype=jnp.int32),
+    )
+    return out, n_overflow.astype(jnp.int32)
+
+
+def exchange_events(
+    batch: EventBatch, n_shards: int, tokens_per_shard: int, bucket: int
+) -> ExchangeResult:
+    """Route events to their owning shard. Must run inside ``shard_map`` over
+    the ``shard`` mesh axis. Returns the locally-owned batch (capacity
+    n_shards * bucket) with **local** token ids (owner offset subtracted)."""
+    bucketed, n_overflow = _bucket_events(batch, n_shards, tokens_per_shard, bucket)
+
+    def a2a(lane):
+        lane = lane.reshape((n_shards, bucket) + lane.shape[1:])
+        out = jax.lax.all_to_all(lane, SHARD_AXIS, split_axis=0, concat_axis=0, tiled=False)
+        return out.reshape((n_shards * bucket,) + lane.shape[2:])
+
+    shard_id = jax.lax.axis_index(SHARD_AXIS)
+    routed = EventBatch(
+        valid=a2a(bucketed.valid),
+        etype=a2a(bucketed.etype),
+        token_id=a2a(bucketed.token_id),
+        tenant_id=a2a(bucketed.tenant_id),
+        ts_ms=a2a(bucketed.ts_ms),
+        received_ms=a2a(bucketed.received_ms),
+        values=a2a(bucketed.values),
+        vmask=a2a(bucketed.vmask),
+        aux=a2a(bucketed.aux),
+        seq=jnp.arange(n_shards * bucket, dtype=jnp.int32),
+    )
+    # globalize -> localize token ids for the owner's local tables
+    local_tokens = jnp.where(
+        routed.valid, routed.token_id - shard_id * tokens_per_shard, NULL_ID
+    )
+    import dataclasses
+
+    routed = dataclasses.replace(routed, token_id=local_tokens)
+    return ExchangeResult(batch=routed, n_overflow=n_overflow)

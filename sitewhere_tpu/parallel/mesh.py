@@ -1,0 +1,46 @@
+"""Device meshes and shardings for the distributed engine.
+
+The reference scales by partitioning Kafka topics on device token and running
+one Streams task per partition (SURVEY.md §2.9 "partition parallelism";
+producers key by device token at EventSourcesManager.java:183). The TPU-native
+equivalent is a 1-D ``shard`` mesh over ICI: every shard owns a contiguous
+slice of the token space and the device-row space, so the whole hot pipeline
+is shard-local — the partition-locality guarantee Kafka gives the reference.
+Cross-shard traffic (mis-routed ingest, global queries) rides XLA collectives
+(parallel/exchange.py), not a broker.
+
+Multi-host: the same mesh spans hosts via jax.distributed; ingest workers
+route host-side by token hash exactly like Kafka partitioners, and the ICI/DCN
+boundary is handled by XLA's collective lowering.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_shards: int | None = None, devices: list | None = None) -> Mesh:
+    """1-D pipeline mesh over ``n_shards`` devices (default: all)."""
+    devs = devices if devices is not None else jax.devices()
+    if n_shards is None:
+        n_shards = len(devs)
+    return Mesh(np.asarray(devs[:n_shards]), (SHARD_AXIS,))
+
+
+def shard_leading(mesh: Mesh) -> NamedSharding:
+    """Shard a stacked [n_shards, ...] pytree leaf along its leading axis."""
+    return NamedSharding(mesh, P(SHARD_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def stack_sharding(mesh: Mesh, tree):
+    """Apply leading-axis sharding to every leaf of a stacked state pytree."""
+    sh = shard_leading(mesh)
+    return jax.tree_util.tree_map(lambda _: sh, tree)

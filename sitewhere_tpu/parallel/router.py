@@ -1,0 +1,48 @@
+"""Host-side batch router: the token-keyed partitioner.
+
+The reference partitions its Kafka topics by device token
+(EventSourcesManager.java:183 sends with deviceToken as the record key), so
+one partition's events always hit the same Streams task. This router plays
+that role for the sharded engine: each decoded event is staged into the
+bucket of the shard that owns its token slice, and ``emit()`` produces the
+stacked ``[n_shards, B_local]`` EventBatch the sharded step consumes.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from sitewhere_tpu.core.events import EventBatch, HostEventBuffer
+
+
+class ShardRouter:
+    """Per-shard staging buffers + stacked emission."""
+
+    def __init__(self, n_shards: int, tokens_per_shard: int, batch_capacity: int,
+                 channels: int = 8):
+        self.n_shards = n_shards
+        self.tokens_per_shard = tokens_per_shard
+        self.buffers = [HostEventBuffer(batch_capacity, channels) for _ in range(n_shards)]
+
+    def append(self, etype: int, global_token: int, tenant_id: int, ts_ms: int,
+               received_ms: int, values=(), aux0: int = -1, aux1: int = -1) -> bool:
+        shard = global_token // self.tokens_per_shard
+        if not 0 <= shard < self.n_shards:
+            return False  # host-side dead letter: token outside global space
+        local = global_token % self.tokens_per_shard
+        return self.buffers[shard].append(
+            etype, local, tenant_id, ts_ms, received_ms, values, aux0, aux1
+        )
+
+    @property
+    def any_full(self) -> bool:
+        return any(b.full for b in self.buffers)
+
+    def total_staged(self) -> int:
+        return sum(len(b) for b in self.buffers)
+
+    def emit(self) -> EventBatch:
+        """Stack per-shard batches into [n_shards, B_local, ...]."""
+        batches = [b.emit() for b in self.buffers]
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *batches)

@@ -1,0 +1,173 @@
+"""Sharded pipeline: the full event engine over an ICI device mesh.
+
+Every shard owns a contiguous slice of the token space and device-row space
+(parallel/mesh.py), so after routing, each shard runs the identical fused
+pipeline (pipeline.py) on its local slice — Kafka partition-locality without
+the broker. The engine state is a *stacked* pytree with a leading
+``[n_shards, ...]`` axis sharded over the mesh; ``shard_map`` maps the
+single-chip step over it. Optional on-device re-routing (exchange=True) runs
+the ICI all-to-all first (BASELINE.json config #5, multi-shard fan-in).
+
+Host contract: per-shard batches carry **local** token ids
+(global_token = shard * tokens_per_shard + local_token); the ingest router
+(parallel/router.py) computes the shard from the global token id, exactly
+like the reference's token-keyed Kafka partitioner
+(EventSourcesManager.java:183).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from sitewhere_tpu.core.events import EventBatch
+from sitewhere_tpu.pipeline import (
+    PipelineConfig,
+    PipelineState,
+    StepOutput,
+    pipeline_step,
+)
+from sitewhere_tpu.parallel.exchange import exchange_events
+from sitewhere_tpu.parallel.mesh import SHARD_AXIS, make_mesh, stack_sharding
+
+
+def create_stacked_state(
+    mesh,
+    device_capacity_per_shard: int,
+    token_capacity_per_shard: int,
+    assignment_capacity_per_shard: int,
+    store_capacity_per_shard: int,
+    channels: int = 8,
+) -> PipelineState:
+    """Create engine state stacked over the mesh's shard axis and placed
+    shard-per-device."""
+    n = mesh.devices.size
+
+    def stacked() -> PipelineState:
+        single = PipelineState.create(
+            device_capacity_per_shard,
+            token_capacity_per_shard,
+            assignment_capacity_per_shard,
+            store_capacity_per_shard,
+            channels,
+        )
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(leaf, (n,) + leaf.shape), single
+        )
+
+    state = jax.jit(stacked, out_shardings=stack_sharding(mesh, jax.eval_shape(stacked)))()
+    return state
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "mesh", "exchange", "tokens_per_shard", "bucket"),
+    donate_argnums=(0,),
+)
+def _sharded_step(
+    state: PipelineState,
+    batch: EventBatch,  # stacked [n_shards, B_local, ...]
+    *,
+    config: PipelineConfig,
+    mesh,
+    exchange: bool,
+    tokens_per_shard: int,
+    bucket: int,
+):
+    n_shards = mesh.devices.size
+
+    def local_step(state_blk, batch_blk):
+        # strip the leading stacked axis of this shard's block
+        lstate = jax.tree_util.tree_map(lambda x: x[0], state_blk)
+        lbatch = jax.tree_util.tree_map(lambda x: x[0], batch_blk)
+        n_overflow = jnp.zeros((), jnp.int32)
+        if exchange:
+            res = exchange_events(lbatch, n_shards, tokens_per_shard, bucket)
+            lbatch, n_overflow = res.batch, res.n_overflow
+        new_state, out = pipeline_step(lstate, lbatch, config)
+        out = out._replace(n_missed=out.n_missed + n_overflow)
+        new_state = dataclasses.replace(
+            new_state,
+            metrics=dataclasses.replace(
+                new_state.metrics, missed=new_state.metrics.missed + n_overflow
+            ),
+        )
+        return (
+            jax.tree_util.tree_map(lambda x: x[None], new_state),
+            jax.tree_util.tree_map(lambda x: x[None], out),
+        )
+
+    return jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )(state, batch)
+
+
+class ShardedEngine:
+    """Host handle for the sharded engine: owns the mesh, compiled step, and
+    stacked state. The reference analog is the full multi-service deployment
+    (one Streams task per partition per service); here it is one object."""
+
+    def __init__(
+        self,
+        n_shards: int | None = None,
+        device_capacity_per_shard: int = 4096,
+        token_capacity_per_shard: int = 8192,
+        assignment_capacity_per_shard: int = 8192,
+        store_capacity_per_shard: int = 1 << 16,
+        channels: int = 8,
+        config: PipelineConfig | None = None,
+        exchange: bool = False,
+        bucket_capacity: int | None = None,
+    ):
+        self.mesh = make_mesh(n_shards)
+        self.n_shards = self.mesh.devices.size
+        self.tokens_per_shard = token_capacity_per_shard
+        self.config = config or PipelineConfig()
+        self.exchange = exchange
+        self.bucket = bucket_capacity or 0
+        self.channels = channels
+        self.state = create_stacked_state(
+            self.mesh,
+            device_capacity_per_shard,
+            token_capacity_per_shard,
+            assignment_capacity_per_shard,
+            store_capacity_per_shard,
+            channels,
+        )
+
+    def shard_of_token(self, global_token: int) -> tuple[int, int]:
+        """(shard, local_token) for a global token id — the host-side
+        partitioner."""
+        return global_token // self.tokens_per_shard, global_token % self.tokens_per_shard
+
+    def step(self, stacked_batch: EventBatch) -> StepOutput:
+        """Run one sharded step; returns stacked per-shard outputs."""
+        if self.exchange and not self.bucket:
+            raise ValueError("exchange=True requires bucket_capacity")
+        self.state, out = _sharded_step(
+            self.state,
+            stacked_batch,
+            config=self.config,
+            mesh=self.mesh,
+            exchange=self.exchange,
+            tokens_per_shard=self.tokens_per_shard,
+            bucket=self.bucket,
+        )
+        return out
+
+    def global_metrics(self):
+        """Sum per-shard metrics (host-side psum analog for reporting)."""
+        m = self.state.metrics
+        return {
+            f.name: int(jnp.sum(getattr(m, f.name)))
+            for f in dataclasses.fields(m)
+        }
