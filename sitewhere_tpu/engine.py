@@ -1,0 +1,416 @@
+"""The host engine: single-node runtime tying ingest to the TPU pipeline.
+
+This object is the deployment analog of the reference's whole service stack
+(SURVEY.md §1): it owns the interners (device tokens, tenants, measurement
+channels, alert types), the staging buffer and flush policy (the batch-size/
+latency scheduler from SURVEY.md §7 "hard parts"), the compiled pipeline
+step, and the host mirror of registry metadata (strings, types) that the
+device tables don't carry.
+
+Two registry write paths stay consistent by construction:
+  * auto-registration happens ON DEVICE (ops/registration.py); the host
+    mirrors it deterministically from the step's ``new_tokens`` readback
+    (allocation order == batch order).
+  * admin CRUD (REST/API path) allocates from the host counter and writes
+    the device row explicitly via a tiny jit'd updater, then bumps the same
+    counters the kernel uses.
+All engine mutations are serialized through one lock, mirroring the
+single-writer semantics the reference gets from Kafka partition ordering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.core.events import EpochBase, HostEventBuffer
+from sitewhere_tpu.core.registry import MAX_ACTIVE_ASSIGNMENTS, TokenInterner
+from sitewhere_tpu.core.state import RECENT_DEPTH
+from sitewhere_tpu.core.types import (
+    DEFAULT_VALUE_CHANNELS,
+    NULL_ID,
+    DeviceAssignmentStatus,
+    EventType,
+    PresenceState,
+)
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+from sitewhere_tpu.pipeline import (
+    PipelineConfig,
+    PipelineState,
+    StepOutput,
+    make_pipeline_step,
+    make_presence_sweep,
+)
+
+
+class ChannelMap:
+    """Measurement-name -> channel-index interner (per engine).
+
+    The reference stores named measurements as rows; the TPU layout is a
+    fixed-width channel vector, so names map to channel lanes. Beyond
+    ``channels`` distinct names, lanes are reused modulo with a collision
+    counter (visible in metrics) — capacity is a config knob."""
+
+    def __init__(self, channels: int):
+        self.channels = channels
+        self.names = TokenInterner(1 << 20)
+        self.collisions = 0
+
+    def channel_of(self, name: str) -> int:
+        nid = self.names.intern(name)
+        if nid >= self.channels:
+            self.collisions += 1
+        return nid % self.channels
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    device_capacity: int = 1 << 17
+    token_capacity: int = 1 << 18
+    assignment_capacity: int = 1 << 18
+    store_capacity: int = 1 << 18
+    channels: int = DEFAULT_VALUE_CHANNELS
+    batch_capacity: int = 8192
+    flush_interval_s: float = 0.05     # max added latency before a forced flush
+    auto_register: bool = True
+    default_device_type: str = "default"
+    presence_missing_s: float = 8 * 3600.0  # DevicePresenceManager default 8h
+
+
+@dataclasses.dataclass
+class DeviceInfo:
+    """Host-side device metadata (strings); hot columns live on device."""
+
+    token: str
+    device_type: str
+    tenant: str
+    area: str | None = None
+    customer: str | None = None
+    metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+    auto_registered: bool = False
+
+
+@jax.jit
+def _admin_create_device(state: PipelineState, token_id, device_id, assignment_id,
+                         type_id, tenant_id, area_id, customer_id):
+    """Write one device + ACTIVE assignment row (API-path creation)."""
+    reg = state.registry
+    reg = dataclasses.replace(
+        reg,
+        token_to_device=reg.token_to_device.at[token_id].set(device_id),
+        device_active=reg.device_active.at[device_id].set(True),
+        device_type=reg.device_type.at[device_id].set(type_id),
+        device_tenant=reg.device_tenant.at[device_id].set(tenant_id),
+        device_area=reg.device_area.at[device_id].set(area_id),
+        device_customer=reg.device_customer.at[device_id].set(customer_id),
+        device_assignments=reg.device_assignments.at[device_id, 0].set(assignment_id),
+        assignment_active=reg.assignment_active.at[assignment_id].set(True),
+        assignment_status=reg.assignment_status.at[assignment_id].set(
+            jnp.int32(DeviceAssignmentStatus.ACTIVE)
+        ),
+        assignment_device=reg.assignment_device.at[assignment_id].set(device_id),
+        assignment_area=reg.assignment_area.at[assignment_id].set(area_id),
+        assignment_customer=reg.assignment_customer.at[assignment_id].set(customer_id),
+    )
+    return dataclasses.replace(
+        state,
+        registry=reg,
+        next_device=jnp.maximum(state.next_device, device_id + 1),
+        next_assignment=jnp.maximum(state.next_assignment, assignment_id + 1),
+    )
+
+
+@jax.jit
+def _admin_set_device_active(state: PipelineState, device_id, active):
+    reg = state.registry
+    return dataclasses.replace(
+        state, registry=dataclasses.replace(
+            reg, device_active=reg.device_active.at[device_id].set(active)
+        )
+    )
+
+
+class Engine:
+    """Single-node engine instance."""
+
+    def __init__(self, config: EngineConfig | None = None):
+        self.config = config or EngineConfig()
+        c = self.config
+        self.epoch = EpochBase()
+        self.lock = threading.RLock()
+        self.tokens = TokenInterner(c.token_capacity)
+        self.tenants = TokenInterner(1 << 16)
+        self.tenants.intern("default")
+        self.device_types = TokenInterner(1 << 16)
+        self.device_types.intern(c.default_device_type)
+        self.areas = TokenInterner(1 << 16)
+        self.customers = TokenInterner(1 << 16)
+        self.alert_types = TokenInterner(1 << 20)
+        self.channel_map = ChannelMap(c.channels)
+        self.event_ids = TokenInterner(1 << 22)  # alternate/correlation ids
+
+        self.state = PipelineState.create(
+            c.device_capacity, c.token_capacity, c.assignment_capacity,
+            c.store_capacity, c.channels,
+        )
+        self._step = make_pipeline_step(
+            PipelineConfig(auto_register=c.auto_register, default_device_type=0)
+        )
+        self._sweep = make_presence_sweep()
+        self._buf = HostEventBuffer(c.batch_capacity, c.channels)
+        self._last_flush = time.monotonic()
+        # host mirrors
+        self.devices: dict[int, DeviceInfo] = {}      # device_id -> info
+        self.token_device: dict[int, int] = {}        # token_id -> device_id
+        self._next_device = 0
+        self._next_assignment = 0
+        self.dead_letters: list[int] = []             # unregistered token ids
+        self.outputs: list[dict] = []                 # recent step summaries
+
+    # ------------------------------------------------------------------ ingest
+    def process(self, req: DecodedRequest) -> None:
+        """Stage one decoded request; flushes when the batch fills."""
+        with self.lock:
+            if req.type is RequestType.REGISTER_DEVICE:
+                self.register_device(
+                    req.device_token,
+                    device_type=req.extras.get("deviceTypeToken",
+                                               self.config.default_device_type),
+                    tenant=req.tenant,
+                    area=req.extras.get("areaToken"),
+                    customer=req.extras.get("customerToken"),
+                )
+                return
+            et = req.event_type
+            if et is None:
+                return
+            now = self.epoch.now_ms()
+            ts = req.event_ts_ms if req.event_ts_ms is not None else now
+            token_id = self.tokens.intern(req.device_token)
+            tenant_id = self.tenants.intern(req.tenant)
+            values = np.zeros(self.config.channels, np.float32)
+            nch = 0
+            aux0 = NULL_ID
+            if et is EventType.MEASUREMENT and req.measurements:
+                for name, val in req.measurements.items():
+                    ch = self.channel_map.channel_of(name)
+                    values[ch] = val
+                    nch = max(nch, ch + 1)
+                self._stage(et, token_id, tenant_id, ts, now, values, nch, aux0, req)
+                return
+            if et is EventType.LOCATION:
+                values[0], values[1] = req.latitude or 0.0, req.longitude or 0.0
+                values[2] = req.elevation or 0.0
+                nch = 3
+            elif et is EventType.ALERT:
+                values[0] = float(int(req.alert_level))
+                nch = 1
+                aux0 = self.alert_types.intern(req.alert_type or "alert")
+            elif et is EventType.COMMAND_RESPONSE and req.originating_event_id:
+                aux0 = self.event_ids.intern(req.originating_event_id)
+            self._stage(et, token_id, tenant_id, ts, now, values, nch, aux0, req)
+
+    def _stage(self, et, token_id, tenant_id, ts, now, values, nch, aux0, req):
+        aux1 = (
+            self.event_ids.intern(req.alternate_id)
+            if req.alternate_id is not None
+            else NULL_ID
+        )
+        # channel mask is a prefix in HostEventBuffer; set values directly
+        i = len(self._buf)
+        if not self._buf.append(et, token_id, tenant_id, ts, now, (), aux0, aux1):
+            self.flush()
+            i = len(self._buf)
+            self._buf.append(et, token_id, tenant_id, ts, now, (), aux0, aux1)
+        if nch:
+            self._buf.values[i, :] = values
+            self._buf.vmask[i, :nch] = True
+        if self._buf.full:
+            self.flush()
+
+    def maybe_flush(self) -> dict | None:
+        """Flush if the latency budget expired (call from a timer loop)."""
+        with self.lock:
+            if len(self._buf) and (
+                time.monotonic() - self._last_flush >= self.config.flush_interval_s
+            ):
+                return self.flush()
+            return None
+
+    def flush(self) -> dict:
+        """Run one pipeline step on the staged batch and sync host mirrors."""
+        with self.lock:
+            batch = self._buf.emit()
+            self.state, out = self._step(self.state, batch)
+            self._last_flush = time.monotonic()
+            return self._absorb_output(out)
+
+    def _absorb_output(self, out: StepOutput) -> dict:
+        new_tokens = [int(t) for t in np.asarray(out.new_tokens) if t != NULL_ID]
+        # mirror device-side auto-registration: allocation order == list order
+        new_dids = []
+        for tid in new_tokens:
+            did = self._next_device
+            self._next_device += 1
+            self._next_assignment += 1
+            self.token_device[tid] = did
+            new_dids.append(did)
+        if new_dids:
+            tenants = np.asarray(self.state.registry.device_tenant[np.asarray(new_dids)])
+            for tid, did, ten in zip(new_tokens, new_dids, tenants):
+                self.devices[did] = DeviceInfo(
+                    token=self.tokens.token(tid),
+                    device_type=self.config.default_device_type,
+                    tenant=self.tenants.token(int(ten)) if int(ten) != NULL_ID else "default",
+                    auto_registered=True,
+                )
+        dead = [int(t) for t in np.asarray(out.dead_tokens) if t != NULL_ID]
+        self.dead_letters.extend(dead)
+        summary = {
+            "found": int(out.n_found),
+            "missed": int(out.n_missed),
+            "registered": int(out.n_registered),
+            "persisted": int(out.n_persisted),
+            "new_tokens": new_tokens,
+            "dead_tokens": dead,
+        }
+        self.outputs.append(summary)
+        del self.outputs[:-256]
+        return summary
+
+    # ------------------------------------------------------------------ admin
+    def register_device(
+        self,
+        token: str,
+        device_type: str | None = None,
+        tenant: str = "default",
+        area: str | None = None,
+        customer: str | None = None,
+        metadata: dict | None = None,
+    ) -> int:
+        """API-path device creation (get-or-create), with explicit metadata —
+        the RegisterDevice / RdbDeviceManagement.createDevice analog."""
+        with self.lock:
+            # staged events may still reference tokens about to be registered
+            if len(self._buf):
+                self.flush()
+            token_id = self.tokens.intern(token)
+            existing = self.token_device.get(token_id)
+            if existing is not None:
+                return existing
+            did = self._next_device
+            aid = self._next_assignment
+            if did >= self.config.device_capacity:
+                raise RuntimeError("device capacity exhausted")
+            self._next_device += 1
+            self._next_assignment += 1
+            type_name = device_type or self.config.default_device_type
+            self.state = _admin_create_device(
+                self.state,
+                jnp.int32(token_id), jnp.int32(did), jnp.int32(aid),
+                jnp.int32(self.device_types.intern(type_name)),
+                jnp.int32(self.tenants.intern(tenant)),
+                jnp.int32(self.areas.intern(area) if area else NULL_ID),
+                jnp.int32(self.customers.intern(customer) if customer else NULL_ID),
+            )
+            self.token_device[token_id] = did
+            self.devices[did] = DeviceInfo(
+                token=token, device_type=type_name, tenant=tenant,
+                area=area, customer=customer, metadata=metadata or {},
+            )
+            return did
+
+    def delete_device(self, token: str) -> bool:
+        with self.lock:
+            tid = self.tokens.lookup(token)
+            did = self.token_device.get(tid)
+            if did is None:
+                return False
+            self.state = _admin_set_device_active(self.state, jnp.int32(did), False)
+            return True
+
+    # ------------------------------------------------------------------ queries
+    def get_device(self, token: str) -> DeviceInfo | None:
+        tid = self.tokens.lookup(token)
+        did = self.token_device.get(tid)
+        return self.devices.get(did) if did is not None else None
+
+    def get_device_state(self, token: str) -> dict | None:
+        """Read back one device's aggregated state (REST device-state API)."""
+        with self.lock:
+            if len(self._buf):
+                self.flush()
+            tid = self.tokens.lookup(token)
+            did = self.token_device.get(tid)
+            if did is None:
+                return None
+            ds = self.state.device_state
+            d = did
+            chans = {}
+            for name, nid in self.channel_map.names.items():
+                ch = nid % self.config.channels
+                ts = int(ds.meas_last_ms[d, ch])
+                if ts > -(2**31) + 10:
+                    chans[name] = {
+                        "value": float(ds.meas_last[d, ch]),
+                        "ts_ms": ts,
+                    }
+            recent_locs = [
+                {
+                    "latitude": float(ds.recent_loc[d, r, 0]),
+                    "longitude": float(ds.recent_loc[d, r, 1]),
+                    "elevation": float(ds.recent_loc[d, r, 2]),
+                    "ts_ms": int(ds.recent_loc_ms[d, r]),
+                }
+                for r in range(RECENT_DEPTH)
+                if bool(ds.recent_loc_valid[d, r])
+            ]
+            recent_alerts = [
+                {
+                    "level": int(ds.recent_alert_level[d, r]),
+                    "type": self.alert_types.token(int(ds.recent_alert_type[d, r])),
+                    "ts_ms": int(ds.recent_alert_ms[d, r]),
+                }
+                for r in range(RECENT_DEPTH)
+                if bool(ds.recent_alert_valid[d, r])
+            ]
+            return {
+                "device": self.devices[did].token,
+                "presence": PresenceState(int(ds.presence[d])).name,
+                "last_interaction_ms": int(ds.last_interaction_ms[d]),
+                "measurements": chans,
+                "recent_locations": recent_locs,
+                "recent_alerts": recent_alerts,
+                "event_counts": {
+                    EventType(e).name: int(ds.event_counts[d, e]) for e in range(6)
+                },
+            }
+
+    def presence_sweep(self) -> list[str]:
+        """Mark stale devices MISSING; returns their tokens (notification
+        hook — PresenceNotificationStrategies.SendOnce analog)."""
+        with self.lock:
+            now = jnp.int32(self.epoch.now_ms())
+            missing_ms = jnp.int32(int(self.config.presence_missing_s * 1000))
+            self.state, newly = self._sweep(self.state, now, missing_ms)
+            idxs = np.nonzero(np.asarray(newly))[0]
+            return [self.devices[int(i)].token for i in idxs if int(i) in self.devices]
+
+    def metrics(self) -> dict:
+        m = self.state.metrics
+        return {
+            "processed": int(m.processed),
+            "found": int(m.found),
+            "missed": int(m.missed),
+            "registered": int(m.registered),
+            "persisted": int(m.persisted),
+            "reg_overflow": int(m.reg_overflow),
+            "channel_collisions": self.channel_map.collisions,
+            "staged": len(self._buf),
+        }

@@ -1,0 +1,307 @@
+"""Event decoders: bytes -> DecodedRequest list.
+
+Decoder lineup mirrors the reference's (SURVEY.md §2.1): JSON device-request,
+JSON string, JSON batch, binary ("protobuf" slot — here a compact
+struct-packed flat format, since our wire schema is flat SoA, not GPB),
+scripted (a user Python callable instead of Groovy — same binding contract:
+payload + metadata in, requests out), composite (metadata extractor + per-
+device-type delegation, sources/decoder/composite/*), and the debug decoders
+(echo / payload logger, sources/decoder/debug/*).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+from typing import Any, Callable, Protocol
+
+from sitewhere_tpu.core.types import AlertLevel
+from sitewhere_tpu.ingest.requests import (
+    DecodedRequest,
+    EventDecodeException,
+    RequestType,
+    parse_request_type,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class EventDecoder(Protocol):
+    def decode(self, payload: bytes, metadata: dict[str, Any]) -> list[DecodedRequest]:
+        ...
+
+
+def _parse_event_date(req: dict) -> int | None:
+    ts = req.get("eventDate")
+    if ts is None:
+        return None
+    if isinstance(ts, (int, float)):
+        return int(ts)
+    # ISO-8601 strings accepted for REST parity
+    import datetime
+
+    try:
+        return int(
+            datetime.datetime.fromisoformat(str(ts).replace("Z", "+00:00")).timestamp() * 1000
+        )
+    except ValueError as e:
+        raise EventDecodeException(f"bad eventDate: {ts!r}") from e
+
+
+def request_from_envelope(envelope: dict, metadata: dict | None = None) -> DecodedRequest:
+    """Map one DeviceRequest JSON envelope to a DecodedRequest."""
+    try:
+        rtype = parse_request_type(envelope["type"])
+        token = envelope.get("deviceToken") or envelope.get("hardwareId")
+        if not token:
+            raise EventDecodeException("missing deviceToken")
+        req = envelope.get("request", {}) or {}
+        out = DecodedRequest(
+            type=rtype,
+            device_token=str(token),
+            tenant=str(envelope.get("tenant", "default")),
+            event_ts_ms=_parse_event_date(req),
+            alternate_id=req.get("alternateId"),
+            metadata=dict(metadata or {}) | dict(req.get("metadata") or {}),
+        )
+        if rtype is RequestType.DEVICE_MEASUREMENT:
+            if "measurements" in req and isinstance(req["measurements"], dict):
+                out.measurements = {str(k): float(v) for k, v in req["measurements"].items()}
+            elif "name" in req:
+                out.measurements = {str(req["name"]): float(req["value"])}
+            else:
+                raise EventDecodeException("measurement request missing name/value")
+        elif rtype is RequestType.DEVICE_LOCATION:
+            out.latitude = float(req["latitude"])
+            out.longitude = float(req["longitude"])
+            out.elevation = float(req.get("elevation", 0.0))
+        elif rtype is RequestType.DEVICE_ALERT:
+            out.alert_type = str(req.get("type", "alert"))
+            lvl = req.get("level", "Info")
+            out.alert_level = (
+                AlertLevel[str(lvl).upper()] if isinstance(lvl, str) else AlertLevel(int(lvl))
+            )
+            out.alert_message = req.get("message")
+        elif rtype is RequestType.ACKNOWLEDGE:
+            out.originating_event_id = req.get("originatingEventId")
+            out.response = req.get("response")
+        elif rtype is RequestType.DEVICE_STATE_CHANGE:
+            out.attribute = str(req.get("attribute", ""))
+            out.state_type = str(req.get("type", ""))
+            out.previous_state = req.get("previousState")
+            out.new_state = req.get("newState")
+        else:
+            out.extras = {k: v for k, v in req.items() if k not in ("metadata",)}
+        return out
+    except EventDecodeException:
+        raise
+    except (KeyError, TypeError, ValueError) as e:
+        raise EventDecodeException(str(e)) from e
+
+
+class JsonDeviceRequestDecoder:
+    """Parse a single DeviceRequest envelope
+    (reference: sources/decoder/json/JsonDeviceRequestDecoder.java)."""
+
+    def decode(self, payload: bytes, metadata: dict[str, Any]) -> list[DecodedRequest]:
+        try:
+            envelope = json.loads(payload)
+        except json.JSONDecodeError as e:
+            raise EventDecodeException(f"invalid JSON: {e}") from e
+        if not isinstance(envelope, dict):
+            raise EventDecodeException("payload is not a JSON object")
+        return [request_from_envelope(envelope, metadata)]
+
+
+class JsonStringDecoder(JsonDeviceRequestDecoder):
+    """String payload variant (reference: JsonStringDeviceRequestDecoder)."""
+
+    def decode(self, payload, metadata):
+        if isinstance(payload, str):
+            payload = payload.encode()
+        return super().decode(payload, metadata)
+
+
+class JsonBatchEventDecoder:
+    """Batch envelope: list of DeviceRequests, or a map with shared token
+    (reference: sources/decoder/json/JsonBatchEventDecoder.java)."""
+
+    def decode(self, payload: bytes, metadata: dict[str, Any]) -> list[DecodedRequest]:
+        try:
+            data = json.loads(payload)
+        except json.JSONDecodeError as e:
+            raise EventDecodeException(f"invalid JSON: {e}") from e
+        if isinstance(data, list):
+            return [request_from_envelope(item, metadata) for item in data]
+        if isinstance(data, dict) and "requests" in data:
+            token = data.get("deviceToken")
+            out = []
+            for item in data["requests"]:
+                if token and "deviceToken" not in item:
+                    item = {**item, "deviceToken": token}
+                out.append(request_from_envelope(item, metadata))
+            return out
+        raise EventDecodeException("batch payload must be a list or {requests: []}")
+
+
+# --- binary flat format (the "protobuf decoder" slot) ------------------------
+#
+# Layout (little-endian), versioned; replaces GPB with a schema tuned for
+# zero-copy batch packing:
+#   u8 version=1 | u8 type | u16 token_len | token utf8 | i64 ts_ms |
+#   u16 n_pairs | n_pairs * (u16 name_len | name | f64 value)      (measurement)
+#   f64 lat | f64 lon | f64 elev                                    (location)
+#   u16 type_len | type | u8 level | u16 msg_len | msg              (alert)
+
+_BIN_MAGIC_VERSION = 1
+_BIN_TYPES = {
+    1: RequestType.DEVICE_MEASUREMENT,
+    2: RequestType.DEVICE_LOCATION,
+    3: RequestType.DEVICE_ALERT,
+    4: RequestType.REGISTER_DEVICE,
+    5: RequestType.ACKNOWLEDGE,
+}
+_BIN_TYPE_IDS = {v: k for k, v in _BIN_TYPES.items()}
+
+
+def encode_binary_request(req: DecodedRequest) -> bytes:
+    """Inverse of BinaryEventDecoder (reference: ProtobufDeviceEventEncoder
+    slot) — used by tests, the load generator, and socket senders."""
+    tid = _BIN_TYPE_IDS[req.type]
+    tok = req.device_token.encode()
+    out = struct.pack("<BBH", _BIN_MAGIC_VERSION, tid, len(tok)) + tok
+    out += struct.pack("<q", req.event_ts_ms if req.event_ts_ms is not None else -1)
+    if req.type is RequestType.DEVICE_MEASUREMENT:
+        pairs = req.measurements or {}
+        out += struct.pack("<H", len(pairs))
+        for name, value in pairs.items():
+            nb = name.encode()
+            out += struct.pack("<H", len(nb)) + nb + struct.pack("<d", float(value))
+    elif req.type is RequestType.DEVICE_LOCATION:
+        out += struct.pack("<ddd", req.latitude or 0.0, req.longitude or 0.0,
+                           req.elevation or 0.0)
+    elif req.type is RequestType.DEVICE_ALERT:
+        tb = (req.alert_type or "alert").encode()
+        mb = (req.alert_message or "").encode()
+        out += struct.pack("<H", len(tb)) + tb
+        out += struct.pack("<B", int(req.alert_level))
+        out += struct.pack("<H", len(mb)) + mb
+    return out
+
+
+class BinaryEventDecoder:
+    """Decode the compact flat binary format (the reference's
+    sources/decoder/protobuf/ProtobufDeviceEventDecoder slot)."""
+
+    def decode(self, payload: bytes, metadata: dict[str, Any]) -> list[DecodedRequest]:
+        try:
+            ver, tid, tlen = struct.unpack_from("<BBH", payload, 0)
+            if ver != _BIN_MAGIC_VERSION:
+                raise EventDecodeException(f"unknown binary version {ver}")
+            off = 4
+            token = payload[off: off + tlen].decode()
+            off += tlen
+            (ts,) = struct.unpack_from("<q", payload, off)
+            off += 8
+            rtype = _BIN_TYPES.get(tid)
+            if rtype is None:
+                raise EventDecodeException(f"unknown binary type id {tid}")
+            req = DecodedRequest(type=rtype, device_token=token,
+                                 event_ts_ms=None if ts < 0 else ts,
+                                 metadata=dict(metadata))
+            if rtype is RequestType.DEVICE_MEASUREMENT:
+                (n,) = struct.unpack_from("<H", payload, off)
+                off += 2
+                pairs = {}
+                for _ in range(n):
+                    (nlen,) = struct.unpack_from("<H", payload, off)
+                    off += 2
+                    name = payload[off: off + nlen].decode()
+                    off += nlen
+                    (val,) = struct.unpack_from("<d", payload, off)
+                    off += 8
+                    pairs[name] = val
+                req.measurements = pairs
+            elif rtype is RequestType.DEVICE_LOCATION:
+                req.latitude, req.longitude, req.elevation = struct.unpack_from(
+                    "<ddd", payload, off
+                )
+            elif rtype is RequestType.DEVICE_ALERT:
+                (tl,) = struct.unpack_from("<H", payload, off)
+                off += 2
+                req.alert_type = payload[off: off + tl].decode()
+                off += tl
+                (lvl,) = struct.unpack_from("<B", payload, off)
+                off += 1
+                req.alert_level = AlertLevel(lvl)
+                (ml,) = struct.unpack_from("<H", payload, off)
+                off += 2
+                req.alert_message = payload[off: off + ml].decode() or None
+            return [req]
+        except (struct.error, UnicodeDecodeError, IndexError) as e:
+            raise EventDecodeException(str(e)) from e
+
+
+class ScriptedDecoder:
+    """User-supplied decode function — the Python analog of the reference's
+    Groovy ScriptedEventDecoder (sources/decoder/ScriptedEventDecoder.java:
+    bindings for payload/metadata, returns request list)."""
+
+    def __init__(self, fn: Callable[[bytes, dict], list[DecodedRequest]]):
+        self.fn = fn
+
+    def decode(self, payload: bytes, metadata: dict[str, Any]) -> list[DecodedRequest]:
+        try:
+            out = self.fn(payload, metadata)
+        except Exception as e:  # user scripts fail -> decode failure DLQ
+            raise EventDecodeException(f"scripted decoder error: {e}") from e
+        if not isinstance(out, list):
+            raise EventDecodeException("scripted decoder must return a list")
+        return out
+
+
+class CompositeDecoder:
+    """Metadata-extractor + per-criteria delegation (reference:
+    sources/decoder/composite/*): extract (device_type, payload') from the
+    raw payload, then route to the decoder mapped for that device type."""
+
+    def __init__(
+        self,
+        extractor: Callable[[bytes, dict], tuple[str, bytes]],
+        choices: dict[str, EventDecoder],
+        default: EventDecoder | None = None,
+    ):
+        self.extractor = extractor
+        self.choices = choices
+        self.default = default
+
+    def decode(self, payload: bytes, metadata: dict[str, Any]) -> list[DecodedRequest]:
+        try:
+            key, inner = self.extractor(payload, metadata)
+        except Exception as e:
+            raise EventDecodeException(f"composite extractor error: {e}") from e
+        decoder = self.choices.get(key, self.default)
+        if decoder is None:
+            raise EventDecodeException(f"no decoder mapped for {key!r}")
+        return decoder.decode(inner, metadata)
+
+
+class EchoStringDecoder:
+    """Debug decoder: logs and drops (reference: debug/EchoStringDecoder)."""
+
+    def decode(self, payload, metadata):
+        logger.info("echo decoder: %r", payload)
+        return []
+
+
+class PayloadLoggerDecoder:
+    """Debug wrapper: logs payload then delegates
+    (reference: debug/PayloadLoggerEventDecoder)."""
+
+    def __init__(self, delegate: EventDecoder):
+        self.delegate = delegate
+
+    def decode(self, payload, metadata):
+        logger.info("payload (%d bytes): %r", len(payload), payload[:256])
+        return self.delegate.decode(payload, metadata)

@@ -1,0 +1,52 @@
+"""Event deduplicators (reference: sources/deduplicator/*).
+
+``AlternateIdDeduplicator`` mirrors the reference's strategy of checking the
+event's alternate id against already-persisted events
+(AlternateIdDeduplicator.java uses getDeviceEventByAlternateId); here the
+check is a host-side bounded LRU set per tenant — O(1), no store round trip,
+sized to cover the at-least-once redelivery window.
+
+``ScriptedDeduplicator`` takes a user Python predicate (Groovy analog).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Callable, Protocol
+
+from sitewhere_tpu.ingest.requests import DecodedRequest
+
+
+class Deduplicator(Protocol):
+    def is_duplicate(self, request: DecodedRequest) -> bool:
+        ...
+
+
+class AlternateIdDeduplicator:
+    """Bounded LRU of (tenant, token, alternate_id) triples."""
+
+    def __init__(self, capacity: int = 1 << 16):
+        self.capacity = capacity
+        self._seen: OrderedDict[tuple, None] = OrderedDict()
+
+    def is_duplicate(self, request: DecodedRequest) -> bool:
+        if request.alternate_id is None:
+            return False
+        key = (request.tenant, request.device_token, request.alternate_id)
+        if key in self._seen:
+            self._seen.move_to_end(key)
+            return True
+        self._seen[key] = None
+        if len(self._seen) > self.capacity:
+            self._seen.popitem(last=False)
+        return False
+
+
+class ScriptedDeduplicator:
+    """User-provided predicate (reference: ScriptedEventDeduplicator)."""
+
+    def __init__(self, fn: Callable[[DecodedRequest], bool]):
+        self.fn = fn
+
+    def is_duplicate(self, request: DecodedRequest) -> bool:
+        return bool(self.fn(request))

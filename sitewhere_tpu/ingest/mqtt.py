@@ -1,0 +1,315 @@
+"""Native MQTT 3.1.1: wire codec, asyncio client, embedded broker, receiver.
+
+The reference's primary ingest protocol is MQTT via the fusesource client
+(sources/mqtt/MqttInboundEventReceiver.java:40-120 — subscribe thread +
+processor pool, QoS 0/1/2) and it also embeds an ActiveMQ broker for
+broker-style sources (sources/activemq/ActiveMqBrokerEventReceiver). No MQTT
+library ships in this image, so the protocol is implemented here: a minimal,
+dependency-free MQTT 3.1.1 subset (CONNECT/CONNACK, PUBLISH QoS 0/1 with
+PUBACK, SUBSCRIBE/SUBACK, PING, DISCONNECT) sufficient for telemetry ingest,
+command downlink publishing (commands/destinations.py), and an embedded
+broker used by tests and the load generator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable
+
+from sitewhere_tpu.ingest.sources import InboundEventReceiver
+
+logger = logging.getLogger(__name__)
+
+# control packet types
+CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
+PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
+
+
+def encode_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+async def read_varint(reader: asyncio.StreamReader) -> int:
+    mult, value = 1, 0
+    for _ in range(4):
+        (b,) = await reader.readexactly(1)
+        value += (b & 0x7F) * mult
+        if not b & 0x80:
+            return value
+        mult *= 128
+    raise ValueError("malformed remaining-length varint")
+
+
+def _utf8(s: str) -> bytes:
+    b = s.encode()
+    return len(b).to_bytes(2, "big") + b
+
+
+def encode_packet(ptype: int, flags: int, payload: bytes) -> bytes:
+    return bytes([(ptype << 4) | flags]) + encode_varint(len(payload)) + payload
+
+
+async def read_packet(reader: asyncio.StreamReader) -> tuple[int, int, bytes]:
+    (h,) = await reader.readexactly(1)
+    length = await read_varint(reader)
+    body = await reader.readexactly(length) if length else b""
+    return h >> 4, h & 0x0F, body
+
+
+def encode_connect(client_id: str, keepalive: int = 60,
+                   username: str | None = None, password: str | None = None) -> bytes:
+    flags = 0x02  # clean session
+    tail = _utf8(client_id)
+    if username is not None:
+        flags |= 0x80
+        tail += _utf8(username)
+    if password is not None:
+        flags |= 0x40
+        tail += _utf8(password)
+    var = _utf8("MQTT") + bytes([4, flags]) + keepalive.to_bytes(2, "big")
+    return encode_packet(CONNECT, 0, var + tail)
+
+
+def encode_publish(topic: str, payload: bytes, qos: int = 0, packet_id: int = 1) -> bytes:
+    var = _utf8(topic)
+    if qos:
+        var += packet_id.to_bytes(2, "big")
+    return encode_packet(PUBLISH, qos << 1, var + payload)
+
+
+def decode_publish(flags: int, body: bytes) -> tuple[str, bytes, int, int]:
+    qos = (flags >> 1) & 0x03
+    tlen = int.from_bytes(body[:2], "big")
+    topic = body[2: 2 + tlen].decode()
+    off = 2 + tlen
+    packet_id = 0
+    if qos:
+        packet_id = int.from_bytes(body[off: off + 2], "big")
+        off += 2
+    return topic, body[off:], qos, packet_id
+
+
+def encode_subscribe(packet_id: int, topics: list[tuple[str, int]]) -> bytes:
+    payload = packet_id.to_bytes(2, "big")
+    for topic, qos in topics:
+        payload += _utf8(topic) + bytes([qos])
+    return encode_packet(SUBSCRIBE, 0x02, payload)
+
+
+def topic_matches(pattern: str, topic: str) -> bool:
+    """MQTT wildcard matching: ``+`` one level, ``#`` trailing multi-level."""
+    pp, tp = pattern.split("/"), topic.split("/")
+    for i, seg in enumerate(pp):
+        if seg == "#":
+            return True
+        if i >= len(tp):
+            return False
+        if seg != "+" and seg != tp[i]:
+            return False
+    return len(pp) == len(tp)
+
+
+class MqttClient:
+    """Minimal asyncio MQTT 3.1.1 client (QoS 0/1)."""
+
+    def __init__(self, host: str, port: int, client_id: str = "sitewhere-tpu",
+                 username: str | None = None, password: str | None = None,
+                 keepalive: int = 60):
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self.username, self.password = username, password
+        self.keepalive = keepalive
+        self.on_message: Callable[[str, bytes], Any] | None = None
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._packet_id = 0
+        self._task: asyncio.Task | None = None
+        self._acks: dict[int, asyncio.Future] = {}
+        self._ping_task: asyncio.Task | None = None
+
+    def _next_id(self) -> int:
+        self._packet_id = self._packet_id % 0xFFFF + 1
+        return self._packet_id
+
+    async def connect(self) -> None:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._writer.write(encode_connect(self.client_id, self.keepalive,
+                                          self.username, self.password))
+        await self._writer.drain()
+        ptype, _, body = await read_packet(self._reader)
+        if ptype != CONNACK or body[1] != 0:
+            raise ConnectionError(f"MQTT connect refused: {body!r}")
+        self._task = asyncio.create_task(self._read_loop())
+        if self.keepalive:
+            self._ping_task = asyncio.create_task(self._ping_loop())
+
+    async def _ping_loop(self) -> None:
+        while True:
+            await asyncio.sleep(max(self.keepalive - 5, 5))
+            self._writer.write(encode_packet(PINGREQ, 0, b""))
+            await self._writer.drain()
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                ptype, flags, body = await read_packet(self._reader)
+                if ptype == PUBLISH:
+                    topic, payload, qos, pid = decode_publish(flags, body)
+                    if qos == 1:
+                        self._writer.write(
+                            encode_packet(PUBACK, 0, pid.to_bytes(2, "big"))
+                        )
+                        await self._writer.drain()
+                    if self.on_message is not None:
+                        res = self.on_message(topic, payload)
+                        if asyncio.iscoroutine(res):
+                            await res
+                elif ptype in (PUBACK, SUBACK, UNSUBACK):
+                    pid = int.from_bytes(body[:2], "big")
+                    fut = self._acks.pop(pid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(body)
+        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+            pass
+
+    async def subscribe(self, topic: str, qos: int = 0) -> None:
+        pid = self._next_id()
+        fut = asyncio.get_running_loop().create_future()
+        self._acks[pid] = fut
+        self._writer.write(encode_subscribe(pid, [(topic, qos)]))
+        await self._writer.drain()
+        await asyncio.wait_for(fut, 10)
+
+    async def publish(self, topic: str, payload: bytes, qos: int = 0) -> None:
+        pid = self._next_id() if qos else 0
+        if qos:
+            fut = asyncio.get_running_loop().create_future()
+            self._acks[pid] = fut
+        self._writer.write(encode_publish(topic, payload, qos, pid))
+        await self._writer.drain()
+        if qos:
+            await asyncio.wait_for(fut, 10)
+
+    async def disconnect(self) -> None:
+        for t in (self._ping_task, self._task):
+            if t is not None:
+                t.cancel()
+        if self._writer is not None:
+            try:
+                self._writer.write(encode_packet(DISCONNECT, 0, b""))
+                await self._writer.drain()
+            except ConnectionResetError:
+                pass
+            self._writer.close()
+
+
+class MqttBroker:
+    """Embedded MQTT broker (QoS 0/1 fan-out, wildcard subscriptions) — the
+    analog of the reference's embedded ActiveMQ broker receiver
+    (sources/activemq/ActiveMqBrokerEventReceiver.java), and the test/load
+    harness for MQTT paths."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host, self.port = host, port
+        self._server: asyncio.AbstractServer | None = None
+        # writer -> list of subscription patterns
+        self._subs: dict[asyncio.StreamWriter, list[str]] = {}
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None and self._server.sockets
+        return self._server.sockets[0].getsockname()[1]
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for w in list(self._subs):
+            w.close()
+        self._subs.clear()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            ptype, _, _ = await read_packet(reader)
+            if ptype != CONNECT:
+                writer.close()
+                return
+            writer.write(encode_packet(CONNACK, 0, b"\x00\x00"))
+            await writer.drain()
+            self._subs[writer] = []
+            while True:
+                ptype, flags, body = await read_packet(reader)
+                if ptype == PUBLISH:
+                    topic, payload, qos, pid = decode_publish(flags, body)
+                    if qos:
+                        writer.write(encode_packet(PUBACK, 0, pid.to_bytes(2, "big")))
+                        await writer.drain()
+                    await self._fanout(topic, payload)
+                elif ptype == SUBSCRIBE:
+                    pid = int.from_bytes(body[:2], "big")
+                    off, grants = 2, []
+                    while off < len(body):
+                        tlen = int.from_bytes(body[off: off + 2], "big")
+                        topic = body[off + 2: off + 2 + tlen].decode()
+                        qos = body[off + 2 + tlen]
+                        off += 3 + tlen
+                        self._subs[writer].append(topic)
+                        grants.append(min(qos, 1))
+                    writer.write(
+                        encode_packet(SUBACK, 0, pid.to_bytes(2, "big") + bytes(grants))
+                    )
+                    await writer.drain()
+                elif ptype == PINGREQ:
+                    writer.write(encode_packet(PINGRESP, 0, b""))
+                    await writer.drain()
+                elif ptype == DISCONNECT:
+                    break
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            self._subs.pop(writer, None)
+            writer.close()
+
+    async def _fanout(self, topic: str, payload: bytes) -> None:
+        pkt = encode_publish(topic, payload, 0, 0)
+        for w, patterns in list(self._subs.items()):
+            if any(topic_matches(p, topic) for p in patterns):
+                try:
+                    w.write(pkt)
+                    await w.drain()
+                except ConnectionResetError:
+                    self._subs.pop(w, None)
+
+
+class MqttEventReceiver(InboundEventReceiver):
+    """Subscribe to a broker topic and submit payloads to the event source
+    (reference: sources/mqtt/MqttInboundEventReceiver.java)."""
+
+    def __init__(self, host: str, port: int, topic: str = "sitewhere/input/#",
+                 qos: int = 0, client_id: str = "sw-ingest",
+                 username: str | None = None, password: str | None = None):
+        super().__init__(f"mqtt:{topic}")
+        self.topic, self.qos = topic, qos
+        self.client = MqttClient(host, port, client_id, username, password)
+
+    async def on_start(self) -> None:
+        self.client.on_message = lambda topic, payload: self.submit(
+            payload, {"topic": topic}
+        )
+        await self.client.connect()
+        await self.client.subscribe(self.topic, self.qos)
+
+    async def on_stop(self) -> None:
+        await self.client.disconnect()

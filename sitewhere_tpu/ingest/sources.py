@@ -1,0 +1,276 @@
+"""Inbound event sources: receivers + decoder + deduplicator.
+
+Mirrors the reference's ingestion edge (SURVEY.md §2.1):
+``InboundEventSource`` binds N protocol receivers to one decoder and an
+optional deduplicator (sources/InboundEventSource.java:35-298 —
+onEncodedEventReceived -> decodePayload -> dedup -> forward, decode/failure/
+duplicate counters at lines 50-59, 233-246); ``EventSourcesManager`` parses
+source configs, owns the forward path, and splits decoded requests into
+event-create vs device-registration flows with a failed-decode dead letter
+(sources/manager/EventSourcesManager.java:38-260, branch at 167-205, DLQ at
+212-220).
+
+Receivers here are asyncio servers/clients (TCP socket, WebSocket, REST
+polling, in-memory; MQTT in ingest/mqtt.py, CoAP in ingest/coap.py) — the
+thread-pool receiver model of the reference (MqttInboundEventReceiver.java:
+56-79) becomes event-loop concurrency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable
+
+from sitewhere_tpu.ingest.decoders import EventDecoder
+from sitewhere_tpu.ingest.dedup import Deduplicator
+from sitewhere_tpu.ingest.requests import DecodedRequest, EventDecodeException, RequestType
+from sitewhere_tpu.utils.lifecycle import LifecycleComponent
+
+logger = logging.getLogger(__name__)
+
+
+class InboundEventReceiver(LifecycleComponent):
+    """Base protocol receiver; concrete receivers call ``submit``."""
+
+    def __init__(self, name: str | None = None, required: bool = True):
+        super().__init__(name, required)
+        self.source: "InboundEventSource | None" = None
+
+    def bind(self, source: "InboundEventSource") -> None:
+        self.source = source
+
+    def submit(self, payload: bytes, metadata: dict[str, Any] | None = None) -> int:
+        assert self.source is not None, "receiver not bound to a source"
+        return self.source.on_encoded_event_received(payload, metadata or {})
+
+
+class InboundEventSource(LifecycleComponent):
+    """One event source: receivers -> decoder -> dedup -> manager."""
+
+    def __init__(
+        self,
+        source_id: str,
+        decoder: EventDecoder,
+        receivers: list[InboundEventReceiver] | None = None,
+        deduplicator: Deduplicator | None = None,
+        tenant: str = "default",
+    ):
+        super().__init__(f"event-source:{source_id}")
+        self.source_id = source_id
+        self.decoder = decoder
+        self.deduplicator = deduplicator
+        self.tenant = tenant
+        self.manager: "EventSourcesManager | None" = None
+        self.receivers = receivers or []
+        for r in self.receivers:
+            r.bind(self)
+            self.add_child(r)
+        # Prometheus-analog counters (InboundEventSource.java:50-59)
+        self.decoded_count = 0
+        self.failed_count = 0
+        self.duplicate_count = 0
+
+    def on_encoded_event_received(self, payload: bytes, metadata: dict[str, Any]) -> int:
+        """Decode one raw payload and forward its requests; returns number of
+        requests forwarded."""
+        assert self.manager is not None, "source not attached to a manager"
+        metadata = {**metadata, "source_id": self.source_id}
+        try:
+            requests = self.decoder.decode(payload, metadata)
+        except EventDecodeException as e:
+            self.failed_count += 1
+            self.manager.on_decode_failed(self.source_id, payload, metadata, e)
+            return 0
+        forwarded = 0
+        for req in requests:
+            if req.tenant == "default":
+                req.tenant = self.tenant
+            if self.deduplicator is not None and self.deduplicator.is_duplicate(req):
+                self.duplicate_count += 1
+                continue
+            self.decoded_count += 1
+            self.manager.on_decoded_request(self.source_id, req)
+            forwarded += 1
+        return forwarded
+
+
+class EventSourcesManager(LifecycleComponent):
+    """Owns all sources for a tenant engine; routes decoded requests.
+
+    ``on_event_request`` receives event-create requests (the decoded-events
+    Kafka topic analog) and ``on_registration_request`` receives registration
+    requests (the device-registration topic analog). Failed decodes land in a
+    bounded in-memory dead letter, mirroring the failed-decode topic."""
+
+    def __init__(
+        self,
+        on_event_request: Callable[[DecodedRequest], None],
+        on_registration_request: Callable[[DecodedRequest], None] | None = None,
+        dead_letter_capacity: int = 4096,
+    ):
+        super().__init__("event-sources-manager")
+        self.sources: dict[str, InboundEventSource] = {}
+        self._on_event = on_event_request
+        self._on_register = on_registration_request
+        self.failed_decodes: list[tuple[str, bytes, str]] = []
+        self.dead_letter_capacity = dead_letter_capacity
+
+    def add_source(self, source: InboundEventSource) -> InboundEventSource:
+        if source.source_id in self.sources:
+            raise ValueError(f"duplicate source id {source.source_id!r}")
+        self.sources[source.source_id] = source
+        source.manager = self
+        self.add_child(source)
+        return source
+
+    def on_decoded_request(self, source_id: str, req: DecodedRequest) -> None:
+        if req.type is RequestType.REGISTER_DEVICE and self._on_register is not None:
+            self._on_register(req)
+        else:
+            self._on_event(req)
+
+    def on_decode_failed(self, source_id: str, payload: bytes,
+                         metadata: dict, error: Exception) -> None:
+        if len(self.failed_decodes) < self.dead_letter_capacity:
+            self.failed_decodes.append((source_id, payload, str(error)))
+        logger.warning("decode failed on %s: %s", source_id, error)
+
+
+# --- concrete receivers ------------------------------------------------------
+
+
+class InMemoryEventReceiver(InboundEventReceiver):
+    """Direct-submit receiver for tests, benchmarks, and embedded use."""
+
+    def __init__(self, name: str = "inmemory"):
+        super().__init__(name)
+
+
+class SocketEventReceiver(InboundEventReceiver):
+    """Raw TCP socket receiver (reference: sources/socket/
+    SocketInboundEventReceiver.java + interaction handlers). Framing modes:
+    ``read_all`` (one payload per connection), ``length_prefixed`` (u32 BE
+    length frames), ``newline`` (one payload per line)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 framing: str = "read_all"):
+        super().__init__(f"socket:{port}")
+        if framing not in ("read_all", "length_prefixed", "newline"):
+            raise ValueError(f"unknown framing {framing!r}")
+        self.host, self.port, self.framing = host, port, framing
+        self._server: asyncio.AbstractServer | None = None
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None and self._server.sockets
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        peer = writer.get_extra_info("peername")
+        meta = {"remote": str(peer)}
+        try:
+            if self.framing == "read_all":
+                payload = await reader.read(-1)
+                if payload:
+                    self.submit(payload, meta)
+            elif self.framing == "length_prefixed":
+                while True:
+                    header = await reader.readexactly(4)
+                    n = int.from_bytes(header, "big")
+                    payload = await reader.readexactly(n)
+                    self.submit(payload, meta)
+            else:  # newline
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        break
+                    line = line.strip()
+                    if line:
+                        self.submit(line, meta)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        finally:
+            writer.close()
+
+    async def on_start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+
+class WebSocketEventReceiver(InboundEventReceiver):
+    """WebSocket receiver for binary or text payloads (reference:
+    sources/websocket/{Binary,String}WebSocketEventReceiver.java)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        super().__init__(f"websocket:{port}")
+        self.host, self.port = host, port
+        self._server = None
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return next(iter(self._server.sockets)).getsockname()[1]
+
+    async def _handle(self, ws) -> None:
+        async for message in ws:
+            payload = message.encode() if isinstance(message, str) else message
+            self.submit(payload, {"remote": str(ws.remote_address)})
+
+    async def on_start(self) -> None:
+        import websockets
+
+        self._server = await websockets.serve(self._handle, self.host, self.port)
+
+    async def on_stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+
+class PollingRestReceiver(InboundEventReceiver):
+    """Poll a REST endpoint on an interval and submit the response body
+    (reference: sources/rest/PollingRestInboundEventReceiver.java)."""
+
+    def __init__(self, url: str, interval_s: float = 10.0,
+                 headers: dict[str, str] | None = None):
+        super().__init__(f"rest-poll:{url}")
+        self.url = url
+        self.interval_s = interval_s
+        self.headers = headers or {}
+        self._task: asyncio.Task | None = None
+
+    async def _poll_loop(self) -> None:
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            while True:
+                try:
+                    async with session.get(self.url, headers=self.headers) as resp:
+                        body = await resp.read()
+                        if resp.status == 200 and body:
+                            self.submit(body, {"url": self.url})
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    logger.warning("poll %s failed: %s", self.url, e)
+                await asyncio.sleep(self.interval_s)
+
+    async def on_start(self) -> None:
+        self._task = asyncio.create_task(self._poll_loop())
+
+    async def on_stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None

@@ -1,0 +1,356 @@
+"""Ingestion-edge tests: decoders, dedup, receivers (socket/websocket/MQTT/
+CoAP), and the engine integration (decode -> batch -> TPU step -> state)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.types import AlertLevel
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ingest.decoders import (
+    BinaryEventDecoder,
+    CompositeDecoder,
+    JsonBatchEventDecoder,
+    JsonDeviceRequestDecoder,
+    ScriptedDecoder,
+    encode_binary_request,
+)
+from sitewhere_tpu.ingest.dedup import AlternateIdDeduplicator
+from sitewhere_tpu.ingest.requests import (
+    DecodedRequest,
+    EventDecodeException,
+    RequestType,
+)
+from sitewhere_tpu.ingest.sources import (
+    EventSourcesManager,
+    InboundEventSource,
+    InMemoryEventReceiver,
+    SocketEventReceiver,
+    WebSocketEventReceiver,
+)
+
+
+def measurement_json(token="dev-1", name="fuel.level", value=123.4, **kw):
+    """The reference's canonical JSON measurement message
+    (EventsHelper.generateJsonMeasurementsMessage)."""
+    return json.dumps(
+        {
+            "deviceToken": token,
+            "type": "DeviceMeasurement",
+            "request": {"name": name, "value": value, **kw},
+        }
+    ).encode()
+
+
+# --- decoders ----------------------------------------------------------------
+
+
+def test_json_decoder_measurement():
+    (req,) = JsonDeviceRequestDecoder().decode(measurement_json(), {})
+    assert req.type is RequestType.DEVICE_MEASUREMENT
+    assert req.device_token == "dev-1"
+    assert req.measurements == {"fuel.level": 123.4}
+
+
+def test_json_decoder_location_alert_ack():
+    d = JsonDeviceRequestDecoder()
+    (loc,) = d.decode(
+        json.dumps(
+            {"deviceToken": "d", "type": "DeviceLocation",
+             "request": {"latitude": 33.7, "longitude": -84.4, "elevation": 10}}
+        ).encode(),
+        {},
+    )
+    assert (loc.latitude, loc.longitude, loc.elevation) == (33.7, -84.4, 10.0)
+    (al,) = d.decode(
+        json.dumps(
+            {"deviceToken": "d", "type": "DeviceAlert",
+             "request": {"type": "engine.overheat", "level": "Critical",
+                         "message": "too hot"}}
+        ).encode(),
+        {},
+    )
+    assert al.alert_type == "engine.overheat"
+    assert al.alert_level is AlertLevel.CRITICAL
+    (ack,) = d.decode(
+        json.dumps(
+            {"deviceToken": "d", "type": "Acknowledge",
+             "request": {"originatingEventId": "evt-9", "response": "ok"}}
+        ).encode(),
+        {},
+    )
+    assert ack.type is RequestType.ACKNOWLEDGE
+    assert ack.originating_event_id == "evt-9"
+
+
+def test_json_decoder_registration_and_aliases():
+    d = JsonDeviceRequestDecoder()
+    (reg,) = d.decode(
+        json.dumps(
+            {"hardwareId": "d9", "type": "RegisterDevice",
+             "request": {"deviceTypeToken": "mega2560", "areaToken": "peachtree"}}
+        ).encode(),
+        {},
+    )
+    assert reg.type is RequestType.REGISTER_DEVICE
+    assert reg.device_token == "d9"
+    assert reg.extras["deviceTypeToken"] == "mega2560"
+
+
+def test_json_decoder_errors():
+    d = JsonDeviceRequestDecoder()
+    for bad in [b"{not json", b"[1,2]", b"{}",
+                json.dumps({"type": "DeviceMeasurement", "request": {}}).encode(),
+                json.dumps({"deviceToken": "d", "type": "Nope", "request": {}}).encode()]:
+        with pytest.raises((EventDecodeException, ValueError)):
+            d.decode(bad, {})
+
+
+def test_batch_decoder():
+    payload = json.dumps(
+        {
+            "deviceToken": "shared",
+            "requests": [
+                {"type": "DeviceMeasurement", "request": {"name": "a", "value": 1}},
+                {"type": "DeviceMeasurement", "request": {"name": "b", "value": 2}},
+            ],
+        }
+    ).encode()
+    reqs = JsonBatchEventDecoder().decode(payload, {})
+    assert [r.device_token for r in reqs] == ["shared", "shared"]
+
+
+def test_binary_roundtrip():
+    d = BinaryEventDecoder()
+    for req in [
+        DecodedRequest(type=RequestType.DEVICE_MEASUREMENT, device_token="dev-7",
+                       event_ts_ms=1234, measurements={"t": 20.5, "rpm": 900.0}),
+        DecodedRequest(type=RequestType.DEVICE_LOCATION, device_token="x",
+                       latitude=1.5, longitude=-2.5, elevation=3.0),
+        DecodedRequest(type=RequestType.DEVICE_ALERT, device_token="y",
+                       alert_type="fire", alert_level=AlertLevel.ERROR,
+                       alert_message="hot"),
+    ]:
+        (back,) = d.decode(encode_binary_request(req), {})
+        assert back.type is req.type
+        assert back.device_token == req.device_token
+        if req.measurements:
+            assert back.measurements == req.measurements
+        if req.latitude is not None:
+            assert (back.latitude, back.longitude, back.elevation) == (1.5, -2.5, 3.0)
+        if req.alert_type:
+            assert (back.alert_type, back.alert_level) == ("fire", AlertLevel.ERROR)
+    with pytest.raises(EventDecodeException):
+        d.decode(b"\x07garbage", {})
+
+
+def test_composite_and_scripted_decoders():
+    inner = JsonDeviceRequestDecoder()
+
+    def extractor(payload, metadata):
+        obj = json.loads(payload)
+        return obj["deviceType"], json.dumps(obj["body"]).encode()
+
+    comp = CompositeDecoder(extractor, {"sensor": inner})
+    payload = json.dumps(
+        {"deviceType": "sensor",
+         "body": {"deviceToken": "c1", "type": "DeviceMeasurement",
+                  "request": {"name": "x", "value": 5}}}
+    ).encode()
+    (req,) = comp.decode(payload, {})
+    assert req.device_token == "c1"
+    with pytest.raises(EventDecodeException):
+        comp.decode(json.dumps({"deviceType": "unknown", "body": {}}).encode(), {})
+
+    scripted = ScriptedDecoder(
+        lambda p, m: [DecodedRequest(type=RequestType.DEVICE_MEASUREMENT,
+                                     device_token=p.decode(),
+                                     measurements={"v": 1.0})]
+    )
+    (req,) = scripted.decode(b"tok", {})
+    assert req.device_token == "tok"
+
+
+def test_alternate_id_dedup():
+    d = AlternateIdDeduplicator(capacity=4)
+    r1 = DecodedRequest(type=RequestType.DEVICE_MEASUREMENT, device_token="a",
+                        alternate_id="m1", measurements={"x": 1})
+    assert not d.is_duplicate(r1)
+    assert d.is_duplicate(r1)
+    r2 = DecodedRequest(type=RequestType.DEVICE_MEASUREMENT, device_token="a",
+                        measurements={"x": 1})  # no alternate id -> never dup
+    assert not d.is_duplicate(r2)
+    assert not d.is_duplicate(r2)
+
+
+# --- sources + receivers -----------------------------------------------------
+
+
+def _mini_engine():
+    return Engine(EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=4096, batch_capacity=16, channels=4,
+    ))
+
+
+def _wire(engine):
+    mgr = EventSourcesManager(
+        on_event_request=engine.process,
+        on_registration_request=engine.process,
+    )
+    return mgr
+
+
+def test_source_decode_and_dlq():
+    engine = _mini_engine()
+    mgr = _wire(engine)
+    recv = InMemoryEventReceiver()
+    src = InboundEventSource("json-src", JsonDeviceRequestDecoder(), [recv],
+                             AlternateIdDeduplicator())
+    mgr.add_source(src)
+    assert recv.submit(measurement_json("m-1")) == 1
+    assert recv.submit(b"not json at all") == 0
+    assert recv.submit(measurement_json("m-1", alternateId="dup-1")) == 1
+    assert recv.submit(measurement_json("m-1", alternateId="dup-1")) == 0  # dup
+    assert src.decoded_count == 2
+    assert src.failed_count == 1
+    assert src.duplicate_count == 1
+    assert len(mgr.failed_decodes) == 1
+    engine.flush()
+    m = engine.metrics()
+    assert m["processed"] == 2
+    assert m["registered"] == 1
+
+
+def test_engine_end_to_end_state():
+    engine = _mini_engine()
+    mgr = _wire(engine)
+    recv = InMemoryEventReceiver()
+    mgr.add_source(InboundEventSource("s", JsonDeviceRequestDecoder(), [recv]))
+    recv.submit(measurement_json("dev-A", "temp", 21.5))
+    recv.submit(measurement_json("dev-A", "temp", 23.5))
+    recv.submit(json.dumps(
+        {"deviceToken": "dev-A", "type": "DeviceLocation",
+         "request": {"latitude": 1.0, "longitude": 2.0}}
+    ).encode())
+    engine.flush()
+    st = engine.get_device_state("dev-A")
+    assert st is not None
+    assert st["measurements"]["temp"]["value"] == 23.5
+    assert st["presence"] == "PRESENT"
+    assert len(st["recent_locations"]) == 1
+    assert st["event_counts"]["MEASUREMENT"] == 2
+    # registration request path (explicit metadata beats auto-register)
+    recv.submit(json.dumps(
+        {"deviceToken": "dev-B", "type": "RegisterDevice",
+         "request": {"deviceTypeToken": "mega2560", "areaToken": "peachtree"}}
+    ).encode())
+    info = engine.get_device("dev-B")
+    assert info is not None and info.device_type == "mega2560"
+    assert not info.auto_registered
+
+
+def test_socket_receiver_framings():
+    async def run():
+        engine = _mini_engine()
+        mgr = _wire(engine)
+        recv = SocketEventReceiver(framing="newline")
+        mgr.add_source(InboundEventSource("sock", JsonDeviceRequestDecoder(), [recv]))
+        await mgr.initialize()
+        await mgr.start()
+        try:
+            r, w = await asyncio.open_connection("127.0.0.1", recv.bound_port)
+            w.write(measurement_json("sock-1") + b"\n" + measurement_json("sock-2") + b"\n")
+            await w.drain()
+            w.close()
+            await asyncio.sleep(0.2)
+        finally:
+            await mgr.stop()
+        engine.flush()
+        assert engine.metrics()["registered"] == 2
+
+    asyncio.run(run())
+
+
+def test_websocket_receiver():
+    async def run():
+        engine = _mini_engine()
+        mgr = _wire(engine)
+        recv = WebSocketEventReceiver()
+        mgr.add_source(InboundEventSource("ws", JsonDeviceRequestDecoder(), [recv]))
+        await mgr.initialize()
+        await mgr.start()
+        try:
+            import websockets
+
+            async with websockets.connect(f"ws://127.0.0.1:{recv.bound_port}") as ws:
+                await ws.send(measurement_json("ws-1"))
+                await ws.send(measurement_json("ws-2").decode())  # text frame
+                await asyncio.sleep(0.2)
+        finally:
+            await mgr.stop()
+        engine.flush()
+        assert engine.metrics()["registered"] == 2
+
+    asyncio.run(run())
+
+
+def test_mqtt_broker_and_receiver():
+    from sitewhere_tpu.ingest.mqtt import MqttBroker, MqttClient, MqttEventReceiver
+
+    async def run():
+        broker = MqttBroker()
+        await broker.start()
+        engine = _mini_engine()
+        mgr = _wire(engine)
+        recv = MqttEventReceiver("127.0.0.1", broker.bound_port,
+                                 topic="sitewhere/input/#")
+        mgr.add_source(InboundEventSource("mqtt", JsonDeviceRequestDecoder(), [recv]))
+        await mgr.initialize()
+        await mgr.start()
+        try:
+            pub = MqttClient("127.0.0.1", broker.bound_port, "publisher")
+            await pub.connect()
+            await pub.publish("sitewhere/input/mq-1", measurement_json("mq-1"), qos=0)
+            await pub.publish("sitewhere/input/mq-2", measurement_json("mq-2"), qos=1)
+            await pub.publish("other/topic", measurement_json("mq-3"))  # not subscribed
+            await asyncio.sleep(0.3)
+            await pub.disconnect()
+        finally:
+            await mgr.stop()
+            await broker.stop()
+        engine.flush()
+        assert engine.metrics()["registered"] == 2  # mq-3 filtered by topic
+
+    asyncio.run(run())
+
+
+def test_coap_receiver_and_client():
+    from sitewhere_tpu.ingest.coap import (
+        CoapClient,
+        CoapServerEventReceiver,
+        CREATED,
+        POST,
+    )
+
+    async def run():
+        engine = _mini_engine()
+        mgr = _wire(engine)
+        recv = CoapServerEventReceiver()
+        mgr.add_source(InboundEventSource("coap", JsonDeviceRequestDecoder(), [recv]))
+        await mgr.initialize()
+        await mgr.start()
+        try:
+            client = CoapClient("127.0.0.1", recv.bound_port)
+            reply = await client.request(POST, ["events", "co-1"],
+                                         measurement_json("co-1"))
+            assert reply["code"] == CREATED
+            await asyncio.sleep(0.1)
+        finally:
+            await mgr.stop()
+        engine.flush()
+        assert engine.metrics()["registered"] == 1
+
+    asyncio.run(run())
