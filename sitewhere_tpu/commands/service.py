@@ -1,0 +1,152 @@
+"""Command delivery service: the downlink pipeline.
+
+End-to-end flow (reference SURVEY.md §3.4): an invocation is persisted as a
+COMMAND_INVOCATION event through the TPU pipeline (REST ->
+addDeviceCommandInvocations analog), the persistence fork exposes it on the
+outbound feed (outbound-command-invocations topic analog), and this service
+consumes the feed: processing strategy -> router -> destination(s), with
+failures pushed to the undelivered dead letter
+(CommandRoutingLogic.java:38-64, EnrichedCommandInvocationsPipeline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+
+from sitewhere_tpu.commands.destinations import CommandDestination, DeliveryError
+from sitewhere_tpu.commands.model import (
+    CommandInvocation,
+    SystemCommand,
+    next_invocation_id,
+)
+from sitewhere_tpu.commands.routing import (
+    CommandProcessingStrategy,
+    CommandRegistry,
+    CommandRouter,
+    NestedDeviceSupport,
+)
+from sitewhere_tpu.core.types import EventType
+from sitewhere_tpu.outbound.feed import FeedConsumer, OutboundEvent
+from sitewhere_tpu.utils.lifecycle import LifecycleComponent
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class UndeliveredCommand:
+    """Dead-letter record (undelivered-command-invocations topic analog)."""
+
+    invocation: CommandInvocation
+    destination_id: str
+    error: str
+
+
+class CommandDeliveryService(LifecycleComponent):
+    """Owns registry, strategy, router, destinations, and the feed consumer."""
+
+    def __init__(self, engine, router: CommandRouter,
+                 registry: CommandRegistry | None = None):
+        super().__init__("command-delivery")
+        self.engine = engine
+        self.registry = registry or CommandRegistry()
+        self.strategy = CommandProcessingStrategy(self.registry)
+        self.router = router
+        self.nested = NestedDeviceSupport(engine)
+        self.destinations: dict[str, CommandDestination] = {}
+        self.undelivered: list[UndeliveredCommand] = []
+        # pending invocations keyed by the engine event id lane (aux0)
+        self._pending: dict[int, CommandInvocation] = {}
+        self.consumer = FeedConsumer(engine, "command-delivery", start_from_latest=True)
+        self.delivered_count = 0
+
+    def add_destination(self, dest: CommandDestination) -> CommandDestination:
+        self.destinations[dest.destination_id] = dest
+        self.add_child(dest)
+        return dest
+
+    # ------------------------------------------------------------- invocation
+    def invoke(self, device_token: str, command_token: str,
+               parameters: dict | None = None, tenant: str = "default",
+               initiator: str = "REST", initiator_id: str = "") -> CommandInvocation:
+        """Create + persist a command invocation event (the REST-path entry:
+        Assignments controller -> addDeviceCommandInvocations analog).
+        Delivery happens when the persisted event surfaces on the feed."""
+        inv = CommandInvocation(
+            invocation_id=next_invocation_id(),
+            command_token=command_token,
+            device_token=device_token,
+            tenant=tenant,
+            parameter_values=parameters or {},
+            initiator=initiator,
+            initiator_id=initiator_id,
+            ts_ms=self.engine.epoch.now_ms(),
+        )
+        # validate early so bad invocations fail at the API surface
+        self.strategy.build_execution(inv)
+        self._pending[inv.invocation_id] = inv
+        # persist through the pipeline; aux0 carries the invocation id
+        from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+        with self.engine.lock:
+            token_id = self.engine.tokens.intern(device_token)
+            tenant_id = self.engine.tenants.intern(tenant)
+            now = self.engine.epoch.now_ms()
+            self.engine._stage(
+                EventType.COMMAND_INVOCATION, token_id, tenant_id, inv.ts_ms,
+                now, None, None, inv.invocation_id,
+                DecodedRequest(type=RequestType.ACKNOWLEDGE,
+                               device_token=device_token),
+            )
+        return inv
+
+    # ---------------------------------------------------------------- pumping
+    async def pump(self) -> int:
+        """Consume newly persisted invocation events and deliver them.
+        Returns the number of invocations processed."""
+        if self.engine.staged_count:
+            self.engine.flush()
+        events = self.consumer.poll()
+        n = 0
+        for ev in events:
+            if ev.etype is EventType.COMMAND_INVOCATION:
+                inv = self._pending.pop(ev.aux0, None)
+                if inv is not None:
+                    await self._route_and_deliver(inv)
+                    n += 1
+        self.consumer.commit(events)
+        return n
+
+    async def _route_and_deliver(self, inv: CommandInvocation) -> None:
+        execution = self.strategy.build_execution(inv)
+        target_token = self.nested.resolve_target_token(inv.device_token)
+        info = self.engine.get_device(target_token)
+        metadata = info.metadata if info else {}
+        dest_ids = self.router.destinations_for(execution)
+        for dest_id in dest_ids:
+            dest = self.destinations.get(dest_id)
+            if dest is None:
+                self.undelivered.append(
+                    UndeliveredCommand(inv, dest_id, "unknown destination")
+                )
+                continue
+            try:
+                await dest.deliver(execution, target_token, metadata)
+                self.delivered_count += 1
+            except DeliveryError as e:
+                logger.warning("delivery to %s failed: %s", dest_id, e)
+                self.undelivered.append(UndeliveredCommand(inv, dest_id, str(e)))
+
+    async def send_system_command(self, device_token: str, command: SystemCommand) -> None:
+        """Deliver a system command (e.g. RegistrationAck) immediately."""
+        info = self.engine.get_device(device_token)
+        metadata = info.metadata if info else {}
+        dtype = info.device_type if info else None
+        for dest_id in self.router.destinations_for_system(command, dtype):
+            dest = self.destinations.get(dest_id)
+            if dest is None:
+                continue
+            try:
+                await dest.deliver_system(command, device_token, metadata)
+            except DeliveryError as e:
+                logger.warning("system command to %s failed: %s", device_token, e)

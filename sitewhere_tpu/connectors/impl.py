@@ -1,0 +1,198 @@
+"""Concrete outbound connectors.
+
+The reference ships MQTT, RabbitMQ, Solr, HTTP (scripted URI/payload), AWS
+SQS, Azure EventHub, InitialState, dweet.io, and Groovy-scripted connectors
+(SURVEY.md §2.7, connectors/{mqtt,rabbitmq,solr,http,aws/sqs,azure,
+initialstate,dweetio,groovy}/). Here:
+
+  * Log / InMemory — debug + test sinks.
+  * Mqtt — publishes event JSON via the native MQTT client.
+  * Http — generic async POST with optional scripted URI/payload builders
+    (the HTTP connector's Groovy builder contract, as Python callables).
+    InitialState and dweet.io are thin presets of it.
+  * Scripted — arbitrary user callable per event.
+  * SearchIndex — feeds the embedded event search index (the Solr slot;
+    search/index.py) so event-search works without external Solr.
+
+RabbitMQ / SQS / EventHub have no reachable brokers in a zero-egress image
+and no SDKs baked in; they are explicit unavailable-by-config stubs that
+fail fast at construction with a clear message (matching our no-silent-gaps
+policy) rather than half-working lookalikes.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any, Callable
+
+from sitewhere_tpu.connectors.base import OutboundConnector, SerialOutboundConnector
+from sitewhere_tpu.outbound.feed import OutboundEvent
+
+logger = logging.getLogger(__name__)
+
+
+class LogConnector(OutboundConnector):
+    async def process_event(self, event: OutboundEvent) -> None:
+        logger.info("outbound event: %s", event.to_json_dict())
+
+
+class InMemoryConnector(OutboundConnector):
+    """Collects events (test/embedded sink)."""
+
+    def __init__(self, connector_id: str = "inmemory", filters=None):
+        super().__init__(connector_id, filters)
+        self.events: list[OutboundEvent] = []
+
+    async def process_event(self, event: OutboundEvent) -> None:
+        self.events.append(event)
+
+
+class MqttConnector(SerialOutboundConnector):
+    """Publish each event as JSON to a topic pattern (reference:
+    connectors/mqtt/MqttOutboundConnector)."""
+
+    def __init__(self, connector_id: str, host: str, port: int,
+                 topic_pattern: str = "sitewhere/outbound/{token}",
+                 qos: int = 0, filters=None):
+        super().__init__(connector_id, filters)
+        from sitewhere_tpu.ingest.mqtt import MqttClient
+
+        self.client = MqttClient(host, port, f"sw-connector-{connector_id}")
+        self.topic_pattern = topic_pattern
+        self.qos = qos
+        self._connected = False
+
+    async def process_event(self, event: OutboundEvent) -> None:
+        if not self._connected:
+            await self.client.connect()
+            self._connected = True
+        topic = self.topic_pattern.format(token=event.device_token,
+                                          type=event.etype.name)
+        await self.client.publish(topic, json.dumps(event.to_json_dict()).encode(),
+                                  self.qos)
+
+    async def on_stop(self) -> None:
+        if self._connected:
+            await self.client.disconnect()
+            self._connected = False
+
+
+UriBuilder = Callable[[OutboundEvent], str]
+PayloadBuilder = Callable[[OutboundEvent], bytes]
+
+
+class HttpConnector(SerialOutboundConnector):
+    """POST events to an HTTP endpoint with scripted URI/payload builders
+    (reference: connectors/http/* with Groovy uri-builder / payload-builder
+    script templates)."""
+
+    def __init__(self, connector_id: str, uri: str | UriBuilder,
+                 payload_builder: PayloadBuilder | None = None,
+                 headers: dict[str, str] | None = None, method: str = "POST",
+                 filters=None):
+        super().__init__(connector_id, filters)
+        self.uri = uri
+        self.payload_builder = payload_builder or (
+            lambda ev: json.dumps(ev.to_json_dict()).encode()
+        )
+        self.headers = {"Content-Type": "application/json", **(headers or {})}
+        self.method = method
+        self._session = None
+
+    async def _get_session(self):
+        if self._session is None:
+            import aiohttp
+
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def process_event(self, event: OutboundEvent) -> None:
+        session = await self._get_session()
+        uri = self.uri(event) if callable(self.uri) else self.uri
+        async with session.request(
+            self.method, uri, data=self.payload_builder(event), headers=self.headers
+        ) as resp:
+            if resp.status >= 300:
+                raise RuntimeError(f"http connector status {resp.status}")
+
+    async def on_stop(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+
+def initial_state_connector(connector_id: str, streaming_access_key: str,
+                            bucket_key: str, filters=None) -> HttpConnector:
+    """InitialState events API preset (reference: connectors/initialstate/)."""
+
+    def payload(ev: OutboundEvent) -> bytes:
+        items = [
+            {"key": name, "value": val, "epoch": ev.ts_ms / 1000.0}
+            for name, val in ev.measurements.items()
+        ]
+        return json.dumps(items).encode()
+
+    return HttpConnector(
+        connector_id,
+        "https://groker.init.st/api/events",
+        payload_builder=payload,
+        headers={"X-IS-AccessKey": streaming_access_key,
+                 "X-IS-BucketKey": bucket_key},
+        filters=filters,
+    )
+
+
+def dweet_connector(connector_id: str, thing_name_pattern: str = "{token}",
+                    filters=None) -> HttpConnector:
+    """dweet.io preset (reference: connectors/dweetio/)."""
+
+    def uri(ev: OutboundEvent) -> str:
+        return f"https://dweet.io/dweet/for/{thing_name_pattern.format(token=ev.device_token)}"
+
+    return HttpConnector(connector_id, uri, filters=filters)
+
+
+class ScriptedConnector(OutboundConnector):
+    """User Python callable per event (reference: connectors/groovy/
+    GroovyOutboundConnector + script templates)."""
+
+    def __init__(self, connector_id: str, fn: Callable[[OutboundEvent], Any],
+                 filters=None):
+        super().__init__(connector_id, filters)
+        self.fn = fn
+
+    async def process_event(self, event: OutboundEvent) -> None:
+        res = self.fn(event)
+        if hasattr(res, "__await__"):
+            await res
+
+
+class SearchIndexConnector(OutboundConnector):
+    """Index events into the embedded search service (the Solr connector
+    slot, connectors/solr/SolrOutboundConnector — see search/index.py)."""
+
+    def __init__(self, connector_id: str, index, filters=None):
+        super().__init__(connector_id, filters)
+        self.index = index
+
+    async def process_event(self, event: OutboundEvent) -> None:
+        self.index.add(event)
+
+
+def _unavailable(kind: str, needs: str):
+    class _Unavailable(OutboundConnector):
+        def __init__(self, *a, **kw):
+            raise RuntimeError(
+                f"{kind} connector requires {needs}, which is not available in "
+                f"this deployment image; configure an HttpConnector bridge or "
+                f"enable the dependency"
+            )
+
+    _Unavailable.__name__ = f"{kind}Connector"
+    return _Unavailable
+
+
+RabbitMqConnector = _unavailable("RabbitMq", "an AMQP client library/broker")
+SqsConnector = _unavailable("Sqs", "the AWS SDK and network egress")
+EventHubConnector = _unavailable("EventHub", "the Azure SDK and network egress")

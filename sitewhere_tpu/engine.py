@@ -173,6 +173,10 @@ class Engine:
         self.dead_letters: list[int] = []             # unregistered token ids
         self.outputs: list[dict] = []                 # recent step summaries
 
+    @property
+    def staged_count(self) -> int:
+        return len(self._buf)
+
     # ------------------------------------------------------------------ ingest
     def process(self, req: DecodedRequest) -> None:
         """Stage one decoded request; flushes when the batch fills."""
@@ -195,42 +199,41 @@ class Engine:
             token_id = self.tokens.intern(req.device_token)
             tenant_id = self.tenants.intern(req.tenant)
             values = np.zeros(self.config.channels, np.float32)
-            nch = 0
+            mask = np.zeros(self.config.channels, np.bool_)
             aux0 = NULL_ID
             if et is EventType.MEASUREMENT and req.measurements:
                 for name, val in req.measurements.items():
                     ch = self.channel_map.channel_of(name)
                     values[ch] = val
-                    nch = max(nch, ch + 1)
-                self._stage(et, token_id, tenant_id, ts, now, values, nch, aux0, req)
+                    mask[ch] = True
+                self._stage(et, token_id, tenant_id, ts, now, values, mask, aux0, req)
                 return
             if et is EventType.LOCATION:
                 values[0], values[1] = req.latitude or 0.0, req.longitude or 0.0
                 values[2] = req.elevation or 0.0
-                nch = 3
+                mask[:3] = True
             elif et is EventType.ALERT:
                 values[0] = float(int(req.alert_level))
-                nch = 1
+                mask[0] = True
                 aux0 = self.alert_types.intern(req.alert_type or "alert")
             elif et is EventType.COMMAND_RESPONSE and req.originating_event_id:
                 aux0 = self.event_ids.intern(req.originating_event_id)
-            self._stage(et, token_id, tenant_id, ts, now, values, nch, aux0, req)
+            self._stage(et, token_id, tenant_id, ts, now, values, mask, aux0, req)
 
-    def _stage(self, et, token_id, tenant_id, ts, now, values, nch, aux0, req):
+    def _stage(self, et, token_id, tenant_id, ts, now, values, mask, aux0, req):
         aux1 = (
             self.event_ids.intern(req.alternate_id)
             if req.alternate_id is not None
             else NULL_ID
         )
-        # channel mask is a prefix in HostEventBuffer; set values directly
         i = len(self._buf)
         if not self._buf.append(et, token_id, tenant_id, ts, now, (), aux0, aux1):
             self.flush()
             i = len(self._buf)
             self._buf.append(et, token_id, tenant_id, ts, now, (), aux0, aux1)
-        if nch:
+        if mask is not None and mask.any():
             self._buf.values[i, :] = values
-            self._buf.vmask[i, :nch] = True
+            self._buf.vmask[i, :] = mask
         if self._buf.full:
             self.flush()
 

@@ -232,12 +232,15 @@ class MqttBroker:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
+        # close live client connections BEFORE wait_closed(): in Python 3.12
+        # Server.wait_closed() blocks until every connection handler returns
         for w in list(self._subs):
             w.close()
         self._subs.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:

@@ -1,0 +1,62 @@
+"""Host readback of event-store ranges — the outbound-topic consumer primitive.
+
+In the reference, everything downstream of persistence (device-state,
+outbound connectors, command delivery) consumes Kafka topics fed by the
+persistence triggers (KafkaEventPersistenceTriggers.java:36-129). Here those
+consumers read ranges of the HBM ring store by absolute cursor — the same
+at-least-once, offset-committed contract as a Kafka consumer group, without
+the broker. ``read_range`` slices [start, start+count) (wrapping) into a
+host-visible struct; each consumer tracks its own committed offset
+(outbound/feed.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.store import EventStore
+
+
+class StoreSlice(NamedTuple):
+    etype: jax.Array
+    device: jax.Array
+    assignment: jax.Array
+    tenant: jax.Array
+    area: jax.Array
+    asset: jax.Array
+    ts_ms: jax.Array
+    received_ms: jax.Array
+    values: jax.Array
+    vmask: jax.Array
+    aux: jax.Array
+    valid: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=("count",))
+def read_range(store: EventStore, start: jax.Array, count: int) -> StoreSlice:
+    """Gather ``count`` rows beginning at absolute position ``start % S``."""
+    s = store.capacity
+    idx = (start + jnp.arange(count, dtype=jnp.int32)) % s
+    return StoreSlice(
+        etype=store.etype[idx],
+        device=store.device[idx],
+        assignment=store.assignment[idx],
+        tenant=store.tenant[idx],
+        area=store.area[idx],
+        asset=store.asset[idx],
+        ts_ms=store.ts_ms[idx],
+        received_ms=store.received_ms[idx],
+        values=store.values[idx],
+        vmask=store.vmask[idx],
+        aux=store.aux[idx],
+        valid=store.valid[idx],
+    )
+
+
+def absolute_cursor(store: EventStore) -> int:
+    """Total events ever written (epoch * capacity + cursor)."""
+    return int(store.epoch) * store.capacity + int(store.cursor)

@@ -1,0 +1,143 @@
+"""Outbound event feed: per-consumer cursor over the persisted event store.
+
+Plays the role of the reference's outbound-events / outbound-command-
+invocations topics plus consumer groups (OutboundPayloadEnrichmentLogic
+enriches and produces, KafkaOutboundConnectorHost consumes with its own
+group offset; SURVEY.md §2.3/§2.7). Each ``FeedConsumer`` owns a committed
+offset into the engine's event store; ``poll()`` returns newly persisted,
+context-enriched events as host records. Offsets commit after the handler
+batch succeeds — at-least-once, exactly like the reference's async offset
+commits (KafkaOutboundConnectorHost.java:156-163).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from sitewhere_tpu.core.types import NULL_ID, EventType
+from sitewhere_tpu.ops.readback import absolute_cursor, read_range
+
+
+@dataclasses.dataclass
+class OutboundEvent:
+    """Host-side enriched event record (GProcessedEventPayload analog)."""
+
+    event_id: int          # absolute store position (unique, ordered)
+    etype: EventType
+    device_token: str
+    device_id: int
+    assignment_id: int
+    tenant: str
+    area_id: int
+    asset_id: int
+    ts_ms: int
+    received_ms: int
+    measurements: dict[str, float]
+    values: list[float]
+    aux0: int
+    aux1: int
+
+    def to_json_dict(self) -> dict[str, Any]:
+        return {
+            "eventId": self.event_id,
+            "type": self.etype.name,
+            "deviceToken": self.device_token,
+            "assignmentId": self.assignment_id,
+            "tenant": self.tenant,
+            "areaId": self.area_id,
+            "assetId": self.asset_id,
+            "eventDateMs": self.ts_ms,
+            "receivedDateMs": self.received_ms,
+            "measurements": self.measurements,
+            "values": self.values,
+        }
+
+
+class FeedConsumer:
+    """One consumer group over the engine's event store."""
+
+    def __init__(self, engine, group_id: str, max_batch: int = 1024,
+                 start_from_latest: bool = False):
+        self.engine = engine
+        self.group_id = group_id
+        self.max_batch = max_batch
+        self.offset = (
+            absolute_cursor(engine.state.store) if start_from_latest else 0
+        )
+        self.lag_lost = 0  # events overwritten before we consumed them
+
+    def poll(self) -> list[OutboundEvent]:
+        """Fetch newly persisted events past the committed offset (does not
+        commit — call ``commit(events)`` after successful processing)."""
+        store = self.engine.state.store
+        head = absolute_cursor(store)
+        if head <= self.offset:
+            return []
+        # ring overwrite: oldest retained position is head - capacity
+        oldest = max(0, head - store.capacity)
+        if self.offset < oldest:
+            self.lag_lost += oldest - self.offset
+            self.offset = oldest
+        count = min(head - self.offset, self.max_batch)
+        sl = read_range(store, np.int32(self.offset % store.capacity), count)
+        return self._enrich(sl, self.offset, count)
+
+    def commit(self, events: list[OutboundEvent]) -> None:
+        if events:
+            self.offset = max(self.offset, events[-1].event_id + 1)
+
+    def _enrich(self, sl, base: int, count: int) -> list[OutboundEvent]:
+        eng = self.engine
+        etype = np.asarray(sl.etype[:count])
+        device = np.asarray(sl.device[:count])
+        assignment = np.asarray(sl.assignment[:count])
+        tenant = np.asarray(sl.tenant[:count])
+        area = np.asarray(sl.area[:count])
+        asset = np.asarray(sl.asset[:count])
+        ts = np.asarray(sl.ts_ms[:count])
+        recv = np.asarray(sl.received_ms[:count])
+        values = np.asarray(sl.values[:count])
+        vmask = np.asarray(sl.vmask[:count])
+        aux = np.asarray(sl.aux[:count])
+        valid = np.asarray(sl.valid[:count])
+
+        # channel -> representative name map (first interned name per lane)
+        lane_names: dict[int, str] = {}
+        for name, nid in eng.channel_map.names.items():
+            lane_names.setdefault(nid % eng.config.channels, name)
+
+        out = []
+        for i in range(count):
+            if not valid[i]:
+                continue
+            info = eng.devices.get(int(device[i]))
+            et = EventType(int(etype[i]))
+            meas = {}
+            if et is EventType.MEASUREMENT:
+                for ch in np.nonzero(vmask[i])[0]:
+                    meas[lane_names.get(int(ch), f"ch{ch}")] = float(values[i, ch])
+            out.append(
+                OutboundEvent(
+                    event_id=base + i,
+                    etype=et,
+                    device_token=info.token if info else f"#{int(device[i])}",
+                    device_id=int(device[i]),
+                    assignment_id=int(assignment[i]),
+                    tenant=(
+                        eng.tenants.token(int(tenant[i]))
+                        if int(tenant[i]) != NULL_ID else "default"
+                    ),
+                    area_id=int(area[i]),
+                    asset_id=int(asset[i]),
+                    ts_ms=int(ts[i]),
+                    received_ms=int(recv[i]),
+                    measurements=meas,
+                    values=[float(v) for v in values[i]],
+                    aux0=int(aux[i, 0]),
+                    aux1=int(aux[i, 1]),
+                )
+            )
+        return out

