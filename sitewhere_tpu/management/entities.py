@@ -1,0 +1,156 @@
+"""Generic entity store: token-addressed CRUD with paging and parent trees.
+
+The reference implements ~22 JPA entity classes and a 2,243-LoC CRUD facade
+(RdbDeviceManagement + device/persistence/rdb/entity/*; SURVEY.md §2.5) with
+the same shape per entity: create/getByToken/update/delete + paged list +
+parent-tree assembly (TreeBuilder). Here one generic, thread-safe,
+token-addressed store provides that shape; concrete managers
+(device_management.py, assets.py) declare their entity dataclasses and
+relations on top. Hot lookup columns stay on-device (core/registry.py) —
+these stores hold the host-side metadata the device tables don't carry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Any, Callable, Generic, Iterable, TypeVar
+
+T = TypeVar("T")
+
+
+class EntityNotFound(KeyError):
+    pass
+
+
+class DuplicateToken(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class SearchResults(Generic[T]):
+    """Paged results (reference: ISearchResults<T> used by every list API)."""
+
+    results: list[T]
+    total: int
+    page: int
+    page_size: int
+
+
+@dataclasses.dataclass
+class EntityMeta:
+    """Common audit columns (reference: every Rdb* entity carries
+    id/token/createdDate/updatedDate/metadata)."""
+
+    id: int
+    token: str
+    created_ms: float
+    updated_ms: float
+    metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class EntityStore(Generic[T]):
+    """Token-addressed CRUD store for one entity kind."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._lock = threading.RLock()
+        self._ids = itertools.count(1)
+        self._by_id: dict[int, T] = {}
+        self._by_token: dict[str, int] = {}
+
+    def create(self, token: str, build: Callable[[EntityMeta], T]) -> T:
+        with self._lock:
+            if token in self._by_token:
+                raise DuplicateToken(f"{self.kind} token {token!r} already exists")
+            now = time.time() * 1000
+            meta = EntityMeta(id=next(self._ids), token=token,
+                              created_ms=now, updated_ms=now)
+            entity = build(meta)
+            self._by_id[meta.id] = entity
+            self._by_token[token] = meta.id
+            return entity
+
+    def get(self, token: str) -> T:
+        with self._lock:
+            eid = self._by_token.get(token)
+            if eid is None:
+                raise EntityNotFound(f"{self.kind} {token!r} not found")
+            return self._by_id[eid]
+
+    def try_get(self, token: str) -> T | None:
+        try:
+            return self.get(token)
+        except EntityNotFound:
+            return None
+
+    def get_by_id(self, eid: int) -> T:
+        with self._lock:
+            if eid not in self._by_id:
+                raise EntityNotFound(f"{self.kind} id {eid} not found")
+            return self._by_id[eid]
+
+    def update(self, token: str, apply: Callable[[T], None]) -> T:
+        with self._lock:
+            entity = self.get(token)
+            apply(entity)
+            meta = getattr(entity, "meta", None)
+            if meta is not None:
+                meta.updated_ms = time.time() * 1000
+            return entity
+
+    def delete(self, token: str) -> T:
+        with self._lock:
+            eid = self._by_token.pop(token, None)
+            if eid is None:
+                raise EntityNotFound(f"{self.kind} {token!r} not found")
+            return self._by_id.pop(eid)
+
+    def list(
+        self,
+        page: int = 1,
+        page_size: int = 100,
+        where: Callable[[T], bool] | None = None,
+        sort_key: Callable[[T], Any] | None = None,
+    ) -> SearchResults[T]:
+        with self._lock:
+            items = list(self._by_id.values())
+        if where is not None:
+            items = [e for e in items if where(e)]
+        items.sort(key=sort_key or (lambda e: e.meta.id))
+        total = len(items)
+        lo = (page - 1) * page_size
+        return SearchResults(items[lo: lo + page_size], total, page, page_size)
+
+    def all(self) -> list[T]:
+        with self._lock:
+            return list(self._by_id.values())
+
+    def __len__(self) -> int:
+        return len(self._by_id)
+
+    def __contains__(self, token: str) -> bool:
+        return token in self._by_token
+
+
+@dataclasses.dataclass
+class TreeNode(Generic[T]):
+    entity: T
+    children: list["TreeNode[T]"] = dataclasses.field(default_factory=list)
+
+
+def build_tree(entities: Iterable[T],
+               parent_token_of: Callable[[T], str | None]) -> list[TreeNode[T]]:
+    """Assemble parent-linked entities into root trees (reference:
+    device/TreeBuilder.java used for area + customer hierarchies)."""
+    by_token = {e.meta.token: TreeNode(e) for e in entities}
+    roots: list[TreeNode[T]] = []
+    for node in by_token.values():
+        parent = parent_token_of(node.entity)
+        if parent and parent in by_token:
+            by_token[parent].children.append(node)
+        else:
+            roots.append(node)
+    return roots

@@ -1,0 +1,265 @@
+"""Schedule management: cron/interval-triggered jobs.
+
+Mirrors service-schedule-management (SURVEY.md §2.8): the reference runs a
+per-tenant Quartz scheduler (RAMJobStore, 5 threads;
+QuartzScheduleManager.java:40-121) over CRUD-backed schedules, with job
+types CommandInvocationJob and InvocationByDeviceCriteriaJob built by
+QuartzBuilder, and triggers kept in sync with schedule CRUD
+(ScheduleManagementTriggers). Quartz is replaced by an asyncio scheduler
+plus a dependency-free 5-field cron parser; "simple" triggers carry
+interval + repeat count.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import datetime
+import time
+from typing import Any, Callable
+
+from sitewhere_tpu.management.entities import EntityMeta, EntityStore
+
+# --- cron ---------------------------------------------------------------
+
+
+def _parse_field(spec: str, lo: int, hi: int) -> set[int]:
+    out: set[int] = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/", 1)
+            step = int(step_s)
+        if part in ("*", ""):
+            lo2, hi2 = lo, hi
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            lo2, hi2 = int(a), int(b)
+        else:
+            lo2 = hi2 = int(part)
+        if not (lo <= lo2 <= hi and lo <= hi2 <= hi):
+            raise ValueError(f"cron field {spec!r} out of range [{lo},{hi}]")
+        out.update(range(lo2, hi2 + 1, step))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class CronExpression:
+    """Standard 5-field cron: minute hour day-of-month month day-of-week."""
+
+    minutes: frozenset[int]
+    hours: frozenset[int]
+    days: frozenset[int]
+    months: frozenset[int]
+    weekdays: frozenset[int]  # 0=Monday (python convention)
+
+    @staticmethod
+    def parse(expr: str) -> "CronExpression":
+        fields = expr.split()
+        if len(fields) != 5:
+            raise ValueError(f"cron expression needs 5 fields: {expr!r}")
+        mi, h, dom, mo, dow = fields
+        return CronExpression(
+            minutes=frozenset(_parse_field(mi, 0, 59)),
+            hours=frozenset(_parse_field(h, 0, 23)),
+            days=frozenset(_parse_field(dom, 1, 31)),
+            months=frozenset(_parse_field(mo, 1, 12)),
+            # cron dow: 0(or 7)=Sunday..6=Saturday; python weekday(): 0=Monday
+            weekdays=frozenset(
+                (v - 1) % 7 for v in _parse_field(dow.replace("7", "0"), 0, 6)
+            ) if dow != "*" else frozenset(range(7)),
+        )
+
+    def matches(self, dt: datetime.datetime) -> bool:
+        return (
+            dt.minute in self.minutes
+            and dt.hour in self.hours
+            and dt.day in self.days
+            and dt.month in self.months
+            and dt.weekday() in self.weekdays
+        )
+
+    def next_fire(self, after: datetime.datetime) -> datetime.datetime:
+        """Next matching minute strictly after ``after`` (bounded scan)."""
+        dt = after.replace(second=0, microsecond=0) + datetime.timedelta(minutes=1)
+        for _ in range(366 * 24 * 60):
+            if self.matches(dt):
+                return dt
+            dt += datetime.timedelta(minutes=1)
+        raise ValueError("cron expression never fires")
+
+
+# --- schedules ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Schedule:
+    meta: EntityMeta
+    name: str
+    trigger_type: str                 # "Cron" | "Simple"
+    cron: str | None = None
+    interval_s: float | None = None
+    repeat_count: int = -1            # -1 = forever
+    start_ms: float | None = None
+    end_ms: float | None = None
+
+
+@dataclasses.dataclass
+class ScheduledJob:
+    meta: EntityMeta
+    schedule_token: str
+    job_type: str                     # "CommandInvocation" | "BatchCommandByCriteria"
+    configuration: dict[str, Any]
+    fired_count: int = 0
+    last_fired_ms: float | None = None
+    last_error: str | None = None
+
+
+class ScheduleManager:
+    """Schedule + job CRUD with an asyncio firing loop."""
+
+    def __init__(self):
+        self.schedules: EntityStore[Schedule] = EntityStore("schedule")
+        self.jobs: EntityStore[ScheduledJob] = EntityStore("scheduled-job")
+        self.executors: dict[str, Callable] = {}
+        self._task: asyncio.Task | None = None
+        self.tick_s = 1.0
+
+    # CRUD ----------------------------------------------------------------
+    def create_schedule(self, token: str, name: str, trigger_type: str,
+                        cron: str | None = None, interval_s: float | None = None,
+                        repeat_count: int = -1, start_ms: float | None = None,
+                        end_ms: float | None = None) -> Schedule:
+        if trigger_type == "Cron":
+            if not cron:
+                raise ValueError("Cron trigger requires a cron expression")
+            CronExpression.parse(cron)  # validate
+        elif trigger_type == "Simple":
+            if not interval_s or interval_s <= 0:
+                raise ValueError("Simple trigger requires a positive interval")
+        else:
+            raise ValueError(f"unknown trigger type {trigger_type!r}")
+        return self.schedules.create(
+            token,
+            lambda m: Schedule(meta=m, name=name, trigger_type=trigger_type,
+                               cron=cron, interval_s=interval_s,
+                               repeat_count=repeat_count, start_ms=start_ms,
+                               end_ms=end_ms),
+        )
+
+    def create_job(self, token: str, schedule_token: str, job_type: str,
+                   configuration: dict[str, Any]) -> ScheduledJob:
+        self.schedules.get(schedule_token)  # must exist
+        if job_type not in self.executors:
+            raise ValueError(f"no executor registered for job type {job_type!r}")
+        return self.jobs.create(
+            token,
+            lambda m: ScheduledJob(meta=m, schedule_token=schedule_token,
+                                   job_type=job_type, configuration=configuration),
+        )
+
+    def register_executor(self, job_type: str, fn: Callable) -> None:
+        """fn(job: ScheduledJob) -> awaitable or None."""
+        self.executors[job_type] = fn
+
+    # firing --------------------------------------------------------------
+    def _due(self, sched: Schedule, job: ScheduledJob, now_ms: float) -> bool:
+        if sched.start_ms is not None and now_ms < sched.start_ms:
+            return False
+        if sched.end_ms is not None and now_ms > sched.end_ms:
+            return False
+        if sched.trigger_type == "Simple":
+            if 0 <= sched.repeat_count < job.fired_count:
+                return False
+            last = job.last_fired_ms if job.last_fired_ms is not None else -1e18
+            return now_ms - last >= sched.interval_s * 1000
+        # Cron: fire when entering a matching minute
+        expr = CronExpression.parse(sched.cron)
+        dt = datetime.datetime.fromtimestamp(now_ms / 1000)
+        if not expr.matches(dt):
+            return False
+        last = job.last_fired_ms
+        return last is None or (now_ms - last) >= 60_000
+
+    async def fire_due(self, now_ms: float | None = None) -> int:
+        """Fire all due jobs once; returns count fired. Exposed separately
+        from the loop so tests and embedded hosts can drive time."""
+        now_ms = now_ms if now_ms is not None else time.time() * 1000
+        fired = 0
+        for job in self.jobs.all():
+            sched = self.schedules.try_get(job.schedule_token)
+            if sched is None:
+                continue
+            if not self._due(sched, job, now_ms):
+                continue
+            job.fired_count += 1
+            job.last_fired_ms = now_ms
+            try:
+                res = self.executors[job.job_type](job)
+                if asyncio.iscoroutine(res):
+                    await res
+                job.last_error = None
+            except Exception as e:
+                job.last_error = str(e)
+            fired += 1
+        return fired
+
+    async def _loop(self) -> None:
+        while True:
+            await self.fire_due()
+            await asyncio.sleep(self.tick_s)
+
+    async def start(self) -> None:
+        self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+def command_invocation_executor(command_service):
+    """Executor for CommandInvocation jobs (reference:
+    schedule/jobs/CommandInvocationJob.java): config carries deviceToken,
+    commandToken, parameterValues."""
+
+    async def execute(job: ScheduledJob) -> None:
+        cfg = job.configuration
+        command_service.invoke(
+            cfg["deviceToken"], cfg["commandToken"],
+            cfg.get("parameterValues", {}),
+            initiator="Scheduler", initiator_id=job.meta.token,
+        )
+        await command_service.pump()
+
+    return execute
+
+
+def batch_command_by_criteria_executor(device_management, batch_manager):
+    """Executor for InvocationByDeviceCriteriaJob (reference:
+    schedule/jobs/InvocationByDeviceCriteriaJob.java): select devices by
+    device type, then run a batch command invocation."""
+
+    async def execute(job: ScheduledJob) -> None:
+        cfg = job.configuration
+        devices = [
+            s.token
+            for s in device_management.list_devices(
+                page_size=1_000_000, device_type=cfg["deviceTypeToken"]
+            ).results
+        ]
+        if not devices:
+            return
+        token = f"{job.meta.token}-{job.fired_count}"
+        batch_manager.create_operation(
+            token, "InvokeCommand", devices,
+            {"commandToken": cfg["commandToken"],
+             "parameterValues": cfg.get("parameterValues", {})},
+        )
+        await batch_manager.process_operation(token)
+
+    return execute

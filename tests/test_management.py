@@ -1,0 +1,272 @@
+"""Tests for the management services: device-management breadth, assets,
+batch operations, scheduling, labels (QR), and device streams."""
+
+import asyncio
+import datetime
+
+import pytest
+
+from sitewhere_tpu.commands.destinations import (
+    CommandDestination,
+    LocalDeliveryProvider,
+    mqtt_topic_extractor,
+)
+from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
+from sitewhere_tpu.commands.model import DeviceCommand
+from sitewhere_tpu.commands.routing import SingleChoiceCommandRouter
+from sitewhere_tpu.commands.service import CommandDeliveryService
+from sitewhere_tpu.core.types import BatchElementStatus
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.management.assets import AssetManagement
+from sitewhere_tpu.management.batch import (
+    BatchCommandInvocationHandler,
+    BatchOperationManager,
+)
+from sitewhere_tpu.management.device_management import AlarmState, DeviceManagement
+from sitewhere_tpu.management.entities import DuplicateToken, EntityNotFound
+from sitewhere_tpu.management.schedule import (
+    CronExpression,
+    ScheduleManager,
+    command_invocation_executor,
+)
+from sitewhere_tpu.management.streams import DeviceStreamManager
+
+
+def _engine():
+    return Engine(EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=4096, batch_capacity=16, channels=4,
+    ))
+
+
+@pytest.fixture
+def dm():
+    return DeviceManagement(_engine())
+
+
+def test_device_type_and_device_crud(dm):
+    dm.create_device_type("thermostat", "Thermostat")
+    summary = dm.create_device("d-1", "thermostat")
+    assert summary.device_type == "thermostat"
+    with pytest.raises(EntityNotFound):
+        dm.create_device("d-2", "no-such-type")
+    with pytest.raises(DuplicateToken):
+        dm.create_device_type("thermostat", "Again")
+    res = dm.list_devices(device_type="thermostat")
+    assert res.total == 1 and res.results[0].token == "d-1"
+    assert dm.delete_device("d-1")
+
+
+def test_area_customer_zone_hierarchy(dm):
+    dm.create_area_type("region", "Region", contained_area_types=["site"])
+    dm.create_area_type("site", "Site")
+    dm.create_area("southeast", "region", "Southeast")
+    dm.create_area("atlanta", "site", "Atlanta", parent_token="southeast")
+    with pytest.raises(ValueError, match="cannot contain"):
+        dm.create_area("nested-region", "region", "Bad", parent_token="southeast")
+    tree = dm.area_tree()
+    assert len(tree) == 1 and tree[0].entity.meta.token == "southeast"
+    assert tree[0].children[0].entity.meta.token == "atlanta"
+
+    dm.create_zone("z-1", "atlanta", "Loading dock",
+                   bounds=[(33.7, -84.4), (33.8, -84.4), (33.8, -84.3)])
+    with pytest.raises(ValueError, match="3 vertices"):
+        dm.create_zone("z-2", "atlanta", "Bad", bounds=[(0, 0), (1, 1)])
+    assert len(dm.zones_for_area("atlanta")) == 1
+
+    dm.create_customer_type("org", "Organization")
+    dm.create_customer("acme", "org", "ACME")
+    dm.create_customer("acme-south", "org", "ACME South", parent_token="acme")
+    ctree = dm.customer_tree()
+    assert ctree[0].entity.name == "ACME"
+    assert ctree[0].children[0].entity.name == "ACME South"
+
+
+def test_statuses_and_alarms(dm):
+    dm.create_device_type("pump", "Pump")
+    dm.create_device("p-1", "pump")
+    dm.create_device_status("s-ok", "pump", "ok", "OK")
+    dm.create_device_status("s-fault", "pump", "fault", "Fault",
+                            background_color="#ff0000")
+    assert {s.code for s in dm.statuses_for_type("pump")} == {"ok", "fault"}
+
+    alarm = dm.create_alarm("a-1", "p-1", "Pressure exceeded")
+    assert alarm.state is AlarmState.TRIGGERED
+    assert dm.acknowledge_alarm("a-1").state is AlarmState.ACKNOWLEDGED
+    assert dm.resolve_alarm("a-1").state is AlarmState.RESOLVED
+    assert len(dm.alarms_for_device("p-1")) == 1
+    with pytest.raises(EntityNotFound):
+        dm.create_alarm("a-2", "ghost", "no device")
+
+
+def test_device_groups_and_expansion(dm):
+    for t in ("g-1", "g-2"):
+        pass
+    dm.create_device("d-1", "default")
+    dm.create_device("d-2", "default")
+    dm.create_device("d-3", "default")
+    dm.create_group("all", "All devices", roles=["monitor"])
+    dm.create_group("subset", "Subset")
+    dm.add_group_elements("subset", [{"device": "d-3", "roles": ["leaf"]}])
+    dm.add_group_elements("all", [
+        {"device": "d-1", "roles": ["primary"]},
+        {"device": "d-2"},
+        {"group": "subset"},
+    ])
+    assert dm.expand_group_devices("all") == ["d-1", "d-2", "d-3"]
+    assert dm.expand_group_devices("all", roles=["primary"]) == ["d-1"]
+    with pytest.raises(ValueError, match="exactly one"):
+        dm.add_group_elements("all", [{"device": "d-1", "group": "subset"}])
+    els = dm.group_elements("all")
+    assert dm.remove_group_element("all", els[0].element_id)
+    assert len(dm.group_elements("all")) == 2
+
+
+def test_asset_management():
+    am = AssetManagement()
+    am.create_asset_type("truck", "Delivery truck")
+    am.create_asset("truck-17", "truck", "Truck 17")
+    with pytest.raises(EntityNotFound):
+        am.create_asset("x", "no-type", "X")
+    res = am.list_assets(asset_type="truck")
+    assert res.total == 1 and res.results[0].name == "Truck 17"
+
+
+def _command_stack(engine):
+    svc = CommandDeliveryService(engine, SingleChoiceCommandRouter("local"))
+    svc.registry.create(DeviceCommand(token="ping", device_type="default", name="ping"))
+    provider = LocalDeliveryProvider()
+    svc.add_destination(CommandDestination(
+        "local", mqtt_topic_extractor(), JsonCommandExecutionEncoder(), provider,
+    ))
+    return svc, provider
+
+
+def test_batch_command_invocation():
+    engine = _engine()
+    for i in range(5):
+        engine.register_device(f"b-{i}")
+    svc, provider = _command_stack(engine)
+    mgr = BatchOperationManager(concurrency=3)
+    mgr.register_handler(BatchCommandInvocationHandler(svc))
+    op = mgr.create_operation("op-1", "InvokeCommand",
+                              [f"b-{i}" for i in range(5)],
+                              {"commandToken": "ping"})
+    op = asyncio.run(mgr.process_operation("op-1"))
+    assert op.status == "Finished"
+    assert op.counts()["SUCCEEDED"] == 5
+    assert len(provider.delivered) == 5
+    assert all(el.response_metadata["invocationId"] for el in op.elements)
+
+
+def test_batch_failure_tracking():
+    engine = _engine()
+    engine.register_device("ok-1")
+    svc, provider = _command_stack(engine)
+    mgr = BatchOperationManager()
+    mgr.register_handler(BatchCommandInvocationHandler(svc))
+    # 'ghost' device: invoke() validates command, but delivery goes to a
+    # failing provider -> simulate handler failure with unknown command
+    op = mgr.create_operation("op-2", "InvokeCommand", ["ok-1", "ghost"],
+                              {"commandToken": "nope"})
+    op = asyncio.run(mgr.process_operation("op-2"))
+    assert op.counts()["FAILED"] == 2
+    assert len(mgr.failed_elements) == 2
+    with pytest.raises(ValueError, match="no handler"):
+        mgr.create_operation("op-3", "Unknown", ["ok-1"])
+
+
+def test_cron_expression():
+    c = CronExpression.parse("*/15 3 * * *")
+    assert c.matches(datetime.datetime(2026, 7, 29, 3, 45))
+    assert not c.matches(datetime.datetime(2026, 7, 29, 4, 0))
+    nxt = c.next_fire(datetime.datetime(2026, 7, 29, 3, 46))
+    assert nxt == datetime.datetime(2026, 7, 30, 3, 0)
+    c2 = CronExpression.parse("0 9 * * 1-5")  # weekdays 9am
+    assert c2.matches(datetime.datetime(2026, 7, 29, 9, 0))   # Wednesday
+    assert not c2.matches(datetime.datetime(2026, 8, 1, 9, 0))  # Saturday
+    with pytest.raises(ValueError):
+        CronExpression.parse("61 * * * *")
+    with pytest.raises(ValueError):
+        CronExpression.parse("* * *")
+
+
+def test_schedule_manager_fires_jobs():
+    engine = _engine()
+    engine.register_device("sched-1")
+    svc, provider = _command_stack(engine)
+    sm = ScheduleManager()
+    sm.register_executor("CommandInvocation", command_invocation_executor(svc))
+    sm.create_schedule("every-sec", "Every second", "Simple", interval_s=0.01,
+                       repeat_count=1)
+    sm.create_job("job-1", "every-sec", "CommandInvocation",
+                  {"deviceToken": "sched-1", "commandToken": "ping"})
+
+    async def run():
+        now = 1_000_000.0
+        n1 = await sm.fire_due(now)
+        n2 = await sm.fire_due(now + 5)       # too soon
+        n3 = await sm.fire_due(now + 20)      # second (last) allowed fire
+        n4 = await sm.fire_due(now + 40)      # repeat count exhausted
+        return n1, n2, n3, n4
+
+    n1, n2, n3, n4 = asyncio.run(run())
+    assert (n1, n2, n3, n4) == (1, 0, 1, 0)
+    assert len(provider.delivered) == 2
+    job = sm.jobs.get("job-1")
+    assert job.fired_count == 2 and job.last_error is None
+
+    with pytest.raises(ValueError, match="cron"):
+        sm.create_schedule("bad", "Bad", "Cron")
+    with pytest.raises(ValueError, match="no executor"):
+        sm.create_job("job-2", "every-sec", "Unknown", {})
+
+
+def test_qr_code_structure():
+    from sitewhere_tpu.labels.qrcode import qr_matrix, qr_png
+
+    M = qr_matrix("sitewhere://tpu/device/dev-123")
+    size = len(M)
+    assert size in (21 + 4 * v for v in range(10))
+    # finder patterns present at three corners
+    for r0, c0 in ((0, 0), (0, size - 7), (size - 7, 0)):
+        assert M[r0][c0] == 1 and M[r0 + 3][c0 + 3] == 1
+        assert M[r0 + 1][c0 + 1] == 0
+    # timing pattern alternates
+    assert [M[6][i] for i in range(8, 12)] == [1, 0, 1, 0]
+    # dark module
+    assert M[size - 8][8] == 1
+    # all cells assigned
+    assert all(v in (0, 1) for row in M for v in row)
+    png = qr_png("short", scale=2, border=1)
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    # larger payloads pick larger versions
+    M2 = qr_matrix("x" * 100)
+    assert len(M2) > size
+
+
+def test_label_manager():
+    from sitewhere_tpu.labels.manager import LabelGeneratorManager
+
+    mgr = LabelGeneratorManager()
+    gen = mgr.get("qrcode")
+    png = gen.device_label("dev-1")
+    assert png[:8] == b"\x89PNG\r\n\x1a\n"
+    assert mgr.list_generators() == [{"id": "qrcode", "name": "QR Code Generator"}]
+    with pytest.raises(KeyError):
+        mgr.get("missing")
+
+
+def test_device_streams():
+    sm = DeviceStreamManager()
+    sm.create_stream("video-1", "cam-1", "video/h264")
+    sm.append_chunk("video-1", 2, b"BBB")
+    sm.append_chunk("video-1", 1, b"AAA")
+    sm.append_chunk("video-1", 3, b"CCC")
+    assert sm.get_chunk("video-1", 2) == b"BBB"
+    assert sm.get_chunk("video-1", 9) is None
+    assert sm.read_all("video-1") == b"AAABBBCCC"
+    stream = sm.streams.get("video-1")
+    assert stream.chunk_count == 3 and stream.total_bytes == 9
+    with pytest.raises(EntityNotFound):
+        sm.append_chunk("ghost", 1, b"x")
