@@ -395,6 +395,75 @@ class Engine:
                 },
             }
 
+    def query_events(
+        self,
+        device_token: str | None = None,
+        etype: EventType | None = None,
+        tenant: str | None = None,
+        since_ms: int | None = None,
+        until_ms: int | None = None,
+        limit: int = 100,
+    ) -> dict:
+        """Filtered, newest-first event query over the HBM ring store — the
+        REST listDeviceEvents/searchDeviceEvents surface (TPU-side scan,
+        only the top rows travel to the host)."""
+        from sitewhere_tpu.ops.query import query_store
+
+        with self.lock:
+            if len(self._buf):
+                self.flush()
+            dev = NULL_ID
+            if device_token is not None:
+                tid = self.tokens.lookup(device_token)
+                dev = self.token_device.get(tid, NULL_ID)
+                if dev == NULL_ID:
+                    return {"total": 0, "events": []}
+            ten = self.tenants.lookup(tenant) if tenant is not None else NULL_ID
+            imin, imax = -(2**31), 2**31 - 1
+            res = query_store(
+                self.state.store,
+                jnp.int32(dev),
+                jnp.int32(int(etype) if etype is not None else NULL_ID),
+                jnp.int32(ten),
+                jnp.int32(since_ms if since_ms is not None else imin),
+                jnp.int32(until_ms if until_ms is not None else imax),
+                limit=limit,
+            )
+            n = int(res.n)
+            lane_names: dict[int, str] = {}
+            for name, nid in self.channel_map.names.items():
+                lane_names.setdefault(nid % self.config.channels, name)
+            events = []
+            vmask = np.asarray(res.vmask[:n])
+            values = np.asarray(res.values[:n])
+            for i in range(n):
+                et = EventType(int(res.etype[i]))
+                info = self.devices.get(int(res.device[i]))
+                ev = {
+                    "type": et.name,
+                    "deviceToken": info.token if info else None,
+                    "assignmentId": int(res.assignment[i]),
+                    "eventDateMs": int(res.ts_ms[i]),
+                    "receivedDateMs": int(res.received_ms[i]),
+                }
+                if et is EventType.MEASUREMENT:
+                    ev["measurements"] = {
+                        lane_names.get(int(c), f"ch{c}"): float(values[i, c])
+                        for c in np.nonzero(vmask[i])[0]
+                    }
+                elif et is EventType.LOCATION:
+                    ev["latitude"], ev["longitude"], ev["elevation"] = (
+                        float(values[i, 0]), float(values[i, 1]), float(values[i, 2])
+                    )
+                elif et is EventType.ALERT:
+                    ev["level"] = int(values[i, 0])
+                    atype = int(res.aux[i, 0])
+                    ev["alertType"] = (
+                        self.alert_types.token(atype) if 0 <= atype < len(self.alert_types) else None
+                    )
+                events.append(ev)
+            return {"total": int(res.total), "events": events}
+
     def presence_sweep(self) -> list[str]:
         """Mark stale devices MISSING; returns their tokens (notification
         hook — PresenceNotificationStrategies.SendOnce analog)."""

@@ -1,0 +1,133 @@
+"""SiteWhereTpuInstance: the composition root — one object wiring every
+service the reference deploys as 15 microservices (SURVEY.md §2 inventory):
+engine (ingest pipeline + device state + event store), device/asset
+management, command delivery, outbound connectors, batch operations,
+scheduling, labels, streams, event search, users/tenants/JWT, and the REST
+gateway (web/rest.py). The reference's per-service k8s topology collapses
+into one TPU-resident engine plus host services sharing it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from sitewhere_tpu.commands.routing import CommandRegistry, SingleChoiceCommandRouter
+from sitewhere_tpu.commands.service import CommandDeliveryService
+from sitewhere_tpu.connectors.base import ConnectorHost, OutboundConnector
+from sitewhere_tpu.connectors.impl import SearchIndexConnector
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ingest.sources import EventSourcesManager, InboundEventSource
+from sitewhere_tpu.instance.auth import JwtService, UserManagement
+from sitewhere_tpu.instance.tenants import TenantManagement
+from sitewhere_tpu.labels.manager import LabelGeneratorManager
+from sitewhere_tpu.management.assets import AssetManagement
+from sitewhere_tpu.management.batch import (
+    BatchCommandInvocationHandler,
+    BatchOperationManager,
+)
+from sitewhere_tpu.management.device_management import DeviceManagement
+from sitewhere_tpu.management.schedule import (
+    ScheduleManager,
+    batch_command_by_criteria_executor,
+    command_invocation_executor,
+)
+from sitewhere_tpu.management.streams import DeviceStreamManager
+from sitewhere_tpu.search.index import EventSearchIndex, SearchProviderManager
+from sitewhere_tpu.utils.lifecycle import LifecycleComponent
+
+
+@dataclasses.dataclass
+class InstanceConfig:
+    instance_id: str = "sitewhere-tpu"
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    jwt_expiration_s: int = 60 * 60 * 24
+    admin_username: str = "admin"
+    admin_password: str = "password"
+    index_events: bool = True
+
+
+class SiteWhereTpuInstance(LifecycleComponent):
+    def __init__(self, config: InstanceConfig | None = None):
+        super().__init__("sitewhere-tpu-instance")
+        self.config = config or InstanceConfig()
+        self.engine = Engine(self.config.engine)
+
+        # ingest edge
+        self.event_sources = EventSourcesManager(
+            on_event_request=self.engine.process,
+            on_registration_request=self.engine.process,
+        )
+        self.add_child(self.event_sources)
+
+        # management services
+        self.device_management = DeviceManagement(self.engine)
+        self.assets = AssetManagement()
+        self.streams = DeviceStreamManager()
+        self.labels = LabelGeneratorManager()
+
+        # downlink
+        self.command_registry = CommandRegistry()
+        self.commands = CommandDeliveryService(
+            self.engine, SingleChoiceCommandRouter("default"),
+            self.command_registry,
+        )
+        self.add_child(self.commands)
+
+        # batch + scheduling
+        self.batch = BatchOperationManager()
+        self.batch.register_handler(BatchCommandInvocationHandler(self.commands))
+        self.scheduler = ScheduleManager()
+        self.scheduler.register_executor(
+            "CommandInvocation", command_invocation_executor(self.commands)
+        )
+        self.scheduler.register_executor(
+            "BatchCommandByCriteria",
+            batch_command_by_criteria_executor(self.device_management, self.batch),
+        )
+
+        # search
+        self.search = SearchProviderManager()
+        self.search_index = EventSearchIndex()
+        self.search.add_provider("embedded", self.search_index)
+        self.connector_hosts: list[ConnectorHost] = []
+        if self.config.index_events:
+            self.add_connector(SearchIndexConnector("search-index", self.search_index))
+
+        # auth + tenants
+        self.users = UserManagement()
+        self.users.create_user(self.config.admin_username,
+                               self.config.admin_password, roles=["admin"])
+        self.jwt = JwtService(expiration_s=self.config.jwt_expiration_s,
+                              issuer=self.config.instance_id)
+        self.tenants = TenantManagement(self.engine, self.device_management)
+        self.tenants.create_tenant("default", "Default Tenant")
+
+    # --- wiring helpers ---------------------------------------------------
+    def add_source(self, source: InboundEventSource) -> InboundEventSource:
+        return self.event_sources.add_source(source)
+
+    def add_connector(self, connector: OutboundConnector,
+                      start_from_latest: bool = False) -> ConnectorHost:
+        host = ConnectorHost(self.engine, connector,
+                             start_from_latest=start_from_latest)
+        self.connector_hosts.append(host)
+        self.add_child(host)
+        return host
+
+    async def pump_outbound(self) -> int:
+        """Drive command delivery + all connector hosts once (embedded mode;
+        under the REST server these run as background tasks)."""
+        n = await self.commands.pump()
+        for host in self.connector_hosts:
+            n += await host.pump()
+        return n
+
+    def info(self) -> dict:
+        return {
+            "instanceId": self.config.instance_id,
+            "version": __import__("sitewhere_tpu").__version__,
+            "devices": len(self.engine.devices),
+            "tenants": len(self.tenants.tenants),
+            "metrics": self.engine.metrics(),
+            "components": self.describe(),
+        }

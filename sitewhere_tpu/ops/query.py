@@ -1,0 +1,72 @@
+"""Device-side event store queries: filtered scan + top-k by time.
+
+The reference's event queries (listDeviceEvents / searchDeviceEvents REST
+paths backed by InfluxDB/Cassandra per-tenant queries) become a masked scan
+over the HBM ring with an on-device sort — the whole store is filtered in
+one XLA program and only the top-``limit`` rows travel to the host.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.core.store import EventStore
+from sitewhere_tpu.core.types import NULL_ID
+from sitewhere_tpu.ops.segment import lex_argsort
+
+
+class QueryResult(NamedTuple):
+    n: jax.Array        # int32[] matches (capped at limit)
+    total: jax.Array    # int32[] total matches in store
+    etype: jax.Array    # int32[limit]
+    device: jax.Array
+    assignment: jax.Array
+    tenant: jax.Array
+    area: jax.Array
+    ts_ms: jax.Array
+    received_ms: jax.Array
+    values: jax.Array   # float32[limit, C]
+    vmask: jax.Array
+    aux: jax.Array
+
+
+@functools.partial(jax.jit, static_argnames=("limit",))
+def query_store(
+    store: EventStore,
+    device: jax.Array,   # int32[] filter (NULL_ID = any)
+    etype: jax.Array,    # int32[] filter (NULL_ID = any)
+    tenant: jax.Array,   # int32[] filter (NULL_ID = any)
+    t0: jax.Array,       # int32[] inclusive lower ts bound
+    t1: jax.Array,       # int32[] inclusive upper ts bound
+    limit: int = 100,
+) -> QueryResult:
+    """Newest-first filtered query over the whole ring."""
+    m = store.valid
+    m &= (device == NULL_ID) | (store.device == device)
+    m &= (etype == NULL_ID) | (store.etype == etype)
+    m &= (tenant == NULL_ID) | (store.tenant == tenant)
+    m &= (store.ts_ms >= t0) & (store.ts_ms <= t1)
+    total = jnp.sum(m.astype(jnp.int32))
+    # sort newest first: key = (-match, -ts)
+    neg_ts = -jnp.maximum(store.ts_ms, jnp.iinfo(jnp.int32).min + 1)
+    _, perm = lex_argsort([(~m).astype(jnp.int32), neg_ts])
+    top = perm[:limit]
+    n = jnp.minimum(total, limit)
+    return QueryResult(
+        n=n,
+        total=total,
+        etype=store.etype[top],
+        device=store.device[top],
+        assignment=store.assignment[top],
+        tenant=store.tenant[top],
+        area=store.area[top],
+        ts_ms=store.ts_ms[top],
+        received_ms=store.received_ms[top],
+        values=store.values[top],
+        vmask=store.vmask[top],
+        aux=store.aux[top],
+    )

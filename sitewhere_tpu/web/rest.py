@@ -1,0 +1,645 @@
+"""REST gateway: the external API surface (aiohttp).
+
+Mirrors the reference's API layer (SURVEY.md §1-L5): instance-management
+hosts 25 JAX-RS controllers (service-instance-management/.../web/rest/
+controllers/, 7,639 LoC) with JWT auth (JwtAuthForApi + BasicAuthForJwt),
+CORS (web/CorsFilter.java), and per-tenant auth headers
+(X-SiteWhere-Tenant-Id / X-SiteWhere-Tenant-Auth). Routes here cover the
+same resource families: auth, devices, device types/statuses/alarms,
+events, device states, command invocations, areas/types/zones,
+customers/types, device groups, assets/types, batch operations, schedules/
+jobs, labels, search, streams, tenants, users, and instance info.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import json
+from typing import Any
+
+from aiohttp import web
+
+from sitewhere_tpu.commands.model import CommandParameter, DeviceCommand, ParameterType
+from sitewhere_tpu.core.types import EventType
+from sitewhere_tpu.ingest.decoders import request_from_envelope
+from sitewhere_tpu.ingest.requests import EventDecodeException
+from sitewhere_tpu.instance.auth import AUTH_ADMIN, AuthenticationError, JwtError
+from sitewhere_tpu.instance.instance import SiteWhereTpuInstance
+from sitewhere_tpu.management.entities import DuplicateToken, EntityNotFound
+
+JSON = "application/json"
+
+
+def _dumps(obj) -> str:
+    import enum as _enum
+
+    def default(o):
+        if isinstance(o, _enum.Enum):
+            return o.value if isinstance(o.value, (str, int)) else o.name
+        return str(o)
+
+    return json.dumps(obj, default=default)
+
+
+def json_response(data=None, *, status: int = 200, headers=None) -> web.Response:
+    return web.json_response(data, status=status, headers=headers, dumps=_dumps)
+PUBLIC_PATHS = ("/api/authapi/jwt", "/api/instance/health")
+
+
+def _meta_dict(meta) -> dict:
+    return {"token": meta.token, "id": meta.id, "createdDateMs": meta.created_ms,
+            "updatedDateMs": meta.updated_ms, "metadata": meta.metadata}
+
+
+def _entity(obj, **extra) -> dict:
+    out = dataclasses.asdict(obj)
+    meta = out.pop("meta", None)
+    if meta:
+        out.update({"token": meta["token"], "createdDateMs": meta["created_ms"],
+                    "updatedDateMs": meta["updated_ms"]})
+    out.update(extra)
+    return out
+
+
+def _paged(res) -> dict:
+    return {
+        "numResults": res.total,
+        "page": res.page,
+        "pageSize": res.page_size,
+        "results": [(_entity(e) if hasattr(e, "meta") else dataclasses.asdict(e))
+                    for e in res.results],
+    }
+
+
+@web.middleware
+async def cors_middleware(request: web.Request, handler):
+    if request.method == "OPTIONS":
+        resp = web.Response()
+    else:
+        resp = await handler(request)
+    resp.headers["Access-Control-Allow-Origin"] = "*"
+    resp.headers["Access-Control-Allow-Methods"] = "GET,POST,PUT,DELETE,OPTIONS"
+    resp.headers["Access-Control-Allow-Headers"] = (
+        "Authorization,Content-Type,X-SiteWhere-Tenant-Id,X-SiteWhere-Tenant-Auth"
+    )
+    return resp
+
+
+def make_app(instance: SiteWhereTpuInstance) -> web.Application:
+    inst = instance
+
+    @web.middleware
+    async def auth_middleware(request: web.Request, handler):
+        if request.method == "OPTIONS" or any(
+            request.path.startswith(p) for p in PUBLIC_PATHS
+        ):
+            return await handler(request)
+        header = request.headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            return json_response({"error": "missing bearer token"}, status=401)
+        try:
+            claims = inst.jwt.validate(header[7:])
+        except JwtError as e:
+            return json_response({"error": str(e)}, status=401)
+        request["user"] = claims["sub"]
+        request["authorities"] = claims.get("auth", [])
+        # tenant-scoped calls check the tenant auth headers like the
+        # reference's tenant filters
+        tenant = request.headers.get("X-SiteWhere-Tenant-Id")
+        if tenant is not None:
+            t = inst.tenants.tenants.try_get(tenant)
+            if t is None:
+                return json_response({"error": "unknown tenant"}, status=404)
+            auth = request.headers.get("X-SiteWhere-Tenant-Auth")
+            is_admin = AUTH_ADMIN in request["authorities"]
+            if auth != t.auth_token and not inst.tenants.user_can_access(
+                tenant, request["user"], is_admin
+            ):
+                return json_response({"error": "tenant access denied"}, status=403)
+            request["tenant"] = tenant
+        return await handler(request)
+
+    @web.middleware
+    async def error_middleware(request: web.Request, handler):
+        try:
+            return await handler(request)
+        except EntityNotFound as e:
+            return json_response({"error": str(e)}, status=404)
+        except DuplicateToken as e:
+            return json_response({"error": str(e)}, status=409)
+        except (ValueError, KeyError, EventDecodeException) as e:
+            return json_response({"error": str(e)}, status=400)
+
+    app = web.Application(middlewares=[cors_middleware, error_middleware,
+                                       auth_middleware])
+    r = app.router
+
+    # --- auth -------------------------------------------------------------
+    async def get_jwt(request: web.Request):
+        header = request.headers.get("Authorization", "")
+        if not header.startswith("Basic "):
+            return json_response({"error": "basic auth required"}, status=401)
+        try:
+            raw = base64.b64decode(header[6:]).decode()
+            username, _, password = raw.partition(":")
+            user = inst.users.authenticate(username, password)
+        except (ValueError, AuthenticationError):
+            return json_response({"error": "bad credentials"}, status=401)
+        token = inst.jwt.generate(username, inst.users.authorities_for(user))
+        return json_response({"token": token},
+                                 headers={"X-Sitewhere-JWT": token})
+
+    r.add_get("/api/authapi/jwt", get_jwt)
+    r.add_get("/api/instance/health", lambda req: json_response({"status": "UP"}))
+
+    # --- instance ---------------------------------------------------------
+    r.add_get("/api/instance", lambda req: json_response(inst.info()))
+    r.add_get("/api/instance/metrics",
+              lambda req: json_response(inst.engine.metrics()))
+
+    # --- devices ----------------------------------------------------------
+    async def create_device(request: web.Request):
+        body = await request.json()
+        summary = inst.device_management.create_device(
+            body["token"], body.get("deviceTypeToken", "default"),
+            tenant=body.get("tenant", request.get("tenant", "default")),
+            area=body.get("areaToken"), customer=body.get("customerToken"),
+            metadata=body.get("metadata"),
+        )
+        return json_response(dataclasses.asdict(summary), status=201)
+
+    async def list_devices(request: web.Request):
+        q = request.query
+        res = inst.device_management.list_devices(
+            page=int(q.get("page", 1)), page_size=int(q.get("pageSize", 100)),
+            device_type=q.get("deviceType"), tenant=q.get("tenant"),
+        )
+        return json_response({
+            "numResults": res.total, "page": res.page, "pageSize": res.page_size,
+            "results": [dataclasses.asdict(s) for s in res.results],
+        })
+
+    async def get_device(request: web.Request):
+        summary = inst.device_management.get_device_summary(
+            request.match_info["token"])
+        return json_response(dataclasses.asdict(summary))
+
+    async def delete_device(request: web.Request):
+        ok = inst.device_management.delete_device(request.match_info["token"])
+        if not ok:
+            raise EntityNotFound(request.match_info["token"])
+        return json_response({"deleted": True})
+
+    r.add_post("/api/devices", create_device)
+    r.add_get("/api/devices", list_devices)
+    r.add_get("/api/devices/{token}", get_device)
+    r.add_delete("/api/devices/{token}", delete_device)
+
+    # --- device events (ingest via REST + query) -------------------------
+    async def post_device_event(request: web.Request):
+        body = await request.json()
+        body.setdefault("deviceToken", request.match_info["token"])
+        req = request_from_envelope(body)
+        req.tenant = request.get("tenant", req.tenant)
+        inst.engine.process(req)
+        inst.engine.flush()
+        return json_response({"accepted": True}, status=201)
+
+    async def get_device_events(request: web.Request):
+        q = request.query
+        et = EventType[q["type"].upper()] if "type" in q else None
+        res = inst.engine.query_events(
+            device_token=request.match_info.get("token"),
+            etype=et,
+            since_ms=int(q["sinceMs"]) if "sinceMs" in q else None,
+            until_ms=int(q["untilMs"]) if "untilMs" in q else None,
+            limit=int(q.get("pageSize", 100)),
+        )
+        return json_response(res)
+
+    async def query_all_events(request: web.Request):
+        q = request.query
+        et = EventType[q["type"].upper()] if "type" in q else None
+        res = inst.engine.query_events(
+            device_token=q.get("deviceToken"), etype=et,
+            tenant=request.get("tenant"),
+            since_ms=int(q["sinceMs"]) if "sinceMs" in q else None,
+            until_ms=int(q["untilMs"]) if "untilMs" in q else None,
+            limit=int(q.get("pageSize", 100)),
+        )
+        return json_response(res)
+
+    r.add_post("/api/devices/{token}/events", post_device_event)
+    r.add_get("/api/devices/{token}/events", get_device_events)
+    r.add_get("/api/events", query_all_events)
+
+    # --- device state -----------------------------------------------------
+    async def get_device_state(request: web.Request):
+        state = inst.engine.get_device_state(request.match_info["token"])
+        if state is None:
+            raise EntityNotFound(request.match_info["token"])
+        return json_response(state)
+
+    async def presence_sweep(request: web.Request):
+        missing = inst.engine.presence_sweep()
+        return json_response({"newlyMissing": missing})
+
+    r.add_get("/api/devices/{token}/state", get_device_state)
+    r.add_post("/api/devicestates/presence/sweep", presence_sweep)
+
+    # --- device types / statuses / alarms --------------------------------
+    async def create_device_type(request: web.Request):
+        body = await request.json()
+        dt = inst.device_management.create_device_type(
+            body["token"], body["name"], description=body.get("description", ""),
+            container_policy=body.get("containerPolicy", "Standalone"),
+        )
+        return json_response(_entity(dt), status=201)
+
+    r.add_post("/api/devicetypes", create_device_type)
+    r.add_get("/api/devicetypes", lambda req: json_response(
+        _paged(inst.device_management.device_types.list())))
+    r.add_get("/api/devicetypes/{token}", lambda req: json_response(
+        _entity(inst.device_management.device_types.get(req.match_info["token"]))))
+
+    async def create_status(request: web.Request):
+        body = await request.json()
+        st = inst.device_management.create_device_status(
+            body["token"], request.match_info["token"], body["code"], body["name"],
+        )
+        return json_response(_entity(st), status=201)
+
+    r.add_post("/api/devicetypes/{token}/statuses", create_status)
+    r.add_get("/api/devicetypes/{token}/statuses", lambda req: json_response(
+        [_entity(s) for s in
+         inst.device_management.statuses_for_type(req.match_info["token"])]))
+
+    async def create_command(request: web.Request):
+        body = await request.json()
+        params = tuple(
+            CommandParameter(p["name"], ParameterType(p.get("type", "String")),
+                             p.get("required", False))
+            for p in body.get("parameters", [])
+        )
+        cmd = DeviceCommand(
+            token=body["token"], device_type=request.match_info["token"],
+            name=body["name"], namespace=body.get("namespace", "http://sitewhere/tpu"),
+            description=body.get("description", ""), parameters=params,
+        )
+        inst.command_registry.create(cmd)
+        return json_response(dataclasses.asdict(cmd), status=201)
+
+    r.add_post("/api/devicetypes/{token}/commands", create_command)
+    r.add_get("/api/devicetypes/{token}/commands", lambda req: json_response(
+        [dataclasses.asdict(c) for c in
+         inst.command_registry.list_for_type(req.match_info["token"])]))
+
+    async def create_alarm(request: web.Request):
+        body = await request.json()
+        alarm = inst.device_management.create_alarm(
+            body["token"], request.match_info["token"], body["message"],
+        )
+        return json_response(_entity(alarm, state=alarm.state.value), status=201)
+
+    async def alarm_transition(request: web.Request):
+        action = request.match_info["action"]
+        token = request.match_info["token"]
+        if action == "acknowledge":
+            alarm = inst.device_management.acknowledge_alarm(token)
+        elif action == "resolve":
+            alarm = inst.device_management.resolve_alarm(token)
+        else:
+            raise ValueError(f"unknown alarm action {action!r}")
+        return json_response(_entity(alarm, state=alarm.state.value))
+
+    r.add_post("/api/devices/{token}/alarms", create_alarm)
+    r.add_get("/api/devices/{token}/alarms", lambda req: json_response(
+        [_entity(a, state=a.state.value) for a in
+         inst.device_management.alarms_for_device(req.match_info["token"])]))
+    r.add_post("/api/alarms/{token}/{action}", alarm_transition)
+
+    # --- command invocation ----------------------------------------------
+    async def invoke_command(request: web.Request):
+        body = await request.json()
+        inv = inst.commands.invoke(
+            request.match_info["token"], body["commandToken"],
+            body.get("parameterValues", {}),
+            tenant=request.get("tenant", "default"),
+            initiator="REST", initiator_id=request.get("user", ""),
+        )
+        await inst.commands.pump()
+        return json_response({
+            "invocationId": inv.invocation_id,
+            "commandToken": inv.command_token,
+            "deviceToken": inv.device_token,
+        }, status=201)
+
+    r.add_post("/api/devices/{token}/invocations", invoke_command)
+    r.add_get("/api/commands/undelivered", lambda req: json_response(
+        [{"invocationId": u.invocation.invocation_id,
+          "destination": u.destination_id, "error": u.error}
+         for u in inst.commands.undelivered]))
+
+    # --- areas / customers / zones / groups -------------------------------
+    async def create_area_type(request: web.Request):
+        body = await request.json()
+        at = inst.device_management.create_area_type(
+            body["token"], body["name"],
+            contained_area_types=body.get("containedAreaTypes", []),
+        )
+        return json_response(_entity(at), status=201)
+
+    async def create_area(request: web.Request):
+        body = await request.json()
+        area = inst.device_management.create_area(
+            body["token"], body["areaTypeToken"], body["name"],
+            parent_token=body.get("parentToken"),
+            description=body.get("description", ""),
+        )
+        return json_response(_entity(area), status=201)
+
+    def _tree_json(nodes):
+        return [
+            {"entity": _entity(n.entity), "children": _tree_json(n.children)}
+            for n in nodes
+        ]
+
+    r.add_post("/api/areatypes", create_area_type)
+    r.add_get("/api/areatypes", lambda req: json_response(
+        _paged(inst.device_management.area_types.list())))
+    r.add_post("/api/areas", create_area)
+    r.add_get("/api/areas", lambda req: json_response(
+        _paged(inst.device_management.areas.list())))
+    r.add_get("/api/areas/tree", lambda req: json_response(
+        _tree_json(inst.device_management.area_tree())))
+    r.add_get("/api/areas/{token}", lambda req: json_response(
+        _entity(inst.device_management.areas.get(req.match_info["token"]))))
+
+    async def create_zone(request: web.Request):
+        body = await request.json()
+        zone = inst.device_management.create_zone(
+            body["token"], body["areaToken"], body["name"],
+            bounds=[(p["latitude"], p["longitude"]) for p in body["bounds"]],
+        )
+        return json_response(_entity(zone), status=201)
+
+    r.add_post("/api/zones", create_zone)
+    r.add_get("/api/areas/{token}/zones", lambda req: json_response(
+        [_entity(z) for z in
+         inst.device_management.zones_for_area(req.match_info["token"])]))
+
+    async def create_customer_type(request: web.Request):
+        body = await request.json()
+        ct = inst.device_management.create_customer_type(body["token"], body["name"])
+        return json_response(_entity(ct), status=201)
+
+    async def create_customer(request: web.Request):
+        body = await request.json()
+        c = inst.device_management.create_customer(
+            body["token"], body["customerTypeToken"], body["name"],
+            parent_token=body.get("parentToken"),
+        )
+        return json_response(_entity(c), status=201)
+
+    r.add_post("/api/customertypes", create_customer_type)
+    r.add_post("/api/customers", create_customer)
+    r.add_get("/api/customers", lambda req: json_response(
+        _paged(inst.device_management.customers.list())))
+    r.add_get("/api/customers/tree", lambda req: json_response(
+        _tree_json(inst.device_management.customer_tree())))
+
+    async def create_group(request: web.Request):
+        body = await request.json()
+        g = inst.device_management.create_group(
+            body["token"], body["name"], roles=body.get("roles", []),
+        )
+        return json_response(_entity(g), status=201)
+
+    async def add_group_elements(request: web.Request):
+        body = await request.json()
+        els = inst.device_management.add_group_elements(
+            request.match_info["token"], body["elements"],
+        )
+        return json_response([dataclasses.asdict(e) for e in els], status=201)
+
+    r.add_post("/api/devicegroups", create_group)
+    r.add_get("/api/devicegroups", lambda req: json_response(
+        _paged(inst.device_management.groups.list())))
+    r.add_post("/api/devicegroups/{token}/elements", add_group_elements)
+    r.add_get("/api/devicegroups/{token}/elements", lambda req: json_response(
+        [dataclasses.asdict(e) for e in
+         inst.device_management.group_elements(req.match_info["token"])]))
+    r.add_get("/api/devicegroups/{token}/devices", lambda req: json_response(
+        inst.device_management.expand_group_devices(
+            req.match_info["token"],
+            roles=req.query.getall("role", None))))
+
+    # --- assets -----------------------------------------------------------
+    async def create_asset_type(request: web.Request):
+        body = await request.json()
+        at = inst.assets.create_asset_type(body["token"], body["name"])
+        return json_response(_entity(at), status=201)
+
+    async def create_asset(request: web.Request):
+        body = await request.json()
+        a = inst.assets.create_asset(body["token"], body["assetTypeToken"],
+                                     body["name"])
+        return json_response(_entity(a), status=201)
+
+    r.add_post("/api/assettypes", create_asset_type)
+    r.add_post("/api/assets", create_asset)
+    r.add_get("/api/assets", lambda req: json_response(
+        _paged(inst.assets.list_assets(
+            asset_type=req.query.get("assetType")))))
+
+    # --- batch ------------------------------------------------------------
+    async def create_batch(request: web.Request):
+        body = await request.json()
+        devices = body.get("deviceTokens")
+        if not devices and body.get("groupToken"):
+            devices = inst.device_management.expand_group_devices(
+                body["groupToken"], roles=body.get("roles"))
+        op = inst.batch.create_operation(
+            body["token"], body.get("operationType", "InvokeCommand"), devices,
+            {"commandToken": body["commandToken"],
+             "parameterValues": body.get("parameterValues", {})},
+        )
+        op = await inst.batch.process_operation(op.meta.token)
+        return json_response(
+            {"token": op.meta.token, "status": op.status, "counts": op.counts()},
+            status=201,
+        )
+
+    r.add_post("/api/batch/command", create_batch)
+    r.add_get("/api/batch/{token}", lambda req: json_response((lambda op: {
+        "token": op.meta.token, "status": op.status,
+        "operationType": op.operation_type, "counts": op.counts(),
+        "elements": [dataclasses.asdict(e) | {"status": e.status.name}
+                     for e in op.elements],
+    })(inst.batch.operations.get(req.match_info["token"]))))
+
+    # --- schedules --------------------------------------------------------
+    async def create_schedule(request: web.Request):
+        body = await request.json()
+        s = inst.scheduler.create_schedule(
+            body["token"], body["name"], body["triggerType"],
+            cron=body.get("cron"), interval_s=body.get("intervalS"),
+            repeat_count=body.get("repeatCount", -1),
+        )
+        return json_response(_entity(s), status=201)
+
+    async def create_job(request: web.Request):
+        body = await request.json()
+        j = inst.scheduler.create_job(
+            body["token"], body["scheduleToken"], body["jobType"],
+            body.get("configuration", {}),
+        )
+        return json_response(_entity(j), status=201)
+
+    r.add_post("/api/schedules", create_schedule)
+    r.add_get("/api/schedules", lambda req: json_response(
+        _paged(inst.scheduler.schedules.list())))
+    r.add_post("/api/jobs", create_job)
+    r.add_get("/api/jobs", lambda req: json_response(
+        _paged(inst.scheduler.jobs.list())))
+
+    # --- labels -----------------------------------------------------------
+    async def get_label(request: web.Request):
+        kind = request.match_info["kind"]
+        token = request.match_info["token"]
+        gen = inst.labels.get(request.query.get("generator", "qrcode"))
+        fn = {
+            "device": gen.device_label, "asset": gen.asset_label,
+            "area": gen.area_label, "customer": gen.customer_label,
+            "devicegroup": gen.device_group_label,
+        }.get(kind)
+        if fn is None:
+            raise ValueError(f"unknown label kind {kind!r}")
+        return web.Response(body=fn(token), content_type="image/png")
+
+    r.add_get("/api/labels/{kind}/{token}", get_label)
+
+    # --- search -----------------------------------------------------------
+    async def search_events(request: web.Request):
+        provider = inst.search.get(request.query.get("provider", "embedded"))
+        if provider is None:
+            raise EntityNotFound("search provider")
+        docs = provider.search(request.query.get("q", "*:*"),
+                               int(request.query.get("pageSize", 100)))
+        return json_response({"numResults": len(docs), "results": docs})
+
+    r.add_get("/api/search/events", search_events)
+    r.add_get("/api/search/providers", lambda req: json_response(
+        [dataclasses.asdict(p) for p in inst.search.list_providers()]))
+
+    # --- streams ----------------------------------------------------------
+    async def create_stream(request: web.Request):
+        body = await request.json()
+        s = inst.streams.create_stream(
+            body["token"], request.match_info["token"],
+            content_type=body.get("contentType", "application/octet-stream"),
+        )
+        return json_response(_entity(s), status=201)
+
+    async def append_stream_chunk(request: web.Request):
+        data = await request.read()
+        seq = int(request.query.get("sequence", 0))
+        inst.streams.append_chunk(request.match_info["stream"], seq, data)
+        return json_response({"appended": len(data)}, status=201)
+
+    async def read_stream(request: web.Request):
+        stream = inst.streams.streams.get(request.match_info["stream"])
+        return web.Response(body=inst.streams.read_all(stream.meta.token),
+                            content_type=stream.content_type)
+
+    r.add_post("/api/devices/{token}/streams", create_stream)
+    r.add_post("/api/streams/{stream}/chunks", append_stream_chunk)
+    r.add_get("/api/streams/{stream}/content", read_stream)
+
+    # --- tenants ----------------------------------------------------------
+    async def create_tenant(request: web.Request):
+        if AUTH_ADMIN not in request.get("authorities", []):
+            return json_response({"error": "admin required"}, status=403)
+        body = await request.json()
+        t = inst.tenants.create_tenant(
+            body["token"], body["name"],
+            authorized_users=body.get("authorizedUserIds", []),
+            dataset_template=body.get("datasetTemplate", "empty"),
+        )
+        return json_response(_entity(t), status=201)
+
+    r.add_post("/api/tenants", create_tenant)
+    r.add_get("/api/tenants", lambda req: json_response(
+        _paged(inst.tenants.tenants.list())))
+    r.add_get("/api/tenants/{token}", lambda req: json_response(
+        _entity(inst.tenants.tenants.get(req.match_info["token"]))))
+
+    # --- users ------------------------------------------------------------
+    async def create_user(request: web.Request):
+        if AUTH_ADMIN not in request.get("authorities", []):
+            return json_response({"error": "admin required"}, status=403)
+        body = await request.json()
+        u = inst.users.create_user(
+            body["username"], body["password"], roles=body.get("roles"),
+            first_name=body.get("firstName", ""), last_name=body.get("lastName", ""),
+            email=body.get("email", ""),
+        )
+        return json_response(
+            {"username": u.username, "roles": u.roles}, status=201)
+
+    r.add_post("/api/users", create_user)
+    r.add_get("/api/users", lambda req: json_response(
+        [{"username": u.username, "roles": u.roles, "enabled": u.enabled}
+         for u in inst.users.users.values()]))
+    r.add_get("/api/users/{username}/authorities", lambda req: json_response(
+        inst.users.authorities_for(inst.users.users[req.match_info["username"]])))
+
+    return app
+
+
+class ServerHandle:
+    """Running REST server + background outbound pump."""
+
+    def __init__(self, runner: web.AppRunner, port: int, pump_task):
+        self.runner = runner
+        self.port = port
+        self._pump_task = pump_task
+
+    async def cleanup(self) -> None:
+        import asyncio
+
+        self._pump_task.cancel()
+        try:
+            await self._pump_task
+        except (asyncio.CancelledError, Exception):
+            pass
+        await self.runner.cleanup()
+
+
+async def start_server(instance: SiteWhereTpuInstance, host: str = "127.0.0.1",
+                       port: int = 0) -> ServerHandle:
+    """Start the REST gateway + background outbound pumps."""
+    import asyncio
+
+    app = make_app(instance)
+
+    async def pump_loop():
+        while True:
+            try:
+                await instance.pump_outbound()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("outbound pump error")
+            await asyncio.sleep(0.05)
+
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    task = asyncio.create_task(pump_loop())
+    bound = site._server.sockets[0].getsockname()[1]
+    return ServerHandle(runner, bound, task)
