@@ -1,0 +1,429 @@
+// swtpu: native host data-plane for the TPU event engine.
+//
+// The reference's ingest edge burns JVM cycles per message (Jackson
+// ObjectMapper per payload in sources/decoder/json/JsonDeviceRequestDecoder,
+// per-message Kafka serialization). Here the host hot loop — JSON
+// device-request decode + token interning + SoA batch packing — is native:
+// a zero-allocation streaming JSON scanner fills the caller's numpy arrays
+// directly, and device tokens / measurement names / alert types are interned
+// in open-addressing string tables so the TPU batch carries int32 ids only.
+//
+// C ABI (ctypes-friendly); no external dependencies.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <cmath>
+#include <string>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- interner
+
+struct Interner {
+    // open addressing, power-of-two capacity
+    std::vector<int32_t> slots;     // index into strings, -1 empty
+    std::vector<std::string> strings;
+    uint64_t mask;
+    int32_t max_entries;
+};
+
+static uint64_t hash_bytes(const char* s, int n) {
+    uint64_t h = 1469598103934665603ull;  // FNV-1a
+    for (int i = 0; i < n; i++) {
+        h ^= (unsigned char)s[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+Interner* swtpu_interner_create(int32_t max_entries) {
+    uint64_t cap = 64;
+    while (cap < (uint64_t)max_entries * 2) cap <<= 1;
+    auto* in = new Interner();
+    in->slots.assign(cap, -1);
+    in->mask = cap - 1;
+    in->max_entries = max_entries;
+    in->strings.reserve(1024);
+    return in;
+}
+
+void swtpu_interner_destroy(Interner* in) { delete in; }
+
+int32_t swtpu_intern(Interner* in, const char* s, int32_t n) {
+    uint64_t h = hash_bytes(s, n) & in->mask;
+    while (true) {
+        int32_t idx = in->slots[h];
+        if (idx < 0) {
+            if ((int32_t)in->strings.size() >= in->max_entries) return -1;
+            int32_t id = (int32_t)in->strings.size();
+            in->strings.emplace_back(s, n);
+            in->slots[h] = id;
+            return id;
+        }
+        const std::string& cand = in->strings[idx];
+        if ((int32_t)cand.size() == n && memcmp(cand.data(), s, n) == 0) return idx;
+        h = (h + 1) & in->mask;
+    }
+}
+
+int32_t swtpu_interner_lookup(Interner* in, const char* s, int32_t n) {
+    uint64_t h = hash_bytes(s, n) & in->mask;
+    while (true) {
+        int32_t idx = in->slots[h];
+        if (idx < 0) return -1;
+        const std::string& cand = in->strings[idx];
+        if ((int32_t)cand.size() == n && memcmp(cand.data(), s, n) == 0) return idx;
+        h = (h + 1) & in->mask;
+    }
+}
+
+int32_t swtpu_interner_size(Interner* in) { return (int32_t)in->strings.size(); }
+
+// copy string #id into out (cap bytes); returns length or -1
+int32_t swtpu_interner_get(Interner* in, int32_t id, char* out, int32_t cap) {
+    if (id < 0 || id >= (int32_t)in->strings.size()) return -1;
+    const std::string& s = in->strings[id];
+    int32_t n = (int32_t)s.size() < cap ? (int32_t)s.size() : cap;
+    memcpy(out, s.data(), n);
+    return (int32_t)s.size();
+}
+
+// ---------------------------------------------------------------- JSON scan
+
+struct Scanner {
+    const char* p;
+    const char* end;
+    bool ok;
+};
+
+static void skip_ws(Scanner& sc) {
+    while (sc.p < sc.end && (*sc.p == ' ' || *sc.p == '\t' || *sc.p == '\n' || *sc.p == '\r'))
+        sc.p++;
+}
+
+static bool expect(Scanner& sc, char c) {
+    skip_ws(sc);
+    if (sc.p < sc.end && *sc.p == c) { sc.p++; return true; }
+    sc.ok = false;
+    return false;
+}
+
+// parse a JSON string (assumes opening quote consumed is NOT done); writes
+// unescaped content into buf, returns length or -1.
+static int parse_string(Scanner& sc, char* buf, int cap) {
+    skip_ws(sc);
+    if (sc.p >= sc.end || *sc.p != '"') { sc.ok = false; return -1; }
+    sc.p++;
+    int n = 0;
+    while (sc.p < sc.end) {
+        char c = *sc.p++;
+        if (c == '"') return n;
+        if (c == '\\') {
+            if (sc.p >= sc.end) break;
+            char e = *sc.p++;
+            switch (e) {
+                case 'n': c = '\n'; break;
+                case 't': c = '\t'; break;
+                case 'r': c = '\r'; break;
+                case 'b': c = '\b'; break;
+                case 'f': c = '\f'; break;
+                case 'u': {
+                    if (sc.end - sc.p < 4) { sc.ok = false; return -1; }
+                    int code = 0;
+                    for (int i = 0; i < 4; i++) {
+                        char h = *sc.p++;
+                        code <<= 4;
+                        if (h >= '0' && h <= '9') code |= h - '0';
+                        else if (h >= 'a' && h <= 'f') code |= h - 'a' + 10;
+                        else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
+                        else { sc.ok = false; return -1; }
+                    }
+                    // UTF-8 encode (BMP only; surrogate pairs unhandled -> '?')
+                    if (code < 0x80) { c = (char)code; }
+                    else {
+                        if (n + 3 < cap) {
+                            if (code < 0x800) {
+                                buf[n++] = (char)(0xC0 | (code >> 6));
+                                c = (char)(0x80 | (code & 0x3F));
+                            } else {
+                                buf[n++] = (char)(0xE0 | (code >> 12));
+                                buf[n++] = (char)(0x80 | ((code >> 6) & 0x3F));
+                                c = (char)(0x80 | (code & 0x3F));
+                            }
+                        } else c = '?';
+                    }
+                    break;
+                }
+                default: c = e;
+            }
+        }
+        if (n < cap) buf[n++] = c;
+    }
+    sc.ok = false;
+    return -1;
+}
+
+static double parse_number(Scanner& sc) {
+    skip_ws(sc);
+    char* endp = nullptr;
+    double v = strtod(sc.p, &endp);
+    if (endp == sc.p) { sc.ok = false; return 0; }
+    sc.p = endp;
+    return v;
+}
+
+// skip any JSON value
+static void skip_value(Scanner& sc);
+
+static void skip_container(Scanner& sc, char open, char close) {
+    int depth = 1;
+    sc.p++;  // consume open
+    while (sc.p < sc.end && depth > 0) {
+        char c = *sc.p;
+        if (c == '"') {
+            char tmp[1];
+            // fast string skip
+            sc.p++;
+            while (sc.p < sc.end && *sc.p != '"') {
+                if (*sc.p == '\\') sc.p++;
+                sc.p++;
+            }
+            if (sc.p < sc.end) sc.p++;
+            continue;
+        }
+        if (c == open) depth++;
+        else if (c == close) depth--;
+        sc.p++;
+    }
+    (void)sizeof(char[1]);
+}
+
+static void skip_value(Scanner& sc) {
+    skip_ws(sc);
+    if (sc.p >= sc.end) { sc.ok = false; return; }
+    char c = *sc.p;
+    if (c == '{') { skip_container(sc, '{', '}'); return; }
+    if (c == '[') { skip_container(sc, '[', ']'); return; }
+    if (c == '"') { char tmp[8]; parse_string(sc, tmp, 0); return; }
+    if (c == 't') { sc.p += 4; return; }
+    if (c == 'f') { sc.p += 5; return; }
+    if (c == 'n') { sc.p += 4; return; }
+    parse_number(sc);
+}
+
+// ---------------------------------------------------------------- decoder
+
+// request envelope types (must match ingest/requests.py RequestType mapping)
+enum ReqType {
+    RT_UNKNOWN = -1,
+    RT_REGISTER = 0,
+    RT_MEASUREMENT = 1,
+    RT_LOCATION = 2,
+    RT_ALERT = 3,
+    RT_STATE_CHANGE = 4,
+    RT_ACK = 5,
+};
+
+static int type_code(const char* s, int n) {
+    if (n == 17 && !memcmp(s, "DeviceMeasurement", 17)) return RT_MEASUREMENT;
+    if (n == 18 && !memcmp(s, "DeviceMeasurements", 18)) return RT_MEASUREMENT;
+    if (n == 14 && !memcmp(s, "DeviceLocation", 14)) return RT_LOCATION;
+    if (n == 11 && !memcmp(s, "DeviceAlert", 11)) return RT_ALERT;
+    if (n == 14 && !memcmp(s, "RegisterDevice", 14)) return RT_REGISTER;
+    if (n == 17 && !memcmp(s, "DeviceStateChange", 17)) return RT_STATE_CHANGE;
+    if (n == 11 && !memcmp(s, "Acknowledge", 11)) return RT_ACK;
+    return RT_UNKNOWN;
+}
+
+static int alert_level_code(const char* s, int n) {
+    if (n == 4 && !memcmp(s, "Info", 4)) return 0;
+    if (n == 7 && !memcmp(s, "Warning", 7)) return 1;
+    if (n == 5 && !memcmp(s, "Error", 5)) return 2;
+    if (n == 8 && !memcmp(s, "Critical", 8)) return 3;
+    return 0;
+}
+
+struct Decoder {
+    Interner* tokens;       // device tokens (shared with engine)
+    Interner* names;        // measurement names
+    Interner* alert_types;  // alert types
+};
+
+Decoder* swtpu_decoder_create(Interner* tokens, int32_t name_cap, int32_t alert_cap) {
+    auto* d = new Decoder();
+    d->tokens = tokens;
+    d->names = swtpu_interner_create(name_cap);
+    d->alert_types = swtpu_interner_create(alert_cap);
+    return d;
+}
+
+Interner* swtpu_decoder_names(Decoder* d) { return d->names; }
+Interner* swtpu_decoder_alert_types(Decoder* d) { return d->alert_types; }
+
+void swtpu_decoder_destroy(Decoder* d) {
+    swtpu_interner_destroy(d->names);
+    swtpu_interner_destroy(d->alert_types);
+    delete d;
+}
+
+// Decode n_msgs JSON device-request envelopes (concatenated in buf, message i
+// at [offsets[i], offsets[i+1])) into SoA output arrays of length n_msgs:
+//   out_rtype     int32: ReqType or -1 on decode failure
+//   out_token     int32: interned device-token id (-1 when missing)
+//   out_ts        int64: eventDate ms or -1
+//   out_values    float32[n_msgs * channels]
+//   out_chmask    uint8[n_msgs * channels]
+//   out_aux0      int32: alert-type id / state attr id (-1 none)
+//   out_level     int32: alert level
+// Measurement names map to channel = name_id % channels; collisions counted
+// in *out_collisions. Returns number successfully decoded.
+int32_t swtpu_decode_batch(
+    Decoder* d,
+    const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
+    int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
+    float* out_values, uint8_t* out_chmask,
+    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
+    int32_t ok_count = 0;
+    int32_t collisions = 0;
+    char sbuf[512];
+
+    for (int32_t i = 0; i < n_msgs; i++) {
+        out_rtype[i] = -1;
+        out_token[i] = -1;
+        out_ts[i] = -1;
+        out_aux0[i] = -1;
+        out_level[i] = 0;
+        memset(out_values + (size_t)i * channels, 0, sizeof(float) * channels);
+        memset(out_chmask + (size_t)i * channels, 0, channels);
+
+        Scanner sc{buf + offsets[i], buf + offsets[i + 1], true};
+        if (!expect(sc, '{')) continue;
+        int rtype = RT_UNKNOWN;
+        int32_t token = -1;
+        bool in_request_done = false;
+        bool first = true;
+        bool failed = false;
+
+        while (sc.ok && !failed) {
+            skip_ws(sc);
+            if (sc.p < sc.end && *sc.p == '}') { sc.p++; break; }
+            if (!first && !expect(sc, ',')) break;
+            first = false;
+            int klen = parse_string(sc, sbuf, sizeof(sbuf));
+            if (klen < 0 || !expect(sc, ':')) { failed = true; break; }
+
+            if ((klen == 11 && !memcmp(sbuf, "deviceToken", 11)) ||
+                (klen == 10 && !memcmp(sbuf, "hardwareId", 10))) {
+                int n = parse_string(sc, sbuf, sizeof(sbuf));
+                if (n < 0) { failed = true; break; }
+                token = swtpu_intern(d->tokens, sbuf, n);
+            } else if (klen == 4 && !memcmp(sbuf, "type", 4)) {
+                int n = parse_string(sc, sbuf, sizeof(sbuf));
+                if (n < 0) { failed = true; break; }
+                rtype = type_code(sbuf, n);
+            } else if (klen == 7 && !memcmp(sbuf, "request", 7)) {
+                // parse the request object with the already-known or
+                // not-yet-known type: collect generically
+                skip_ws(sc);
+                if (sc.p >= sc.end || *sc.p != '{') { skip_value(sc); continue; }
+                sc.p++;
+                bool rfirst = true;
+                float lat = 0, lon = 0, elev = 0;
+                bool have_loc = false;
+                char mname[128]; int mname_len = -1;
+                double mval = 0; bool have_mval = false;
+                while (sc.ok) {
+                    skip_ws(sc);
+                    if (sc.p < sc.end && *sc.p == '}') { sc.p++; break; }
+                    if (!rfirst && !expect(sc, ',')) break;
+                    rfirst = false;
+                    int rk = parse_string(sc, sbuf, sizeof(sbuf));
+                    if (rk < 0 || !expect(sc, ':')) { failed = true; break; }
+                    if (rk == 9 && !memcmp(sbuf, "eventDate", 9)) {
+                        skip_ws(sc);
+                        if (sc.p < sc.end && *sc.p == '"') skip_value(sc);  // ISO dates -> host path
+                        else out_ts[i] = (int64_t)parse_number(sc);
+                    } else if (rk == 12 && !memcmp(sbuf, "measurements", 12)) {
+                        skip_ws(sc);
+                        if (sc.p < sc.end && *sc.p == '{') {
+                            sc.p++;
+                            bool mfirst = true;
+                            while (sc.ok) {
+                                skip_ws(sc);
+                                if (sc.p < sc.end && *sc.p == '}') { sc.p++; break; }
+                                if (!mfirst && !expect(sc, ',')) break;
+                                mfirst = false;
+                                int nn = parse_string(sc, sbuf, sizeof(sbuf));
+                                if (nn < 0 || !expect(sc, ':')) { failed = true; break; }
+                                double v = parse_number(sc);
+                                int32_t nid = swtpu_intern(d->names, sbuf, nn);
+                                if (nid >= 0) {
+                                    if (nid >= channels) collisions++;
+                                    int ch = nid % channels;
+                                    out_values[(size_t)i * channels + ch] = (float)v;
+                                    out_chmask[(size_t)i * channels + ch] = 1;
+                                }
+                            }
+                        } else skip_value(sc);
+                    } else if (rk == 4 && !memcmp(sbuf, "name", 4)) {
+                        mname_len = parse_string(sc, mname, sizeof(mname));
+                        if (mname_len < 0) { failed = true; break; }
+                    } else if (rk == 5 && !memcmp(sbuf, "value", 5)) {
+                        mval = parse_number(sc);
+                        have_mval = true;
+                    } else if (rk == 8 && !memcmp(sbuf, "latitude", 8)) {
+                        lat = (float)parse_number(sc); have_loc = true;
+                    } else if (rk == 9 && !memcmp(sbuf, "longitude", 9)) {
+                        lon = (float)parse_number(sc); have_loc = true;
+                    } else if (rk == 9 && !memcmp(sbuf, "elevation", 9)) {
+                        elev = (float)parse_number(sc);
+                    } else if (rk == 5 && !memcmp(sbuf, "level", 5)) {
+                        skip_ws(sc);
+                        if (sc.p < sc.end && *sc.p == '"') {
+                            int n = parse_string(sc, sbuf, sizeof(sbuf));
+                            if (n >= 0) out_level[i] = alert_level_code(sbuf, n);
+                        } else out_level[i] = (int32_t)parse_number(sc);
+                    } else if (rk == 4 && !memcmp(sbuf, "type", 4)) {
+                        int n = parse_string(sc, sbuf, sizeof(sbuf));
+                        if (n >= 0) out_aux0[i] = swtpu_intern(d->alert_types, sbuf, n);
+                    } else {
+                        skip_value(sc);
+                    }
+                }
+                if (mname_len >= 0 && have_mval) {
+                    int32_t nid = swtpu_intern(d->names, mname, mname_len);
+                    if (nid >= 0) {
+                        if (nid >= channels) collisions++;
+                        int ch = nid % channels;
+                        out_values[(size_t)i * channels + ch] = (float)mval;
+                        out_chmask[(size_t)i * channels + ch] = 1;
+                    }
+                }
+                if (have_loc) {
+                    out_values[(size_t)i * channels + 0] = lat;
+                    out_values[(size_t)i * channels + 1] = lon;
+                    out_values[(size_t)i * channels + 2] = elev;
+                    out_chmask[(size_t)i * channels + 0] = 1;
+                    out_chmask[(size_t)i * channels + 1] = 1;
+                    out_chmask[(size_t)i * channels + 2] = 1;
+                }
+                in_request_done = true;
+            } else {
+                skip_value(sc);
+            }
+        }
+
+        if (!failed && sc.ok && rtype != RT_UNKNOWN && token >= 0) {
+            out_rtype[i] = rtype;
+            out_token[i] = token;
+            ok_count++;
+        }
+        (void)in_request_done;
+    }
+    *out_collisions = collisions;
+    return ok_count;
+}
+
+}  // extern "C"

@@ -57,9 +57,9 @@ class ChannelMap:
     ``channels`` distinct names, lanes are reused modulo with a collision
     counter (visible in metrics) — capacity is a config knob."""
 
-    def __init__(self, channels: int):
+    def __init__(self, channels: int, names=None):
         self.channels = channels
-        self.names = TokenInterner(1 << 20)
+        self.names = names if names is not None else TokenInterner(1 << 20)
         self.collisions = 0
 
     def channel_of(self, name: str) -> int:
@@ -81,6 +81,7 @@ class EngineConfig:
     auto_register: bool = True
     default_device_type: str = "default"
     presence_missing_s: float = 8 * 3600.0  # DevicePresenceManager default 8h
+    use_native: bool = True            # C++ decode/interning data plane
 
 
 @dataclasses.dataclass
@@ -144,15 +145,31 @@ class Engine:
         c = self.config
         self.epoch = EpochBase()
         self.lock = threading.RLock()
-        self.tokens = TokenInterner(c.token_capacity)
+        # the native host data-plane (C++ decode + interning) is the default;
+        # pure-Python fallback when no compiler is available
+        self._native_decoder = None
+        if c.use_native:
+            try:
+                from sitewhere_tpu.ingest.fast_decode import NativeBatchDecoder
+                from sitewhere_tpu.native.binding import NativeInterner
+
+                self.tokens = NativeInterner(c.token_capacity)
+                self._native_decoder = NativeBatchDecoder(self.tokens, c.channels)
+            except (RuntimeError, OSError):
+                self._native_decoder = None
+        if self._native_decoder is not None:
+            self.channel_map = ChannelMap(c.channels, self._native_decoder.names)
+            self.alert_types = self._native_decoder.alert_types
+        else:
+            self.tokens = TokenInterner(c.token_capacity)
+            self.channel_map = ChannelMap(c.channels)
+            self.alert_types = TokenInterner(1 << 20)
         self.tenants = TokenInterner(1 << 16)
         self.tenants.intern("default")
         self.device_types = TokenInterner(1 << 16)
         self.device_types.intern(c.default_device_type)
         self.areas = TokenInterner(1 << 16)
         self.customers = TokenInterner(1 << 16)
-        self.alert_types = TokenInterner(1 << 20)
-        self.channel_map = ChannelMap(c.channels)
         self.event_ids = TokenInterner(1 << 22)  # alternate/correlation ids
 
         self.state = PipelineState.create(
@@ -195,7 +212,13 @@ class Engine:
             if et is None:
                 return
             now = self.epoch.now_ms()
-            ts = req.event_ts_ms if req.event_ts_ms is not None else now
+            # wire timestamps are absolute unix ms; device arrays carry int32
+            # ms relative to the engine epoch base
+            if req.event_ts_ms is not None:
+                base_ms = int(self.epoch.base_unix_s * 1000)
+                ts = int(np.clip(req.event_ts_ms - base_ms, -(2**31) + 1, 2**31 - 1))
+            else:
+                ts = now
             token_id = self.tokens.intern(req.device_token)
             tenant_id = self.tenants.intern(req.tenant)
             values = np.zeros(self.config.channels, np.float32)
@@ -236,6 +259,92 @@ class Engine:
             self._buf.vmask[i, :] = mask
         if self._buf.full:
             self.flush()
+
+    def ingest_json_batch(self, payloads: list[bytes],
+                          tenant: str = "default") -> dict:
+        """Fast path: decode a batch of JSON device-request payloads in one
+        native call and stage them vectorized (no per-event Python). Returns
+        a summary with decode failures (failed-decode DLQ analog).
+        Registration envelopes fall back to the per-request path (they carry
+        string metadata the hot path doesn't extract)."""
+        if self._native_decoder is None:
+            # pure-Python fallback keeps the API uniform
+            from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
+
+            dec = JsonDeviceRequestDecoder()
+            failed = 0
+            for p in payloads:
+                try:
+                    for req in dec.decode(p, {}):
+                        req.tenant = tenant
+                        self.process(req)
+                except Exception:
+                    failed += 1
+            return {"decoded": len(payloads) - failed, "failed": failed}
+
+        from sitewhere_tpu.ingest.fast_decode import RT_REGISTER, RTYPE_TO_ETYPE
+
+        res = self._native_decoder.decode(payloads)
+        with self.lock:
+            now = self.epoch.now_ms()
+            base_ms = int(self.epoch.base_unix_s * 1000)
+            etype = RTYPE_TO_ETYPE[np.clip(res.rtype, -1, 7)]
+            ok = (res.rtype >= 0) & (etype >= 0)
+            regs = res.rtype == RT_REGISTER
+            failed = int(np.sum(res.rtype < 0))
+            # registration envelopes: slow path with full metadata
+            if np.any(regs):
+                from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
+
+                dec = JsonDeviceRequestDecoder()
+                for i in np.nonzero(regs)[0]:
+                    try:
+                        for req in dec.decode(payloads[int(i)], {}):
+                            req.tenant = tenant
+                            self.process(req)
+                    except Exception:
+                        failed += 1
+            # relative int32 timestamps (absent -> now)
+            ts_rel = np.where(
+                res.ts_ms64 >= 0,
+                np.clip(res.ts_ms64 - base_ms, -(2**31) + 1, 2**31 - 1),
+                now,
+            ).astype(np.int32)
+            values = res.values
+            # alert rows carry their level in values[:, 0]
+            alert_rows = ok & (etype == int(EventType.ALERT))
+            if np.any(alert_rows):
+                values = values.copy()
+                values[alert_rows, 0] = res.level[alert_rows]
+            idxs = np.nonzero(ok)[0]
+            tenant_id = self.tenants.intern(tenant)
+            staged = 0
+            pos = 0
+            while pos < len(idxs):
+                room = self.config.batch_capacity - len(self._buf)
+                if room == 0:
+                    self.flush()
+                    room = self.config.batch_capacity
+                chunk = idxs[pos: pos + room]
+                b = self._buf
+                lo = b._n
+                hi = lo + len(chunk)
+                b.etype[lo:hi] = etype[chunk]
+                b.token_id[lo:hi] = res.token_id[chunk]
+                b.tenant_id[lo:hi] = tenant_id
+                b.ts_ms[lo:hi] = ts_rel[chunk]
+                b.received_ms[lo:hi] = now
+                b.values[lo:hi] = values[chunk]
+                b.vmask[lo:hi] = res.chmask[chunk]
+                b.aux[lo:hi, 0] = res.aux0[chunk]
+                b._n = hi
+                staged += len(chunk)
+                pos += room
+            if self._buf.full:
+                self.flush()
+            self.channel_map.collisions += res.collisions
+            return {"decoded": int(np.sum(ok)), "failed": failed,
+                    "staged": staged}
 
     def maybe_flush(self) -> dict | None:
         """Flush if the latency budget expired (call from a timer loop)."""

@@ -1,0 +1,105 @@
+"""Native batch JSON decoder: list-of-payloads -> SoA arrays in one C call.
+
+This is the performance path for the ingest edge (SURVEY.md §3.2 hot loop
+"decode" — the reference runs Jackson per message on the JVM). Payloads are
+concatenated into one buffer, the C++ scanner fills numpy arrays directly,
+and device tokens / measurement names / alert types come back as interned
+int32 ids ready for EventBatch packing. Falls back to the pure-Python
+JsonDeviceRequestDecoder when the native library is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import NamedTuple
+
+import numpy as np
+
+from sitewhere_tpu.native.binding import NativeInterner, load_library
+
+# native rtype codes (swtpu.cpp ReqType) -> core EventType / registration
+RT_REGISTER = 0
+RT_MEASUREMENT = 1
+RT_LOCATION = 2
+RT_ALERT = 3
+RT_STATE_CHANGE = 4
+RT_ACK = 5
+
+# map native rtype -> core EventType ordinal (EventType in core/types.py)
+RTYPE_TO_ETYPE = np.full(8, -1, np.int32)
+RTYPE_TO_ETYPE[RT_MEASUREMENT] = 0
+RTYPE_TO_ETYPE[RT_LOCATION] = 1
+RTYPE_TO_ETYPE[RT_ALERT] = 2
+RTYPE_TO_ETYPE[RT_ACK] = 4
+RTYPE_TO_ETYPE[RT_STATE_CHANGE] = 5
+
+
+class DecodedArrays(NamedTuple):
+    n_ok: int
+    rtype: np.ndarray      # int32[N] native request type (-1 = decode failed)
+    token_id: np.ndarray   # int32[N]
+    ts_ms64: np.ndarray    # int64[N] epoch ms (-1 = absent)
+    values: np.ndarray     # float32[N, C]
+    chmask: np.ndarray     # bool[N, C]
+    aux0: np.ndarray       # int32[N] alert-type id
+    level: np.ndarray      # int32[N] alert level
+    collisions: int
+
+
+class NativeBatchDecoder:
+    """Holds the C++ decoder + its interners. The token interner is shared
+    with the engine (ids must be the engine's ids)."""
+
+    def __init__(self, token_interner: NativeInterner, channels: int,
+                 name_capacity: int = 1 << 20, alert_capacity: int = 1 << 16):
+        self.lib = load_library()
+        if self.lib is None:
+            raise RuntimeError("native library unavailable")
+        self.tokens = token_interner
+        self.channels = channels
+        self.handle = self.lib.swtpu_decoder_create(
+            token_interner.handle, name_capacity, alert_capacity
+        )
+        self.names = NativeInterner(
+            name_capacity, self.lib, self.lib.swtpu_decoder_names(self.handle)
+        )
+        self.alert_types = NativeInterner(
+            alert_capacity, self.lib, self.lib.swtpu_decoder_alert_types(self.handle)
+        )
+
+    def decode(self, payloads: list[bytes]) -> DecodedArrays:
+        n = len(payloads)
+        c = self.channels
+        buf = b"".join(payloads)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum([len(p) for p in payloads], out=offsets[1:])
+        rtype = np.empty(n, np.int32)
+        token = np.empty(n, np.int32)
+        ts = np.empty(n, np.int64)
+        values = np.empty((n, c), np.float32)
+        chmask = np.empty((n, c), np.uint8)
+        aux0 = np.empty(n, np.int32)
+        level = np.empty(n, np.int32)
+        collisions = ctypes.c_int32(0)
+
+        def ptr(a, t):
+            return a.ctypes.data_as(ctypes.POINTER(t))
+
+        n_ok = int(self.lib.swtpu_decode_batch(
+            self.handle, buf, ptr(offsets, ctypes.c_int64),
+            np.int32(n), np.int32(c),
+            ptr(rtype, ctypes.c_int32), ptr(token, ctypes.c_int32),
+            ptr(ts, ctypes.c_int64),
+            ptr(values, ctypes.c_float), ptr(chmask, ctypes.c_uint8),
+            ptr(aux0, ctypes.c_int32), ptr(level, ctypes.c_int32),
+            ctypes.byref(collisions),
+        ))
+        return DecodedArrays(
+            n_ok=n_ok, rtype=rtype, token_id=token, ts_ms64=ts,
+            values=values, chmask=chmask.astype(bool), aux0=aux0, level=level,
+            collisions=int(collisions.value),
+        )
+
+
+def native_available() -> bool:
+    return load_library() is not None

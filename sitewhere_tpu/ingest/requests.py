@@ -56,7 +56,9 @@ class DecodedRequest:
     type: RequestType
     device_token: str
     tenant: str = "default"
-    event_ts_ms: int | None = None       # ms since epoch base (None = now)
+    event_ts_ms: int | None = None       # absolute unix ms (None = now);
+                                         # the engine converts to its int32
+                                         # epoch-relative clock when staging
     # measurement: {name: value}; retained as dict until channel mapping
     measurements: dict[str, float] | None = None
     # location

@@ -1,0 +1,146 @@
+"""ctypes binding + on-demand build of the native host data-plane (swtpu).
+
+Builds native/src/swtpu.cpp with g++ -O3 on first use (cached in
+native/build/). Falls back cleanly: ``load_library()`` returns None when no
+compiler is available, and callers (ingest/fast_decode.py, engine interners)
+use the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import pathlib
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+_REPO = pathlib.Path(__file__).resolve().parents[2]
+_SRC = _REPO / "native" / "src" / "swtpu.cpp"
+_BUILD = _REPO / "native" / "build"
+_SO = _BUILD / "libswtpu.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.swtpu_interner_create.restype = c.c_void_p
+    lib.swtpu_interner_create.argtypes = [c.c_int32]
+    lib.swtpu_interner_destroy.argtypes = [c.c_void_p]
+    lib.swtpu_intern.restype = c.c_int32
+    lib.swtpu_intern.argtypes = [c.c_void_p, c.c_char_p, c.c_int32]
+    lib.swtpu_interner_lookup.restype = c.c_int32
+    lib.swtpu_interner_lookup.argtypes = [c.c_void_p, c.c_char_p, c.c_int32]
+    lib.swtpu_interner_size.restype = c.c_int32
+    lib.swtpu_interner_size.argtypes = [c.c_void_p]
+    lib.swtpu_interner_get.restype = c.c_int32
+    lib.swtpu_interner_get.argtypes = [c.c_void_p, c.c_int32, c.c_char_p, c.c_int32]
+    lib.swtpu_decoder_create.restype = c.c_void_p
+    lib.swtpu_decoder_create.argtypes = [c.c_void_p, c.c_int32, c.c_int32]
+    lib.swtpu_decoder_destroy.argtypes = [c.c_void_p]
+    lib.swtpu_decoder_names.restype = c.c_void_p
+    lib.swtpu_decoder_names.argtypes = [c.c_void_p]
+    lib.swtpu_decoder_alert_types.restype = c.c_void_p
+    lib.swtpu_decoder_alert_types.argtypes = [c.c_void_p]
+    lib.swtpu_decode_batch.restype = c.c_int32
+    lib.swtpu_decode_batch.argtypes = [
+        c.c_void_p,                      # decoder
+        c.c_char_p,                      # buf
+        c.POINTER(c.c_int64),            # offsets
+        c.c_int32, c.c_int32,            # n_msgs, channels
+        c.POINTER(c.c_int32),            # out_rtype
+        c.POINTER(c.c_int32),            # out_token
+        c.POINTER(c.c_int64),            # out_ts
+        c.POINTER(c.c_float),            # out_values
+        c.POINTER(c.c_uint8),            # out_chmask
+        c.POINTER(c.c_int32),            # out_aux0
+        c.POINTER(c.c_int32),            # out_level
+        c.POINTER(c.c_int32),            # out_collisions
+    ]
+    return lib
+
+
+def build_library(force: bool = False) -> pathlib.Path | None:
+    """Compile the shared library (cached by source mtime)."""
+    _BUILD.mkdir(parents=True, exist_ok=True)
+    if _SO.exists() and not force and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        return _SO
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           str(_SRC), "-o", str(_SO)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        logger.warning("native build failed (%s); using Python fallback",
+                       getattr(e, "stderr", e))
+        return None
+    return _SO
+
+
+def load_library() -> ctypes.CDLL | None:
+    """Build (if needed) and load libswtpu; None when unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        so = build_library()
+        if so is None:
+            return None
+        try:
+            _lib = _configure(ctypes.CDLL(str(so)))
+        except OSError as e:
+            logger.warning("failed to load %s: %s", so, e)
+            _lib = None
+        return _lib
+
+
+class NativeInterner:
+    """TokenInterner-compatible wrapper over the C++ open-addressing table.
+
+    Keeps a lazily-synced Python-side list of strings (ids are dense and
+    append-only, so syncing pulls only the tail)."""
+
+    def __init__(self, capacity: int, lib: ctypes.CDLL | None = None,
+                 handle: int | None = None):
+        self.capacity = capacity
+        self.lib = lib or load_library()
+        if self.lib is None:
+            raise RuntimeError("native library unavailable")
+        self.handle = handle if handle is not None else self.lib.swtpu_interner_create(capacity)
+        self._tokens: list[str] = []
+
+    def __len__(self) -> int:
+        return int(self.lib.swtpu_interner_size(self.handle))
+
+    def intern(self, token: str) -> int:
+        b = token.encode()
+        tid = int(self.lib.swtpu_intern(self.handle, b, len(b)))
+        if tid < 0:
+            raise RuntimeError(f"token capacity {self.capacity} exhausted")
+        return tid
+
+    def lookup(self, token: str) -> int:
+        b = token.encode()
+        return int(self.lib.swtpu_interner_lookup(self.handle, b, len(b)))
+
+    def _sync(self) -> None:
+        n = len(self)
+        buf = ctypes.create_string_buffer(1024)
+        while len(self._tokens) < n:
+            i = len(self._tokens)
+            ln = int(self.lib.swtpu_interner_get(self.handle, i, buf, 1024))
+            self._tokens.append(buf.raw[: min(ln, 1024)].decode(errors="replace"))
+
+    def token(self, tid: int) -> str:
+        if tid >= len(self._tokens):
+            self._sync()
+        return self._tokens[tid]
+
+    def items(self):
+        self._sync()
+        return ((s, i) for i, s in enumerate(self._tokens))

@@ -354,3 +354,78 @@ def test_coap_receiver_and_client():
         assert engine.metrics()["registered"] == 1
 
     asyncio.run(run())
+
+
+def test_native_fast_ingest_path():
+    """Native C++ batch decode -> vectorized staging -> pipeline step."""
+    import json as _json
+
+    from sitewhere_tpu.ingest.fast_decode import native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    engine = _mini_engine()
+    payloads = [
+        _json.dumps({"deviceToken": f"n-{i % 5}", "type": "DeviceMeasurement",
+                     "request": {"name": "temp", "value": 20.0 + i,
+                                 "eventDate": int(engine.epoch.base_unix_s * 1000) + i}}
+                    ).encode()
+        for i in range(12)
+    ]
+    payloads.append(_json.dumps(
+        {"deviceToken": "n-loc", "type": "DeviceLocation",
+         "request": {"latitude": 1.0, "longitude": 2.0}}).encode())
+    payloads.append(_json.dumps(
+        {"deviceToken": "n-0", "type": "DeviceAlert",
+         "request": {"type": "hot", "level": "Error"}}).encode())
+    payloads.append(b"{broken")
+    summary = engine.ingest_json_batch(payloads)
+    assert summary["decoded"] == 14
+    assert summary["failed"] == 1
+    engine.flush()
+    m = engine.metrics()
+    assert m["processed"] == 14
+    assert m["registered"] == 6  # n-0..n-4 + n-loc
+    st = engine.get_device_state("n-0")
+    assert st["measurements"]["temp"]["value"] == 30.0  # i=10 is latest for n-0
+    assert st["recent_alerts"][0]["type"] == "hot"
+    assert st["recent_alerts"][0]["level"] == 2
+    stl = engine.get_device_state("n-loc")
+    assert stl["recent_locations"][0]["latitude"] == 1.0
+
+
+def test_native_and_python_paths_agree():
+    """The fast path and the per-request path must produce identical state."""
+    import json as _json
+
+    from sitewhere_tpu.ingest.fast_decode import native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    msgs = [
+        {"deviceToken": f"agree-{i % 3}", "type": "DeviceMeasurement",
+         "request": {"name": "x", "value": float(i), "eventDate": 1000 + i}}
+        for i in range(9)
+    ]
+    eng_native = _mini_engine()
+    base = int(eng_native.epoch.base_unix_s * 1000)
+    for m in msgs:
+        m["request"]["eventDate"] = base + m["request"]["eventDate"]
+    eng_native.ingest_json_batch([_json.dumps(m).encode() for m in msgs])
+    eng_native.flush()
+
+    from sitewhere_tpu.engine import Engine, EngineConfig as _EC
+
+    eng_py = Engine(_EC(device_capacity=64, token_capacity=128,
+                        assignment_capacity=128, store_capacity=4096,
+                        batch_capacity=16, channels=4, use_native=False))
+    eng_py.epoch = eng_native.epoch
+    eng_py.ingest_json_batch([_json.dumps(m).encode() for m in msgs])
+    eng_py.flush()
+
+    for tok in ("agree-0", "agree-1", "agree-2"):
+        a = eng_native.get_device_state(tok)
+        b = eng_py.get_device_state(tok)
+        assert a["measurements"]["x"]["value"] == b["measurements"]["x"]["value"]
+        assert a["measurements"]["x"]["ts_ms"] == b["measurements"]["x"]["ts_ms"]
+        assert a["event_counts"] == b["event_counts"]
