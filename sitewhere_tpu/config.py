@@ -1,0 +1,275 @@
+"""Configuration system: JSON tenant config -> component graphs.
+
+The reference parses per-tenant JSON into component graphs with hand-written
+parsers over generic ``{type, id, configuration}`` wrappers
+(EventSourcesParser.java:50-126, CommandDestinationsParser,
+OutboundConnectorsParser; SURVEY.md §5.6). Same model here: declarative JSON
+describing event sources (receiver + decoder + deduplicator), outbound
+connectors (type + filters), and command destinations/routers, materialized
+by registered factory functions. The config plane is plain JSON files/dicts
+instead of ZooKeeper/k8s CRDs.
+
+Example::
+
+    {
+      "eventSources": [
+        {"id": "mqtt-in", "type": "mqtt",
+         "decoder": {"type": "json"},
+         "deduplicator": {"type": "alternate-id"},
+         "configuration": {"host": "127.0.0.1", "port": 1883,
+                            "topic": "sitewhere/input/#"}}
+      ],
+      "outboundConnectors": [
+        {"id": "audit", "type": "inmemory",
+         "filters": [{"type": "device-type", "operation": "include",
+                       "deviceTypes": ["thermostat"]}]}
+      ],
+      "commandRouting": {
+        "router": {"type": "single-choice", "destination": "default-mqtt"},
+        "destinations": [
+          {"id": "default-mqtt", "type": "mqtt",
+           "encoder": {"type": "json"},
+           "configuration": {"host": "127.0.0.1", "port": 1883}}
+        ]
+      }
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any, Callable
+
+from sitewhere_tpu.commands.destinations import (
+    CommandDestination,
+    CoapDeliveryProvider,
+    LocalDeliveryProvider,
+    MqttDeliveryProvider,
+    SmsDeliveryProvider,
+    coap_metadata_extractor,
+    mqtt_topic_extractor,
+    sms_phone_extractor,
+)
+from sitewhere_tpu.commands.encoders import (
+    BinaryCommandExecutionEncoder,
+    JsonCommandExecutionEncoder,
+    JsonStringCommandExecutionEncoder,
+)
+from sitewhere_tpu.commands.routing import (
+    DeviceTypeMappingCommandRouter,
+    NoOpCommandRouter,
+    SingleChoiceCommandRouter,
+)
+from sitewhere_tpu.connectors.base import AreaFilter, DeviceTypeFilter
+from sitewhere_tpu.connectors.impl import (
+    HttpConnector,
+    InMemoryConnector,
+    LogConnector,
+    MqttConnector,
+)
+from sitewhere_tpu.ingest.decoders import (
+    BinaryEventDecoder,
+    EchoStringDecoder,
+    JsonBatchEventDecoder,
+    JsonDeviceRequestDecoder,
+)
+from sitewhere_tpu.ingest.dedup import AlternateIdDeduplicator
+from sitewhere_tpu.ingest.sources import (
+    InboundEventSource,
+    InMemoryEventReceiver,
+    PollingRestReceiver,
+    SocketEventReceiver,
+    WebSocketEventReceiver,
+)
+
+
+class ConfigError(ValueError):
+    pass
+
+
+DECODERS: dict[str, Callable[[dict], Any]] = {
+    "json": lambda cfg: JsonDeviceRequestDecoder(),
+    "json-batch": lambda cfg: JsonBatchEventDecoder(),
+    "binary": lambda cfg: BinaryEventDecoder(),
+    "protobuf": lambda cfg: BinaryEventDecoder(),  # flat-binary replaces GPB
+    "echo": lambda cfg: EchoStringDecoder(),
+}
+
+DEDUPLICATORS: dict[str, Callable[[dict], Any]] = {
+    "alternate-id": lambda cfg: AlternateIdDeduplicator(
+        capacity=cfg.get("capacity", 1 << 16)),
+}
+
+RECEIVERS: dict[str, Callable[[dict], Any]] = {
+    "inmemory": lambda cfg: InMemoryEventReceiver(cfg.get("name", "inmemory")),
+    "socket": lambda cfg: SocketEventReceiver(
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 0),
+        framing=cfg.get("framing", "read_all")),
+    "websocket": lambda cfg: WebSocketEventReceiver(
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 0)),
+    "rest-poll": lambda cfg: PollingRestReceiver(
+        cfg["url"], interval_s=cfg.get("intervalS", 10.0),
+        headers=cfg.get("headers")),
+}
+
+
+def _mqtt_receiver(cfg: dict):
+    from sitewhere_tpu.ingest.mqtt import MqttEventReceiver
+
+    return MqttEventReceiver(
+        cfg.get("host", "127.0.0.1"), cfg["port"],
+        topic=cfg.get("topic", "sitewhere/input/#"), qos=cfg.get("qos", 0),
+        username=cfg.get("username"), password=cfg.get("password"),
+    )
+
+
+def _coap_receiver(cfg: dict):
+    from sitewhere_tpu.ingest.coap import CoapServerEventReceiver
+
+    return CoapServerEventReceiver(cfg.get("host", "127.0.0.1"),
+                                   cfg.get("port", 0))
+
+
+RECEIVERS["mqtt"] = _mqtt_receiver
+RECEIVERS["coap"] = _coap_receiver
+
+
+def build_event_source(spec: dict) -> InboundEventSource:
+    """One {id, type, decoder, deduplicator, configuration} wrapper ->
+    InboundEventSource (EventSourcesParser analog)."""
+    sid = spec.get("id")
+    if not sid:
+        raise ConfigError("event source requires an id")
+    rtype = spec.get("type")
+    if rtype not in RECEIVERS:
+        raise ConfigError(f"unknown event source type {rtype!r} "
+                          f"(known: {sorted(RECEIVERS)})")
+    receiver = RECEIVERS[rtype](spec.get("configuration", {}))
+    dspec = spec.get("decoder", {"type": "json"})
+    if dspec.get("type") not in DECODERS:
+        raise ConfigError(f"unknown decoder type {dspec.get('type')!r}")
+    decoder = DECODERS[dspec["type"]](dspec)
+    dedup = None
+    ddspec = spec.get("deduplicator")
+    if ddspec is not None:
+        if ddspec.get("type") not in DEDUPLICATORS:
+            raise ConfigError(f"unknown deduplicator type {ddspec.get('type')!r}")
+        dedup = DEDUPLICATORS[ddspec["type"]](ddspec)
+    return InboundEventSource(sid, decoder, [receiver], dedup,
+                              tenant=spec.get("tenant", "default"))
+
+
+def build_filters(specs: list[dict], engine) -> list:
+    out = []
+    for f in specs or []:
+        ftype = f.get("type")
+        if ftype == "area":
+            out.append(AreaFilter(f.get("areaIds", []),
+                                  f.get("operation", "include")))
+        elif ftype == "device-type":
+            out.append(DeviceTypeFilter(engine, f.get("deviceTypes", []),
+                                        f.get("operation", "include")))
+        else:
+            raise ConfigError(f"unknown filter type {ftype!r}")
+    return out
+
+
+def build_connector(spec: dict, engine):
+    """{id, type, filters, configuration} -> OutboundConnector
+    (OutboundConnectorsParser analog)."""
+    cid = spec.get("id")
+    ctype = spec.get("type")
+    cfg = spec.get("configuration", {})
+    filters = build_filters(spec.get("filters"), engine)
+    if ctype == "log":
+        return LogConnector(cid, filters)
+    if ctype == "inmemory":
+        return InMemoryConnector(cid, filters)
+    if ctype == "mqtt":
+        return MqttConnector(cid, cfg.get("host", "127.0.0.1"), cfg["port"],
+                             topic_pattern=cfg.get(
+                                 "topic", "sitewhere/outbound/{token}"),
+                             qos=cfg.get("qos", 0), filters=filters)
+    if ctype == "http":
+        return HttpConnector(cid, cfg["uri"], headers=cfg.get("headers"),
+                             method=cfg.get("method", "POST"), filters=filters)
+    raise ConfigError(f"unknown connector type {ctype!r}")
+
+
+ENCODERS = {
+    "json": lambda cfg: JsonCommandExecutionEncoder(),
+    "json-string": lambda cfg: JsonStringCommandExecutionEncoder(),
+    "binary": lambda cfg: BinaryCommandExecutionEncoder(),
+    "protobuf": lambda cfg: BinaryCommandExecutionEncoder(),
+}
+
+
+def build_destination(spec: dict) -> CommandDestination:
+    """{id, type, encoder, configuration} -> CommandDestination
+    (CommandDestinationsParser analog)."""
+    did = spec.get("id")
+    dtype = spec.get("type")
+    cfg = spec.get("configuration", {})
+    espec = spec.get("encoder", {"type": "json"})
+    if espec.get("type") not in ENCODERS:
+        raise ConfigError(f"unknown encoder type {espec.get('type')!r}")
+    encoder = ENCODERS[espec["type"]](espec)
+    if dtype == "mqtt":
+        provider = MqttDeliveryProvider(cfg.get("host", "127.0.0.1"),
+                                        cfg["port"], qos=cfg.get("qos", 1))
+        extractor = mqtt_topic_extractor(
+            cfg.get("commandTopic", "sitewhere/commands/{token}"),
+            cfg.get("systemTopic", "sitewhere/system/{token}"))
+    elif dtype == "coap":
+        provider = CoapDeliveryProvider()
+        extractor = coap_metadata_extractor(cfg.get("defaultPort", 5683))
+    elif dtype == "sms":
+        provider = SmsDeliveryProvider(
+            gateway_url=cfg.get("gatewayUrl"), account=cfg.get("account", ""),
+            auth_token=cfg.get("authToken", ""),
+            from_number=cfg.get("fromNumber", ""))
+        extractor = sms_phone_extractor()
+    elif dtype == "local":
+        provider = LocalDeliveryProvider()
+        extractor = mqtt_topic_extractor()
+    else:
+        raise ConfigError(f"unknown destination type {dtype!r}")
+    return CommandDestination(did, extractor, encoder, provider)
+
+
+def build_router(spec: dict):
+    rtype = spec.get("type", "single-choice")
+    if rtype == "single-choice":
+        return SingleChoiceCommandRouter(spec["destination"])
+    if rtype == "device-type-mapping":
+        return DeviceTypeMappingCommandRouter(spec.get("mappings", {}),
+                                              spec.get("default"))
+    if rtype == "noop":
+        return NoOpCommandRouter()
+    raise ConfigError(f"unknown router type {rtype!r}")
+
+
+def apply_tenant_config(instance, config: dict | str | pathlib.Path) -> dict:
+    """Materialize a tenant configuration onto a running instance; returns a
+    summary of built components."""
+    if isinstance(config, (str, pathlib.Path)):
+        config = json.loads(pathlib.Path(config).read_text())
+    summary = {"eventSources": [], "connectors": [], "destinations": []}
+    for spec in config.get("eventSources", []):
+        source = build_event_source(spec)
+        instance.add_source(source)
+        summary["eventSources"].append(source.source_id)
+    for spec in config.get("outboundConnectors", []):
+        connector = build_connector(spec, instance.engine)
+        instance.add_connector(connector)
+        summary["connectors"].append(connector.connector_id)
+    routing = config.get("commandRouting")
+    if routing:
+        for spec in routing.get("destinations", []):
+            dest = build_destination(spec)
+            instance.commands.add_destination(dest)
+            summary["destinations"].append(dest.destination_id)
+        if "router" in routing:
+            instance.commands.router = build_router(routing["router"])
+    return summary

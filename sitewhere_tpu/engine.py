@@ -357,7 +357,9 @@ class Engine:
 
     def flush(self) -> dict:
         """Run one pipeline step on the staged batch and sync host mirrors."""
-        with self.lock:
+        from sitewhere_tpu.utils.tracing import stage
+
+        with self.lock, stage("pipeline_step"):
             batch = self._buf.emit()
             self.state, out = self._step(self.state, batch)
             self._last_flush = time.monotonic()

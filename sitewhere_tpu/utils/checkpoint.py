@@ -1,0 +1,113 @@
+"""Checkpoint / resume: durable snapshots of the engine.
+
+The reference has no state checkpointing — durability is Kafka offsets +
+external databases, and the 5s window store is lossy on restart
+(DeviceStatePipeline.java:84-86 in-memory store; SURVEY.md §5.5). The TPU
+build does better by design: one snapshot captures the ENTIRE engine —
+registry tables, device-state store, event ring, allocation counters,
+metrics — plus the host mirrors (interners, device metadata, epoch base).
+Pairing a snapshot with the replayable ingest log (utils/ingestlog.py)
+gives exact at-least-once resume: restore the snapshot, replay the log
+tail past the snapshot's store cursor, and the idempotent state merge
+converges to the pre-crash state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+import jax
+import numpy as np
+
+from sitewhere_tpu.core.events import EpochBase
+from sitewhere_tpu.engine import DeviceInfo, Engine
+from sitewhere_tpu.ops.readback import absolute_cursor
+
+
+def _flatten_state(state) -> dict[str, np.ndarray]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    return {jax.tree_util.keystr(path): np.asarray(leaf) for path, leaf in flat}
+
+
+def save_engine(engine: Engine, directory: str | pathlib.Path) -> dict:
+    """Write a full snapshot; returns the manifest."""
+    directory = pathlib.Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    with engine.lock:
+        if engine.staged_count:
+            engine.flush()
+        arrays = _flatten_state(engine.state)
+        np.savez_compressed(directory / "state.npz", **arrays)
+        host = {
+            "epoch_base_unix_s": engine.epoch.base_unix_s,
+            "next_device": engine._next_device,
+            "next_assignment": engine._next_assignment,
+            "store_cursor": absolute_cursor(engine.state.store),
+            "tokens": [engine.tokens.token(i) for i in range(len(engine.tokens))],
+            "tenants": [engine.tenants.token(i) for i in range(len(engine.tenants))],
+            "device_types": [engine.device_types.token(i)
+                             for i in range(len(engine.device_types))],
+            "channel_names": [engine.channel_map.names.token(i)
+                              for i in range(len(engine.channel_map.names))],
+            "alert_types": [engine.alert_types.token(i)
+                            for i in range(len(engine.alert_types))],
+            "token_device": {str(k): v for k, v in engine.token_device.items()},
+            "devices": {
+                str(did): dataclasses.asdict(info)
+                for did, info in engine.devices.items()
+            },
+            "dead_letters": engine.dead_letters[-4096:],
+            "config": dataclasses.asdict(engine.config),
+        }
+        (directory / "host.json").write_text(json.dumps(host))
+        manifest = {
+            "format": 1,
+            "arrays": len(arrays),
+            "devices": len(engine.devices),
+            "store_cursor": host["store_cursor"],
+        }
+        (directory / "manifest.json").write_text(json.dumps(manifest))
+        return manifest
+
+
+def restore_engine(directory: str | pathlib.Path) -> Engine:
+    """Reconstruct an engine from a snapshot directory."""
+    from sitewhere_tpu.engine import EngineConfig
+
+    directory = pathlib.Path(directory)
+    host = json.loads((directory / "host.json").read_text())
+    config = EngineConfig(**host["config"])
+    engine = Engine(config)
+    engine.epoch = EpochBase(host["epoch_base_unix_s"])
+
+    # device state arrays: rebuild the pytree with saved leaves
+    data = np.load(directory / "state.npz")
+    flat, treedef = jax.tree_util.tree_flatten_with_path(engine.state)
+    leaves = []
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        arr = data[key]
+        leaves.append(jax.numpy.asarray(arr))
+    engine.state = jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # host mirrors
+    for tok in host["tokens"]:
+        engine.tokens.intern(tok)
+    for t in host["tenants"]:
+        engine.tenants.intern(t)
+    for t in host["device_types"]:
+        engine.device_types.intern(t)
+    for n in host["channel_names"]:
+        engine.channel_map.names.intern(n)
+    for a in host["alert_types"]:
+        engine.alert_types.intern(a)
+    engine.token_device = {int(k): v for k, v in host["token_device"].items()}
+    engine.devices = {
+        int(k): DeviceInfo(**v) for k, v in host["devices"].items()
+    }
+    engine._next_device = host["next_device"]
+    engine._next_assignment = host["next_assignment"]
+    engine.dead_letters = list(host["dead_letters"])
+    return engine

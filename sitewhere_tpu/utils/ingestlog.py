@@ -1,0 +1,118 @@
+"""Replayable ingest log: the durability half of checkpoint/resume.
+
+The reference leans on Kafka's durable topics for at-least-once replay
+(SURVEY.md §5.4/5.5). Without a broker, the engine appends every accepted
+raw payload batch to a segmented, length-prefixed log BEFORE staging it;
+on restart, replaying segments past the snapshot's watermark re-feeds the
+idempotent pipeline. Segments rotate by size and old segments can be
+pruned once a snapshot covers them.
+
+Record framing: u32 LE payload length + payload bytes. A record length of
+0xFFFFFFFF marks a watermark record whose payload is the JSON-encoded
+absolute store cursor.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import struct
+import threading
+from typing import Iterator
+
+_WATERMARK = 0xFFFFFFFF
+
+
+class IngestLog:
+    def __init__(self, directory: str | pathlib.Path,
+                 segment_bytes: int = 64 << 20):
+        self.dir = pathlib.Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.segment_bytes = segment_bytes
+        self._lock = threading.Lock()
+        existing = sorted(self.dir.glob("segment-*.log"))
+        self._seg_index = (
+            int(existing[-1].stem.split("-")[1]) + 1 if existing else 0
+        )
+        self._fh = None
+        self._open_segment()
+
+    def _open_segment(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        path = self.dir / f"segment-{self._seg_index:08d}.log"
+        self._fh = open(path, "ab")
+
+    def append(self, payload: bytes) -> None:
+        with self._lock:
+            self._fh.write(struct.pack("<I", len(payload)))
+            self._fh.write(payload)
+            if self._fh.tell() >= self.segment_bytes:
+                self._fh.flush()
+                self._seg_index += 1
+                self._open_segment()
+
+    def append_watermark(self, store_cursor: int) -> None:
+        """Record that all payloads so far are reflected at this cursor."""
+        body = json.dumps({"cursor": store_cursor}).encode()
+        with self._lock:
+            self._fh.write(struct.pack("<I", _WATERMARK))
+            self._fh.write(struct.pack("<I", len(body)))
+            self._fh.write(body)
+            self._fh.flush()
+
+    def sync(self) -> None:
+        with self._lock:
+            self._fh.flush()
+            import os
+
+            os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+    def replay(self, after_cursor: int = -1) -> Iterator[bytes]:
+        """Yield payloads recorded after the last watermark <= after_cursor
+        (everything, when no watermark qualifies)."""
+        pending: list[bytes] = []
+        emitting = after_cursor < 0
+        for path in sorted(self.dir.glob("segment-*.log")):
+            with open(path, "rb") as fh:
+                while True:
+                    head = fh.read(4)
+                    if len(head) < 4:
+                        break
+                    (n,) = struct.unpack("<I", head)
+                    if n == _WATERMARK:
+                        (m,) = struct.unpack("<I", fh.read(4))
+                        meta = json.loads(fh.read(m))
+                        if not emitting:
+                            if meta["cursor"] <= after_cursor:
+                                pending.clear()  # covered by the snapshot
+                            else:
+                                # snapshot falls before this watermark: the
+                                # held records may not be reflected — replay
+                                emitting = True
+                                yield from pending
+                                pending.clear()
+                        continue
+                    payload = fh.read(n)
+                    if len(payload) < n:
+                        break  # torn tail write: stop cleanly
+                    if emitting:
+                        yield payload
+                    else:
+                        pending.append(payload)
+        yield from pending
+
+    def prune(self, keep_segments: int = 2) -> int:
+        """Delete old segments (call after a snapshot); returns count."""
+        segs = sorted(self.dir.glob("segment-*.log"))
+        removed = 0
+        for path in segs[:-keep_segments] if keep_segments else segs:
+            path.unlink()
+            removed += 1
+        return removed

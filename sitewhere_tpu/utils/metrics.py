@@ -1,0 +1,176 @@
+"""Metrics: counters/gauges/histograms with Prometheus text exposition.
+
+The reference creates Prometheus metrics through its framework — per-tenant
+labeled counters (InboundEventSource.java:50-59, EventPersistenceMapper.java:
+46-47) and histograms (DeviceLookupMapper.java:34-36,
+DeviceStatePersistenceMapper.java:55-60) scraped from each microservice.
+Here one in-process registry covers the host services, the engine exports
+its device-side counters into it, and /api/instance/metrics/prometheus
+serves the standard text format.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Iterator
+
+_DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+    2.5, 5.0, 10.0,
+)
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def expose(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} counter"
+        for key, val in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+
+
+class Gauge(Counter):
+    def expose(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} gauge"
+        for key, val in sorted(self._values.items()):
+            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+
+
+class Histogram:
+    def __init__(self, name: str, help_text: str,
+                 buckets: tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_text
+        self.buckets = buckets
+        self._lock = threading.Lock()
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            if key not in self._counts:
+                self._counts[key] = [0] * len(self.buckets)
+                self._sums[key] = 0.0
+                self._totals[key] = 0
+            idx = bisect.bisect_left(self.buckets, value)
+            if idx < len(self.buckets):
+                self._counts[key][idx] += 1
+            self._sums[key] += value
+            self._totals[key] += 1
+
+    def time(self, **labels):
+        """Context manager measuring a stage duration — the per-stage latency
+        histograms of the reference's pipeline mappers."""
+        hist = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                hist.observe(time.perf_counter() - self.t0, **labels)
+
+        return _Timer()
+
+    def quantile(self, q: float, **labels) -> float | None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.get(key)
+            total = self._totals.get(key, 0)
+            if not counts or not total:
+                return None
+            target = q * total
+            acc = 0
+            for i, c in enumerate(counts):
+                acc += c
+                if acc >= target:
+                    return self.buckets[i]
+            return self.buckets[-1]
+
+    def expose(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} histogram"
+        for key in sorted(self._counts):
+            labels = dict(key)
+            acc = 0
+            for bound, c in zip(self.buckets, self._counts[key]):
+                acc += c
+                le = dict(labels, le=repr(bound))
+                yield f"{self.name}_bucket{_fmt_labels(le)} {acc}"
+            inf = dict(labels, le="+Inf")
+            yield f"{self.name}_bucket{_fmt_labels(inf)} {self._totals[key]}"
+            yield f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}"
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._metrics: dict[str, Counter | Gauge | Histogram] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_text), Counter)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_text), Gauge)
+
+    def histogram(self, name: str, help_text: str = "",
+                  buckets: tuple[float, ...] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_text, buckets), Histogram)
+
+    def _get(self, name, build, kind):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = build()
+                self._metrics[name] = m
+            elif not isinstance(m, kind):
+                raise TypeError(f"metric {name!r} already registered as {type(m).__name__}")
+            return m
+
+    def expose_text(self) -> str:
+        lines: list[str] = []
+        for m in self._metrics.values():
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+
+def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
+                          tenant: str = "all") -> None:
+    """Push the engine's device-side counters into the registry (scrape-time
+    sync; the device counters are the source of truth)."""
+    reg = registry or REGISTRY
+    for name, value in engine.metrics().items():
+        reg.gauge(f"swtpu_engine_{name}",
+                  f"engine counter {name}").set(value, tenant=tenant)

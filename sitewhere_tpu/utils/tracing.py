@@ -1,0 +1,66 @@
+"""Tracing / profiling hooks.
+
+The reference defers tracing to the Istio mesh and measures stages with
+Prometheus histograms (SURVEY.md §5.1). Here: lightweight host-side stage
+spans feeding the metrics histograms, plus a wrapper around the JAX
+profiler for device traces (viewable in TensorBoard/Perfetto).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+from sitewhere_tpu.utils.metrics import REGISTRY
+
+_STAGE_HIST = REGISTRY.histogram(
+    "swtpu_stage_seconds", "host pipeline stage latency"
+)
+
+_local = threading.local()
+
+
+@contextlib.contextmanager
+def stage(name: str, **labels):
+    """Span for one pipeline stage; nests (child spans record their own
+    stage label), observations land in the shared histogram."""
+    t0 = time.perf_counter()
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    stack.append(name)
+    try:
+        yield
+    finally:
+        stack.pop()
+        _STAGE_HIST.observe(time.perf_counter() - t0, stage=name, **labels)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """Capture a JAX device profile (xplane) for the enclosed block."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Decorator: trace a function as a stage span + XLA annotation."""
+    import functools
+
+    import jax
+
+    def wrap(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            with stage(name), jax.profiler.TraceAnnotation(name):
+                return fn(*args, **kwargs)
+
+        return inner
+
+    return wrap

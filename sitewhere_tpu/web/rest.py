@@ -158,6 +158,15 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     r.add_get("/api/instance/metrics",
               lambda req: json_response(inst.engine.metrics()))
 
+    async def prometheus_metrics(request: web.Request):
+        from sitewhere_tpu.utils.metrics import REGISTRY, export_engine_metrics
+
+        export_engine_metrics(inst.engine)
+        return web.Response(text=REGISTRY.expose_text(),
+                            content_type="text/plain")
+
+    r.add_get("/api/instance/metrics/prometheus", prometheus_metrics)
+
     # --- devices ----------------------------------------------------------
     async def create_device(request: web.Request):
         body = await request.json()
