@@ -82,6 +82,8 @@ class EngineConfig:
     default_device_type: str = "default"
     presence_missing_s: float = 8 * 3600.0  # DevicePresenceManager default 8h
     use_native: bool = True            # C++ decode/interning data plane
+    analytics_devices: int = 0         # HBM telemetry windows for [0, M)
+    analytics_window: int = 128        # W timesteps per window
 
 
 @dataclasses.dataclass
@@ -175,6 +177,8 @@ class Engine:
         self.state = PipelineState.create(
             c.device_capacity, c.token_capacity, c.assignment_capacity,
             c.store_capacity, c.channels,
+            analytics_devices=c.analytics_devices,
+            analytics_window=c.analytics_window,
         )
         self._step = make_pipeline_step(
             PipelineConfig(auto_register=c.auto_register, default_device_type=0)

@@ -1,0 +1,143 @@
+"""Analytics service: anomaly scoring over the engine's live telemetry
+windows — the `service-tpu-analytics` microservice of BASELINE.json.
+
+Data flow: the pipeline step keeps [M, W, C] windows HBM-resident
+(pipeline.py stage 5) -> Pallas feature extraction + normalization
+(ops/window_features.py) -> AnomalyModel scores (models/anomaly.py), all
+without leaving the device; only scores and threshold crossings reach the
+host. Crossings are injected back into the pipeline as system-sourced
+DeviceAlert events, so downstream consumers (device state, connectors,
+command delivery) see anomalies exactly like device-originated alerts —
+the outbound-connectors fan-out path of the reference.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sitewhere_tpu.core.types import AlertLevel, AlertSource
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+from sitewhere_tpu.models.anomaly import AnomalyConfig, AnomalyModel, make_train_step
+from sitewhere_tpu.models.windows import snapshot_windows
+from sitewhere_tpu.ops.window_features import normalize_windows, window_features
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _score_windows(model: AnomalyModel, params, data, filled, min_fill):
+    """windows [M, W, C] -> (scores [M], valid [M]); devices without enough
+    samples score 0/invalid."""
+    feats = window_features(data)
+    normed = normalize_windows(data, feats)
+    scores = model.apply(params, normed)
+    valid = filled >= min_fill
+    return jnp.where(valid, scores, 0.0), valid, feats
+
+
+class AnalyticsService:
+    """Owns the anomaly model + training/scoring over engine windows."""
+
+    def __init__(self, engine, cfg: AnomalyConfig | None = None,
+                 threshold: float = 3.0, min_fill: int | None = None,
+                 learning_rate: float = 1e-3):
+        if engine.config.analytics_devices <= 0:
+            raise ValueError("engine has no analytics windows "
+                             "(set EngineConfig.analytics_devices > 0)")
+        self.engine = engine
+        w = engine.config.analytics_window
+        c = engine.config.channels
+        self.cfg = cfg or AnomalyConfig(sensors=c, window=w,
+                                        hidden=256, lstm_hidden=256, latent=32)
+        assert self.cfg.sensors == c and self.cfg.window == w
+        self.model = AnomalyModel(self.cfg)
+        rng = np.random.default_rng(0)
+        x0 = jnp.asarray(rng.standard_normal((2, w, c)), jnp.float32)
+        self.params = self.model.init(jax.random.key(0), x0)
+        self.tx = optax.adamw(learning_rate)
+        self.opt_state = self.tx.init(self.params)
+        self._train = jax.jit(make_train_step(self.model, self.tx))
+        self.threshold = threshold
+        self.min_fill = min_fill if min_fill is not None else w
+        # running score statistics for the adaptive threshold (z-score)
+        self._score_mean = 0.0
+        self._score_m2 = 1.0
+        self._score_n = 1e-3
+
+    def _windows(self):
+        wins = self.engine.state.windows
+        if wins is None:
+            raise RuntimeError("engine windows disappeared")
+        return wins
+
+    def train_on_live(self, batch_size: int = 256, steps: int = 1) -> float:
+        """Self-supervised training on the current (sufficiently filled)
+        windows — 'normal' is whatever the fleet is doing."""
+        wins = self._windows()
+        data = snapshot_windows(wins)
+        filled = np.asarray(wins.filled)
+        eligible = np.nonzero(filled >= self.min_fill)[0]
+        if eligible.size == 0:
+            return float("nan")
+        rng = np.random.default_rng(int(filled.sum()) % (2**31))
+        loss = float("nan")
+        feats = window_features(data)
+        normed = normalize_windows(data, feats)
+        for _ in range(steps):
+            pick = rng.choice(eligible, size=min(batch_size, eligible.size),
+                              replace=False)
+            x = normed[jnp.asarray(pick)]
+            self.params, self.opt_state, loss = self._train(
+                self.params, self.opt_state, x)
+        return float(loss)
+
+    def score_all(self) -> dict:
+        """Score every analytics device; returns scores + anomalous tokens."""
+        wins = self._windows()
+        data = snapshot_windows(wins)
+        scores, valid, _ = _score_windows(
+            self.model, self.params, data, wins.filled, jnp.int32(self.min_fill)
+        )
+        scores_np = np.asarray(scores)
+        valid_np = np.asarray(valid)
+        vs = scores_np[valid_np]
+        if vs.size:
+            # Welford-ish running stats over scored populations
+            self._score_n += vs.size
+            delta = vs.mean() - self._score_mean
+            self._score_mean += delta * vs.size / self._score_n
+            self._score_m2 += vs.var() * vs.size
+        std = max(np.sqrt(self._score_m2 / self._score_n), 1e-6)
+        z = (scores_np - self._score_mean) / std
+        anomalous = valid_np & (z > self.threshold)
+        tokens = []
+        for did in np.nonzero(anomalous)[0]:
+            info = self.engine.devices.get(int(did))
+            if info is not None:
+                tokens.append(info.token)
+        return {
+            "scores": scores_np,
+            "valid": valid_np,
+            "zscores": z,
+            "anomalous_tokens": tokens,
+        }
+
+    def emit_anomaly_alerts(self, result: dict | None = None) -> int:
+        """Inject DeviceAlert events for anomalous devices back into the
+        pipeline (system-sourced alerts flow to state/connectors/commands
+        like any other event)."""
+        result = result if result is not None else self.score_all()
+        for token in result["anomalous_tokens"]:
+            self.engine.process(DecodedRequest(
+                type=RequestType.DEVICE_ALERT,
+                device_token=token,
+                alert_type="analytics.anomaly",
+                alert_level=AlertLevel.WARNING,
+                alert_message="anomaly score exceeded threshold",
+            ))
+        if result["anomalous_tokens"]:
+            self.engine.flush()
+        return len(result["anomalous_tokens"])

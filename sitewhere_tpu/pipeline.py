@@ -32,6 +32,7 @@ from sitewhere_tpu.core.registry import RegistryTables
 from sitewhere_tpu.core.state import DeviceStateStore
 from sitewhere_tpu.core.store import EventStore
 from sitewhere_tpu.core.types import NULL_ID, EventType
+from sitewhere_tpu.models.windows import TelemetryWindows, append_measurements
 from sitewhere_tpu.ops.lookup import expand_assignments, lookup_devices
 from sitewhere_tpu.ops.persist import append_events
 from sitewhere_tpu.ops.registration import register_misses
@@ -70,6 +71,9 @@ class PipelineState:
     next_device: jax.Array      # int32[] device-row allocation counter
     next_assignment: jax.Array  # int32[]
     metrics: PipelineMetrics
+    # optional HBM-resident telemetry windows feeding the analytics service
+    # (BASELINE.json north star); None disables the update stage.
+    windows: TelemetryWindows | None = None
 
     @staticmethod
     def create(
@@ -81,6 +85,8 @@ class PipelineState:
         bootstrap: RegistryTables | None = None,
         next_device: int = 0,
         next_assignment: int = 0,
+        analytics_devices: int = 0,
+        analytics_window: int = 128,
     ) -> "PipelineState":
         return PipelineState(
             registry=bootstrap
@@ -91,6 +97,11 @@ class PipelineState:
             next_device=jnp.asarray(next_device, jnp.int32),
             next_assignment=jnp.asarray(next_assignment, jnp.int32),
             metrics=PipelineMetrics.zeros(),
+            windows=(
+                TelemetryWindows.zeros(analytics_devices, analytics_window, channels)
+                if analytics_devices > 0
+                else None
+            ),
         )
 
 
@@ -185,7 +196,16 @@ def pipeline_step(
         aux=batch.aux[src],
     )
 
-    # 5. windowed device-state merge (device-state analog)
+    # 5. telemetry-window update for the analytics service (devices with
+    #    dense id < analytics capacity get HBM-resident sliding windows)
+    windows = state.windows
+    if windows is not None:
+        windows = append_measurements(
+            windows, res.device, res.found, batch.etype, batch.ts_ms,
+            batch.seq, batch.values,
+        )
+
+    # 6. windowed device-state merge (device-state analog)
     new_device_state = merge_batch_state(
         state.device_state,
         dev=res.device,
@@ -216,6 +236,7 @@ def pipeline_step(
         next_device=next_device,
         next_assignment=next_assignment,
         metrics=metrics,
+        windows=windows,
     )
     out = StepOutput(
         n_found=n_found,

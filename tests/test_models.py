@@ -107,3 +107,61 @@ def test_anomaly_model_dp_tp_sharded(rng):
     assert any(
         "tp" in str(getattr(leaf, "sharding", "")) for leaf in flat
     )
+
+
+def test_window_features_pallas_matches_reference(rng):
+    from sitewhere_tpu.ops.window_features import (
+        normalize_windows,
+        window_features,
+        window_features_reference,
+    )
+
+    x = jnp.asarray(rng.standard_normal((100, 16, 8)), jnp.float32)
+    ref = window_features_reference(x)
+    pal = window_features(x, tile_m=32, force_pallas=True)
+    np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    normed = normalize_windows(x, ref)
+    np.testing.assert_allclose(np.asarray(normed.mean(axis=1)), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(normed.std(axis=1)), 1.0, atol=1e-2)
+
+
+def test_analytics_service_end_to_end(rng):
+    """Windows fill from live events through the pipeline; the analytics
+    service trains, scores, and injects anomaly alerts back as events."""
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+    from sitewhere_tpu.models.anomaly import AnomalyConfig
+    from sitewhere_tpu.models.service import AnalyticsService
+
+    W = 8
+    engine = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=4096, batch_capacity=32, channels=4,
+        analytics_devices=16, analytics_window=W,
+    ))
+    svc = AnalyticsService(
+        engine,
+        AnomalyConfig(sensors=4, window=W, hidden=64, lstm_hidden=64, latent=8),
+        threshold=2.5, min_fill=W,
+    )
+    # 8 devices emit W sinusoid-ish samples; device an-7 is wildly different
+    for t in range(W):
+        for d in range(8):
+            val = float(np.sin(t / 3) + 0.01 * d) if d != 7 else float(1e3 * (t + 1))
+            engine.process(DecodedRequest(
+                type=RequestType.DEVICE_MEASUREMENT, device_token=f"an-{d}",
+                measurements={"x": val},
+            ))
+    engine.flush()
+    wins = engine.state.windows
+    assert int(wins.filled[0]) == W  # windows actually filled by the pipeline
+    loss = svc.train_on_live(batch_size=8, steps=3)
+    assert np.isfinite(loss)
+    result = svc.score_all()
+    assert result["valid"][:8].all()
+    assert not result["valid"][8:].any()
+    n = svc.emit_anomaly_alerts(result)
+    if n:  # alerts landed in device state as system alerts
+        st = engine.get_device_state(result["anomalous_tokens"][0])
+        assert st["recent_alerts"][0]["type"] == "analytics.anomaly"
