@@ -14,14 +14,14 @@ initialstate,dweetio,groovy}/). Here:
   * SearchIndex — feeds the embedded event search index (the Solr slot;
     search/index.py) so event-search works without external Solr.
 
-RabbitMQ / SQS / EventHub have no reachable brokers in a zero-egress image
-and no SDKs baked in; they are explicit unavailable-by-config stubs that
-fail fast at construction with a clear message (matching our no-silent-gaps
-policy) rather than half-working lookalikes.
+  * RabbitMq — publishes event JSON to a topic exchange via the native
+    AMQP 0-9-1 client (ingest/amqp.py), with optional multicaster /
+    route-builder routing exactly like the reference connector.
 """
 
 from __future__ import annotations
 
+import asyncio
 import json
 import logging
 from typing import Any, Callable
@@ -180,6 +180,63 @@ class SearchIndexConnector(OutboundConnector):
         self.index.add(event)
 
 
+class RabbitMqConnector(SerialOutboundConnector):
+    """Publish each event as JSON to an AMQP topic exchange (reference:
+    connectors/rabbitmq/RabbitMqOutboundConnector.java:96-97,200-237 —
+    per-tenant topic exchange, fixed topic by default, multicaster routes or
+    a route builder when configured)."""
+
+    def __init__(self, connector_id: str, host: str, port: int,
+                 exchange: str = "sitewhere.events",
+                 topic: str = "sitewhere.output", multicaster=None,
+                 route_builder=None, username: str = "guest",
+                 password: str = "guest", filters=None):
+        super().__init__(connector_id, filters)
+        self.host, self.port = host, port
+        self.username, self.password = username, password
+        self.exchange, self.topic = exchange, topic
+        self.multicaster, self.route_builder = multicaster, route_builder
+        self.client = None
+
+    async def _ensure_connected(self):
+        if self.client is not None:
+            return self.client
+        from sitewhere_tpu.ingest.amqp import AmqpClient
+
+        client = AmqpClient(self.host, self.port, self.username, self.password)
+        try:
+            await client.connect()
+            await client.declare_exchange(self.exchange, "topic")
+        except Exception:
+            await client.close()
+            raise
+        self.client = client
+        return client
+
+    async def process_event(self, event: OutboundEvent) -> None:
+        client = await self._ensure_connected()
+        if self.multicaster is not None:
+            routes = self.multicaster.routes_for(event)
+        elif self.route_builder is not None:
+            routes = [self.route_builder.build(event, event.device_token)]
+        else:
+            routes = [self.topic]
+        body = json.dumps(event.to_json_dict()).encode()
+        try:
+            for route in routes:
+                await client.publish(self.exchange, route, body)
+        except (OSError, ConnectionError, asyncio.TimeoutError):
+            # drop the dead connection so the serial retry reconnects
+            self.client = None
+            await client.close()
+            raise
+
+    async def on_stop(self) -> None:
+        if self.client is not None:
+            await self.client.close()
+            self.client = None
+
+
 def _unavailable(kind: str, needs: str):
     class _Unavailable(OutboundConnector):
         def __init__(self, *a, **kw):
@@ -193,6 +250,5 @@ def _unavailable(kind: str, needs: str):
     return _Unavailable
 
 
-RabbitMqConnector = _unavailable("RabbitMq", "an AMQP client library/broker")
 SqsConnector = _unavailable("Sqs", "the AWS SDK and network egress")
 EventHubConnector = _unavailable("EventHub", "the Azure SDK and network egress")

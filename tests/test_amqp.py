@@ -1,0 +1,225 @@
+"""AMQP 0-9-1 edge tests: codec, embedded broker, client, RabbitMQ receiver
+(sources/rabbitmq/RabbitMqInboundEventReceiver.java parity) and outbound
+connector (connectors/rabbitmq/RabbitMqOutboundConnector.java parity)."""
+
+import asyncio
+import json
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ingest.amqp import (
+    AmqpBroker,
+    AmqpClient,
+    ArgReader,
+    ArgWriter,
+    RabbitMqEventReceiver,
+    topic_key_matches,
+)
+from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
+from sitewhere_tpu.ingest.sources import EventSourcesManager, InboundEventSource
+from sitewhere_tpu.outbound.feed import OutboundEvent
+from sitewhere_tpu.core.types import EventType
+
+
+def measurement_json(token="dev-1", name="fuel.level", value=123.4):
+    return json.dumps({
+        "deviceToken": token,
+        "type": "DeviceMeasurement",
+        "request": {"name": name, "value": value},
+    }).encode()
+
+
+def test_topic_key_matching():
+    assert topic_key_matches("a.b.c", "a.b.c")
+    assert topic_key_matches("a.*.c", "a.x.c")
+    assert not topic_key_matches("a.*.c", "a.x.y.c")
+    assert topic_key_matches("a.#", "a")
+    assert topic_key_matches("a.#", "a.b.c.d")
+    assert topic_key_matches("#.c", "a.b.c")
+    assert topic_key_matches("#", "anything.at.all")
+    assert not topic_key_matches("a.b", "a.b.c")
+    assert not topic_key_matches("a.b.c", "a.b")
+
+
+def test_arg_codec_roundtrip():
+    data = (ArgWriter().short(0).shortstr("queue-name").bit(False).bit(True)
+            .bit(False).longstr(b"payload").long(42).longlong(1 << 40)
+            .table({"k": "v"}).done())
+    r = ArgReader(data)
+    assert r.short() == 0
+    assert r.shortstr() == "queue-name"
+    assert r.bits(3) == [False, True, False]
+    assert r.longstr() == b"payload"
+    assert r.long() == 42
+    assert r.longlong() == 1 << 40
+    assert r.table() == {"k": "v"}
+
+
+def test_broker_publish_consume_default_exchange():
+    async def run():
+        broker = AmqpBroker()
+        await broker.start()
+        got: list[tuple[str, str, bytes]] = []
+        try:
+            consumer = AmqpClient("127.0.0.1", broker.bound_port)
+            consumer.on_message = lambda ex, key, body: got.append((ex, key, body))
+            await consumer.connect()
+            await consumer.declare_queue("q1")
+            await consumer.consume("q1")
+
+            producer = AmqpClient("127.0.0.1", broker.bound_port)
+            await producer.connect()
+            await producer.publish("", "q1", b"hello")
+            await producer.publish("", "other-queue", b"dropped")
+            await asyncio.sleep(0.2)
+            await producer.close()
+            await consumer.close()
+        finally:
+            await broker.stop()
+        assert got == [("", "q1", b"hello")]
+
+    asyncio.run(run())
+
+
+def test_broker_topic_exchange_and_pending_buffer():
+    async def run():
+        broker = AmqpBroker()
+        await broker.start()
+        got: list[bytes] = []
+        try:
+            producer = AmqpClient("127.0.0.1", broker.bound_port)
+            await producer.connect()
+            await producer.declare_exchange("ex.telemetry", "topic")
+            # bind + publish BEFORE any consumer: must buffer in the queue
+            await producer.declare_queue("qt")
+            await producer.bind_queue("qt", "ex.telemetry", "site.*.temp")
+            await producer.publish("ex.telemetry", "site.a.temp", b"m1")
+            await producer.publish("ex.telemetry", "site.a.humidity", b"nope")
+
+            consumer = AmqpClient("127.0.0.1", broker.bound_port)
+            consumer.on_message = lambda ex, key, body: got.append(body)
+            await consumer.connect()
+            await consumer.declare_queue("qt")
+            await consumer.consume("qt")
+            await asyncio.sleep(0.1)
+            await producer.publish("ex.telemetry", "site.b.temp", b"m2")
+            await asyncio.sleep(0.2)
+            await producer.close()
+            await consumer.close()
+        finally:
+            await broker.stop()
+        assert got == [b"m1", b"m2"]
+
+    asyncio.run(run())
+
+
+def test_rabbitmq_receiver_end_to_end():
+    async def run():
+        broker = AmqpBroker()
+        await broker.start()
+        engine = Engine(EngineConfig(
+            device_capacity=64, token_capacity=128, assignment_capacity=128,
+            store_capacity=4096, batch_capacity=16, channels=4,
+        ))
+        mgr = EventSourcesManager(
+            on_event_request=engine.process,
+            on_registration_request=engine.process,
+        )
+        recv = RabbitMqEventReceiver("127.0.0.1", broker.bound_port,
+                                     queue="sw.input")
+        mgr.add_source(InboundEventSource("amqp", JsonDeviceRequestDecoder(), [recv]))
+        await mgr.initialize()
+        await mgr.start()
+        try:
+            pub = AmqpClient("127.0.0.1", broker.bound_port)
+            await pub.connect()
+            await pub.publish("", "sw.input", measurement_json("amqp-1"))
+            await pub.publish("", "sw.input", measurement_json("amqp-2"))
+            await asyncio.sleep(0.3)
+            await pub.close()
+        finally:
+            await mgr.stop()
+            await broker.stop()
+        engine.flush()
+        assert engine.metrics()["registered"] == 2
+
+    asyncio.run(run())
+
+
+def test_rabbitmq_receiver_reconnects():
+    """Broker comes up AFTER the receiver starts; the reconnect loop
+    (reference: scheduleReconnect, RabbitMqInboundEventReceiver.java:60-75)
+    must attach once it is reachable."""
+
+    async def run():
+        probe = AmqpBroker()
+        await probe.start()
+        port = probe.bound_port
+        await probe.stop()  # now nothing listens on `port`
+
+        engine = Engine(EngineConfig(
+            device_capacity=64, token_capacity=128, assignment_capacity=128,
+            store_capacity=4096, batch_capacity=16, channels=4,
+        ))
+        mgr = EventSourcesManager(
+            on_event_request=engine.process,
+            on_registration_request=engine.process,
+        )
+        recv = RabbitMqEventReceiver("127.0.0.1", port, queue="sw.input",
+                                     reconnect_interval_s=0.1)
+        mgr.add_source(InboundEventSource("amqp", JsonDeviceRequestDecoder(), [recv]))
+        await mgr.initialize()
+        await mgr.start()
+        broker = AmqpBroker(port=port)
+        await broker.start()
+        try:
+            await asyncio.sleep(0.4)  # allow the reconnect loop to attach
+            pub = AmqpClient("127.0.0.1", port)
+            await pub.connect()
+            await pub.publish("", "sw.input", measurement_json("rc-1"))
+            await asyncio.sleep(0.3)
+            await pub.close()
+        finally:
+            await mgr.stop()
+            await broker.stop()
+        engine.flush()
+        assert engine.metrics()["registered"] == 1
+
+    asyncio.run(run())
+
+
+def test_rabbitmq_connector_publishes_to_topic_exchange():
+    from sitewhere_tpu.connectors.impl import RabbitMqConnector
+
+    ev = OutboundEvent(
+        event_id=1, etype=EventType.MEASUREMENT, device_token="d-1",
+        device_id=0, assignment_id=0, tenant="default", area_id=0, asset_id=0,
+        ts_ms=1000, received_ms=1001, measurements={"temp": 20.5},
+        values=[20.5], aux0=0, aux1=0,
+    )
+
+    async def run():
+        broker = AmqpBroker()
+        await broker.start()
+        got: list[tuple[str, bytes]] = []
+        try:
+            sub = AmqpClient("127.0.0.1", broker.bound_port)
+            sub.on_message = lambda ex, key, body: got.append((key, body))
+            await sub.connect()
+            await sub.declare_exchange("sitewhere.events", "topic")
+            await sub.declare_queue("sink")
+            await sub.bind_queue("sink", "sitewhere.events", "#")
+            await sub.consume("sink")
+
+            conn = RabbitMqConnector("rmq", "127.0.0.1", broker.bound_port)
+            await conn.process_event(ev)
+            await asyncio.sleep(0.2)
+            await conn.on_stop()
+            await sub.close()
+        finally:
+            await broker.stop()
+        assert len(got) == 1
+        key, body = got[0]
+        assert key == "sitewhere.output"
+        assert json.loads(body)["deviceToken"] == "d-1"
+
+    asyncio.run(run())

@@ -35,7 +35,6 @@ from sitewhere_tpu.connectors.base import (
 )
 from sitewhere_tpu.connectors.impl import (
     InMemoryConnector,
-    RabbitMqConnector,
     SearchIndexConnector,
 )
 from sitewhere_tpu.core.types import EventType
@@ -262,5 +261,9 @@ def test_search_index_connector_and_queries():
 
 
 def test_unavailable_connectors_fail_fast():
-    with pytest.raises(RuntimeError, match="AMQP"):
-        RabbitMqConnector("r")
+    from sitewhere_tpu.connectors.impl import EventHubConnector, SqsConnector
+
+    with pytest.raises(RuntimeError, match="AWS SDK"):
+        SqsConnector("s")
+    with pytest.raises(RuntimeError, match="Azure SDK"):
+        EventHubConnector("e")
