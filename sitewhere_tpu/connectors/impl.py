@@ -17,6 +17,10 @@ initialstate,dweetio,groovy}/). Here:
   * RabbitMq — publishes event JSON to a topic exchange via the native
     AMQP 0-9-1 client (ingest/amqp.py), with optional multicaster /
     route-builder routing exactly like the reference connector.
+  * EventHub — sends into a partitioned event hub keyed by device token
+    (hub semantics in ingest/eventhub.py).
+  * Sqs — SigV4-signed SQS SendMessage via stdlib signing
+    (connectors/aws.py; re-exported here).
 """
 
 from __future__ import annotations
@@ -237,18 +241,19 @@ class RabbitMqConnector(SerialOutboundConnector):
             self.client = None
 
 
-def _unavailable(kind: str, needs: str):
-    class _Unavailable(OutboundConnector):
-        def __init__(self, *a, **kw):
-            raise RuntimeError(
-                f"{kind} connector requires {needs}, which is not available in "
-                f"this deployment image; configure an HttpConnector bridge or "
-                f"enable the dependency"
-            )
+class EventHubConnector(SerialOutboundConnector):
+    """Send event JSON into a partitioned event hub keyed by device token
+    (reference: connectors/azure/EventHubOutboundConnector.java — sendEvent
+    per event type; hub semantics in ingest/eventhub.py)."""
 
-    _Unavailable.__name__ = f"{kind}Connector"
-    return _Unavailable
+    def __init__(self, connector_id: str, hub, filters=None):
+        super().__init__(connector_id, filters)
+        self.hub = hub
+
+    async def process_event(self, event: OutboundEvent) -> None:
+        self.hub.send(json.dumps(event.to_json_dict()).encode(),
+                      partition_key=event.device_token)
 
 
-SqsConnector = _unavailable("Sqs", "the AWS SDK and network egress")
-EventHubConnector = _unavailable("EventHub", "the Azure SDK and network egress")
+# real implementation lives in connectors/aws.py (stdlib SigV4 signer)
+from sitewhere_tpu.connectors.aws import SqsConnector  # noqa: E402,F401

@@ -282,7 +282,7 @@ class AmqpBroker:
                              + encode_content(channel, body))
                 await writer.drain()
                 return
-            except ConnectionResetError:
+            except ConnectionError:
                 q.consumers.popleft()
         q.pending.append(body)
 
@@ -372,7 +372,7 @@ class AmqpBroker:
                     ex, key = r.shortstr(), r.shortstr()
                     pub[channel] = (ex, key, -1, bytearray())
                 await writer.drain()
-        except (asyncio.IncompleteReadError, ConnectionResetError, ValueError):
+        except (asyncio.IncompleteReadError, ConnectionError, ValueError):
             pass
         finally:
             self._writers.discard(writer)
@@ -468,7 +468,7 @@ class AmqpClient:
                     if len(acc) >= size:
                         await self._dispatch(deliver, bytes(acc))
                         deliver = None
-        except (asyncio.IncompleteReadError, ConnectionResetError,
+        except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
             pass
 
@@ -517,7 +517,7 @@ class AmqpClient:
                     0, CONN_CLOSE,
                     ArgWriter().short(200).shortstr("bye").short(0).short(0).done()))
                 await self._writer.drain()
-            except ConnectionResetError:
+            except ConnectionError:
                 pass
             self._writer.close()
             self._writer = None

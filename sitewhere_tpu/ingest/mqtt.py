@@ -177,7 +177,7 @@ class MqttClient:
                     fut = self._acks.pop(pid, None)
                     if fut is not None and not fut.done():
                         fut.set_result(body)
-        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
 
     async def subscribe(self, topic: str, qos: int = 0) -> None:
@@ -206,7 +206,7 @@ class MqttClient:
             try:
                 self._writer.write(encode_packet(DISCONNECT, 0, b""))
                 await self._writer.drain()
-            except ConnectionResetError:
+            except ConnectionError:
                 pass
             self._writer.close()
 
@@ -279,7 +279,7 @@ class MqttBroker:
                     await writer.drain()
                 elif ptype == DISCONNECT:
                     break
-        except (asyncio.IncompleteReadError, ConnectionResetError):
+        except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             self._subs.pop(writer, None)
@@ -292,7 +292,7 @@ class MqttBroker:
                 try:
                     w.write(pkt)
                     await w.drain()
-                except ConnectionResetError:
+                except ConnectionError:
                     self._subs.pop(w, None)
 
 

@@ -189,7 +189,7 @@ class SocketEventReceiver(InboundEventReceiver):
                     line = line.strip()
                     if line:
                         self.submit(line, meta)
-        except (asyncio.IncompleteReadError, ConnectionResetError):
+        except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             writer.close()

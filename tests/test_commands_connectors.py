@@ -260,10 +260,15 @@ def test_search_index_connector_and_queries():
     assert len(docs) == 2
 
 
-def test_unavailable_connectors_fail_fast():
-    from sitewhere_tpu.connectors.impl import EventHubConnector, SqsConnector
-
-    with pytest.raises(RuntimeError, match="AWS SDK"):
-        SqsConnector("s")
-    with pytest.raises(RuntimeError, match="Azure SDK"):
-        EventHubConnector("e")
+def test_connector_surface_importable():
+    """Every reference connector type resolves to a real class (no
+    unavailable-stub gates remain)."""
+    from sitewhere_tpu.connectors.impl import (  # noqa: F401
+        EventHubConnector,
+        HttpConnector,
+        MqttConnector,
+        RabbitMqConnector,
+        ScriptedConnector,
+        SearchIndexConnector,
+        SqsConnector,
+    )

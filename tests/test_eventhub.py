@@ -1,0 +1,173 @@
+"""Partitioned event-hub tests: partition-key routing, processor-host batch
+delivery, checkpoint/resume, multi-host partition splitting, receiver +
+connector (sources/azure/EventHubInboundEventReceiver.java parity)."""
+
+import asyncio
+import json
+
+from sitewhere_tpu.core.types import EventType
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
+from sitewhere_tpu.ingest.eventhub import (
+    CheckpointStore,
+    EventHub,
+    EventHubEventReceiver,
+    EventProcessorHost,
+)
+from sitewhere_tpu.ingest.sources import EventSourcesManager, InboundEventSource
+from sitewhere_tpu.outbound.feed import OutboundEvent
+
+
+def test_partition_key_stability_and_round_robin():
+    hub = EventHub("telemetry", partition_count=4)
+    a1 = hub.send(b"1", partition_key="dev-a")
+    a2 = hub.send(b"2", partition_key="dev-a")
+    assert a1.partition_id == a2.partition_id  # stable hash per key
+    assert a2.sequence_number == a1.sequence_number + 1
+    pids = {hub.send(b"x").partition_id for _ in range(4)}
+    assert pids == {0, 1, 2, 3}  # keyless round-robin covers all partitions
+
+
+def test_processor_host_batches_and_checkpoints(tmp_path):
+    hub = EventHub("telemetry", partition_count=2)
+    store = CheckpointStore(tmp_path / "ckpt.json")
+    for i in range(12):
+        hub.send(b"m%d" % i, partition_key=f"k{i}")
+
+    got: list[bytes] = []
+
+    async def run_host():
+        host = EventProcessorHost(hub, "$Default", store, checkpoint_every=5)
+        host.on_events = lambda pid, batch: got.extend(ev.body for ev in batch)
+        await host.register()
+        await asyncio.sleep(0.2)
+        await host.unregister()
+
+    asyncio.run(run_host())
+    assert sorted(got) == sorted(b"m%d" % i for i in range(12))
+    # checkpoints persisted: sum of checkpointed offsets covers all but the
+    # sub-checkpoint_every tail of each partition
+    total_ckpt = sum(store.get("$Default", p, hub.epoch) for p in range(2))
+    assert total_ckpt >= 12 - 2 * 4
+
+    # a NEW host with a NEW store file handle resumes from the checkpoint,
+    # not from zero
+    store2 = CheckpointStore(tmp_path / "ckpt.json")
+    resumed: list[bytes] = []
+
+    async def run_resumed():
+        host = EventProcessorHost(hub, "$Default", store2, checkpoint_every=5)
+        host.on_events = lambda pid, batch: resumed.extend(ev.body for ev in batch)
+        await host.register()
+        await asyncio.sleep(0.2)
+        await host.unregister()
+
+    asyncio.run(run_resumed())
+    assert len(resumed) == 12 - total_ckpt
+
+
+def test_two_hosts_split_partitions():
+    hub = EventHub("telemetry", partition_count=4)
+    seen = {1: set(), 2: set()}
+
+    async def run():
+        h1 = EventProcessorHost(hub, "grp")
+        h2 = EventProcessorHost(hub, "grp")
+        h1.on_events = lambda pid, batch: seen[1].add(pid)
+        h2.on_events = lambda pid, batch: seen[2].add(pid)
+        await h1.register()
+        await h2.register()
+        for i in range(32):
+            hub.send(b"x", partition_key=f"k{i}")
+        await asyncio.sleep(0.3)
+        await h1.unregister()
+        await h2.unregister()
+
+    asyncio.run(run())
+    assert seen[1] and seen[2]
+    assert not (seen[1] & seen[2])  # disjoint ownership
+    assert seen[1] | seen[2] == {0, 1, 2, 3}
+
+
+def test_retention_trims_and_reader_ages_out():
+    hub = EventHub("small", partition_count=1, retention=5)
+    for i in range(12):
+        hub.send(b"m%d" % i, partition_key="k")
+    assert hub.end_offset(0) == 12
+    # only the last 5 retained; a reader from 0 ages out to offset 7
+    batch = hub.read(0, 0, 100)
+    assert [e.body for e in batch] == [b"m7", b"m8", b"m9", b"m10", b"m11"]
+    assert batch[0].offset == 7
+
+
+def test_checkpoint_clamped_to_fresh_hub(tmp_path):
+    """A persisted checkpoint from a previous log generation must not
+    swallow the new run's first events: epochs differ, so resume from 0."""
+    store = CheckpointStore(tmp_path / "c.json")
+    store.checkpoint("$Default", 0, 10, epoch="previous-run-epoch")
+
+    hub = EventHub("fresh", partition_count=1)
+    got: list[bytes] = []
+
+    async def run():
+        host = EventProcessorHost(hub, "$Default",
+                                  CheckpointStore(tmp_path / "c.json"))
+        host.on_events = lambda pid, batch: got.extend(e.body for e in batch)
+        await host.register()
+        hub.send(b"first", partition_key="k")
+        await asyncio.sleep(0.2)
+        await host.unregister()
+
+    asyncio.run(run())
+    assert got == [b"first"]
+
+
+def test_eventhub_receiver_end_to_end():
+    hub = EventHub("ingest", partition_count=3)
+
+    async def run():
+        engine = Engine(EngineConfig(
+            device_capacity=64, token_capacity=128, assignment_capacity=128,
+            store_capacity=4096, batch_capacity=16, channels=4,
+        ))
+        mgr = EventSourcesManager(
+            on_event_request=engine.process,
+            on_registration_request=engine.process,
+        )
+        recv = EventHubEventReceiver(hub)
+        mgr.add_source(InboundEventSource("hub", JsonDeviceRequestDecoder(), [recv]))
+        await mgr.initialize()
+        await mgr.start()
+        try:
+            for i in range(10):
+                hub.send(json.dumps({
+                    "deviceToken": f"hub-{i}", "type": "DeviceMeasurement",
+                    "request": {"name": "t", "value": float(i)},
+                }).encode(), partition_key=f"hub-{i}")
+            await asyncio.sleep(0.3)
+        finally:
+            await mgr.stop()
+        engine.flush()
+        assert engine.metrics()["registered"] == 10
+        assert engine.metrics()["persisted"] == 10
+
+    asyncio.run(run())
+
+
+def test_eventhub_connector():
+    from sitewhere_tpu.connectors.impl import EventHubConnector
+
+    hub = EventHub("out", partition_count=2)
+    ev = OutboundEvent(
+        event_id=1, etype=EventType.MEASUREMENT, device_token="d-1",
+        device_id=0, assignment_id=0, tenant="default", area_id=0, asset_id=0,
+        ts_ms=1000, received_ms=1001, measurements={"temp": 20.5},
+        values=[20.5], aux0=0, aux1=0,
+    )
+
+    asyncio.run(EventHubConnector("hub", hub).process_event(ev))
+    bodies = [e for p in range(hub.partition_count)
+              for e in hub.read(p, 0, 100)]
+    assert len(bodies) == 1
+    assert json.loads(bodies[0].body)["deviceToken"] == "d-1"
+    assert bodies[0].partition_key == "d-1"
