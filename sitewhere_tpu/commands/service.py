@@ -45,6 +45,8 @@ class UndeliveredCommand:
 class CommandDeliveryService(LifecycleComponent):
     """Owns registry, strategy, router, destinations, and the feed consumer."""
 
+    HISTORY_LIMIT = 10_000
+
     def __init__(self, engine, router: CommandRouter,
                  registry: CommandRegistry | None = None):
         super().__init__("command-delivery")
@@ -57,6 +59,9 @@ class CommandDeliveryService(LifecycleComponent):
         self.undelivered: list[UndeliveredCommand] = []
         # pending invocations keyed by the engine event id lane (aux0)
         self._pending: dict[int, CommandInvocation] = {}
+        # retained history for the CommandInvocations controller queries,
+        # bounded FIFO so long-running instances don't grow without bound
+        self.history: dict[int, CommandInvocation] = {}
         self.consumer = FeedConsumer(engine, "command-delivery", start_from_latest=True)
         self.delivered_count = 0
 
@@ -85,6 +90,9 @@ class CommandDeliveryService(LifecycleComponent):
         # validate early so bad invocations fail at the API surface
         self.strategy.build_execution(inv)
         self._pending[inv.invocation_id] = inv
+        self.history[inv.invocation_id] = inv
+        while len(self.history) > self.HISTORY_LIMIT:
+            self.history.pop(next(iter(self.history)))
         # persist through the pipeline; aux0 carries the invocation id
         from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
 
@@ -136,6 +144,25 @@ class CommandDeliveryService(LifecycleComponent):
             except DeliveryError as e:
                 logger.warning("delivery to %s failed: %s", dest_id, e)
                 self.undelivered.append(UndeliveredCommand(inv, dest_id, str(e)))
+
+    def get_invocation(self, invocation_id: int) -> CommandInvocation | None:
+        """Lookup a retained invocation (CommandInvocations controller
+        GET /invocations/{id})."""
+        return self.history.get(invocation_id)
+
+    def responses_for(self, invocation_id: int, limit: int = 100) -> list[dict]:
+        """Command responses whose originatingEventId names this invocation
+        (CommandInvocations controller listCommandInvocationResponses).
+        Devices post COMMAND_RESPONSE events with originatingEventId set to
+        the string invocation id they received."""
+        from sitewhere_tpu.core.types import NULL_ID
+
+        oid = self.engine.event_ids.lookup(str(invocation_id))
+        if oid == NULL_ID:
+            return []
+        res = self.engine.query_events(
+            etype=EventType.COMMAND_RESPONSE, aux0=oid, limit=limit)
+        return res["events"]
 
     async def send_system_command(self, device_token: str, command: SystemCommand) -> None:
         """Deliver a system command (e.g. RegistrationAck) immediately."""

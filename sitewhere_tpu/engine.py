@@ -99,6 +99,25 @@ class DeviceInfo:
     auto_registered: bool = False
 
 
+@dataclasses.dataclass
+class AssignmentInfo:
+    """Host-side assignment metadata (reference: device assignments managed by
+    RdbDeviceManagement + the Assignments REST controller); the hot columns
+    (status/device/asset/area/customer) also live on-device for expansion."""
+
+    token: str
+    id: int
+    device_token: str
+    tenant: str
+    status: str = "ACTIVE"                 # DeviceAssignmentStatus name
+    asset: str | None = None
+    area: str | None = None
+    customer: str | None = None
+    metadata: dict[str, Any] = dataclasses.field(default_factory=dict)
+    created_ms: int = 0
+    released_ms: int | None = None
+
+
 @jax.jit
 def _admin_create_device(state: PipelineState, token_id, device_id, assignment_id,
                          type_id, tenant_id, area_id, customer_id):
@@ -137,6 +156,62 @@ def _admin_set_device_active(state: PipelineState, device_id, active):
             reg, device_active=reg.device_active.at[device_id].set(active)
         )
     )
+
+
+@jax.jit
+def _admin_update_device(state: PipelineState, device_id, type_id, area_id,
+                         customer_id):
+    reg = state.registry
+    return dataclasses.replace(
+        state, registry=dataclasses.replace(
+            reg,
+            device_type=reg.device_type.at[device_id].set(type_id),
+            device_area=reg.device_area.at[device_id].set(area_id),
+            device_customer=reg.device_customer.at[device_id].set(customer_id),
+        )
+    )
+
+
+@jax.jit
+def _admin_add_assignment(state: PipelineState, device_id, assignment_id, slot,
+                          asset_id, area_id, customer_id):
+    """Attach an additional ACTIVE assignment to a device slot (the
+    RdbDeviceManagement.createDeviceAssignment analog; slots feed the
+    per-assignment event expansion of DeviceAssignmentsLookupMapper)."""
+    reg = state.registry
+    reg = dataclasses.replace(
+        reg,
+        device_assignments=reg.device_assignments.at[device_id, slot].set(assignment_id),
+        assignment_active=reg.assignment_active.at[assignment_id].set(True),
+        assignment_status=reg.assignment_status.at[assignment_id].set(
+            jnp.int32(DeviceAssignmentStatus.ACTIVE)
+        ),
+        assignment_device=reg.assignment_device.at[assignment_id].set(device_id),
+        assignment_asset=reg.assignment_asset.at[assignment_id].set(asset_id),
+        assignment_area=reg.assignment_area.at[assignment_id].set(area_id),
+        assignment_customer=reg.assignment_customer.at[assignment_id].set(customer_id),
+    )
+    return dataclasses.replace(
+        state, registry=reg,
+        next_assignment=jnp.maximum(state.next_assignment, assignment_id + 1),
+    )
+
+
+@jax.jit
+def _admin_set_assignment_status(state: PipelineState, assignment_id, status, active):
+    """Update assignment status; when deactivated (release), also detach it
+    from its device's slot row so event expansion stops targeting it."""
+    reg = state.registry
+    did = reg.assignment_device[assignment_id]
+    row = reg.device_assignments[did]
+    new_row = jnp.where((row == assignment_id) & ~active, jnp.int32(NULL_ID), row)
+    reg = dataclasses.replace(
+        reg,
+        assignment_status=reg.assignment_status.at[assignment_id].set(status),
+        assignment_active=reg.assignment_active.at[assignment_id].set(active),
+        device_assignments=reg.device_assignments.at[did].set(new_row),
+    )
+    return dataclasses.replace(state, registry=reg)
 
 
 class Engine:
@@ -189,6 +264,10 @@ class Engine:
         # host mirrors
         self.devices: dict[int, DeviceInfo] = {}      # device_id -> info
         self.token_device: dict[int, int] = {}        # token_id -> device_id
+        self.assignments: dict[int, AssignmentInfo] = {}   # assignment_id -> info
+        self.assignment_tokens: dict[str, int] = {}        # token -> assignment_id
+        self.device_slots: dict[int, list[int]] = {}       # device_id -> slot row
+        self.assets = TokenInterner(1 << 16)
         self._next_device = 0
         self._next_assignment = 0
         self.dead_letters: list[int] = []             # unregistered token ids
@@ -373,21 +452,26 @@ class Engine:
         new_tokens = [int(t) for t in np.asarray(out.new_tokens) if t != NULL_ID]
         # mirror device-side auto-registration: allocation order == list order
         new_dids = []
+        new_aids = []
         for tid in new_tokens:
             did = self._next_device
+            aid = self._next_assignment
             self._next_device += 1
             self._next_assignment += 1
             self.token_device[tid] = did
             new_dids.append(did)
+            new_aids.append(aid)
         if new_dids:
             tenants = np.asarray(self.state.registry.device_tenant[np.asarray(new_dids)])
-            for tid, did, ten in zip(new_tokens, new_dids, tenants):
+            for tid, did, aid, ten in zip(new_tokens, new_dids, new_aids, tenants):
+                tenant = self.tenants.token(int(ten)) if int(ten) != NULL_ID else "default"
                 self.devices[did] = DeviceInfo(
                     token=self.tokens.token(tid),
                     device_type=self.config.default_device_type,
-                    tenant=self.tenants.token(int(ten)) if int(ten) != NULL_ID else "default",
+                    tenant=tenant,
                     auto_registered=True,
                 )
+                self._record_assignment(aid, did, slot=0)
         dead = [int(t) for t in np.asarray(out.dead_tokens) if t != NULL_ID]
         self.dead_letters.extend(dead)
         summary = {
@@ -442,6 +526,7 @@ class Engine:
                 token=token, device_type=type_name, tenant=tenant,
                 area=area, customer=customer, metadata=metadata or {},
             )
+            self._record_assignment(aid, did, slot=0, area=area, customer=customer)
             return did
 
     def delete_device(self, token: str) -> bool:
@@ -452,6 +537,139 @@ class Engine:
                 return False
             self.state = _admin_set_device_active(self.state, jnp.int32(did), False)
             return True
+
+    def update_device(self, token: str, device_type: str | None = None,
+                      area: str | None = None, customer: str | None = None,
+                      metadata: dict | None = None) -> DeviceInfo:
+        """Update device columns + host metadata (RdbDeviceManagement.updateDevice)."""
+        with self.lock:
+            tid = self.tokens.lookup(token)
+            did = self.token_device.get(tid)
+            if did is None:
+                raise KeyError(f"device {token!r} not registered")
+            info = self.devices[did]
+            if device_type is not None:
+                info.device_type = device_type
+            if area is not None:
+                info.area = area
+            if customer is not None:
+                info.customer = customer
+            if metadata is not None:
+                info.metadata = metadata
+            self.state = _admin_update_device(
+                self.state, jnp.int32(did),
+                jnp.int32(self.device_types.intern(info.device_type)),
+                jnp.int32(self.areas.intern(info.area) if info.area else NULL_ID),
+                jnp.int32(self.customers.intern(info.customer) if info.customer else NULL_ID),
+            )
+            return info
+
+    # ------------------------------------------------------------- assignments
+    def _record_assignment(self, aid: int, did: int, slot: int,
+                           token: str | None = None, asset: str | None = None,
+                           area: str | None = None, customer: str | None = None,
+                           metadata: dict | None = None) -> AssignmentInfo:
+        """Record host metadata for an assignment already written on-device
+        (by _admin_create_device / _admin_add_assignment / the registration
+        kernel). Caller holds the engine lock."""
+        dev = self.devices[did]
+        tok = token or f"{dev.token}:a{aid}"
+        info = AssignmentInfo(
+            token=tok, id=aid, device_token=dev.token, tenant=dev.tenant,
+            asset=asset, area=area or dev.area, customer=customer or dev.customer,
+            metadata=metadata or {}, created_ms=self.epoch.now_ms(),
+        )
+        self.assignments[aid] = info
+        self.assignment_tokens[tok] = aid
+        slots = self.device_slots.setdefault(did, [NULL_ID] * MAX_ACTIVE_ASSIGNMENTS)
+        slots[slot] = aid
+        return info
+
+    def create_assignment(self, device_token: str, token: str | None = None,
+                          asset: str | None = None, area: str | None = None,
+                          customer: str | None = None,
+                          metadata: dict | None = None) -> AssignmentInfo:
+        """Attach an additional ACTIVE assignment to a registered device
+        (reference: RdbDeviceManagement.createDeviceAssignment via the
+        Assignments REST controller)."""
+        with self.lock:
+            if len(self._buf):
+                self.flush()
+            tid = self.tokens.lookup(device_token)
+            did = self.token_device.get(tid)
+            if did is None:
+                raise KeyError(f"device {device_token!r} not registered")
+            if token is not None and token in self.assignment_tokens:
+                raise ValueError(f"assignment token {token!r} already exists")
+            slots = self.device_slots.setdefault(
+                did, [NULL_ID] * MAX_ACTIVE_ASSIGNMENTS)
+            try:
+                slot = slots.index(NULL_ID)
+            except ValueError:
+                # client-correctable conflict, not an engine fault
+                raise ValueError(
+                    f"device {device_token!r} already has "
+                    f"{MAX_ACTIVE_ASSIGNMENTS} active assignments") from None
+            aid = self._next_assignment
+            if aid >= self.config.assignment_capacity:
+                raise RuntimeError("assignment capacity exhausted")
+            self._next_assignment += 1
+            self.state = _admin_add_assignment(
+                self.state, jnp.int32(did), jnp.int32(aid), jnp.int32(slot),
+                jnp.int32(self.assets.intern(asset) if asset else NULL_ID),
+                jnp.int32(self.areas.intern(area) if area else NULL_ID),
+                jnp.int32(self.customers.intern(customer) if customer else NULL_ID),
+            )
+            return self._record_assignment(
+                aid, did, slot, token=token, asset=asset, area=area,
+                customer=customer, metadata=metadata)
+
+    def get_assignment(self, token: str) -> AssignmentInfo | None:
+        aid = self.assignment_tokens.get(token)
+        return self.assignments.get(aid) if aid is not None else None
+
+    def list_assignments(self, device_token: str | None = None,
+                         status: str | None = None) -> list[AssignmentInfo]:
+        with self.lock:
+            out = [
+                a for a in self.assignments.values()
+                if (device_token is None or a.device_token == device_token)
+                and (status is None or a.status == status)
+            ]
+            return sorted(out, key=lambda a: a.id)
+
+    def _set_assignment_status(self, token: str,
+                               status: DeviceAssignmentStatus) -> AssignmentInfo:
+        with self.lock:
+            if len(self._buf):
+                self.flush()
+            aid = self.assignment_tokens.get(token)
+            if aid is None:
+                raise KeyError(f"assignment {token!r} not found")
+            active = status is not DeviceAssignmentStatus.RELEASED
+            self.state = _admin_set_assignment_status(
+                self.state, jnp.int32(aid), jnp.int32(status), active)
+            info = self.assignments[aid]
+            info.status = status.name
+            if not active:
+                info.released_ms = self.epoch.now_ms()
+                tid = self.tokens.lookup(info.device_token)
+                did = self.token_device.get(tid)
+                if did is not None and did in self.device_slots:
+                    slots = self.device_slots[did]
+                    self.device_slots[did] = [
+                        NULL_ID if s == aid else s for s in slots]
+            return info
+
+    def release_assignment(self, token: str) -> AssignmentInfo:
+        """End an assignment (reference: Assignments controller
+        /assignments/{token}/end -> endDeviceAssignment)."""
+        return self._set_assignment_status(token, DeviceAssignmentStatus.RELEASED)
+
+    def mark_assignment_missing(self, token: str) -> AssignmentInfo:
+        """Flag an assignment MISSING (reference: /assignments/{token}/missing);
+        it stays active so events still expand to it."""
+        return self._set_assignment_status(token, DeviceAssignmentStatus.MISSING)
 
     # ------------------------------------------------------------------ queries
     def get_device(self, token: str) -> DeviceInfo | None:
@@ -510,6 +728,63 @@ class Engine:
                 },
             }
 
+    def search_device_states(
+        self,
+        last_interaction_before_ms: int | None = None,
+        presence: str | None = None,
+        device_tokens: list[str] | None = None,
+        area: str | None = None,
+        device_type: str | None = None,
+        limit: int = 100,
+    ) -> list[dict]:
+        """Filtered device-state search (reference: DeviceStates controller
+        POST /devicestates/search -> searchDeviceStates with
+        lastInteractionDateBefore / presenceMissingDateBefore criteria).
+        Filters run vectorized over the device-resident state columns."""
+        with self.lock:
+            if len(self._buf):
+                self.flush()
+            n = self._next_device
+            if n == 0:
+                return []
+            ds = self.state.device_state
+            last = np.asarray(ds.last_interaction_ms[:n])
+            pres = np.asarray(ds.presence[:n])
+            mask = np.ones(n, np.bool_)
+            if last_interaction_before_ms is not None:
+                mask &= last < last_interaction_before_ms
+            if presence is not None:
+                mask &= pres == int(PresenceState[presence.upper()])
+            if device_tokens is not None:
+                wanted = {
+                    self.token_device.get(self.tokens.lookup(t)) for t in device_tokens
+                }
+                sel = np.zeros(n, np.bool_)
+                for d in wanted:
+                    if d is not None and d < n:
+                        sel[d] = True
+                mask &= sel
+            if area is not None or device_type is not None:
+                for d in np.nonzero(mask)[0]:
+                    info = self.devices.get(int(d))
+                    if info is None or (area is not None and info.area != area) or (
+                        device_type is not None and info.device_type != device_type
+                    ):
+                        mask[d] = False
+            out = []
+            for d in np.nonzero(mask)[0][:limit]:
+                info = self.devices.get(int(d))
+                if info is None:
+                    continue
+                out.append({
+                    "device": info.token,
+                    "deviceType": info.device_type,
+                    "tenant": info.tenant,
+                    "presence": PresenceState(int(pres[d])).name,
+                    "lastInteractionMs": int(last[d]),
+                })
+            return out
+
     def query_events(
         self,
         device_token: str | None = None,
@@ -518,10 +793,13 @@ class Engine:
         since_ms: int | None = None,
         until_ms: int | None = None,
         limit: int = 100,
+        assignment_id: int | None = None,
+        aux0: int | None = None,
     ) -> dict:
         """Filtered, newest-first event query over the HBM ring store — the
         REST listDeviceEvents/searchDeviceEvents surface (TPU-side scan,
-        only the top rows travel to the host)."""
+        only the top rows travel to the host). ``assignment_id`` / ``aux0``
+        filter on-device so the limit applies after filtering."""
         from sitewhere_tpu.ops.query import query_store
 
         with self.lock:
@@ -543,6 +821,9 @@ class Engine:
                 jnp.int32(since_ms if since_ms is not None else imin),
                 jnp.int32(until_ms if until_ms is not None else imax),
                 limit=limit,
+                assignment=(jnp.int32(assignment_id)
+                            if assignment_id is not None else None),
+                aux0=jnp.int32(aux0) if aux0 is not None else None,
             )
             n = int(res.n)
             lane_names: dict[int, str] = {}
@@ -575,6 +856,13 @@ class Engine:
                     atype = int(res.aux[i, 0])
                     ev["alertType"] = (
                         self.alert_types.token(atype) if 0 <= atype < len(self.alert_types) else None
+                    )
+                elif et is EventType.COMMAND_INVOCATION:
+                    ev["invocationId"] = int(res.aux[i, 0])
+                elif et is EventType.COMMAND_RESPONSE:
+                    oid = int(res.aux[i, 0])
+                    ev["originatingEventId"] = (
+                        self.event_ids.token(oid) if 0 <= oid < len(self.event_ids) else None
                     )
                 events.append(ev)
             return {"total": int(res.total), "events": events}

@@ -37,6 +37,7 @@ class RequestType(enum.Enum):
 _TYPE_ALIASES = {
     "DeviceMeasurements": RequestType.DEVICE_MEASUREMENT,
     "RegisterDevice": RequestType.REGISTER_DEVICE,
+    "DeviceCommandResponse": RequestType.ACKNOWLEDGE,
 }
 
 

@@ -188,6 +188,9 @@ class UserManagement:
             if password is not None:
                 user.hashed_password = hash_password(password)
             if roles is not None:
+                unknown = [r for r in roles if r not in self.roles]
+                if unknown:
+                    raise ValueError(f"unknown roles: {unknown}")
                 user.roles = roles
             if enabled is not None:
                 user.enabled = enabled

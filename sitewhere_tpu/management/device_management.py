@@ -194,13 +194,15 @@ class DeviceManagement:
         if info is None:
             raise EntityNotFound(f"device {token!r} not found")
         state = self.engine.get_device_state(token)
+        n_active = len([a for a in self.engine.list_assignments(token)
+                        if a.status != "RELEASED"]) or 1
         return DeviceSummary(
             token=info.token,
             device_type=info.device_type,
             tenant=info.tenant,
             area=info.area,
             customer=info.customer,
-            active_assignments=1,
+            active_assignments=n_active,
             presence=state["presence"] if state else None,
             last_interaction_ms=state["last_interaction_ms"] if state else None,
         )
@@ -226,6 +228,21 @@ class DeviceManagement:
 
     def delete_device(self, token: str) -> bool:
         return self.engine.delete_device(token)
+
+    def update_device(self, token: str, device_type: str | None = None,
+                      area: str | None = None, customer: str | None = None,
+                      metadata: dict | None = None) -> DeviceSummary:
+        if device_type is not None and device_type not in self.device_types:
+            raise EntityNotFound(f"device-type {device_type!r} not found")
+        if area is not None and area not in self.areas:
+            raise EntityNotFound(f"area {area!r} not found")
+        if customer is not None and customer not in self.customers:
+            raise EntityNotFound(f"customer {customer!r} not found")
+        try:
+            self.engine.update_device(token, device_type, area, customer, metadata)
+        except KeyError:
+            raise EntityNotFound(f"device {token!r} not found") from None
+        return self.get_device_summary(token)
 
     # --- statuses ---------------------------------------------------------
     def create_device_status(self, token: str, device_type: str, code: str,

@@ -61,25 +61,6 @@ def mha_reference(
     return out.astype(q.dtype)
 
 
-def streaming_softmax_update(m, l, acc, s, v):
-    """One flash-attention block update, shared with ring attention.
-
-    m:   [..., Q]        running row max
-    l:   [..., Q]        running normalizer
-    acc: [..., Q, D]     unnormalized output accumulator
-    s:   [..., Q, K]     new score block (already scaled/masked, float32)
-    v:   [..., K, D]     value block
-    """
-    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-    alpha = jnp.exp(m - m_new)
-    p = jnp.exp(s - m_new[..., None])
-    l_new = l * alpha + jnp.sum(p, axis=-1)
-    acc_new = acc * alpha[..., None] + jnp.einsum(
-        "...qk,...kd->...qd", p, v.astype(jnp.float32)
-    )
-    return m_new, l_new, acc_new
-
-
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_sc, l_sc, acc_sc, *,
                   sm_scale, causal, block_q, block_k, num_kb):
     """One (bh, qi, ki) grid step: fold key block ki into the running softmax

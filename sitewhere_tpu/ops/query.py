@@ -43,12 +43,18 @@ def query_store(
     t0: jax.Array,       # int32[] inclusive lower ts bound
     t1: jax.Array,       # int32[] inclusive upper ts bound
     limit: int = 100,
+    assignment: jax.Array | None = None,  # int32[] filter (NULL_ID = any)
+    aux0: jax.Array | None = None,        # int32[] filter on aux[:, 0]
 ) -> QueryResult:
     """Newest-first filtered query over the whole ring."""
     m = store.valid
     m &= (device == NULL_ID) | (store.device == device)
     m &= (etype == NULL_ID) | (store.etype == etype)
     m &= (tenant == NULL_ID) | (store.tenant == tenant)
+    if assignment is not None:
+        m &= (assignment == NULL_ID) | (store.assignment == assignment)
+    if aux0 is not None:
+        m &= (aux0 == NULL_ID) | (store.aux[:, 0] == aux0)
     m &= (store.ts_ms >= t0) & (store.ts_ms <= t1)
     total = jnp.sum(m.astype(jnp.int32))
     # sort newest first: key = (-match, -ts)

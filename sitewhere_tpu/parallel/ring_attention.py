@@ -8,8 +8,7 @@ TPU-native decompositions, both pure XLA collectives over the ICI mesh:
     axis ``sp``. Each device keeps its query shard pinned and streams the
     key/value shards around the ring with ``lax.ppermute`` (neighbor hops —
     exactly the ICI-friendly pattern), folding each arriving block into the
-    flash-attention running softmax (ops/attention.py's
-    ``streaming_softmax_update``). Compute and communication overlap: the
+    flash-attention running softmax. Compute and communication overlap: the
     matmul for block t hides the permute for block t+1 (XLA schedules the
     ppermute async). Memory per device: O(S/n) — no full-sequence tensor
     anywhere.

@@ -350,6 +350,85 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
           "destination": u.destination_id, "error": u.error}
          for u in inst.commands.undelivered]))
 
+    async def get_invocation(request: web.Request):
+        inv = inst.commands.get_invocation(int(request.match_info["id"]))
+        if inv is None:
+            raise EntityNotFound("invocation")
+        return json_response({
+            "invocationId": inv.invocation_id, "commandToken": inv.command_token,
+            "deviceToken": inv.device_token, "tenant": inv.tenant,
+            "parameterValues": inv.parameter_values, "initiator": inv.initiator,
+            "initiatorId": inv.initiator_id, "eventDateMs": inv.ts_ms,
+        })
+
+    r.add_get("/api/invocations/{id}", get_invocation)
+    r.add_get("/api/invocations/{id}/responses", lambda req: json_response(
+        inst.commands.responses_for(int(req.match_info["id"]))))
+
+    # --- assignments ------------------------------------------------------
+    def _assignment_json(a) -> dict:
+        return {
+            "token": a.token, "id": a.id, "deviceToken": a.device_token,
+            "tenant": a.tenant, "status": a.status, "assetToken": a.asset,
+            "areaToken": a.area, "customerToken": a.customer,
+            "metadata": a.metadata, "createdDateMs": a.created_ms,
+            "releasedDateMs": a.released_ms,
+        }
+
+    async def create_assignment(request: web.Request):
+        body = await request.json()
+        if inst.engine.get_device(body["deviceToken"]) is None:
+            raise EntityNotFound(f"device {body['deviceToken']!r} not found")
+        a = inst.engine.create_assignment(
+            body["deviceToken"], token=body.get("token"),
+            asset=body.get("assetToken"), area=body.get("areaToken"),
+            customer=body.get("customerToken"), metadata=body.get("metadata"),
+        )
+        return json_response(_assignment_json(a), status=201)
+
+    async def get_assignment(request: web.Request):
+        a = inst.engine.get_assignment(request.match_info["token"])
+        if a is None:
+            raise EntityNotFound("assignment")
+        return json_response(_assignment_json(a))
+
+    async def assignment_transition(request: web.Request):
+        token = request.match_info["token"]
+        action = request.match_info["action"]
+        if inst.engine.get_assignment(token) is None:
+            raise EntityNotFound("assignment")
+        if action == "end":
+            a = inst.engine.release_assignment(token)
+        elif action == "missing":
+            a = inst.engine.mark_assignment_missing(token)
+        else:
+            raise ValueError(f"unknown assignment action {action!r}")
+        return json_response(_assignment_json(a))
+
+    async def assignment_events(request: web.Request):
+        a = inst.engine.get_assignment(request.match_info["token"])
+        if a is None:
+            raise EntityNotFound("assignment")
+        q = request.query
+        et = EventType[q["type"].upper()] if "type" in q else None
+        res = inst.engine.query_events(
+            device_token=a.device_token, etype=et, assignment_id=a.id,
+            limit=int(q.get("pageSize", 100)),
+        )
+        return json_response(res)
+
+    r.add_post("/api/assignments", create_assignment)
+    r.add_get("/api/assignments", lambda req: json_response(
+        [_assignment_json(a) for a in inst.engine.list_assignments(
+            device_token=req.query.get("deviceToken"),
+            status=req.query.get("status"))]))
+    r.add_get("/api/assignments/{token}", get_assignment)
+    r.add_post("/api/assignments/{token}/{action}", assignment_transition)
+    r.add_get("/api/assignments/{token}/events", assignment_events)
+    r.add_get("/api/devices/{token}/assignments", lambda req: json_response(
+        [_assignment_json(a) for a in inst.engine.list_assignments(
+            device_token=req.match_info["token"])]))
+
     # --- areas / customers / zones / groups -------------------------------
     async def create_area_type(request: web.Request):
         body = await request.json()
@@ -603,6 +682,160 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
          for u in inst.users.users.values()]))
     r.add_get("/api/users/{username}/authorities", lambda req: json_response(
         inst.users.authorities_for(inst.users.users[req.match_info["username"]])))
+
+    def _user_json(u) -> dict:
+        return {"username": u.username, "roles": u.roles, "enabled": u.enabled,
+                "firstName": u.first_name, "lastName": u.last_name,
+                "email": u.email}
+
+    async def get_user(request: web.Request):
+        u = inst.users.users.get(request.match_info["username"])
+        if u is None:
+            raise EntityNotFound("user")
+        return json_response(_user_json(u))
+
+    async def update_user(request: web.Request):
+        if AUTH_ADMIN not in request.get("authorities", []):
+            return json_response({"error": "admin required"}, status=403)
+        body = await request.json()
+        u = inst.users.update_user(
+            request.match_info["username"], password=body.get("password"),
+            roles=body.get("roles"), enabled=body.get("enabled"),
+        )
+        return json_response(_user_json(u))
+
+    async def delete_user(request: web.Request):
+        if AUTH_ADMIN not in request.get("authorities", []):
+            return json_response({"error": "admin required"}, status=403)
+        if not inst.users.delete_user(request.match_info["username"]):
+            raise EntityNotFound("user")
+        return json_response({"deleted": True})
+
+    r.add_get("/api/users/{username}", get_user)
+    r.add_put("/api/users/{username}", update_user)
+    r.add_delete("/api/users/{username}", delete_user)
+
+    # --- roles / authorities (reference: Roles.java + Authorities.java) ---
+    async def create_role(request: web.Request):
+        if AUTH_ADMIN not in request.get("authorities", []):
+            return json_response({"error": "admin required"}, status=403)
+        body = await request.json()
+        inst.users.create_role(body["role"], body.get("authorities", []))
+        return json_response({"role": body["role"]}, status=201)
+
+    r.add_get("/api/roles", lambda req: json_response(
+        [{"role": name, "authorities": auths}
+         for name, auths in inst.users.roles.items()]))
+    r.add_post("/api/roles", create_role)
+    r.add_get("/api/authorities", lambda req: json_response(
+        sorted({a for auths in inst.users.roles.values() for a in auths})))
+
+    # --- system (reference: System.java version endpoint) -----------------
+    async def system_version(request: web.Request):
+        import jax
+
+        import sitewhere_tpu
+
+        return json_response({
+            "edition": "SiteWhere-TPU", "version": sitewhere_tpu.__version__,
+            "backend": jax.default_backend(),
+            "deviceCount": jax.device_count(),
+        })
+
+    r.add_get("/api/system/version", system_version)
+
+    # --- device-state search (reference: DeviceStates.java POST search) ---
+    async def device_state_search(request: web.Request):
+        body = await request.json() if request.can_read_body else {}
+        states = inst.engine.search_device_states(
+            last_interaction_before_ms=body.get("lastInteractionDateBeforeMs"),
+            presence=body.get("presence"),
+            device_tokens=body.get("deviceTokens"),
+            area=body.get("areaToken"),
+            device_type=body.get("deviceTypeToken"),
+            limit=int(body.get("pageSize", 100)),
+        )
+        return json_response({"numResults": len(states), "results": states})
+
+    r.add_post("/api/devicestates/search", device_state_search)
+
+    # --- update/delete surface (reference: each controller's PUT/DELETE) --
+    async def update_device(request: web.Request):
+        body = await request.json()
+        s = inst.device_management.update_device(
+            request.match_info["token"],
+            device_type=body.get("deviceTypeToken"),
+            area=body.get("areaToken"), customer=body.get("customerToken"),
+            metadata=body.get("metadata"),
+        )
+        return json_response(dataclasses.asdict(s))
+
+    r.add_put("/api/devices/{token}", update_device)
+
+    def _store_update(store, fields: dict[str, str]):
+        """PUT handler over an EntityStore: body camelCase key -> attr."""
+        async def handler(request: web.Request):
+            body = await request.json()
+
+            def apply(e):
+                for key, attr in fields.items():
+                    if key in body:
+                        setattr(e, attr, body[key])
+                if "metadata" in body:
+                    e.meta.metadata = body["metadata"]
+
+            e = store.update(request.match_info["token"], apply)
+            return json_response(_entity(e))
+
+        return handler
+
+    def _store_delete(store):
+        async def handler(request: web.Request):
+            store.delete(request.match_info["token"])
+            return json_response({"deleted": True})
+
+        return handler
+
+    def _store_get(store):
+        async def handler(request: web.Request):
+            return json_response(_entity(store.get(request.match_info["token"])))
+
+        return handler
+
+    dm = inst.device_management
+    named = {"name": "name", "description": "description"}
+    for path, store, fields in [
+        ("/api/devicetypes/{token}", dm.device_types, named),
+        ("/api/areatypes/{token}", dm.area_types, named),
+        ("/api/areas/{token}", dm.areas, named),
+        ("/api/customertypes/{token}", dm.customer_types, named),
+        ("/api/customers/{token}", dm.customers, named),
+        ("/api/zones/{token}", dm.zones, named),
+        ("/api/devicegroups/{token}", dm.groups,
+         {"name": "name", "description": "description", "roles": "roles"}),
+        ("/api/assettypes/{token}", inst.assets.asset_types, named),
+        ("/api/assets/{token}", inst.assets.assets, named),
+        ("/api/schedules/{token}", inst.scheduler.schedules, {"name": "name"}),
+        ("/api/jobs/{token}", inst.scheduler.jobs, {}),
+        ("/api/tenants/{token}", inst.tenants.tenants,
+         {"name": "name", "authorizedUserIds": "authorized_users"}),
+    ]:
+        r.add_put(path, _store_update(store, fields))
+        r.add_delete(path, _store_delete(store))
+    # GET-by-token for families that lacked it
+    r.add_get("/api/areatypes/{token}", _store_get(dm.area_types))
+    r.add_get("/api/customertypes", lambda req: json_response(
+        _paged(dm.customer_types.list())))
+    r.add_get("/api/customertypes/{token}", _store_get(dm.customer_types))
+    r.add_get("/api/customers/{token}", _store_get(dm.customers))
+    r.add_get("/api/zones/{token}", _store_get(dm.zones))
+    r.add_get("/api/devicegroups/{token}", _store_get(dm.groups))
+    r.add_get("/api/assettypes", lambda req: json_response(
+        _paged(inst.assets.asset_types.list())))
+    r.add_get("/api/assettypes/{token}", _store_get(inst.assets.asset_types))
+    r.add_get("/api/assets/{token}", _store_get(inst.assets.assets))
+    r.add_get("/api/schedules/{token}", _store_get(inst.scheduler.schedules))
+    r.add_get("/api/jobs/{token}", _store_get(inst.scheduler.jobs))
 
     return app
 

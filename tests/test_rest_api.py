@@ -273,3 +273,139 @@ def test_jwt_and_password_primitives():
     assert verify_password("hunter2", h)
     assert not verify_password("hunter3", h)
     assert not verify_password("hunter2", "garbage")
+
+
+def test_assignments_over_rest(api):
+    call, inst, loop = api
+    call("POST", "/api/devicetypes", {"token": "meter", "name": "Meter"})
+    call("POST", "/api/devices", {"token": "m-1", "deviceTypeToken": "meter"})
+
+    # registering a device creates a default ACTIVE assignment
+    status, existing = call("GET", "/api/devices/m-1/assignments")
+    assert status == 200 and len(existing) == 1
+    assert existing[0]["status"] == "ACTIVE"
+
+    # attach a second assignment with an explicit token
+    status, a = call("POST", "/api/assignments",
+                     {"deviceToken": "m-1", "token": "m-1-winter",
+                      "areaToken": "plant-a"})
+    assert status == 201 and a["token"] == "m-1-winter"
+    status, got = call("GET", "/api/assignments/m-1-winter")
+    assert status == 200 and got["areaToken"] == "plant-a"
+
+    # events now expand to both active assignments
+    call("POST", "/api/devices/m-1/events",
+         {"type": "DeviceMeasurement", "request": {"name": "kwh", "value": 5.0}})
+    status, evs = call("GET", "/api/assignments/m-1-winter/events")
+    assert status == 200 and evs["total"] == 1
+
+    # mark missing keeps it active; end releases + detaches the slot
+    status, a = call("POST", "/api/assignments/m-1-winter/missing")
+    assert status == 200 and a["status"] == "MISSING"
+    status, a = call("POST", "/api/assignments/m-1-winter/end")
+    assert status == 200 and a["status"] == "RELEASED"
+    assert a["releasedDateMs"] is not None
+    status, active = call("GET", "/api/assignments",
+                          params={"deviceToken": "m-1", "status": "ACTIVE"})
+    assert status == 200 and len(active) == 1
+
+    # released assignment no longer receives expanded events
+    call("POST", "/api/devices/m-1/events",
+         {"type": "DeviceMeasurement", "request": {"name": "kwh", "value": 6.0}})
+    status, evs = call("GET", "/api/assignments/m-1-winter/events")
+    assert evs["total"] == 1
+
+    # unknown device / assignment -> 404
+    status, _ = call("POST", "/api/assignments", {"deviceToken": "ghost"})
+    assert status == 404
+    status, _ = call("GET", "/api/assignments/ghost")
+    assert status == 404
+
+
+def test_crud_update_delete_over_rest(api):
+    call, inst, loop = api
+    call("POST", "/api/devicetypes", {"token": "cam", "name": "Camera"})
+    status, dt = call("PUT", "/api/devicetypes/cam",
+                      {"name": "IP Camera", "description": "PoE"})
+    assert status == 200 and dt["name"] == "IP Camera"
+
+    call("POST", "/api/devices", {"token": "c-1", "deviceTypeToken": "cam"})
+    call("POST", "/api/areatypes", {"token": "site", "name": "Site"})
+    call("POST", "/api/areas", {"token": "hq", "areaTypeToken": "site",
+                                "name": "HQ"})
+    status, dev = call("PUT", "/api/devices/c-1",
+                       {"areaToken": "hq", "metadata": {"rack": "r7"}})
+    assert status == 200 and dev["area"] == "hq"
+
+    # asset type + asset get/update/delete
+    call("POST", "/api/assettypes", {"token": "person", "name": "Person"})
+    call("POST", "/api/assets", {"token": "bob", "assetTypeToken": "person",
+                                 "name": "Bob"})
+    status, a = call("PUT", "/api/assets/bob", {"name": "Robert"})
+    assert status == 200 and a["name"] == "Robert"
+    status, a = call("GET", "/api/assets/bob")
+    assert a["name"] == "Robert"
+    status, _ = call("DELETE", "/api/assets/bob")
+    assert status == 200
+    status, _ = call("GET", "/api/assets/bob")
+    assert status == 404
+
+    # delete propagates 404 afterwards across stores
+    status, _ = call("DELETE", "/api/devicetypes/cam")
+    assert status == 200
+    status, _ = call("GET", "/api/devicetypes/cam")
+    assert status == 404
+
+
+def test_roles_system_and_state_search(api):
+    call, inst, loop = api
+    # roles / authorities (Roles.java / Authorities.java analogs)
+    status, roles = call("GET", "/api/roles")
+    assert status == 200 and {r["role"] for r in roles} >= {"admin", "user"}
+    status, _ = call("POST", "/api/roles",
+                     {"role": "operator", "authorities": ["VIEW_SERVER_INFORMATION"]})
+    assert status == 201
+    status, auths = call("GET", "/api/authorities")
+    assert status == 200 and "ADMINISTER_USERS" in auths
+
+    # user get/update/delete
+    call("POST", "/api/users", {"username": "carol", "password": "pw",
+                                "roles": ["user"]})
+    status, u = call("PUT", "/api/users/carol", {"roles": ["operator"]})
+    assert status == 200 and u["roles"] == ["operator"]
+    status, _ = call("DELETE", "/api/users/carol")
+    assert status == 200
+    status, _ = call("GET", "/api/users/carol")
+    assert status == 404
+
+    # system version (System.java analog)
+    status, v = call("GET", "/api/system/version")
+    assert status == 200 and v["edition"] == "SiteWhere-TPU"
+
+    # device-state search (DeviceStates.java POST /search analog)
+    call("POST", "/api/devices", {"token": "s-1", "deviceTypeToken": "default"})
+    call("POST", "/api/devices/s-1/events",
+         {"type": "DeviceMeasurement", "request": {"name": "t", "value": 1.0}})
+    status, res = call("POST", "/api/devicestates/search",
+                       {"presence": "PRESENT"})
+    assert status == 200 and res["numResults"] == 1
+    assert res["results"][0]["device"] == "s-1"
+    status, res = call("POST", "/api/devicestates/search",
+                       {"deviceTokens": ["nope"]})
+    assert res["numResults"] == 0
+
+    # command invocation retained queries (CommandInvocations.java analog)
+    call("POST", "/api/devicetypes/default/commands",
+         {"token": "ping", "name": "ping"})
+    status, inv = call("POST", "/api/devices/s-1/invocations",
+                       {"commandToken": "ping"})
+    assert status == 201
+    inv_id = inv["invocationId"]
+    status, got = call("GET", f"/api/invocations/{inv_id}")
+    assert status == 200 and got["commandToken"] == "ping"
+    # device posts a response naming the invocation id
+    call("POST", "/api/devices/s-1/events",
+         {"type": "DeviceCommandResponse",
+          "request": {"originatingEventId": str(inv_id), "response": "pong"}})
+    status, resp = call("GET", f"/api/invocations/{inv_id}/responses")
+    assert status == 200 and len(resp) == 1
