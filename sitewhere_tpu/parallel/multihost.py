@@ -1,0 +1,96 @@
+"""Multi-host (pod-scale) bootstrap: the DCN side of the distributed engine.
+
+The reference scales past one node with Kafka brokers + k8s replicas
+(SURVEY.md §2.9 "distributed communication backend"); the TPU-native
+equivalent is a single global mesh spanning hosts. Inside a pod slice the
+mesh axes ride ICI; across pods XLA lowers the same collectives onto DCN.
+Hosts never talk to each other directly: each process feeds the shards whose
+devices it can address (the Kafka-partition-locality analog), and the
+`lax.all_to_all` exchange (parallel/exchange.py) moves mis-routed events
+between shards on the interconnect.
+
+Process topology:
+  * `initialize()` wraps `jax.distributed.initialize` (coordinator, rank).
+  * `local_shard_ids(mesh)` — which rows of the stacked state this host owns.
+  * `assemble_stacked_batch(mesh, shard_batches)` — build the global
+    [n_shards, B, ...] EventBatch from per-shard host buffers, placing each
+    shard's rows directly on its owning device (zero cross-host copies; the
+    runtime only stitches metadata).
+Single-process meshes (tests, one host) degrade to "all shards local".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+from sitewhere_tpu.core.events import EventBatch
+from sitewhere_tpu.parallel.mesh import SHARD_AXIS, shard_leading
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Join the multi-host job; returns False when single-process.
+
+    Must run before any backend use on every host of the pod. TPU pods and
+    cluster launchers auto-discover all three arguments from the
+    environment, so bare ``initialize()`` works there; outside a cluster the
+    auto-detection failure is swallowed and the process stays single-host.
+    Explicitly passed arguments always raise on failure.
+    """
+    explicit = coordinator_address is not None or num_processes is not None
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except (ValueError, RuntimeError):
+        if explicit:
+            raise
+        return False
+
+
+def local_shard_ids(mesh) -> list[int]:
+    """Shard-axis indices whose device is addressable by this process —
+    the set of stacked-state rows this host's ingest workers feed."""
+    me = jax.process_index()
+    return [
+        i for i, d in enumerate(mesh.devices.flat) if d.process_index == me
+    ]
+
+
+def assemble_stacked_batch(mesh, shard_batches: dict[int, EventBatch]) -> EventBatch:
+    """Build the global stacked [n_shards, B, ...] EventBatch.
+
+    ``shard_batches`` maps shard index -> that shard's local EventBatch
+    (host numpy arrays, e.g. ``HostEventBuffer.emit()``); this process must
+    provide exactly its ``local_shard_ids``. Each shard's rows are placed on
+    the shard's own device and the global array is assembled from the
+    single-device pieces — the multi-host-safe construction (no host ever
+    materializes another host's rows).
+    """
+    devs = list(mesh.devices.flat)
+    mine = local_shard_ids(mesh)
+    missing = set(mine) - set(shard_batches)
+    if missing:
+        raise ValueError(f"missing batches for local shards {sorted(missing)}")
+
+    template = shard_batches[mine[0]]
+    sharding = shard_leading(mesh)
+
+    def glue(field: str):
+        pieces = []
+        for i in mine:
+            arr = np.asarray(getattr(shard_batches[i], field))[None]
+            pieces.append(jax.device_put(arr, devs[i]))
+        shape = (len(devs),) + pieces[0].shape[1:]
+        return jax.make_array_from_single_device_arrays(shape, sharding, pieces)
+
+    return EventBatch(**{
+        f.name: glue(f.name) for f in dataclasses.fields(template)
+    })

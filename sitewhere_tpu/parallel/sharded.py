@@ -25,7 +25,15 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+import numpy as np
+
 from sitewhere_tpu.core.events import EventBatch
+from sitewhere_tpu.core.types import (
+    NULL_ID,
+    NUM_EVENT_TYPES,
+    EventType,
+    PresenceState,
+)
 from sitewhere_tpu.pipeline import (
     PipelineConfig,
     PipelineState,
@@ -171,3 +179,150 @@ class ShardedEngine:
             f.name: int(jnp.sum(getattr(m, f.name)))
             for f in dataclasses.fields(m)
         }
+
+    # --------------------------------------------------------------- queries
+    def query_events(
+        self,
+        etype: EventType | None = None,
+        tenant_id: int | None = None,
+        since_ms: int | None = None,
+        until_ms: int | None = None,
+        limit: int = 100,
+    ) -> dict:
+        """Global newest-first event query: every shard scans its local ring
+        in parallel (vmapped on-device filter + top-k), then the per-shard
+        pages merge on the host. The reference analog is a scatter-gather
+        query across per-partition stores."""
+        imin, imax = -(2**31), 2**31 - 1
+        res = _stacked_query(
+            self.state.store,
+            jnp.int32(int(etype) if etype is not None else NULL_ID),
+            jnp.int32(tenant_id if tenant_id is not None else NULL_ID),
+            jnp.int32(since_ms if since_ms is not None else imin),
+            jnp.int32(until_ms if until_ms is not None else imax),
+            limit=limit,
+        )
+        total = int(np.sum(np.asarray(res.total)))
+        # one device->host transfer per field, not per row
+        ns = np.asarray(res.n)
+        ts = np.asarray(res.ts_ms)
+        etypes = np.asarray(res.etype)
+        devices = np.asarray(res.device)
+        assignments = np.asarray(res.assignment)
+        tenants = np.asarray(res.tenant)
+        rows = []
+        for shard in range(self.n_shards):
+            for i in range(int(ns[shard])):
+                rows.append((int(ts[shard, i]), shard, i))
+        rows.sort(key=lambda r: -r[0])
+        events = [
+            {
+                "shard": shard,
+                "type": EventType(int(etypes[shard, i])).name,
+                "device": int(devices[shard, i]),
+                "assignmentId": int(assignments[shard, i]),
+                "tenant": int(tenants[shard, i]),
+                "eventDateMs": t,
+            }
+            for t, shard, i in rows[:limit]
+        ]
+        return {"total": total, "events": events}
+
+    def presence_sweep(self, now_ms: int, missing_ms: int) -> list[tuple[int, int]]:
+        """Mark stale devices MISSING on every shard at once; returns
+        (shard, local_device_id) pairs newly missing."""
+        self.state, newly = _stacked_sweep(
+            self.state, jnp.int32(now_ms), jnp.int32(missing_ms))
+        out = np.asarray(newly)
+        return [(int(s), int(d)) for s, d in zip(*np.nonzero(out))]
+
+    def device_state_summary(self, shard: int, device_id: int) -> dict:
+        """Read back one device's aggregated state from its owning shard."""
+        ds = self.state.device_state
+        return {
+            "shard": shard,
+            "device": device_id,
+            "presence": PresenceState(int(ds.presence[shard, device_id])).name,
+            "lastInteractionMs": int(ds.last_interaction_ms[shard, device_id]),
+            "eventCounts": {
+                EventType(e).name: int(ds.event_counts[shard, device_id, e])
+                for e in range(NUM_EVENT_TYPES)
+            },
+        }
+
+    # ----------------------------------------------------------- durability
+    def save(self, directory) -> dict:
+        """Snapshot the stacked state (all shards) to a directory."""
+        import json
+        import pathlib
+
+        from sitewhere_tpu.utils.checkpoint import _flatten_state
+
+        directory = pathlib.Path(directory)
+        directory.mkdir(parents=True, exist_ok=True)
+        arrays = _flatten_state(self.state)
+        np.savez_compressed(directory / "sharded_state.npz", **arrays)
+        manifest = {
+            "format": 1,
+            "n_shards": self.n_shards,
+            "tokens_per_shard": self.tokens_per_shard,
+            "channels": self.channels,
+            "metrics": self.global_metrics(),
+        }
+        (directory / "sharded_manifest.json").write_text(json.dumps(manifest))
+        return manifest
+
+    def restore(self, directory) -> None:
+        """Load a snapshot saved by :meth:`save` into this engine's mesh
+        (shard count must match; resharding is a host-side reshape away)."""
+        import json
+        import pathlib
+
+        directory = pathlib.Path(directory)
+        manifest = json.loads(
+            (directory / "sharded_manifest.json").read_text())
+        for key, have in (("n_shards", self.n_shards),
+                          ("tokens_per_shard", self.tokens_per_shard),
+                          ("channels", self.channels)):
+            if manifest[key] != have:
+                raise ValueError(
+                    f"snapshot {key}={manifest[key]} != engine {key}={have}")
+        data = np.load(directory / "sharded_state.npz")
+        flat, treedef = jax.tree_util.tree_flatten_with_path(self.state)
+        sharding = stack_sharding(self.mesh, self.state)
+        shardings_flat = jax.tree_util.tree_leaves(sharding)
+        leaves = []
+        for (p, cur), sh in zip(flat, shardings_flat):
+            key = jax.tree_util.keystr(p)
+            arr = data[key]
+            if arr.shape != cur.shape:
+                raise ValueError(
+                    f"snapshot leaf {key} shape {arr.shape} != engine "
+                    f"{cur.shape} (capacity mismatch)")
+            leaves.append(jax.device_put(arr, sh))
+        self.state = jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+@functools.partial(jax.jit, static_argnames=("limit",))
+def _stacked_query(store, etype, tenant, t0, t1, *, limit):
+    """Per-shard ring query vmapped over the stacked shard axis; XLA keeps
+    each shard's scan on its own device (no cross-shard traffic until the
+    host merges the top pages)."""
+    from sitewhere_tpu.ops.query import query_store
+
+    def one(st):
+        return query_store(st, jnp.int32(NULL_ID), etype, tenant, t0, t1,
+                           limit=limit)
+
+    return jax.vmap(one)(store)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _stacked_sweep(state: PipelineState, now_ms, missing_ms):
+    from sitewhere_tpu.ops.window import presence_sweep
+
+    def one(ds, active):
+        return presence_sweep(ds, active, now_ms, missing_ms)
+
+    ds, newly = jax.vmap(one)(state.device_state, state.registry.device_active)
+    return dataclasses.replace(state, device_state=ds), newly

@@ -22,7 +22,7 @@ import jax
 import numpy as np
 
 from sitewhere_tpu.core.events import EpochBase
-from sitewhere_tpu.engine import DeviceInfo, Engine
+from sitewhere_tpu.engine import AssignmentInfo, DeviceInfo, Engine
 from sitewhere_tpu.ops.readback import absolute_cursor
 
 
@@ -53,11 +53,22 @@ def save_engine(engine: Engine, directory: str | pathlib.Path) -> dict:
                               for i in range(len(engine.channel_map.names))],
             "alert_types": [engine.alert_types.token(i)
                             for i in range(len(engine.alert_types))],
+            "areas": [engine.areas.token(i) for i in range(len(engine.areas))],
+            "customers": [engine.customers.token(i)
+                          for i in range(len(engine.customers))],
+            "assets": [engine.assets.token(i) for i in range(len(engine.assets))],
+            "event_ids": [engine.event_ids.token(i)
+                          for i in range(len(engine.event_ids))],
             "token_device": {str(k): v for k, v in engine.token_device.items()},
             "devices": {
                 str(did): dataclasses.asdict(info)
                 for did, info in engine.devices.items()
             },
+            "assignments": {
+                str(aid): dataclasses.asdict(info)
+                for aid, info in engine.assignments.items()
+            },
+            "device_slots": {str(k): v for k, v in engine.device_slots.items()},
             "dead_letters": engine.dead_letters[-4096:],
             "config": dataclasses.asdict(engine.config),
         }
@@ -103,9 +114,27 @@ def restore_engine(directory: str | pathlib.Path) -> Engine:
         engine.channel_map.names.intern(n)
     for a in host["alert_types"]:
         engine.alert_types.intern(a)
+    for a in host.get("areas", []):
+        engine.areas.intern(a)
+    for c in host.get("customers", []):
+        engine.customers.intern(c)
+    for a in host.get("assets", []):
+        engine.assets.intern(a)
+    for e in host.get("event_ids", []):
+        engine.event_ids.intern(e)
     engine.token_device = {int(k): v for k, v in host["token_device"].items()}
     engine.devices = {
         int(k): DeviceInfo(**v) for k, v in host["devices"].items()
+    }
+    engine.assignments = {
+        int(k): AssignmentInfo(**v)
+        for k, v in host.get("assignments", {}).items()
+    }
+    engine.assignment_tokens = {
+        info.token: aid for aid, info in engine.assignments.items()
+    }
+    engine.device_slots = {
+        int(k): list(v) for k, v in host.get("device_slots", {}).items()
     }
     engine._next_device = host["next_device"]
     engine._next_assignment = host["next_assignment"]

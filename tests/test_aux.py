@@ -202,3 +202,30 @@ def test_tracing_stage_spans():
     text = REGISTRY.expose_text()
     assert 'stage="unit-test-stage"' in text
     assert 'stage="unit-test-child"' in text
+
+
+def test_checkpoint_preserves_assignments(tmp_path):
+    """Assignment mirrors (tokens, slots, status) survive snapshot/restore."""
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.utils.checkpoint import restore_engine, save_engine
+
+    engine = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    engine.register_device("d1", area="hq", customer="acme")
+    engine.create_assignment("d1", token="d1-x", asset="forklift")
+    engine.release_assignment("d1-x")
+    engine.create_assignment("d1", token="d1-y")
+
+    save_engine(engine, tmp_path / "snap")
+    restored = restore_engine(tmp_path / "snap")
+
+    assert {a.token for a in restored.list_assignments("d1")} == \
+        {a.token for a in engine.list_assignments("d1")}
+    assert restored.get_assignment("d1-x").status == "RELEASED"
+    assert restored.get_assignment("d1-y").status == "ACTIVE"
+    assert restored.get_assignment("d1-x").asset == "forklift"
+    assert restored.device_slots == engine.device_slots
+    # the restored engine can keep allocating without colliding
+    a = restored.create_assignment("d1", token="d1-z")
+    assert a.id == engine._next_assignment
