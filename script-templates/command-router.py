@@ -1,0 +1,9 @@
+"""Scripted command router template.
+
+Binding contract (reference: ScriptedCommandRouter): define
+``destinations_for(execution)`` returning a list of destination ids.
+"""
+
+
+def destinations_for(execution):
+    return ["default"]

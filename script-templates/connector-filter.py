@@ -1,0 +1,10 @@
+"""Scripted outbound-connector filter template.
+
+Binding contract (reference: connectors/groovy/filter/ScriptedFilter):
+define ``is_excluded(event)`` -> True to EXCLUDE the event.
+"""
+
+
+def is_excluded(event):
+    # example: only forward alert events
+    return event.etype.name != "ALERT"

@@ -1,0 +1,20 @@
+"""Scripted event decoder template.
+
+Binding contract (reference: ScriptedEventDecoder Groovy binding — payload,
+metadata, builder): define ``decode(payload, metadata)`` returning a list of
+DecodedRequest. Raise to send the payload to the failed-decode dead letter.
+"""
+
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+
+def decode(payload, metadata):
+    # example: fixed-format "token,name,value" CSV lines
+    out = []
+    for line in payload.decode().strip().splitlines():
+        token, name, value = line.split(",")
+        out.append(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token=token,
+            measurements={name: float(value)},
+        ))
+    return out

@@ -1,0 +1,11 @@
+"""Scripted outbound connector template.
+
+Binding contract (reference: script-templates/outbound-connector/*.groovy):
+define ``process_event(event)``; may be sync or async.
+"""
+
+SEEN = []
+
+
+def process_event(event):
+    SEEN.append((event.device_id, event.etype.name, event.ts_ms))

@@ -88,17 +88,33 @@ class ConfigError(ValueError):
     pass
 
 
+def _scripted_decoder(cfg: dict):
+    from sitewhere_tpu.ingest.decoders import ScriptedDecoder
+    from sitewhere_tpu.utils.scripting import script_handle
+
+    return ScriptedDecoder(script_handle(cfg, "decode"))
+
+
+def _scripted_deduplicator(cfg: dict):
+    from sitewhere_tpu.ingest.dedup import ScriptedDeduplicator
+    from sitewhere_tpu.utils.scripting import script_handle
+
+    return ScriptedDeduplicator(script_handle(cfg, "is_duplicate"))
+
+
 DECODERS: dict[str, Callable[[dict], Any]] = {
     "json": lambda cfg: JsonDeviceRequestDecoder(),
     "json-batch": lambda cfg: JsonBatchEventDecoder(),
     "binary": lambda cfg: BinaryEventDecoder(),
     "protobuf": lambda cfg: BinaryEventDecoder(),  # flat-binary replaces GPB
     "echo": lambda cfg: EchoStringDecoder(),
+    "scripted": _scripted_decoder,
 }
 
 DEDUPLICATORS: dict[str, Callable[[dict], Any]] = {
     "alternate-id": lambda cfg: AlternateIdDeduplicator(
         capacity=cfg.get("capacity", 1 << 16)),
+    "scripted": _scripted_deduplicator,
 }
 
 RECEIVERS: dict[str, Callable[[dict], Any]] = {
@@ -170,6 +186,11 @@ def build_filters(specs: list[dict], engine) -> list:
         elif ftype == "device-type":
             out.append(DeviceTypeFilter(engine, f.get("deviceTypes", []),
                                         f.get("operation", "include")))
+        elif ftype == "scripted":
+            from sitewhere_tpu.connectors.base import ScriptedFilter
+            from sitewhere_tpu.utils.scripting import script_handle
+
+            out.append(ScriptedFilter(script_handle(f, "is_excluded")))
         else:
             raise ConfigError(f"unknown filter type {ftype!r}")
     return out
@@ -194,7 +215,20 @@ def build_connector(spec: dict, engine):
     if ctype == "http":
         return HttpConnector(cid, cfg["uri"], headers=cfg.get("headers"),
                              method=cfg.get("method", "POST"), filters=filters)
+    if ctype == "scripted":
+        from sitewhere_tpu.connectors.impl import ScriptedConnector
+        from sitewhere_tpu.utils.scripting import script_handle
+
+        return ScriptedConnector(cid, script_handle(cfg, "process_event"),
+                                 filters=filters)
     raise ConfigError(f"unknown connector type {ctype!r}")
+
+
+def _scripted_encoder(cfg: dict):
+    from sitewhere_tpu.commands.encoders import ScriptedCommandExecutionEncoder
+    from sitewhere_tpu.utils.scripting import script_handle
+
+    return ScriptedCommandExecutionEncoder(script_handle(cfg, "encode"))
 
 
 ENCODERS = {
@@ -202,6 +236,7 @@ ENCODERS = {
     "json-string": lambda cfg: JsonStringCommandExecutionEncoder(),
     "binary": lambda cfg: BinaryCommandExecutionEncoder(),
     "protobuf": lambda cfg: BinaryCommandExecutionEncoder(),
+    "scripted": _scripted_encoder,
 }
 
 
@@ -247,6 +282,11 @@ def build_router(spec: dict):
                                               spec.get("default"))
     if rtype == "noop":
         return NoOpCommandRouter()
+    if rtype == "scripted":
+        from sitewhere_tpu.commands.routing import ScriptedCommandRouter
+        from sitewhere_tpu.utils.scripting import script_handle
+
+        return ScriptedCommandRouter(script_handle(spec, "destinations_for"))
     raise ConfigError(f"unknown router type {rtype!r}")
 
 

@@ -41,7 +41,8 @@ def initialize(coordinator_address: str | None = None,
     auto-detection failure is swallowed and the process stays single-host.
     Explicitly passed arguments always raise on failure.
     """
-    explicit = coordinator_address is not None or num_processes is not None
+    explicit = (coordinator_address is not None or num_processes is not None
+                or process_id is not None)
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -64,7 +65,8 @@ def local_shard_ids(mesh) -> list[int]:
     ]
 
 
-def assemble_stacked_batch(mesh, shard_batches: dict[int, EventBatch]) -> EventBatch:
+def assemble_stacked_batch(mesh, shard_batches: dict[int, EventBatch],
+                           template: EventBatch | None = None) -> EventBatch:
     """Build the global stacked [n_shards, B, ...] EventBatch.
 
     ``shard_batches`` maps shard index -> that shard's local EventBatch
@@ -72,25 +74,33 @@ def assemble_stacked_batch(mesh, shard_batches: dict[int, EventBatch]) -> EventB
     provide exactly its ``local_shard_ids``. Each shard's rows are placed on
     the shard's own device and the global array is assembled from the
     single-device pieces — the multi-host-safe construction (no host ever
-    materializes another host's rows).
+    materializes another host's rows). A process that owns no mesh devices
+    still participates but must pass ``template`` (any local-shaped
+    EventBatch, e.g. an empty buffer's emit) to supply shapes/dtypes.
     """
     devs = list(mesh.devices.flat)
     mine = local_shard_ids(mesh)
     missing = set(mine) - set(shard_batches)
     if missing:
         raise ValueError(f"missing batches for local shards {sorted(missing)}")
+    if mine:
+        template = shard_batches[mine[0]]
+    elif template is None:
+        raise ValueError(
+            "process owns no mesh devices; pass `template` for batch shapes")
 
-    template = shard_batches[mine[0]]
     sharding = shard_leading(mesh)
 
     def glue(field: str):
-        pieces = []
-        for i in mine:
-            arr = np.asarray(getattr(shard_batches[i], field))[None]
-            pieces.append(jax.device_put(arr, devs[i]))
-        shape = (len(devs),) + pieces[0].shape[1:]
+        local_shape = np.asarray(getattr(template, field)).shape
+        pieces = [
+            jax.device_put(np.asarray(getattr(shard_batches[i], field))[None],
+                           devs[i])
+            for i in mine
+        ]
+        shape = (len(devs),) + local_shape
         return jax.make_array_from_single_device_arrays(shape, sharding, pieces)
 
     return EventBatch(**{
-        f.name: glue(f.name) for f in dataclasses.fields(template)
+        f.name: glue(f.name) for f in dataclasses.fields(EventBatch)
     })

@@ -237,15 +237,19 @@ class ShardedEngine:
         return [(int(s), int(d)) for s, d in zip(*np.nonzero(out))]
 
     def device_state_summary(self, shard: int, device_id: int) -> dict:
-        """Read back one device's aggregated state from its owning shard."""
+        """Read back one device's aggregated state from its owning shard —
+        three device->host transfers total, not one per scalar."""
         ds = self.state.device_state
+        presence = int(jax.device_get(ds.presence[shard, device_id]))
+        last = int(jax.device_get(ds.last_interaction_ms[shard, device_id]))
+        counts = np.asarray(jax.device_get(ds.event_counts[shard, device_id]))
         return {
             "shard": shard,
             "device": device_id,
-            "presence": PresenceState(int(ds.presence[shard, device_id])).name,
-            "lastInteractionMs": int(ds.last_interaction_ms[shard, device_id]),
+            "presence": PresenceState(presence).name,
+            "lastInteractionMs": last,
             "eventCounts": {
-                EventType(e).name: int(ds.event_counts[shard, device_id, e])
+                EventType(e).name: int(counts[e])
                 for e in range(NUM_EVENT_TYPES)
             },
         }

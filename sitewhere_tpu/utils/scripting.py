@@ -1,0 +1,119 @@
+"""Scripting component: user script hooks loaded from files.
+
+The reference customizes pipeline behavior with Groovy scripts managed by
+the framework's ScriptingComponent/ScriptingUtils (+ Binding): scripted
+event decoders (ScriptedEventDecoder.java:32-63), deduplicators, command
+routers/encoders, connector filters, payload/URI builders, and dataset
+bootstrap scripts — shipped as templates in
+dockerimage/script-templates/*/*.groovy with a documented binding contract.
+
+Here scripts are plain Python files. A script exposes one or more named
+functions (the binding contract is the function signature); the manager
+compiles the file once, caches by (path, mtime) so edits hot-reload —
+the analog of the reference's ZooKeeper-backed script versioning — and
+hands `ScriptHandle`s to the scripted components in ingest/decoders.py,
+ingest/dedup.py, commands/routing.py, connectors/base.py, and config.py.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import threading
+from typing import Any, Callable
+
+
+class ScriptError(ValueError):
+    pass
+
+
+class ScriptHandle:
+    """One callable resolved from a script file; re-resolves on reload."""
+
+    def __init__(self, manager: "ScriptManager", path: pathlib.Path,
+                 function: str):
+        self._manager = manager
+        self._path = path
+        self._function = function
+
+    @property
+    def name(self) -> str:
+        return f"{self._path.name}:{self._function}"
+
+    def __call__(self, *args, **kwargs):
+        fn = self._manager._resolve(self._path, self._function)
+        return fn(*args, **kwargs)
+
+
+class ScriptManager:
+    """Loads, caches, and hot-reloads script files (ScriptingComponent +
+    ScriptingUtils analog)."""
+
+    def __init__(self, root: str | pathlib.Path | None = None):
+        self.root = pathlib.Path(root) if root is not None else None
+        self._lock = threading.Lock()
+        # path -> (mtime, namespace)
+        self._cache: dict[pathlib.Path, tuple[float, dict[str, Any]]] = {}
+
+    def _path_of(self, script: str | pathlib.Path) -> pathlib.Path:
+        p = pathlib.Path(script)
+        if not p.is_absolute() and self.root is not None:
+            p = self.root / p
+        return p
+
+    def _load(self, path: pathlib.Path) -> dict[str, Any]:
+        try:
+            mtime = path.stat().st_mtime
+        except OSError as e:
+            raise ScriptError(f"script {path} not readable: {e}") from e
+        with self._lock:
+            cached = self._cache.get(path)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+        # compile/exec OUTSIDE the lock: scripts may themselves resolve other
+        # scripts through this manager at import time (composite scripts),
+        # and a slow load must not stall every other scripted hook. Two
+        # racing loads of the same file both succeed; last one wins.
+        ns: dict[str, Any] = {"__file__": str(path), "__name__": path.stem}
+        code = compile(path.read_text(), str(path), "exec")
+        exec(code, ns)
+        with self._lock:
+            self._cache[path] = (mtime, ns)
+        return ns
+
+    def _resolve(self, path: pathlib.Path, function: str) -> Callable:
+        ns = self._load(path)
+        fn = ns.get(function)
+        if not callable(fn):
+            raise ScriptError(
+                f"script {path} does not define callable {function!r} "
+                f"(defines: {sorted(k for k, v in ns.items() if callable(v) and not k.startswith('_'))})")
+        return fn
+
+    def handle(self, script: str | pathlib.Path,
+               function: str) -> ScriptHandle:
+        """Resolve (and eagerly validate) a script function."""
+        path = self._path_of(script)
+        self._resolve(path, function)   # fail fast at config time
+        return ScriptHandle(self, path, function)
+
+    def list_scripts(self) -> list[str]:
+        """Script files under the template root (script-templates analog)."""
+        if self.root is None or not self.root.exists():
+            return []
+        return sorted(str(p.relative_to(self.root))
+                      for p in self.root.rglob("*.py"))
+
+
+# module-level default manager; config.py binds "scripted" component types
+# through it so bare {"script": "...", "function": "..."} specs work.
+DEFAULT_MANAGER = ScriptManager()
+
+
+def script_handle(spec: dict, default_function: str,
+                  manager: ScriptManager | None = None) -> ScriptHandle:
+    """Build a handle from a ``{script, function?}`` config spec — the
+    shared plumbing for every scripted component type in config.py."""
+    if "script" not in spec:
+        raise ScriptError("scripted component requires a 'script' path")
+    mgr = manager or DEFAULT_MANAGER
+    return mgr.handle(spec["script"], spec.get("function", default_function))
