@@ -103,6 +103,33 @@ def main() -> None:
         f"found={int(m.found)} registered={int(m.registered)} persisted={int(m.persisted)}"
     )
 
+    # Diagnostic (stderr): full HOST path — JSON bytes -> C++ decode ->
+    # staging -> fused step -> state merged. This is the wire-facing
+    # inbound->device-state latency of BASELINE.md (target p99 < 50 ms).
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.loadgen import run_engine_load
+
+    eng = Engine(EngineConfig(
+        device_capacity=1 << 15, token_capacity=1 << 16,
+        assignment_capacity=1 << 16, store_capacity=1 << 17,
+        batch_capacity=8192,
+    ))
+    stats = run_engine_load(eng, n_batches=20, batch_size=8192,
+                            n_devices=10_000)
+    log(
+        f"host e2e sync (json->decode->state visible): "
+        f"{stats.events_per_s:,.0f} ev/s, "
+        f"p50={stats.latency_p50_ms:.1f}ms p99={stats.latency_p99_ms:.1f}ms "
+        f"(batch=8192, native={eng._native_decoder is not None})"
+    )
+    pstats = run_engine_load(eng, n_batches=20, batch_size=8192,
+                             n_devices=10_000, warmup_batches=1,
+                             pipelined=True)
+    log(
+        f"host e2e pipelined (steady-state ingest): "
+        f"{pstats.events_per_s:,.0f} ev/s"
+    )
+
     baseline_per_chip = 1_000_000 / 8
     print(
         json.dumps(

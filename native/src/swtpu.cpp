@@ -213,6 +213,18 @@ static void skip_value(Scanner& sc) {
     parse_number(sc);
 }
 
+// number field that may legally hold a JSON literal (null/true/false):
+// the literal is skipped and NaN returned so callers treat it as absent
+// rather than failing the whole payload.
+static double parse_number_or_literal(Scanner& sc) {
+    skip_ws(sc);
+    if (sc.p < sc.end && (*sc.p == 'n' || *sc.p == 't' || *sc.p == 'f')) {
+        skip_value(sc);
+        return std::nan("");
+    }
+    return parse_number(sc);
+}
+
 // ---------------------------------------------------------------- decoder
 
 // request envelope types (must match ingest/requests.py RequestType mapping)
@@ -344,7 +356,10 @@ int32_t swtpu_decode_batch(
                     if (rk == 9 && !memcmp(sbuf, "eventDate", 9)) {
                         skip_ws(sc);
                         if (sc.p < sc.end && *sc.p == '"') skip_value(sc);  // ISO dates -> host path
-                        else out_ts[i] = (int64_t)parse_number(sc);
+                        else {
+                            double tv = parse_number_or_literal(sc);
+                            if (!std::isnan(tv)) out_ts[i] = (int64_t)tv;
+                        }
                     } else if (rk == 12 && !memcmp(sbuf, "measurements", 12)) {
                         skip_ws(sc);
                         if (sc.p < sc.end && *sc.p == '{') {
@@ -357,7 +372,8 @@ int32_t swtpu_decode_batch(
                                 mfirst = false;
                                 int nn = parse_string(sc, sbuf, sizeof(sbuf));
                                 if (nn < 0 || !expect(sc, ':')) { failed = true; break; }
-                                double v = parse_number(sc);
+                                double v = parse_number_or_literal(sc);
+                                if (std::isnan(v)) continue;
                                 int32_t nid = swtpu_intern(d->names, sbuf, nn);
                                 if (nid >= 0) {
                                     if (nid >= channels) collisions++;
@@ -371,20 +387,26 @@ int32_t swtpu_decode_batch(
                         mname_len = parse_string(sc, mname, sizeof(mname));
                         if (mname_len < 0) { failed = true; break; }
                     } else if (rk == 5 && !memcmp(sbuf, "value", 5)) {
-                        mval = parse_number(sc);
-                        have_mval = true;
+                        mval = parse_number_or_literal(sc);
+                        have_mval = !std::isnan(mval);
                     } else if (rk == 8 && !memcmp(sbuf, "latitude", 8)) {
-                        lat = (float)parse_number(sc); have_loc = true;
+                        double dv = parse_number_or_literal(sc);
+                        if (!std::isnan(dv)) { lat = (float)dv; have_loc = true; }
                     } else if (rk == 9 && !memcmp(sbuf, "longitude", 9)) {
-                        lon = (float)parse_number(sc); have_loc = true;
+                        double dv = parse_number_or_literal(sc);
+                        if (!std::isnan(dv)) { lon = (float)dv; have_loc = true; }
                     } else if (rk == 9 && !memcmp(sbuf, "elevation", 9)) {
-                        elev = (float)parse_number(sc);
+                        double dv = parse_number_or_literal(sc);
+                        if (!std::isnan(dv)) elev = (float)dv;
                     } else if (rk == 5 && !memcmp(sbuf, "level", 5)) {
                         skip_ws(sc);
                         if (sc.p < sc.end && *sc.p == '"') {
                             int n = parse_string(sc, sbuf, sizeof(sbuf));
                             if (n >= 0) out_level[i] = alert_level_code(sbuf, n);
-                        } else out_level[i] = (int32_t)parse_number(sc);
+                        } else {
+                            double dv = parse_number_or_literal(sc);
+                            if (!std::isnan(dv)) out_level[i] = (int32_t)dv;
+                        }
                     } else if (rk == 4 && !memcmp(sbuf, "type", 4)) {
                         int n = parse_string(sc, sbuf, sizeof(sbuf));
                         if (n >= 0) out_aux0[i] = swtpu_intern(d->alert_types, sbuf, n);

@@ -272,10 +272,19 @@ class Engine:
         self._next_assignment = 0
         self.dead_letters: list[int] = []             # unregistered token ids
         self.outputs: list[dict] = []                 # recent step summaries
+        self._pending_outs: list[StepOutput] = []     # un-absorbed step outputs
 
     @property
     def staged_count(self) -> int:
         return len(self._buf)
+
+    def _sync_mirrors(self) -> None:
+        """Make host mirrors current: run any staged batch and absorb any
+        pending async outputs. Caller holds the lock."""
+        if len(self._buf):
+            self.flush_async()
+        if self._pending_outs:
+            self.drain()
 
     # ------------------------------------------------------------------ ingest
     def process(self, req: DecodedRequest) -> None:
@@ -334,14 +343,14 @@ class Engine:
         )
         i = len(self._buf)
         if not self._buf.append(et, token_id, tenant_id, ts, now, (), aux0, aux1):
-            self.flush()
+            self.flush_async()
             i = len(self._buf)
             self._buf.append(et, token_id, tenant_id, ts, now, (), aux0, aux1)
         if mask is not None and mask.any():
             self._buf.values[i, :] = values
             self._buf.vmask[i, :] = mask
         if self._buf.full:
-            self.flush()
+            self.flush_async()
 
     def ingest_json_batch(self, payloads: list[bytes],
                           tenant: str = "default") -> dict:
@@ -406,7 +415,7 @@ class Engine:
             while pos < len(idxs):
                 room = self.config.batch_capacity - len(self._buf)
                 if room == 0:
-                    self.flush()
+                    self.flush_async()
                     room = self.config.batch_capacity
                 chunk = idxs[pos: pos + room]
                 b = self._buf
@@ -424,18 +433,22 @@ class Engine:
                 staged += len(chunk)
                 pos += room
             if self._buf.full:
-                self.flush()
+                self.flush_async()
             self.channel_map.collisions += res.collisions
             return {"decoded": int(np.sum(ok)), "failed": failed,
                     "staged": staged}
 
     def maybe_flush(self) -> dict | None:
-        """Flush if the latency budget expired (call from a timer loop)."""
+        """Flush if the latency budget expired (call from a timer loop).
+        Also drains async-flushed outputs so mirror staleness is bounded by
+        the same interval."""
         with self.lock:
-            if len(self._buf) and (
-                time.monotonic() - self._last_flush >= self.config.flush_interval_s
-            ):
+            expired = (time.monotonic() - self._last_flush
+                       >= self.config.flush_interval_s)
+            if len(self._buf) and expired:
                 return self.flush()
+            if self._pending_outs and expired:
+                return self.drain()[-1]
             return None
 
     def flush(self) -> dict:
@@ -443,12 +456,39 @@ class Engine:
         from sitewhere_tpu.utils.tracing import stage
 
         with self.lock, stage("pipeline_step"):
+            self.flush_async()
+            return self.drain()[-1]
+
+    def flush_async(self) -> None:
+        """Dispatch a step on the staged batch with NO host synchronization:
+        the step output queues for :meth:`drain`. This is the steady-state
+        ingest path — back-to-back batches pipeline on device while the host
+        stages the next one (SURVEY.md §7 'avoid Python in the steady-state
+        loop'); host mirrors lag until the next drain/flush, which every
+        host-facing query performs first."""
+        with self.lock:
             batch = self._buf.emit()
             self.state, out = self._step(self.state, batch)
+            self._pending_outs.append(out)
             self._last_flush = time.monotonic()
-            return self._absorb_output(out)
+
+    def drain(self) -> list[dict]:
+        """Absorb every queued step output into the host mirrors (one
+        device->host transfer for the whole backlog); returns summaries."""
+        with self.lock:
+            if not self._pending_outs:
+                return [{"found": 0, "missed": 0, "registered": 0,
+                         "persisted": 0, "new_tokens": [], "dead_tokens": []}]
+            outs, self._pending_outs = self._pending_outs, []
+            outs = jax.device_get(outs)
+            return [self._absorb_output(o) for o in outs]
 
     def _absorb_output(self, out: StepOutput) -> dict:
+        # ``out`` is already host-resident: drain() device_gets the whole
+        # pending backlog in ONE transfer — per-leaf np.asarray/int() reads
+        # would each cost a full round trip (~100ms+ when the chip sits
+        # behind a network tunnel), turning a sub-ms step into a
+        # seconds-long flush.
         new_tokens = [int(t) for t in np.asarray(out.new_tokens) if t != NULL_ID]
         # mirror device-side auto-registration: allocation order == list order
         new_dids = []
@@ -500,8 +540,7 @@ class Engine:
         the RegisterDevice / RdbDeviceManagement.createDevice analog."""
         with self.lock:
             # staged events may still reference tokens about to be registered
-            if len(self._buf):
-                self.flush()
+            self._sync_mirrors()
             token_id = self.tokens.intern(token)
             existing = self.token_device.get(token_id)
             if existing is not None:
@@ -593,8 +632,7 @@ class Engine:
         (reference: RdbDeviceManagement.createDeviceAssignment via the
         Assignments REST controller)."""
         with self.lock:
-            if len(self._buf):
-                self.flush()
+            self._sync_mirrors()
             tid = self.tokens.lookup(device_token)
             did = self.token_device.get(tid)
             if did is None:
@@ -641,8 +679,7 @@ class Engine:
     def _set_assignment_status(self, token: str,
                                status: DeviceAssignmentStatus) -> AssignmentInfo:
         with self.lock:
-            if len(self._buf):
-                self.flush()
+            self._sync_mirrors()
             aid = self.assignment_tokens.get(token)
             if aid is None:
                 raise KeyError(f"assignment {token!r} not found")
@@ -673,6 +710,9 @@ class Engine:
 
     # ------------------------------------------------------------------ queries
     def get_device(self, token: str) -> DeviceInfo | None:
+        if self._pending_outs:
+            with self.lock:
+                self._sync_mirrors()
         tid = self.tokens.lookup(token)
         did = self.token_device.get(tid)
         return self.devices.get(did) if did is not None else None
@@ -680,8 +720,7 @@ class Engine:
     def get_device_state(self, token: str) -> dict | None:
         """Read back one device's aggregated state (REST device-state API)."""
         with self.lock:
-            if len(self._buf):
-                self.flush()
+            self._sync_mirrors()
             tid = self.tokens.lookup(token)
             did = self.token_device.get(tid)
             if did is None:
@@ -742,8 +781,7 @@ class Engine:
         lastInteractionDateBefore / presenceMissingDateBefore criteria).
         Filters run vectorized over the device-resident state columns."""
         with self.lock:
-            if len(self._buf):
-                self.flush()
+            self._sync_mirrors()
             n = self._next_device
             if n == 0:
                 return []
@@ -803,8 +841,7 @@ class Engine:
         from sitewhere_tpu.ops.query import query_store
 
         with self.lock:
-            if len(self._buf):
-                self.flush()
+            self._sync_mirrors()
             dev = NULL_ID
             if device_token is not None:
                 tid = self.tokens.lookup(device_token)

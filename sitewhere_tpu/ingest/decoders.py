@@ -66,19 +66,26 @@ def request_from_envelope(envelope: dict, metadata: dict | None = None) -> Decod
             metadata=dict(metadata or {}) | dict(req.get("metadata") or {}),
         )
         if rtype is RequestType.DEVICE_MEASUREMENT:
+            # JSON null values parse as absent, matching the native decoder
+            # (a measurement with a null value still decodes, with no lanes)
             if "measurements" in req and isinstance(req["measurements"], dict):
-                out.measurements = {str(k): float(v) for k, v in req["measurements"].items()}
+                out.measurements = {str(k): float(v)
+                                    for k, v in req["measurements"].items()
+                                    if v is not None}
             elif "name" in req:
-                out.measurements = {str(req["name"]): float(req["value"])}
+                out.measurements = (
+                    {str(req["name"]): float(req["value"])}
+                    if req.get("value") is not None else {}
+                )
             else:
                 raise EventDecodeException("measurement request missing name/value")
         elif rtype is RequestType.DEVICE_LOCATION:
-            out.latitude = float(req["latitude"])
-            out.longitude = float(req["longitude"])
-            out.elevation = float(req.get("elevation", 0.0))
+            out.latitude = float(req["latitude"] if req["latitude"] is not None else 0.0)
+            out.longitude = float(req["longitude"] if req["longitude"] is not None else 0.0)
+            out.elevation = float(req.get("elevation") or 0.0)
         elif rtype is RequestType.DEVICE_ALERT:
-            out.alert_type = str(req.get("type", "alert"))
-            lvl = req.get("level", "Info")
+            out.alert_type = str(req.get("type") or "alert")
+            lvl = req.get("level") or "Info"
             out.alert_level = (
                 AlertLevel[str(lvl).upper()] if isinstance(lvl, str) else AlertLevel(int(lvl))
             )

@@ -1,0 +1,182 @@
+"""Load generator: the benchmark driver for the ingest→device-state path.
+
+The reference's only load tooling is a manual JMS sender — 5 threads x 100
+hard-coded JSON measurement messages aimed at a live instance
+(service-event-sources/src/test/java/com/sitewhere/sources/
+EventSourceTests.java:49-71, payloads built by EventsHelper.java). This
+module is the CI-runnable equivalent (SURVEY.md §4d): it generates the same
+canonical DeviceRequest measurement JSON, drives either the engine's native
+host path or a live REST gateway, and reports throughput plus end-to-end
+ingest→device-state latency percentiles — the BASELINE.md north-star metrics
+(events/sec/chip, inbound→state p99 < 50 ms).
+
+Modes:
+  * engine — payload bytes → C++ batch decode → staging → fused TPU step →
+    state merged. Latency is measured per batch from first submit to the
+    flush return that made the batch's events visible in device state.
+  * rest — HTTP POSTs against a running gateway (wire-level e2e).
+
+CLI: ``python -m sitewhere_tpu.loadgen --batches 50 --batch-size 4096``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import numpy as np
+
+
+def generate_measurements_message(token: str, seq: int,
+                                  name: str = "engine.temperature",
+                                  value: float | None = None) -> bytes:
+    """Canonical JSON measurement DeviceRequest
+    (EventsHelper.generateJsonMeasurementsMessage analog)."""
+    payload = {
+        "deviceToken": token,
+        "type": "DeviceMeasurement",
+        "request": {
+            "name": name,
+            "value": value if value is not None else round(20.0 + (seq % 80) * 0.5, 2),
+            "eventDate": None,
+            "updateState": True,
+            "metadata": {"seq": str(seq)},
+        },
+    }
+    return json.dumps(payload).encode()
+
+
+@dataclasses.dataclass
+class LoadStats:
+    events_sent: int
+    events_decoded: int
+    events_failed: int
+    wall_s: float
+    events_per_s: float
+    latency_p50_ms: float
+    latency_p99_ms: float
+    latency_max_ms: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _percentiles(lat_ms: list[float]) -> tuple[float, float, float]:
+    arr = np.asarray(lat_ms)
+    return (float(np.percentile(arr, 50)), float(np.percentile(arr, 99)),
+            float(arr.max()))
+
+
+def run_engine_load(engine, n_batches: int = 50, batch_size: int = 4096,
+                    n_devices: int = 10_000, seed: int = 0,
+                    warmup_batches: int = 3,
+                    pipelined: bool = False) -> LoadStats:
+    """Drive the full host path: JSON bytes → native decode → staged → fused
+    step → device state.
+
+    pipelined=False — per-batch latency = submit → flush return (state
+    merged and visible on the host), the inbound→device-state span of
+    SURVEY.md §3.2-3.3.
+    pipelined=True — steady-state throughput: batches dispatch with
+    ``flush_async`` (no host sync inside the loop) and mirrors drain once
+    at the end; latency percentiles then cover only the submit span.
+    """
+    rng = np.random.default_rng(seed)
+    toks = [f"lg-{i}" for i in range(n_devices)]
+
+    def make_batch(b: int) -> list[bytes]:
+        picks = rng.integers(0, n_devices, batch_size)
+        return [generate_measurements_message(toks[d], b * batch_size + i)
+                for i, d in enumerate(picks)]
+
+    for w in range(warmup_batches):          # compile + interner warm
+        engine.ingest_json_batch(make_batch(w))
+        engine.flush()
+
+    # pre-build payloads so the generator itself stays out of the timing
+    prebuilt = [make_batch(b) for b in range(n_batches)]
+    latencies: list[float] = []
+    decoded = failed = 0
+    t0 = time.perf_counter()
+    for payloads in prebuilt:
+        s0 = time.perf_counter()
+        res = engine.ingest_json_batch(payloads)
+        if pipelined:
+            if engine.staged_count:
+                engine.flush_async()
+        else:
+            engine.flush()                    # state merged on return
+        latencies.append((time.perf_counter() - s0) * 1e3)
+        decoded += res["decoded"]
+        failed += res["failed"]
+    if pipelined:
+        engine.drain()
+        import jax
+
+        jax.block_until_ready(engine.state.metrics.persisted)
+    wall = time.perf_counter() - t0
+    p50, p99, mx = _percentiles(latencies)
+    sent = n_batches * batch_size
+    return LoadStats(sent, decoded, failed, wall, sent / wall, p50, p99, mx)
+
+
+async def run_rest_load(base_url: str, jwt: str, n_workers: int = 5,
+                        msgs_per_worker: int = 100,
+                        device_prefix: str = "rest-lg") -> LoadStats:
+    """Wire-level driver: N concurrent workers x M posts each (the 5x100
+    pattern of EventSourceTests.java:50-53) against /api/devices/{t}/events."""
+    import asyncio
+
+    import aiohttp
+
+    latencies: list[float] = []
+    failed = 0
+    headers = {"Authorization": f"Bearer {jwt}"}
+
+    async def worker(w: int, session: aiohttp.ClientSession):
+        nonlocal failed
+        token = f"{device_prefix}-{w}"
+        for i in range(msgs_per_worker):
+            body = json.loads(generate_measurements_message(token, i))
+            s0 = time.perf_counter()
+            async with session.post(
+                f"{base_url}/api/devices/{token}/events",
+                json=body, headers=headers,
+            ) as r:
+                if r.status != 201:
+                    failed += 1
+                await r.read()
+            latencies.append((time.perf_counter() - s0) * 1e3)
+
+    t0 = time.perf_counter()
+    async with aiohttp.ClientSession() as session:
+        await asyncio.gather(*(worker(w, session) for w in range(n_workers)))
+    wall = time.perf_counter() - t0
+    sent = n_workers * msgs_per_worker
+    p50, p99, mx = _percentiles(latencies)
+    return LoadStats(sent, sent - failed, failed, wall, sent / wall, p50, p99, mx)
+
+
+def main() -> None:
+    import argparse
+
+    from sitewhere_tpu.engine import Engine, EngineConfig
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--batches", type=int, default=50)
+    ap.add_argument("--batch-size", type=int, default=4096)
+    ap.add_argument("--devices", type=int, default=10_000)
+    args = ap.parse_args()
+
+    engine = Engine(EngineConfig(
+        device_capacity=max(1 << 15, 1 << (args.devices - 1).bit_length()),
+        token_capacity=1 << 17, assignment_capacity=1 << 17,
+        store_capacity=1 << 18, batch_capacity=args.batch_size,
+    ))
+    stats = run_engine_load(engine, args.batches, args.batch_size, args.devices)
+    print(json.dumps(stats.to_dict()))
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,11 @@ class FeedConsumer:
     def poll(self) -> list[OutboundEvent]:
         """Fetch newly persisted events past the committed offset (does not
         commit — call ``commit(events)`` after successful processing)."""
+        # async flushes may have advanced the store past the host mirrors;
+        # sync first so _enrich sees every auto-registered device's token
+        if self.engine._pending_outs:
+            with self.engine.lock:
+                self.engine._sync_mirrors()
         store = self.engine.state.store
         head = absolute_cursor(store)
         if head <= self.offset:

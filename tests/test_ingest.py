@@ -429,3 +429,50 @@ def test_native_and_python_paths_agree():
         assert a["measurements"]["x"]["value"] == b["measurements"]["x"]["value"]
         assert a["measurements"]["x"]["ts_ms"] == b["measurements"]["x"]["ts_ms"]
         assert a["event_counts"] == b["event_counts"]
+
+
+def test_native_decode_tolerates_json_literals():
+    """null/true/false in number-valued fields must not fail the payload
+    (the reference's JSON model routinely serializes eventDate: null)."""
+    from sitewhere_tpu.engine import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    payloads = [
+        b'{"deviceToken": "n-1", "type": "DeviceMeasurement", "request":'
+        b' {"name": "t", "value": 5.5, "eventDate": null, "updateState": true}}',
+        b'{"deviceToken": "n-1", "type": "DeviceLocation", "request":'
+        b' {"latitude": 1.0, "longitude": 2.0, "elevation": null}}',
+        b'{"deviceToken": "n-1", "type": "DeviceMeasurement", "request":'
+        b' {"name": "t", "value": null}}',  # no usable value -> still decodes
+    ]
+    res = eng.ingest_json_batch(payloads)
+    assert res["failed"] == 0, res
+    eng.flush()
+    st = eng.get_device_state("n-1")
+    assert st["measurements"]["t"]["value"] == 5.5
+    assert st["recent_locations"][0]["latitude"] == 1.0
+
+
+def test_python_decoder_tolerates_json_literals():
+    """REST / non-native path accepts the same null-bearing payloads as the
+    native batch decoder (parity)."""
+    from sitewhere_tpu.ingest.decoders import request_from_envelope
+
+    r = request_from_envelope({
+        "deviceToken": "n-2", "type": "DeviceMeasurement",
+        "request": {"name": "t", "value": None, "eventDate": None}})
+    assert r.measurements == {}
+    r = request_from_envelope({
+        "deviceToken": "n-2", "type": "DeviceMeasurement",
+        "request": {"measurements": {"a": 1.0, "b": None}}})
+    assert r.measurements == {"a": 1.0}
+    r = request_from_envelope({
+        "deviceToken": "n-2", "type": "DeviceLocation",
+        "request": {"latitude": 1.5, "longitude": 2.5, "elevation": None}})
+    assert r.elevation == 0.0
+    r = request_from_envelope({
+        "deviceToken": "n-2", "type": "DeviceAlert",
+        "request": {"type": None, "level": None, "message": "x"}})
+    assert r.alert_type == "alert"

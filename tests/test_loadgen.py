@@ -1,0 +1,112 @@
+"""Load-generator tests (SURVEY.md §4d: the CI-runnable analog of the
+reference's manual EventSourceTests senders)."""
+
+import json
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.loadgen import (
+    LoadStats,
+    generate_measurements_message,
+    run_engine_load,
+)
+
+
+def _engine():
+    return Engine(EngineConfig(
+        device_capacity=256, token_capacity=512, assignment_capacity=512,
+        store_capacity=8192, batch_capacity=128, channels=8,
+    ))
+
+
+def test_canonical_message_decodes():
+    msg = json.loads(generate_measurements_message("lg-1", 7))
+    assert msg["deviceToken"] == "lg-1"
+    assert msg["type"] == "DeviceMeasurement"
+    assert msg["request"]["name"] == "engine.temperature"
+    assert msg["request"]["metadata"]["seq"] == "7"
+
+
+def test_engine_load_reaches_device_state():
+    eng = _engine()
+    stats = run_engine_load(eng, n_batches=4, batch_size=64, n_devices=16,
+                            warmup_batches=1)
+    assert isinstance(stats, LoadStats)
+    assert stats.events_sent == 4 * 64
+    assert stats.events_decoded == stats.events_sent
+    assert stats.events_failed == 0
+    assert stats.events_per_s > 0
+    assert stats.latency_p50_ms <= stats.latency_p99_ms <= stats.latency_max_ms
+    # every generated device registered and aggregated state
+    st = eng.get_device_state("lg-0")
+    assert st is not None and "engine.temperature" in st["measurements"]
+    assert eng.metrics()["persisted"] >= stats.events_sent
+
+
+def test_rest_load_five_by_hundred():
+    """The reference's 5 threads x 100 messages pattern over live HTTP."""
+    import asyncio
+    import base64
+
+    from sitewhere_tpu.instance.instance import InstanceConfig, SiteWhereTpuInstance
+    from sitewhere_tpu.loadgen import run_rest_load
+    from sitewhere_tpu.web.rest import start_server
+
+    async def go():
+        import aiohttp
+
+        inst = SiteWhereTpuInstance(InstanceConfig(engine=EngineConfig(
+            device_capacity=64, token_capacity=128, assignment_capacity=128,
+            store_capacity=4096, batch_capacity=16, channels=4)))
+        server = await start_server(inst)
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                basic = base64.b64encode(b"admin:password").decode()
+                async with s.get(
+                    f"{base}/api/authapi/jwt",
+                    headers={"Authorization": f"Basic {basic}"},
+                ) as r:
+                    jwt = (await r.json())["token"]
+            stats = await run_rest_load(base, jwt, n_workers=5,
+                                        msgs_per_worker=20)
+            inst.engine.flush()
+            state = inst.engine.get_device_state("rest-lg-0")
+        finally:
+            await server.cleanup()
+        return stats, state
+
+    stats, state = asyncio.new_event_loop().run_until_complete(go())
+    assert stats.events_sent == 100
+    assert stats.events_failed == 0
+    assert state is not None
+
+
+def test_engine_load_pipelined_matches_sync_results():
+    """Async steady-state ingest persists the same events; host mirrors
+    catch up on drain."""
+    eng = _engine()
+    stats = run_engine_load(eng, n_batches=4, batch_size=64, n_devices=16,
+                            warmup_batches=1, pipelined=True)
+    assert stats.events_decoded == stats.events_sent
+    assert eng.metrics()["persisted"] >= stats.events_sent
+    # mirrors synced: every device visible through the host API
+    for i in range(16):
+        assert eng.get_device(f"lg-{i}") is not None
+
+
+def test_flush_async_drain_semantics():
+    """flush_async defers host sync; queries force _sync_mirrors."""
+    from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+    eng = _engine()
+    for i in range(10):
+        eng.process(DecodedRequest(type=RequestType.DEVICE_MEASUREMENT,
+                                   device_token=f"as-{i}",
+                                   measurements={"x": float(i)}))
+    eng.flush_async()
+    # device-side registered; host mirror may lag until a query syncs it
+    st = eng.get_device_state("as-3")        # get_device_state syncs mirrors
+    assert st is not None and st["measurements"]["x"]["value"] == 3.0
+    assert eng.get_device("as-9") is not None
+    summaries = eng.drain()                   # nothing pending -> zero summary
+    assert summaries[-1]["registered"] == 0
