@@ -92,13 +92,17 @@ def main() -> None:
     elapsed = time.perf_counter() - t_start
 
     events = STEPS * BATCH
-    eps = events / elapsed
     lat_ms = sorted(1000 * l for l in lat)
     p50 = lat_ms[len(lat_ms) // 2]
     p99 = lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))]
+    # Headline = sustained wall-clock throughput (what BASELINE.md defines);
+    # the median-step rate is logged as a diagnostic for the chip's
+    # dispatch-jitter-free capability.
+    eps = events / elapsed
     m = state.metrics
     log(
-        f"{events} events in {elapsed:.3f}s  -> {eps:,.0f} ev/s/chip; "
+        f"{events} events in {elapsed:.3f}s -> {eps:,.0f} ev/s/chip sustained; "
+        f"median-step capability {BATCH / (p50 / 1000):,.0f} ev/s; "
         f"step p50={p50:.2f}ms p99={p99:.2f}ms; "
         f"found={int(m.found)} registered={int(m.registered)} persisted={int(m.persisted)}"
     )
@@ -129,6 +133,26 @@ def main() -> None:
         f"host e2e pipelined (steady-state ingest): "
         f"{pstats.events_per_s:,.0f} ev/s"
     )
+
+    # Diagnostic (stderr): analytics scoring path (BASELINE config #4) —
+    # anomaly score on 100-sensor windows, windows/s on the chip.
+    from sitewhere_tpu.models.anomaly import AnomalyConfig, AnomalyModel
+
+    cfg = AnomalyConfig(sensors=100, window=128, hidden=256, lstm_hidden=256)
+    model = AnomalyModel(cfg)
+    xw = jnp.asarray(rng.standard_normal((256, cfg.window, cfg.sensors)),
+                     jnp.float32)
+    params = model.init(jax.random.key(0), xw)
+    score = jax.jit(model.apply)
+    jax.block_until_ready(score(params, xw))
+    lat_w = []
+    for _ in range(10):
+        t1 = time.perf_counter()
+        jax.block_until_ready(score(params, xw))
+        lat_w.append(time.perf_counter() - t1)
+    med = sorted(lat_w)[len(lat_w) // 2]
+    log(f"analytics (anomaly score, 256x128x100): "
+        f"{256 / med:,.0f} windows/s, median {1e3 * med:.1f}ms")
 
     baseline_per_chip = 1_000_000 / 8
     print(

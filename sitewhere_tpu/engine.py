@@ -324,9 +324,13 @@ class Engine:
                 self._stage(et, token_id, tenant_id, ts, now, values, mask, aux0, req)
                 return
             if et is EventType.LOCATION:
-                values[0], values[1] = req.latitude or 0.0, req.longitude or 0.0
-                values[2] = req.elevation or 0.0
-                mask[:3] = True
+                # lanes only when coordinates were provided — a location
+                # request with null coords persists with no location lanes
+                # (native decoder parity; no null-island (0,0) rows)
+                if req.latitude is not None and req.longitude is not None:
+                    values[0], values[1] = req.latitude, req.longitude
+                    values[2] = req.elevation or 0.0
+                    mask[:3] = True
             elif et is EventType.ALERT:
                 values[0] = float(int(req.alert_level))
                 mask[0] = True
@@ -465,8 +469,11 @@ class Engine:
         ingest path — back-to-back batches pipeline on device while the host
         stages the next one (SURVEY.md §7 'avoid Python in the steady-state
         loop'); host mirrors lag until the next drain/flush, which every
-        host-facing query performs first."""
+        host-facing query performs first. No-op on an empty buffer (never
+        dispatches a zero-event device step)."""
         with self.lock:
+            if not len(self._buf):
+                return
             batch = self._buf.emit()
             self.state, out = self._step(self.state, batch)
             self._pending_outs.append(out)
@@ -908,6 +915,8 @@ class Engine:
         """Mark stale devices MISSING; returns their tokens (notification
         hook — PresenceNotificationStrategies.SendOnce analog)."""
         with self.lock:
+            self._sync_mirrors()   # async-registered devices must be mirrored
+                                   # or their one-shot notification is lost
             now = jnp.int32(self.epoch.now_ms())
             missing_ms = jnp.int32(int(self.config.presence_missing_s * 1000))
             self.state, newly = self._sweep(self.state, now, missing_ms)

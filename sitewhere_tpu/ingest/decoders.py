@@ -80,8 +80,11 @@ def request_from_envelope(envelope: dict, metadata: dict | None = None) -> Decod
             else:
                 raise EventDecodeException("measurement request missing name/value")
         elif rtype is RequestType.DEVICE_LOCATION:
-            out.latitude = float(req["latitude"] if req["latitude"] is not None else 0.0)
-            out.longitude = float(req["longitude"] if req["longitude"] is not None else 0.0)
+            # null coordinates decode as an absent location (native parity:
+            # have_loc stays false) — never as null island (0, 0)
+            if req["latitude"] is not None and req["longitude"] is not None:
+                out.latitude = float(req["latitude"])
+                out.longitude = float(req["longitude"])
             out.elevation = float(req.get("elevation") or 0.0)
         elif rtype is RequestType.DEVICE_ALERT:
             out.alert_type = str(req.get("type") or "alert")

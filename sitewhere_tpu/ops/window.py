@@ -148,7 +148,10 @@ def merge_batch_state(
     meas_last_ms = jnp.maximum(state.meas_last_ms, cand_ts)
 
     # --- locations --------------------------------------------------------
-    take_l = found & (etype == EventType.LOCATION)
+    # vmask lane 0 gates the ring: a LOCATION event decoded without
+    # coordinates (null lat/lon) counts in event_counts but must not record
+    # a (0, 0) null-island row
+    take_l = found & (etype == EventType.LOCATION) & vmask[:, 0]
     l_valid, l_ts, (l_vals,) = _batch_recent_ring(
         n, take_l, dev, ts_ms, seq, [values[:, :LOC_LANES]]
     )

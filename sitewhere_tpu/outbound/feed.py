@@ -73,11 +73,13 @@ class FeedConsumer:
         """Fetch newly persisted events past the committed offset (does not
         commit — call ``commit(events)`` after successful processing)."""
         # async flushes may have advanced the store past the host mirrors;
-        # sync first so _enrich sees every auto-registered device's token
-        if self.engine._pending_outs:
-            with self.engine.lock:
-                self.engine._sync_mirrors()
-        store = self.engine.state.store
+        # drain under the engine lock so no flush_async can slip between the
+        # mirror sync and the store-head read (else _enrich would see events
+        # from devices the mirror doesn't know yet)
+        with self.engine.lock:
+            if self.engine._pending_outs:
+                self.engine.drain()
+            store = self.engine.state.store
         head = absolute_cursor(store)
         if head <= self.offset:
             return []

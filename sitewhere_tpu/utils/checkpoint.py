@@ -36,8 +36,9 @@ def save_engine(engine: Engine, directory: str | pathlib.Path) -> dict:
     directory = pathlib.Path(directory)
     directory.mkdir(parents=True, exist_ok=True)
     with engine.lock:
-        if engine.staged_count:
-            engine.flush()
+        # staged batches AND async-flushed outputs must both land before the
+        # snapshot, or the saved mirrors lag the saved device state
+        engine._sync_mirrors()
         arrays = _flatten_state(engine.state)
         np.savez_compressed(directory / "state.npz", **arrays)
         host = {

@@ -1,0 +1,118 @@
+"""Concurrency-safety stress tests (SURVEY.md §5.3).
+
+The reference leans on Kafka partition ordering, single-writer executors,
+and JPA transactions for safety; the engine's contract is one RLock
+serializing mutations with async flush outputs drained before any host
+read. These tests hammer that contract from many threads at once.
+"""
+
+import threading
+
+import numpy as np
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+
+def _engine():
+    return Engine(EngineConfig(
+        device_capacity=512, token_capacity=1024, assignment_capacity=1024,
+        store_capacity=1 << 14, batch_capacity=64, channels=4,
+    ))
+
+
+def test_concurrent_ingest_and_queries():
+    """8 writer threads + 4 reader threads; totals must balance exactly."""
+    eng = _engine()
+    N_WRITERS, PER_WRITER = 8, 200
+    errors = []
+
+    def writer(w: int):
+        try:
+            for i in range(PER_WRITER):
+                eng.process(DecodedRequest(
+                    type=RequestType.DEVICE_MEASUREMENT,
+                    device_token=f"c-{w}-{i % 20}",
+                    measurements={"v": float(i)},
+                ))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(30):
+                eng.query_events(limit=5)
+                eng.search_device_states(limit=5)
+                eng.get_device("c-0-0")
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(N_WRITERS)]
+    threads += [threading.Thread(target=reader) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    eng.flush()
+    m = eng.metrics()
+    total = N_WRITERS * PER_WRITER
+    assert m["processed"] == total
+    assert m["persisted"] == total            # every event expanded once
+    assert m["registered"] == N_WRITERS * 20  # distinct tokens
+    # host mirror agrees with device counters
+    assert len(eng.devices) == N_WRITERS * 20
+    # event store totals match
+    res = eng.query_events(limit=1)
+    assert res["total"] == min(total, eng.config.store_capacity)
+
+
+def test_concurrent_admin_and_ingest():
+    """Registrations/assignments racing with ingest keep ids consistent."""
+    eng = _engine()
+    errors = []
+
+    def admin(w: int):
+        try:
+            for i in range(25):
+                tok = f"adm-{w}-{i}"
+                eng.register_device(tok)
+                a = eng.create_assignment(tok)
+                eng.release_assignment(a.token)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def ingest(w: int):
+        try:
+            for i in range(100):
+                eng.process(DecodedRequest(
+                    type=RequestType.DEVICE_MEASUREMENT,
+                    device_token=f"adm-{w % 4}-{i % 25}",
+                    measurements={"v": 1.0},
+                ))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=admin, args=(w,)) for w in range(4)]
+    threads += [threading.Thread(target=ingest, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    eng.flush()
+
+    # assignment ids unique and mirrors consistent
+    ids = [a.id for a in eng.assignments.values()]
+    assert len(ids) == len(set(ids))
+    # each admin device: default assignment ACTIVE + extra RELEASED
+    for w in range(4):
+        for i in range(25):
+            asgs = eng.list_assignments(f"adm-{w}-{i}")
+            statuses = sorted(a.status for a in asgs)
+            assert statuses == ["ACTIVE", "RELEASED"], (w, i, statuses)
+    # no device row double-allocated
+    dids = list(eng.token_device.values())
+    assert len(dids) == len(set(dids))

@@ -476,3 +476,24 @@ def test_python_decoder_tolerates_json_literals():
         "deviceToken": "n-2", "type": "DeviceAlert",
         "request": {"type": None, "level": None, "message": "x"}})
     assert r.alert_type == "alert"
+
+
+def test_null_location_never_null_island():
+    """null lat/lon must not create a (0, 0) location on either path."""
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.ingest.decoders import request_from_envelope
+
+    r = request_from_envelope({
+        "deviceToken": "ni-1", "type": "DeviceLocation",
+        "request": {"latitude": None, "longitude": None}})
+    assert r.latitude is None and r.longitude is None
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    eng.process(r)
+    eng.flush()
+    st = eng.get_device_state("ni-1")
+    assert st is not None
+    assert st["recent_locations"] == []          # event persisted, no coords
+    assert st["event_counts"]["LOCATION"] == 1
