@@ -82,6 +82,8 @@ class EngineConfig:
     default_device_type: str = "default"
     presence_missing_s: float = 8 * 3600.0  # DevicePresenceManager default 8h
     use_native: bool = True            # C++ decode/interning data plane
+    fair_tenancy: bool = False         # round-robin batch formation across
+                                       # tenants (multi-tenant fairness)
     analytics_devices: int = 0         # HBM telemetry windows for [0, M)
     analytics_window: int = 128        # W timesteps per window
 
@@ -273,15 +275,17 @@ class Engine:
         self.dead_letters: list[int] = []             # unregistered token ids
         self.outputs: list[dict] = []                 # recent step summaries
         self._pending_outs: list[StepOutput] = []     # un-absorbed step outputs
+        self._fair_queues: dict[int, list] = {}       # tenant_id -> staged rows
+        self._fair_queued = 0
 
     @property
     def staged_count(self) -> int:
-        return len(self._buf)
+        return len(self._buf) + self._fair_queued
 
     def _sync_mirrors(self) -> None:
         """Make host mirrors current: run any staged batch and absorb any
         pending async outputs. Caller holds the lock."""
-        if len(self._buf):
+        while len(self._buf) or self._fair_queued:
             self.flush_async()
         if self._pending_outs:
             self.drain()
@@ -345,6 +349,14 @@ class Engine:
             if req.alternate_id is not None
             else NULL_ID
         )
+        if self.config.fair_tenancy:
+            self._fair_enqueue(
+                tenant_id,
+                (et, token_id, tenant_id, ts, now,
+                 values.copy() if mask is not None and mask.any() else None,
+                 mask.copy() if mask is not None and mask.any() else None,
+                 aux0, aux1))
+            return
         i = len(self._buf)
         if not self._buf.append(et, token_id, tenant_id, ts, now, (), aux0, aux1):
             self.flush_async()
@@ -355,6 +367,45 @@ class Engine:
             self._buf.vmask[i, :] = mask
         if self._buf.full:
             self.flush_async()
+
+    def _fair_enqueue(self, tenant_id: int, row: tuple) -> None:
+        """Queue one staged row under its tenant. Caller holds the lock."""
+        import collections
+
+        q = self._fair_queues.get(tenant_id)
+        if q is None:
+            q = self._fair_queues[tenant_id] = collections.deque()
+        q.append(row)
+        self._fair_queued += 1
+        if self._fair_queued >= self.config.batch_capacity:
+            self.flush_async()
+
+    def _form_fair_batch(self) -> None:
+        """Round-robin the per-tenant queues into the staging buffer —
+        fairness in batch formation (SURVEY.md §7 'hard parts': a tenant's
+        burst must not starve the others' latency). Caller holds the lock."""
+        while self._fair_queued and not self._buf.full:
+            progressed = False
+            for tid in list(self._fair_queues):
+                q = self._fair_queues[tid]
+                if not q:
+                    continue
+                if self._buf.full:
+                    break
+                et, token_id, tenant_id, ts, now, values, mask, aux0, aux1 = \
+                    q.popleft()
+                i = len(self._buf)
+                self._buf.append(et, token_id, tenant_id, ts, now, (),
+                                 aux0, aux1)
+                if mask is not None:
+                    self._buf.values[i, :] = values
+                    self._buf.vmask[i, :] = mask
+                self._fair_queued -= 1
+                progressed = True
+            if not progressed:
+                break
+        for tid in [t for t, q in self._fair_queues.items() if not q]:
+            del self._fair_queues[tid]
 
     def ingest_json_batch(self, payloads: list[bytes],
                           tenant: str = "default") -> dict:
@@ -414,6 +465,22 @@ class Engine:
                 values[alert_rows, 0] = res.level[alert_rows]
             idxs = np.nonzero(ok)[0]
             tenant_id = self.tenants.intern(tenant)
+            if self.config.fair_tenancy:
+                # fair mode: the fast path must honor the same per-tenant
+                # round-robin as process(), or a flooding tenant bypasses it
+                for j in idxs:
+                    j = int(j)
+                    row_mask = res.chmask[j]
+                    has_vals = bool(row_mask.any())
+                    self._fair_enqueue(tenant_id, (
+                        int(etype[j]), int(res.token_id[j]), tenant_id,
+                        int(ts_rel[j]), now,
+                        values[j].copy() if has_vals else None,
+                        row_mask.copy() if has_vals else None,
+                        int(res.aux0[j]), NULL_ID))
+                self.channel_map.collisions += res.collisions
+                return {"decoded": int(np.sum(ok)), "failed": failed,
+                        "staged": int(len(idxs))}
             staged = 0
             pos = 0
             while pos < len(idxs):
@@ -449,7 +516,7 @@ class Engine:
         with self.lock:
             expired = (time.monotonic() - self._last_flush
                        >= self.config.flush_interval_s)
-            if len(self._buf) and expired:
+            if (len(self._buf) or self._fair_queued) and expired:
                 return self.flush()
             if self._pending_outs and expired:
                 return self.drain()[-1]
@@ -461,6 +528,8 @@ class Engine:
 
         with self.lock, stage("pipeline_step"):
             self.flush_async()
+            while self._fair_queued:   # fair mode: one batch per dispatch
+                self.flush_async()
             return self.drain()[-1]
 
     def flush_async(self) -> None:
@@ -472,6 +541,10 @@ class Engine:
         host-facing query performs first. No-op on an empty buffer (never
         dispatches a zero-event device step)."""
         with self.lock:
+            # drain fair queues whenever rows are queued (even if the flag
+            # was toggled off afterwards — queued rows must never strand)
+            if self._fair_queued:
+                self._form_fair_batch()
             if not len(self._buf):
                 return
             batch = self._buf.emit()
@@ -892,9 +965,13 @@ class Engine:
                         for c in np.nonzero(vmask[i])[0]
                     }
                 elif et is EventType.LOCATION:
-                    ev["latitude"], ev["longitude"], ev["elevation"] = (
-                        float(values[i, 0]), float(values[i, 1]), float(values[i, 2])
-                    )
+                    if vmask[i, 0]:
+                        ev["latitude"], ev["longitude"], ev["elevation"] = (
+                            float(values[i, 0]), float(values[i, 1]),
+                            float(values[i, 2])
+                        )
+                    else:  # decoded without coordinates — never null island
+                        ev["latitude"] = ev["longitude"] = ev["elevation"] = None
                 elif et is EventType.ALERT:
                     ev["level"] = int(values[i, 0])
                     atype = int(res.aux[i, 0])

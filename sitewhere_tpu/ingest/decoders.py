@@ -189,8 +189,13 @@ def encode_binary_request(req: DecodedRequest) -> bytes:
             nb = name.encode()
             out += struct.pack("<H", len(nb)) + nb + struct.pack("<d", float(value))
     elif req.type is RequestType.DEVICE_LOCATION:
-        out += struct.pack("<ddd", req.latitude or 0.0, req.longitude or 0.0,
-                           req.elevation or 0.0)
+        # NaN wires "absent coordinates" so a null-coord location survives a
+        # binary round trip without turning into null island (0, 0)
+        out += struct.pack(
+            "<ddd",
+            req.latitude if req.latitude is not None else float("nan"),
+            req.longitude if req.longitude is not None else float("nan"),
+            req.elevation or 0.0)
     elif req.type is RequestType.DEVICE_ALERT:
         tb = (req.alert_type or "alert").encode()
         mb = (req.alert_message or "").encode()
@@ -234,9 +239,10 @@ class BinaryEventDecoder:
                     pairs[name] = val
                 req.measurements = pairs
             elif rtype is RequestType.DEVICE_LOCATION:
-                req.latitude, req.longitude, req.elevation = struct.unpack_from(
-                    "<ddd", payload, off
-                )
+                lat, lon, elev = struct.unpack_from("<ddd", payload, off)
+                req.latitude = None if lat != lat else lat    # NaN = absent
+                req.longitude = None if lon != lon else lon
+                req.elevation = elev
             elif rtype is RequestType.DEVICE_ALERT:
                 (tl,) = struct.unpack_from("<H", payload, off)
                 off += 2

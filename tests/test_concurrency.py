@@ -116,3 +116,103 @@ def test_concurrent_admin_and_ingest():
     # no device row double-allocated
     dids = list(eng.token_device.values())
     assert len(dids) == len(set(dids))
+
+
+def test_fair_tenancy_batch_formation():
+    """A flooding tenant must not starve others: with fair_tenancy the
+    first formed batch round-robins across tenants, so the small tenant's
+    events all land in the first flush."""
+    eng = Engine(EngineConfig(
+        device_capacity=512, token_capacity=1024, assignment_capacity=1024,
+        store_capacity=1 << 14, batch_capacity=64, channels=4,
+        fair_tenancy=True, flush_interval_s=1e9,
+    ))
+    # tenant A floods 120 events FIRST, then tenant B stages 10. Suspend
+    # the capacity auto-flush while queueing so one batch formation is
+    # observable (the staging buffer itself stays 64 slots).
+    eng.config.batch_capacity = 1 << 20
+    for i in range(120):
+        eng.process(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token=f"a-{i % 8}",
+            tenant="A", measurements={"v": 1.0}))
+    for i in range(10):
+        eng.process(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token=f"b-{i}",
+            tenant="B", measurements={"v": 2.0}))
+    eng.config.batch_capacity = 64
+    # one batch dispatch only (queries would force a full sync, so observe
+    # the partial state via metrics + the fair queues directly)
+    eng.flush_async()
+    eng.drain()
+    assert eng.metrics()["persisted"] == 64
+    a_tid, b_tid = eng.tenants.lookup("A"), eng.tenants.lookup("B")
+    # all 10 of B's events made the first 64-slot batch (round-robin),
+    # despite 120 of A's queued ahead of them
+    assert not eng._fair_queues.get(b_tid)
+    assert len(eng._fair_queues[a_tid]) == 120 - (64 - 10)
+    # draining the rest delivers everything exactly once
+    eng.flush()
+    assert eng.metrics()["persisted"] == 130
+    assert eng.query_events(tenant="B", limit=100)["total"] == 10
+    assert eng.query_events(tenant="A", limit=1)["total"] == 120
+    assert eng.staged_count == 0
+
+
+def test_fair_tenancy_off_is_fifo():
+    """Default mode preserves strict FIFO: B's late events wait."""
+    eng = Engine(EngineConfig(
+        device_capacity=512, token_capacity=1024, assignment_capacity=1024,
+        store_capacity=1 << 14, batch_capacity=64, channels=4,
+        flush_interval_s=1e9,
+    ))
+    for i in range(60):
+        eng.process(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token=f"a-{i % 8}",
+            tenant="A", measurements={"v": 1.0}))
+    for i in range(10):
+        eng.process(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token=f"b-{i}",
+            tenant="B", measurements={"v": 2.0}))
+    # the auto-flush at 64 staged ran with only 4 of B's events; the other
+    # 6 still sit in the FIFO buffer (queries would sync, so inspect direct)
+    eng.drain()
+    assert eng.metrics()["persisted"] == 64
+    b_tid = eng.tenants.lookup("B")
+    assert len(eng._buf) == 6
+    assert all(t == b_tid for t in eng._buf.tenant_id[:6])
+    eng.flush()
+    assert eng.metrics()["persisted"] == 70
+    assert eng.query_events(tenant="B", limit=100)["total"] == 10
+
+
+def test_fair_tenancy_fast_path_and_toggle_off():
+    """ingest_json_batch honors fairness, and rows queued before the flag
+    is toggled off still drain (no flush() hang)."""
+    eng = Engine(EngineConfig(
+        device_capacity=512, token_capacity=1024, assignment_capacity=1024,
+        store_capacity=1 << 14, batch_capacity=64, channels=4,
+        fair_tenancy=True, flush_interval_s=1e9,
+    ))
+    eng.config.batch_capacity = 1 << 20    # suspend auto-dispatch
+    payloads_a = [
+        (b'{"deviceToken": "fa-%d", "type": "DeviceMeasurement",'
+         b' "request": {"name": "v", "value": 1.0}}' % (i % 8))
+        for i in range(100)
+    ]
+    eng.ingest_json_batch(payloads_a, tenant="A")
+    for i in range(10):
+        eng.process(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token=f"fb-{i}",
+            tenant="B", measurements={"v": 2.0}))
+    eng.config.batch_capacity = 64
+    assert eng._fair_queued == 110
+    eng.flush_async()
+    eng.drain()
+    # first 64-slot batch round-robins: all 10 of B's rows made it
+    assert eng.metrics()["persisted"] == 64
+    assert not eng._fair_queues.get(eng.tenants.lookup("B"))
+    # toggling fairness off must not strand the queued remainder
+    eng.config.fair_tenancy = False
+    eng.flush()
+    assert eng.metrics()["persisted"] == 110
+    assert eng._fair_queued == 0

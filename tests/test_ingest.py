@@ -497,3 +497,22 @@ def test_null_location_never_null_island():
     assert st is not None
     assert st["recent_locations"] == []          # event persisted, no coords
     assert st["event_counts"]["LOCATION"] == 1
+
+
+def test_binary_roundtrip_null_location():
+    """NaN wires absent coords through the binary codec (no null island)."""
+    from sitewhere_tpu.ingest.decoders import (
+        BinaryEventDecoder,
+        encode_binary_request,
+    )
+    from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+    req = DecodedRequest(type=RequestType.DEVICE_LOCATION, device_token="bl-1")
+    wire = encode_binary_request(req)
+    back = BinaryEventDecoder().decode(wire, {})[0]
+    assert back.latitude is None and back.longitude is None
+    # real coordinates still round-trip exactly
+    req2 = DecodedRequest(type=RequestType.DEVICE_LOCATION, device_token="bl-1",
+                          latitude=12.5, longitude=-3.25, elevation=7.0)
+    back2 = BinaryEventDecoder().decode(encode_binary_request(req2), {})[0]
+    assert (back2.latitude, back2.longitude, back2.elevation) == (12.5, -3.25, 7.0)
