@@ -101,6 +101,30 @@ class DeviceInfo:
     auto_registered: bool = False
 
 
+class _FairChunk:
+    """A run of staged rows for one tenant awaiting fair batch formation.
+    ``pos`` advances as formation slices rows out; arrays are never copied
+    after enqueue."""
+
+    __slots__ = ("etype", "token", "ts", "recv", "values", "vmask",
+                 "aux0", "aux1", "pos")
+
+    def __init__(self, etype, token, ts, recv, values, vmask, aux0, aux1):
+        self.etype = etype
+        self.token = token
+        self.ts = ts
+        self.recv = recv
+        self.values = values
+        self.vmask = vmask
+        self.aux0 = aux0
+        self.aux1 = aux1
+        self.pos = 0
+
+    @property
+    def remaining(self) -> int:
+        return len(self.etype) - self.pos
+
+
 @dataclasses.dataclass
 class AssignmentInfo:
     """Host-side assignment metadata (reference: device assignments managed by
@@ -350,12 +374,18 @@ class Engine:
             else NULL_ID
         )
         if self.config.fair_tenancy:
-            self._fair_enqueue(
-                tenant_id,
-                (et, token_id, tenant_id, ts, now,
-                 values.copy() if mask is not None and mask.any() else None,
-                 mask.copy() if mask is not None and mask.any() else None,
-                 aux0, aux1))
+            i32 = np.int32
+            has_vals = mask is not None and (mask.any() or values.any())
+            self._fair_enqueue(tenant_id, _FairChunk(
+                etype=np.array([et], i32),
+                token=np.array([token_id], i32),
+                ts=np.array([ts], i32),
+                recv=np.array([now], i32),
+                values=values[None].copy() if has_vals else None,
+                vmask=mask[None].copy() if has_vals else None,
+                aux0=np.array([aux0], i32),
+                aux1=np.array([aux1], i32),
+            ))
             return
         i = len(self._buf)
         if not self._buf.append(et, token_id, tenant_id, ts, now, (), aux0, aux1):
@@ -368,42 +398,60 @@ class Engine:
         if self._buf.full:
             self.flush_async()
 
-    def _fair_enqueue(self, tenant_id: int, row: tuple) -> None:
-        """Queue one staged row under its tenant. Caller holds the lock."""
+    def _fair_enqueue(self, tenant_id: int, chunk: "_FairChunk") -> None:
+        """Queue a chunk of staged rows under its tenant (O(1) per chunk —
+        the fast path enqueues a whole decode batch at once). Caller holds
+        the lock."""
         import collections
 
         q = self._fair_queues.get(tenant_id)
         if q is None:
             q = self._fair_queues[tenant_id] = collections.deque()
-        q.append(row)
-        self._fair_queued += 1
+        q.append(chunk)
+        self._fair_queued += chunk.remaining
         if self._fair_queued >= self.config.batch_capacity:
             self.flush_async()
 
+    def fair_backlog(self, tenant: str) -> int:
+        """Rows queued but not yet batched for one tenant (fair mode)."""
+        tid = self.tenants.lookup(tenant)
+        return sum(c.remaining for c in self._fair_queues.get(tid, ()))
+
     def _form_fair_batch(self) -> None:
-        """Round-robin the per-tenant queues into the staging buffer —
-        fairness in batch formation (SURVEY.md §7 'hard parts': a tenant's
-        burst must not starve the others' latency). Caller holds the lock."""
-        while self._fair_queued and not self._buf.full:
-            progressed = False
-            for tid in list(self._fair_queues):
-                q = self._fair_queues[tid]
-                if not q:
-                    continue
-                if self._buf.full:
-                    break
-                et, token_id, tenant_id, ts, now, values, mask, aux0, aux1 = \
-                    q.popleft()
-                i = len(self._buf)
-                self._buf.append(et, token_id, tenant_id, ts, now, (),
-                                 aux0, aux1)
-                if mask is not None:
-                    self._buf.values[i, :] = values
-                    self._buf.vmask[i, :] = mask
-                self._fair_queued -= 1
-                progressed = True
-            if not progressed:
+        """Quota-sliced batch formation across tenants — fairness in batch
+        formation (SURVEY.md §7 'hard parts': a tenant's burst must not
+        starve the others' latency). Each pass gives every tenant with
+        backlog an equal share of the remaining room, copied as vectorized
+        slices. Caller holds the lock."""
+        b = self._buf
+        while self._fair_queued and not b.full:
+            active = [t for t, q in self._fair_queues.items() if q]
+            if not active:
                 break
+            quota = max(1, (b.capacity - len(b)) // len(active))
+            for tid in active:
+                q = self._fair_queues[tid]
+                take = quota
+                while take > 0 and q and not b.full:
+                    ch = q[0]
+                    k = min(take, ch.remaining, b.capacity - len(b))
+                    lo, hi, p = b._n, b._n + k, ch.pos
+                    b.etype[lo:hi] = ch.etype[p:p + k]
+                    b.token_id[lo:hi] = ch.token[p:p + k]
+                    b.tenant_id[lo:hi] = tid
+                    b.ts_ms[lo:hi] = ch.ts[p:p + k]
+                    b.received_ms[lo:hi] = ch.recv[p:p + k]
+                    if ch.values is not None:
+                        b.values[lo:hi] = ch.values[p:p + k]
+                        b.vmask[lo:hi] = ch.vmask[p:p + k]
+                    b.aux[lo:hi, 0] = ch.aux0[p:p + k]
+                    b.aux[lo:hi, 1] = ch.aux1[p:p + k]
+                    b._n = hi
+                    ch.pos += k
+                    take -= k
+                    self._fair_queued -= k
+                    if ch.remaining == 0:
+                        q.popleft()
         for tid in [t for t, q in self._fair_queues.items() if not q]:
             del self._fair_queues[tid]
 
@@ -467,17 +515,21 @@ class Engine:
             tenant_id = self.tenants.intern(tenant)
             if self.config.fair_tenancy:
                 # fair mode: the fast path must honor the same per-tenant
-                # round-robin as process(), or a flooding tenant bypasses it
-                for j in idxs:
-                    j = int(j)
-                    row_mask = res.chmask[j]
-                    has_vals = bool(row_mask.any())
-                    self._fair_enqueue(tenant_id, (
-                        int(etype[j]), int(res.token_id[j]), tenant_id,
-                        int(ts_rel[j]), now,
-                        values[j].copy() if has_vals else None,
-                        row_mask.copy() if has_vals else None,
-                        int(res.aux0[j]), NULL_ID))
+                # quota as process(). The whole call shares one tenant, so
+                # the entire decode batch enqueues as ONE chunk (array
+                # slices — no per-row Python). ``values`` goes in whole:
+                # alert rows carry their level there with chmask unset.
+                if len(idxs):
+                    self._fair_enqueue(tenant_id, _FairChunk(
+                        etype=etype[idxs],
+                        token=res.token_id[idxs],
+                        ts=ts_rel[idxs],
+                        recv=np.full(len(idxs), now, np.int32),
+                        values=values[idxs],
+                        vmask=res.chmask[idxs],
+                        aux0=res.aux0[idxs],
+                        aux1=np.full(len(idxs), NULL_ID, np.int32),
+                    ))
                 self.channel_map.collisions += res.collisions
                 return {"decoded": int(np.sum(ok)), "failed": failed,
                         "staged": int(len(idxs))}

@@ -155,6 +155,57 @@ class JsonBatchEventDecoder:
         raise EventDecodeException("batch payload must be a list or {requests: []}")
 
 
+def split_json_array(raw: bytes) -> list[bytes]:
+    """Split a top-level JSON array into its raw element byte slices without
+    materializing Python objects — the bulk REST ingest path hands the
+    slices straight to the native batch decoder (one parse total instead
+    of parse + re-serialize + parse)."""
+    i, n = 0, len(raw)
+    while i < n and raw[i] in b" \t\r\n":
+        i += 1
+    if i >= n or raw[i] != ord("["):
+        raise EventDecodeException("expected a JSON array")
+    i += 1
+    out: list[bytes] = []
+    depth = 0
+    in_str = False
+    esc = False
+    start = -1
+    while i < n:
+        c = raw[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif c == ord("\\"):
+                esc = True
+            elif c == ord('"'):
+                in_str = False
+        elif c == ord('"'):
+            in_str = True
+            if depth == 0 and start < 0:
+                start = i
+        elif c in b"{[":
+            if depth == 0 and start < 0:
+                start = i
+            depth += 1
+        elif c in b"}]":
+            if depth == 0 and c == ord("]"):   # end of the top-level array
+                if start >= 0:
+                    out.append(raw[start:i].strip())
+                return out
+            depth -= 1
+        elif depth == 0:
+            if c == ord(","):
+                if start < 0:
+                    raise EventDecodeException("empty array element")
+                out.append(raw[start:i].strip())
+                start = -1
+            elif start < 0 and c not in b" \t\r\n":
+                start = i                       # literal/number element
+        i += 1
+    raise EventDecodeException("unterminated JSON array")
+
+
 # --- binary flat format (the "protobuf decoder" slot) ------------------------
 #
 # Layout (little-endian), versioned; replaces GPB with a schema tuned for

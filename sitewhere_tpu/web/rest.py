@@ -730,6 +730,47 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     r.add_get("/api/authorities", lambda req: json_response(
         sorted({a for auths in inst.users.roles.values() for a in auths})))
 
+    # --- batch event ingest (wire-level bulk path) ------------------------
+    async def post_event_batch(request: web.Request):
+        """Accept a JSON array of DeviceRequest envelopes in one call — the
+        bulk ingest surface the per-device POST cannot batch. Rows decode
+        through the native batch path when available."""
+        from sitewhere_tpu.ingest.decoders import split_json_array
+
+        body = await request.read()
+        rows = split_json_array(body)   # raw slices; decoded once, natively
+        res = inst.engine.ingest_json_batch(
+            rows, tenant=request.get("tenant", "default"))
+        inst.engine.flush()
+        return json_response(res, status=201)
+
+    r.add_post("/api/events/batch", post_event_batch)
+
+    # --- openapi (reference: OpenAPI annotations on every controller) -----
+    async def openapi_spec(request: web.Request):
+        """Minimal OpenAPI 3 document generated from the live route table."""
+        paths: dict[str, dict] = {}
+        for route in r.routes():
+            info = route.resource.get_info() if route.resource else {}
+            path = info.get("path") or info.get("formatter")
+            if not path or route.method == "OPTIONS":
+                continue
+            ops = paths.setdefault(path, {})
+            ops[route.method.lower()] = {
+                "summary": (route.handler.__doc__ or "").strip().split("\n")[0],
+                "responses": {"200": {"description": "OK"}},
+            }
+        import sitewhere_tpu
+
+        return json_response({
+            "openapi": "3.0.0",
+            "info": {"title": "SiteWhere-TPU REST API",
+                     "version": sitewhere_tpu.__version__},
+            "paths": dict(sorted(paths.items())),
+        })
+
+    r.add_get("/api/openapi.json", openapi_spec)
+
     # --- system (reference: System.java version endpoint) -----------------
     async def system_version(request: web.Request):
         import jax

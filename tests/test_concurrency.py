@@ -145,11 +145,10 @@ def test_fair_tenancy_batch_formation():
     eng.flush_async()
     eng.drain()
     assert eng.metrics()["persisted"] == 64
-    a_tid, b_tid = eng.tenants.lookup("A"), eng.tenants.lookup("B")
-    # all 10 of B's events made the first 64-slot batch (round-robin),
+    # all 10 of B's events made the first 64-slot batch (fair quota),
     # despite 120 of A's queued ahead of them
-    assert not eng._fair_queues.get(b_tid)
-    assert len(eng._fair_queues[a_tid]) == 120 - (64 - 10)
+    assert eng.fair_backlog("B") == 0
+    assert eng.fair_backlog("A") == 120 - (64 - 10)
     # draining the rest delivers everything exactly once
     eng.flush()
     assert eng.metrics()["persisted"] == 130
@@ -210,7 +209,7 @@ def test_fair_tenancy_fast_path_and_toggle_off():
     eng.drain()
     # first 64-slot batch round-robins: all 10 of B's rows made it
     assert eng.metrics()["persisted"] == 64
-    assert not eng._fair_queues.get(eng.tenants.lookup("B"))
+    assert eng.fair_backlog("B") == 0
     # toggling fairness off must not strand the queued remainder
     eng.config.fair_tenancy = False
     eng.flush()

@@ -516,3 +516,38 @@ def test_binary_roundtrip_null_location():
                           latitude=12.5, longitude=-3.25, elevation=7.0)
     back2 = BinaryEventDecoder().decode(encode_binary_request(req2), {})[0]
     assert (back2.latitude, back2.longitude, back2.elevation) == (12.5, -3.25, 7.0)
+
+
+def test_split_json_array():
+    from sitewhere_tpu.ingest.decoders import EventDecodeException, split_json_array
+
+    raw = b' [ {"a": [1, 2], "s": "x,]}"} , {"b": {"c": 3}},\n {"d": 4} ] '
+    parts = split_json_array(raw)
+    assert parts == [b'{"a": [1, 2], "s": "x,]}"}', b'{"b": {"c": 3}}',
+                     b'{"d": 4}']
+    assert split_json_array(b"[]") == []
+    assert split_json_array(b'["lone"]') == [b'"lone"']
+    import pytest as _pytest
+    with _pytest.raises(EventDecodeException):
+        split_json_array(b'{"not": "array"}')
+    with _pytest.raises(EventDecodeException):
+        split_json_array(b'[{"unterminated": 1}')
+
+
+def test_fair_mode_preserves_alert_levels():
+    """Regression: alert levels ride the values row with chmask unset; the
+    fair-mode fast path must not drop them."""
+    from sitewhere_tpu.engine import Engine, EngineConfig
+
+    for fair in (False, True):
+        eng = Engine(EngineConfig(
+            device_capacity=32, token_capacity=64, assignment_capacity=64,
+            store_capacity=512, batch_capacity=8, channels=4,
+            fair_tenancy=fair))
+        eng.ingest_json_batch([
+            b'{"deviceToken": "al-1", "type": "DeviceAlert", "request":'
+            b' {"type": "fire", "level": "Error", "message": "hot"}}'])
+        eng.flush()
+        st = eng.get_device_state("al-1")
+        assert st["recent_alerts"][0]["level"] == 2, (fair, st)
+        assert st["recent_alerts"][0]["type"] == "fire"

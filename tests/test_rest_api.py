@@ -409,3 +409,29 @@ def test_roles_system_and_state_search(api):
           "request": {"originatingEventId": str(inv_id), "response": "pong"}})
     status, resp = call("GET", f"/api/invocations/{inv_id}/responses")
     assert status == 200 and len(resp) == 1
+
+
+def test_batch_ingest_and_openapi(api):
+    call, inst, loop = api
+    rows = [
+        {"deviceToken": f"bi-{i % 4}", "type": "DeviceMeasurement",
+         "request": {"name": "t", "value": float(i)}}
+        for i in range(20)
+    ]
+    status, res = call("POST", "/api/events/batch", rows)
+    assert status == 201 and res["decoded"] == 20 and res["failed"] == 0
+    status, ev = call("GET", "/api/events")
+    assert ev["total"] == 20
+
+    # malformed body -> 400, and bad rows count as failed decodes
+    status, _ = call("POST", "/api/events/batch", {"not": "a list"})
+    assert status == 400
+    status, res = call("POST", "/api/events/batch",
+                       [{"type": "DeviceMeasurement", "request": {}}])
+    assert status == 201 and res["failed"] == 1
+
+    status, spec = call("GET", "/api/openapi.json")
+    assert status == 200 and spec["openapi"] == "3.0.0"
+    assert "/api/devices" in spec["paths"]
+    assert "post" in spec["paths"]["/api/events/batch"]
+    assert len(spec["paths"]) > 60
