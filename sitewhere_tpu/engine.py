@@ -414,8 +414,9 @@ class Engine:
 
     def fair_backlog(self, tenant: str) -> int:
         """Rows queued but not yet batched for one tenant (fair mode)."""
-        tid = self.tenants.lookup(tenant)
-        return sum(c.remaining for c in self._fair_queues.get(tid, ()))
+        with self.lock:
+            tid = self.tenants.lookup(tenant)
+            return sum(c.remaining for c in self._fair_queues.get(tid, ()))
 
     def _form_fair_batch(self) -> None:
         """Quota-sliced batch formation across tenants — fairness in batch

@@ -1,0 +1,51 @@
+"""Control-plane RPC wire protocol (the reference's gRPC/HTTP2 analog).
+
+The reference's services talk to each other over gRPC with per-service
+routers that dispatch each call into the right tenant engine
+(service-device-state/.../grpc/DeviceStateRouter.java:40-72,
+DeviceStateGrpcServer.java:18-23; SURVEY.md §1-L3). gRPC is the sync
+control/query plane — not the event hot path — so the TPU-native
+equivalent keeps that role: a compact length-prefixed framing over TCP
+(4-byte big-endian length + JSON body) carrying
+``{"id", "method", "tenant", "params"}`` requests and
+``{"id", "result"} | {"id", "error", "code"}`` responses. Streams
+multiplex by id, so one connection carries concurrent in-flight calls the
+way HTTP/2 does for gRPC.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any
+
+MAX_FRAME = 16 << 20  # 16 MiB, mirrors gRPC's default max message scale
+
+
+class RpcError(Exception):
+    """Remote error surfaced to the caller (code mirrors HTTP semantics)."""
+
+    def __init__(self, message: str, code: int = 500):
+        super().__init__(message)
+        self.code = code
+
+
+def encode_frame(obj: dict[str, Any]) -> bytes:
+    body = json.dumps(obj, separators=(",", ":")).encode()
+    if len(body) > MAX_FRAME:
+        raise RpcError(f"frame too large: {len(body)}", 413)
+    return struct.pack(">I", len(body)) + body
+
+
+async def read_frame(reader) -> dict[str, Any] | None:
+    """Read one frame; None on clean EOF at a frame boundary."""
+    try:
+        # asyncio.IncompleteReadError subclasses EOFError
+        header = await reader.readexactly(4)
+    except (EOFError, ConnectionError, OSError):
+        return None
+    (length,) = struct.unpack(">I", header)
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}", 413)
+    body = await reader.readexactly(length)
+    return json.loads(body)

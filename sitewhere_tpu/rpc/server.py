@@ -1,0 +1,189 @@
+"""Control-plane RPC server: method registry + tenant dispatch.
+
+Mirrors the reference's per-service gRPC servers and routers: each
+data-owning service hosts a ``*GrpcServer`` whose ``*Router`` resolves the
+tenant from call metadata and executes inside that tenant's engine
+(DeviceStateRouter.java:62-72 ``GrpcTenantEngineProvider
+.executeInTenantEngine``; SURVEY.md §1-L3). Here one server hosts the
+method families of the reference's API surface (device-management,
+event-management, device-state) over the instance, with tenant checks on
+every call.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import Any, Awaitable, Callable
+
+from sitewhere_tpu.core.types import EventType
+from sitewhere_tpu.rpc.protocol import RpcError, encode_frame, read_frame
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[..., Any]
+
+
+class RpcServer:
+    """Asyncio TCP server with a method registry; calls multiplex by id."""
+
+    def __init__(self, tenant_validator: Callable[[str], bool] | None = None):
+        self.methods: dict[str, Handler] = {}
+        self._tenant_scoped: dict[str, bool] = {}
+        self._tenant_validator = tenant_validator
+        self._server: asyncio.AbstractServer | None = None
+        self.port: int | None = None
+
+    def register(self, name: str, fn: Handler) -> None:
+        import inspect
+
+        self.methods[name] = fn
+        self._tenant_scoped[name] = (
+            "tenant" in inspect.signature(fn).parameters)
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._serve, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve(self, reader, writer) -> None:
+        lock = asyncio.Lock()
+        tasks: set[asyncio.Task] = set()
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                task = asyncio.ensure_future(
+                    self._dispatch(frame, writer, lock))
+                tasks.add(task)                 # keep a strong reference
+                task.add_done_callback(tasks.discard)
+        except Exception:
+            logger.exception("rpc connection error")
+        finally:
+            if tasks:                           # let in-flight calls respond
+                await asyncio.gather(*tasks, return_exceptions=True)
+            writer.close()
+
+    async def _dispatch(self, frame: dict, writer, lock) -> None:
+        rid = frame.get("id")
+        try:
+            method = frame.get("method", "")
+            fn = self.methods.get(method)
+            if fn is None:
+                raise RpcError(f"unknown method {method!r}", 404)
+            tenant = frame.get("tenant")
+            if tenant is not None and self._tenant_validator is not None \
+                    and not self._tenant_validator(tenant):
+                # the router's unknown-tenant rejection
+                raise RpcError(f"unknown tenant {tenant!r}", 404)
+            params = frame.get("params") or {}
+            if tenant is not None and self._tenant_scoped.get(method):
+                # executeInTenantEngine semantics: a tenant-bound connection
+                # operates in ITS tenant — callers cannot address another
+                params["tenant"] = tenant
+            result = fn(**params)
+            if isinstance(result, Awaitable):
+                result = await result
+            resp = {"id": rid, "result": result}
+        except RpcError as e:
+            resp = {"id": rid, "error": str(e), "code": e.code}
+        except (KeyError, ValueError, TypeError) as e:
+            resp = {"id": rid, "error": str(e), "code": 400}
+        except Exception as e:
+            logger.exception("rpc handler failure")
+            resp = {"id": rid, "error": str(e), "code": 500}
+        async with lock:   # frames must not interleave on the socket
+            if writer.is_closing():
+                return
+            try:
+                writer.write(encode_frame(resp))
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass       # client went away mid-response
+
+
+def build_instance_rpc(instance) -> RpcServer:
+    """Register the reference's cross-service API families over one
+    instance — the method surface the gRPC ``*ApiChannel`` clients consume
+    (device-management / event-management / device-state; SURVEY.md §1-L3)."""
+    inst = instance
+    srv = RpcServer(
+        tenant_validator=lambda t: inst.tenants.tenants.try_get(t) is not None)
+
+    # --- device-management (DeviceManagementImpl analog) ------------------
+    def get_device_by_token(token: str):
+        info = inst.engine.get_device(token)
+        if info is None:
+            return None
+        return dataclasses.asdict(info)
+
+    def create_device(token: str, deviceType: str = "default",
+                      tenant: str = "default", area: str = None,
+                      customer: str = None, metadata: dict = None):
+        s = inst.device_management.create_device(
+            token, deviceType, tenant=tenant, area=area, customer=customer,
+            metadata=metadata)
+        return dataclasses.asdict(s)
+
+    def list_devices(page: int = 1, pageSize: int = 100,
+                     deviceType: str = None, tenant: str = None):
+        res = inst.device_management.list_devices(
+            page=page, page_size=pageSize, device_type=deviceType,
+            tenant=tenant)
+        return {"numResults": res.total,
+                "results": [dataclasses.asdict(s) for s in res.results]}
+
+    def get_active_assignments(token: str):
+        return [dataclasses.asdict(a)
+                for a in inst.engine.list_assignments(token)
+                if a.status != "RELEASED"]
+
+    # --- event-management (DeviceEventManagementImpl analog) --------------
+    def list_device_events(token: str = None, type: str = None,
+                           sinceMs: int = None, untilMs: int = None,
+                           pageSize: int = 100, tenant: str = None):
+        et = EventType[type.upper()] if type else None
+        return inst.engine.query_events(
+            device_token=token, etype=et, tenant=tenant,
+            since_ms=sinceMs, until_ms=untilMs, limit=pageSize)
+
+    def add_device_event(envelope: dict, tenant: str = "default"):
+        from sitewhere_tpu.ingest.decoders import request_from_envelope
+
+        req = request_from_envelope(envelope)
+        req.tenant = tenant
+        inst.engine.process(req)
+        inst.engine.flush()
+        return {"accepted": True}
+
+    # --- device-state (DeviceStateImpl analog, incl. search) --------------
+    def get_device_state(token: str):
+        return inst.engine.get_device_state(token)
+
+    def search_device_states(lastInteractionBeforeMs: int = None,
+                             presence: str = None, deviceTokens: list = None,
+                             pageSize: int = 100):
+        return inst.engine.search_device_states(
+            last_interaction_before_ms=lastInteractionBeforeMs,
+            presence=presence, device_tokens=deviceTokens, limit=pageSize)
+
+    for name, fn in {
+        "DeviceManagement.getDeviceByToken": get_device_by_token,
+        "DeviceManagement.createDevice": create_device,
+        "DeviceManagement.listDevices": list_devices,
+        "DeviceManagement.getActiveAssignments": get_active_assignments,
+        "DeviceEventManagement.listDeviceEvents": list_device_events,
+        "DeviceEventManagement.addDeviceEvent": add_device_event,
+        "DeviceState.getDeviceState": get_device_state,
+        "DeviceState.searchDeviceStates": search_device_states,
+    }.items():
+        srv.register(name, fn)
+    return srv

@@ -1,0 +1,141 @@
+"""Control-plane RPC tests (reference: the L3 gRPC APIs + routers +
+cached api channels; SURVEY.md §1-L3)."""
+
+import asyncio
+
+import pytest
+
+from sitewhere_tpu.engine import EngineConfig
+from sitewhere_tpu.instance.instance import InstanceConfig, SiteWhereTpuInstance
+from sitewhere_tpu.rpc.client import CachedDeviceClient, RpcClient
+from sitewhere_tpu.rpc.protocol import RpcError
+from sitewhere_tpu.rpc.server import build_instance_rpc
+
+
+def _instance():
+    return SiteWhereTpuInstance(InstanceConfig(engine=EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=4096, batch_capacity=16, channels=4,
+    )))
+
+
+def test_rpc_end_to_end():
+    async def go():
+        inst = _instance()
+        srv = build_instance_rpc(inst)
+        port = await srv.start()
+        cli = await RpcClient(port=port).connect()
+        try:
+            # device-management family
+            dev = await cli.call("DeviceManagement.createDevice",
+                                 token="r-1", deviceType="default")
+            assert dev["token"] == "r-1"
+            got = await cli.call("DeviceManagement.getDeviceByToken",
+                                 token="r-1")
+            assert got["device_type"] == "default"
+            assert await cli.call("DeviceManagement.getDeviceByToken",
+                                  token="ghost") is None
+            listing = await cli.call("DeviceManagement.listDevices")
+            assert listing["numResults"] == 1
+            asgs = await cli.call("DeviceManagement.getActiveAssignments",
+                                  token="r-1")
+            assert len(asgs) == 1 and asgs[0]["status"] == "ACTIVE"
+
+            # event-management family
+            await cli.call("DeviceEventManagement.addDeviceEvent",
+                           envelope={"deviceToken": "r-1",
+                                     "type": "DeviceMeasurement",
+                                     "request": {"name": "t", "value": 9.5}})
+            evs = await cli.call("DeviceEventManagement.listDeviceEvents",
+                                 token="r-1")
+            assert evs["total"] == 1
+            assert evs["events"][0]["measurements"]["t"] == 9.5
+
+            # device-state family
+            st = await cli.call("DeviceState.getDeviceState", token="r-1")
+            assert st["presence"] == "PRESENT"
+            states = await cli.call("DeviceState.searchDeviceStates",
+                                    presence="PRESENT")
+            assert len(states) == 1
+
+            # concurrent in-flight multiplexing on one connection
+            results = await asyncio.gather(*(
+                cli.call("DeviceState.getDeviceState", token="r-1")
+                for _ in range(16)))
+            assert all(r["presence"] == "PRESENT" for r in results)
+
+            # errors: unknown method 404, bad params 400
+            with pytest.raises(RpcError) as ei:
+                await cli.call("Nope.method")
+            assert ei.value.code == 404
+            with pytest.raises(RpcError) as ei:
+                await cli.call("DeviceManagement.getDeviceByToken", bogus=1)
+            assert ei.value.code == 400
+        finally:
+            await cli.close()
+            await srv.stop()
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_rpc_tenant_dispatch_and_cache():
+    async def go():
+        inst = _instance()
+        srv = build_instance_rpc(inst)
+        port = await srv.start()
+        # unknown tenant rejected like the reference's router
+        bad = await RpcClient(port=port, tenant="nope").connect()
+        try:
+            with pytest.raises(RpcError) as ei:
+                await bad.call("DeviceManagement.listDevices")
+            assert ei.value.code == 404
+        finally:
+            await bad.close()
+
+        cli = await RpcClient(port=port, tenant="default").connect()
+        try:
+            await cli.call("DeviceManagement.createDevice", token="c-1")
+            cached = CachedDeviceClient(cli, ttl_s=60)
+            a = await cached.get_device_by_token("c-1")
+            b = await cached.get_device_by_token("c-1")
+            assert a == b
+            assert cached.hits == 1 and cached.misses == 1
+            # negative lookups are not cached
+            assert await cached.get_device_by_token("ghost") is None
+            assert await cached.get_device_by_token("ghost") is None
+            assert cached.misses == 3
+            cached.invalidate("c-1")
+            await cached.get_device_by_token("c-1")
+            assert cached.misses == 4
+        finally:
+            await cli.close()
+            await srv.stop()
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_rpc_tenant_binding_enforced():
+    """A tenant-bound connection cannot address another tenant's data
+    (executeInTenantEngine semantics)."""
+    async def go():
+        inst = _instance()
+        inst.tenants.create_tenant("t-b", "Tenant B")
+        srv = build_instance_rpc(inst)
+        port = await srv.start()
+        cli = await RpcClient(port=port, tenant="default").connect()
+        try:
+            await cli.call("DeviceEventManagement.addDeviceEvent",
+                           envelope={"deviceToken": "tb-1",
+                                     "type": "DeviceMeasurement",
+                                     "request": {"name": "t", "value": 1.0}},
+                           tenant="t-b")   # override attempt ignored
+            evs = await cli.call("DeviceEventManagement.listDeviceEvents",
+                                 tenant="t-b")  # forced back to 'default'
+            assert evs["total"] == 1  # sees its OWN tenant's event
+            assert inst.engine.query_events(tenant="t-b")["total"] == 0
+            assert inst.engine.query_events(tenant="default")["total"] == 1
+        finally:
+            await cli.close()
+            await srv.stop()
+
+    asyncio.new_event_loop().run_until_complete(go())
