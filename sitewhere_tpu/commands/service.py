@@ -125,25 +125,48 @@ class CommandDeliveryService(LifecycleComponent):
         self.consumer.commit(events)
         return n
 
-    async def _route_and_deliver(self, inv: CommandInvocation) -> None:
-        execution = self.strategy.build_execution(inv)
+    def _resolve_target(self, inv: CommandInvocation) -> tuple[str, dict]:
         target_token = self.nested.resolve_target_token(inv.device_token)
         info = self.engine.get_device(target_token)
-        metadata = info.metadata if info else {}
-        dest_ids = self.router.destinations_for(execution)
-        for dest_id in dest_ids:
-            dest = self.destinations.get(dest_id)
-            if dest is None:
-                self.undelivered.append(
-                    UndeliveredCommand(inv, dest_id, "unknown destination")
-                )
-                continue
-            try:
-                await dest.deliver(execution, target_token, metadata)
-                self.delivered_count += 1
-            except DeliveryError as e:
-                logger.warning("delivery to %s failed: %s", dest_id, e)
-                self.undelivered.append(UndeliveredCommand(inv, dest_id, str(e)))
+        return target_token, (info.metadata if info else {})
+
+    async def _route_and_deliver(self, inv: CommandInvocation) -> None:
+        execution = self.strategy.build_execution(inv)
+        target_token, metadata = self._resolve_target(inv)
+        for dest_id in self.router.destinations_for(execution):
+            await self._deliver_to(inv, execution, dest_id,
+                                   target_token, metadata)
+
+    async def _deliver_to(self, inv: CommandInvocation, execution,
+                          dest_id: str, target_token: str,
+                          metadata: dict) -> None:
+        """Deliver one execution to one destination; failures dead-letter."""
+        dest = self.destinations.get(dest_id)
+        if dest is None:
+            self.undelivered.append(
+                UndeliveredCommand(inv, dest_id, "unknown destination")
+            )
+            return
+        try:
+            await dest.deliver(execution, target_token, metadata)
+            self.delivered_count += 1
+        except DeliveryError as e:
+            logger.warning("delivery to %s failed: %s", dest_id, e)
+            self.undelivered.append(UndeliveredCommand(inv, dest_id, str(e)))
+
+    async def retry_undelivered(self) -> dict:
+        """Re-route every dead-lettered invocation (the reference parks
+        failures on the undelivered-command-invocations topic for later
+        redelivery; CommandRoutingLogic.java:55-63). Invocations that fail
+        again return to the dead-letter list."""
+        parked, self.undelivered = self.undelivered, []
+        for u in parked:
+            execution = self.strategy.build_execution(u.invocation)
+            target_token, metadata = self._resolve_target(u.invocation)
+            await self._deliver_to(u.invocation, execution, u.destination_id,
+                                   target_token, metadata)
+        return {"retried": len(parked),
+                "stillUndelivered": len(self.undelivered)}
 
     def get_invocation(self, invocation_id: int) -> CommandInvocation | None:
         """Lookup a retained invocation (CommandInvocations controller

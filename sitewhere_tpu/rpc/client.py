@@ -56,22 +56,32 @@ class RpcClient:
         self._pending.clear()
 
     async def _recv_loop(self) -> None:
-        while True:
-            frame = await read_frame(self._reader)
-            if frame is None:
-                for fut in self._pending.values():
-                    if not fut.done():
-                        fut.set_exception(ConnectionError("server closed"))
-                self._pending.clear()
-                return
-            fut = self._pending.pop(frame.get("id"), None)
-            if fut is None or fut.done():
-                continue
-            if "error" in frame:
-                fut.set_exception(
-                    RpcError(frame["error"], frame.get("code", 500)))
-            else:
-                fut.set_result(frame.get("result"))
+        error: BaseException = ConnectionError("server closed")
+        try:
+            while True:
+                frame = await read_frame(self._reader)
+                if frame is None:
+                    break
+                fut = self._pending.pop(frame.get("id"), None)
+                if fut is None or fut.done():
+                    continue
+                if "error" in frame:
+                    fut.set_exception(
+                        RpcError(frame["error"], frame.get("code", 500)))
+                else:
+                    fut.set_result(frame.get("result"))
+        except asyncio.CancelledError:
+            error = ConnectionError("client closed")
+            raise
+        except Exception as e:
+            # protocol violation (oversized frame, corrupt JSON): the
+            # connection is unusable — fail every in-flight call loudly
+            error = e
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(error)
+            self._pending.clear()
 
     async def call(self, method: str, **params: Any) -> Any:
         rid = next(self._ids)

@@ -89,6 +89,11 @@ class RpcServer:
                 # executeInTenantEngine semantics: a tenant-bound connection
                 # operates in ITS tenant — callers cannot address another
                 params["tenant"] = tenant
+            elif (self._tenant_validator is not None
+                  and params.get("tenant") is not None
+                  and not self._tenant_validator(params["tenant"])):
+                # unbound connections still cannot name unknown tenants
+                raise RpcError(f"unknown tenant {params['tenant']!r}", 404)
             result = fn(**params)
             if isinstance(result, Awaitable):
                 result = await result
@@ -100,11 +105,15 @@ class RpcServer:
         except Exception as e:
             logger.exception("rpc handler failure")
             resp = {"id": rid, "error": str(e), "code": 500}
+        try:
+            wire = encode_frame(resp)
+        except RpcError as e:      # oversized result: still answer the call
+            wire = encode_frame({"id": rid, "error": str(e), "code": e.code})
         async with lock:   # frames must not interleave on the socket
             if writer.is_closing():
                 return
             try:
-                writer.write(encode_frame(resp))
+                writer.write(wire)
                 await writer.drain()
             except (ConnectionError, OSError):
                 pass       # client went away mid-response

@@ -7,9 +7,12 @@ on restart, replaying segments past the snapshot's watermark re-feeds the
 idempotent pipeline. Segments rotate by size and old segments can be
 pruned once a snapshot covers them.
 
-Record framing: u32 LE payload length + payload bytes. A record length of
-0xFFFFFFFF marks a watermark record whose payload is the JSON-encoded
-absolute store cursor.
+Record framing: u32 LE payload length + u32 LE CRC32 + payload bytes. A
+record length of 0xFFFFFFFF marks a watermark record whose payload is the
+JSON-encoded absolute store cursor. The CRC catches torn and corrupted
+records on replay (Kafka's per-record CRC analog): replay stops cleanly at
+the first bad record of the tail segment instead of feeding garbage into
+the pipeline.
 """
 
 from __future__ import annotations
@@ -18,9 +21,11 @@ import json
 import pathlib
 import struct
 import threading
+import zlib
 from typing import Iterator
 
 _WATERMARK = 0xFFFFFFFF
+_MAGIC = b"SWAL1\n"   # segment format marker; absent = legacy length-only
 
 
 class IngestLog:
@@ -42,10 +47,13 @@ class IngestLog:
             self._fh.close()
         path = self.dir / f"segment-{self._seg_index:08d}.log"
         self._fh = open(path, "ab")
+        if self._fh.tell() == 0:
+            self._fh.write(_MAGIC)
 
     def append(self, payload: bytes) -> None:
         with self._lock:
-            self._fh.write(struct.pack("<I", len(payload)))
+            self._fh.write(struct.pack("<II", len(payload),
+                                       zlib.crc32(payload)))
             self._fh.write(payload)
             if self._fh.tell() >= self.segment_bytes:
                 self._fh.flush()
@@ -57,7 +65,7 @@ class IngestLog:
         body = json.dumps({"cursor": store_cursor}).encode()
         with self._lock:
             self._fh.write(struct.pack("<I", _WATERMARK))
-            self._fh.write(struct.pack("<I", len(body)))
+            self._fh.write(struct.pack("<II", len(body), zlib.crc32(body)))
             self._fh.write(body)
             self._fh.flush()
 
@@ -79,16 +87,58 @@ class IngestLog:
         (everything, when no watermark qualifies)."""
         pending: list[bytes] = []
         emitting = after_cursor < 0
-        for path in sorted(self.dir.glob("segment-*.log")):
+
+        def read_record(fh, checked: bool):
+            """(is_watermark, payload), "eof" at a record boundary, or
+            "bad" on a torn/corrupt record. ``checked`` = current framing
+            (len+crc); False = legacy (length-only) segments written before
+            the CRC format."""
+            head = fh.read(4)
+            if not head:
+                return "eof"
+            if len(head) < 4:
+                return "bad"
+            (n,) = struct.unpack("<I", head)
+            wm = n == _WATERMARK
+            if wm:
+                head = fh.read(4)
+                if len(head) < 4:
+                    return "bad"
+                (n,) = struct.unpack("<I", head)
+            if checked:
+                crc_raw = fh.read(4)
+                if len(crc_raw) < 4:
+                    return "bad"
+                (crc,) = struct.unpack("<I", crc_raw)
+            payload = fh.read(n)
+            if len(payload) < n:
+                return "bad"
+            if checked and zlib.crc32(payload) != crc:
+                return "bad"
+            return wm, payload
+
+        paths = sorted(self.dir.glob("segment-*.log"))
+        for si, path in enumerate(paths):
             with open(path, "rb") as fh:
+                probe = fh.read(len(_MAGIC))
+                checked = probe == _MAGIC
+                if not checked:
+                    fh.seek(0)   # legacy segment: no marker, no CRC
                 while True:
-                    head = fh.read(4)
-                    if len(head) < 4:
-                        break
-                    (n,) = struct.unpack("<I", head)
-                    if n == _WATERMARK:
-                        (m,) = struct.unpack("<I", fh.read(4))
-                        meta = json.loads(fh.read(m))
+                    rec = read_record(fh, checked)
+                    if rec == "eof":
+                        break    # clean end of segment
+                    if rec == "bad":
+                        if si == len(paths) - 1:
+                            break   # torn tail of the live segment: expected
+                        # corruption in a SEALED segment: stop the WHOLE
+                        # replay — skipping ahead (or into later segments)
+                        # would leave a silent gap in the stream
+                        yield from pending
+                        return
+                    wm, payload = rec
+                    if wm:
+                        meta = json.loads(payload)
                         if not emitting:
                             if meta["cursor"] <= after_cursor:
                                 pending.clear()  # covered by the snapshot
@@ -99,9 +149,6 @@ class IngestLog:
                                 yield from pending
                                 pending.clear()
                         continue
-                    payload = fh.read(n)
-                    if len(payload) < n:
-                        break  # torn tail write: stop cleanly
                     if emitting:
                         yield payload
                     else:

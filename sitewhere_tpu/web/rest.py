@@ -350,6 +350,11 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
           "destination": u.destination_id, "error": u.error}
          for u in inst.commands.undelivered]))
 
+    async def retry_undelivered(request: web.Request):
+        return json_response(await inst.commands.retry_undelivered())
+
+    r.add_post("/api/commands/undelivered/retry", retry_undelivered)
+
     async def get_invocation(request: web.Request):
         inv = inst.commands.get_invocation(int(request.match_info["id"]))
         if inv is None:

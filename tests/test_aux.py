@@ -299,3 +299,61 @@ def test_scripting_component_end_to_end(tmp_path):
 
     ns = DEFAULT_MANAGER._load(connector_script)
     assert ns["SEEN"] == ["sdev-1"]
+
+
+def test_wal_crc_detects_corruption(tmp_path):
+    """Corrupted or torn WAL records stop replay cleanly instead of
+    feeding garbage to the pipeline."""
+    from sitewhere_tpu.utils.ingestlog import IngestLog
+
+    log = IngestLog(tmp_path, segment_bytes=1 << 20)
+    log.append(b"good-1")
+    log.append(b"good-2")
+    log.append(b"good-3")
+    log.close()
+    seg = sorted(tmp_path.glob("segment-*.log"))[0]
+    data = bytearray(seg.read_bytes())
+    # flip a byte inside the LAST record's payload
+    data[-2] ^= 0xFF
+    seg.write_bytes(bytes(data))
+    replayed = list(IngestLog(tmp_path).replay())
+    assert replayed == [b"good-1", b"good-2"]
+    # torn tail: truncate mid-record
+    seg.write_bytes(bytes(data[:-3]))
+    replayed = list(IngestLog(tmp_path).replay())
+    assert replayed == [b"good-1", b"good-2"]
+
+
+def test_wal_legacy_and_midchain_corruption(tmp_path):
+    """Legacy (pre-CRC) segments still replay; corruption in a mid-chain
+    segment stops the whole replay instead of leaving a silent gap."""
+    import struct
+
+    from sitewhere_tpu.utils.ingestlog import IngestLog
+
+    # hand-write a legacy segment (length-only framing, no magic)
+    legacy = tmp_path / "segment-00000000.log"
+    with open(legacy, "wb") as fh:
+        for msg in (b"old-1", b"old-2"):
+            fh.write(struct.pack("<I", len(msg)))
+            fh.write(msg)
+    # new-format segment continues the chain
+    log = IngestLog(tmp_path)
+    log.append(b"new-1")
+    log.close()
+    assert list(IngestLog(tmp_path).replay()) == [b"old-1", b"old-2", b"new-1"]
+
+    # corruption in a NEW-format mid-chain segment stops the whole replay
+    # (CRC catches the flipped byte; a later segment exists)
+    log = IngestLog(tmp_path)     # rotates to a fresh tail segment
+    log.append(b"new-2")
+    log.close()
+    segs = sorted(tmp_path.glob("segment-*.log"))
+    assert len(segs) >= 3
+    mid = segs[1]                  # the segment holding new-1
+    data = bytearray(mid.read_bytes())
+    data[-2] ^= 0xFF               # flip a byte inside new-1's payload
+    mid.write_bytes(bytes(data))
+    out = list(IngestLog(tmp_path).replay())
+    assert b"new-2" not in out and b"new-1" not in out
+    assert out[:2] == [b"old-1", b"old-2"]

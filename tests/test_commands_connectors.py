@@ -272,3 +272,61 @@ def test_connector_surface_importable():
         SearchIndexConnector,
         SqsConnector,
     )
+
+
+def test_undelivered_retry_targets_failed_destination():
+    """Parked invocations retry against their failed destination only
+    (undelivered-command-invocations topic redelivery analog)."""
+    import asyncio
+
+    import pytest
+
+    from sitewhere_tpu.commands.destinations import (
+        CommandDestination,
+        DeliveryError,
+        LocalDeliveryProvider,
+        mqtt_topic_extractor,
+    )
+    from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
+    from sitewhere_tpu.commands.model import DeviceCommand
+    from sitewhere_tpu.commands.routing import SingleChoiceCommandRouter
+    from sitewhere_tpu.commands.service import CommandDeliveryService
+    from sitewhere_tpu.engine import Engine, EngineConfig
+
+    async def go():
+        eng = Engine(EngineConfig(
+            device_capacity=32, token_capacity=64, assignment_capacity=64,
+            store_capacity=512, batch_capacity=8, channels=4))
+        eng.register_device("rt-1")
+        svc = CommandDeliveryService(eng, SingleChoiceCommandRouter("flaky"))
+        svc.registry.create(DeviceCommand(token="ping", device_type="default",
+                                          name="ping"))
+
+        class FlakyProvider(LocalDeliveryProvider):
+            def __init__(self):
+                super().__init__()
+                self.fail = True
+
+            async def deliver(self, target, payload, is_system=False):
+                if self.fail:
+                    raise DeliveryError("destination down")
+                await super().deliver(target, payload, is_system)
+
+        provider = FlakyProvider()
+        svc.add_destination(CommandDestination(
+            "flaky", mqtt_topic_extractor(), JsonCommandExecutionEncoder(),
+            provider))
+        svc.invoke("rt-1", "ping", {})
+        await svc.pump()
+        assert len(svc.undelivered) == 1
+        # destination still down: retry re-parks it
+        res = await svc.retry_undelivered()
+        assert res == {"retried": 1, "stillUndelivered": 1}
+        # destination recovers: retry delivers
+        provider.fail = False
+        res = await svc.retry_undelivered()
+        assert res == {"retried": 1, "stillUndelivered": 0}
+        assert svc.delivered_count == 1
+        assert provider.delivered  # payload reached the local sink
+
+    asyncio.new_event_loop().run_until_complete(go())
