@@ -160,11 +160,21 @@ class CommandDeliveryService(LifecycleComponent):
         redelivery; CommandRoutingLogic.java:55-63). Invocations that fail
         again return to the dead-letter list."""
         parked, self.undelivered = self.undelivered, []
-        for u in parked:
-            execution = self.strategy.build_execution(u.invocation)
-            target_token, metadata = self._resolve_target(u.invocation)
-            await self._deliver_to(u.invocation, execution, u.destination_id,
-                                   target_token, metadata)
+        for i, u in enumerate(parked):
+            try:
+                execution = self.strategy.build_execution(u.invocation)
+                target_token, metadata = self._resolve_target(u.invocation)
+                await self._deliver_to(u.invocation, execution,
+                                       u.destination_id, target_token,
+                                       metadata)
+            except Exception as e:
+                # unexpected failure (e.g. command since deleted, transport
+                # error outside DeliveryError): nothing may be lost — re-park
+                # this entry and every not-yet-retried one, then surface
+                logger.exception("retry of %s failed", u.destination_id)
+                self.undelivered.append(dataclasses.replace(u, error=str(e)))
+                self.undelivered.extend(parked[i + 1:])
+                raise
         return {"retried": len(parked),
                 "stillUndelivered": len(self.undelivered)}
 

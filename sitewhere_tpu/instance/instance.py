@@ -93,6 +93,14 @@ class SiteWhereTpuInstance(LifecycleComponent):
         if self.config.index_events:
             self.add_connector(SearchIndexConnector("search-index", self.search_index))
 
+        # analytics (service-tpu-analytics analog) — live when the engine
+        # carries HBM telemetry windows
+        self.analytics = None
+        if self.config.engine.analytics_devices > 0:
+            from sitewhere_tpu.models.service import AnalyticsService
+
+            self.analytics = AnalyticsService(self.engine)
+
         # auth + tenants
         self.users = UserManagement()
         self.users.create_user(self.config.admin_username,

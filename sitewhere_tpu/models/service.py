@@ -94,8 +94,10 @@ class AnalyticsService:
                 self.params, self.opt_state, x)
         return float(loss)
 
-    def score_all(self) -> dict:
-        """Score every analytics device; returns scores + anomalous tokens."""
+    def score_all(self, update_stats: bool = True) -> dict:
+        """Score every analytics device; returns scores + anomalous tokens.
+        ``update_stats=False`` makes the call read-only (dashboard polls
+        must not drag the adaptive z-score baseline)."""
         wins = self._windows()
         data = snapshot_windows(wins)
         scores, valid, _ = _score_windows(
@@ -104,7 +106,7 @@ class AnalyticsService:
         scores_np = np.asarray(scores)
         valid_np = np.asarray(valid)
         vs = scores_np[valid_np]
-        if vs.size:
+        if update_stats and vs.size:
             # Welford-ish running stats over scored populations
             self._score_n += vs.size
             delta = vs.mean() - self._score_mean
@@ -124,6 +126,67 @@ class AnalyticsService:
             "zscores": z,
             "anomalous_tokens": tokens,
         }
+
+    # ---------------------------------------------------------- persistence
+    def save_model(self, directory) -> dict:
+        """Checkpoint params + optimizer state + score statistics (orbax).
+        The reference has no model persistence (no ML); this pairs with the
+        engine snapshot so analytics resumes where it left off."""
+        import pathlib
+
+        import orbax.checkpoint as ocp
+
+        directory = pathlib.Path(directory).absolute()
+        with ocp.StandardCheckpointer() as ckpt:
+            ckpt.save(directory / "model", {
+                "params": self.params,
+                "opt_state": self.opt_state,
+            }, force=True)
+        meta = {"score_mean": float(self._score_mean),
+                "score_m2": float(self._score_m2),
+                "score_n": float(self._score_n),
+                "threshold": float(self.threshold)}
+        import json
+
+        (directory / "analytics.json").write_text(json.dumps(meta))
+        return meta
+
+    def restore_model(self, directory) -> None:
+        import json
+        import pathlib
+
+        import orbax.checkpoint as ocp
+
+        directory = pathlib.Path(directory).absolute()
+        with ocp.StandardCheckpointer() as ckpt:
+            restored = ckpt.restore(directory / "model", {
+                "params": self.params,
+                "opt_state": self.opt_state,
+            })
+        self.params = restored["params"]
+        self.opt_state = restored["opt_state"]
+        meta = json.loads((directory / "analytics.json").read_text())
+        self._score_mean = meta["score_mean"]
+        self._score_m2 = meta["score_m2"]
+        self._score_n = meta["score_n"]
+        self.threshold = meta["threshold"]
+
+    # ------------------------------------------------------ background loop
+    async def run(self, interval_s: float = 5.0, train_steps: int = 1,
+                  stop_event=None) -> None:
+        """Background analytics loop: train on live windows, score, inject
+        anomaly alerts — the always-on `service-tpu-analytics` process."""
+        import asyncio
+
+        while stop_event is None or not stop_event.is_set():
+            try:
+                self.train_on_live(steps=train_steps)
+                self.emit_anomaly_alerts()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("analytics loop error")
+            await asyncio.sleep(interval_s)
 
     def emit_anomaly_alerts(self, result: dict | None = None) -> int:
         """Inject DeviceAlert events for anomalous devices back into the

@@ -33,6 +33,7 @@ class RpcClient:
         self._writer = None
         self._recv_task = None
         self._send_lock: asyncio.Lock | None = None
+        self._dead: BaseException | None = None   # terminal connection error
 
     async def connect(self) -> "RpcClient":
         self._reader, self._writer = await asyncio.open_connection(
@@ -78,12 +79,17 @@ class RpcClient:
             # connection is unusable — fail every in-flight call loudly
             error = e
         finally:
+            self._dead = error   # later call()s fail fast, never hang
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(error)
             self._pending.clear()
 
     async def call(self, method: str, **params: Any) -> Any:
+        if self._dead is not None:
+            # writes to a lost asyncio transport do not raise; without this
+            # check a post-disconnect call would park a future forever
+            raise ConnectionError(f"rpc connection dead: {self._dead}")
         rid = next(self._ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[rid] = fut

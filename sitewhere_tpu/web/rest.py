@@ -18,6 +18,7 @@ import dataclasses
 import json
 from typing import Any
 
+import numpy as np
 from aiohttp import web
 
 from sitewhere_tpu.commands.model import CommandParameter, DeviceCommand, ParameterType
@@ -734,6 +735,44 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     r.add_post("/api/roles", create_role)
     r.add_get("/api/authorities", lambda req: json_response(
         sorted({a for auths in inst.users.roles.values() for a in auths})))
+
+    # --- analytics (service-tpu-analytics surface) ------------------------
+    def _analytics():
+        if inst.analytics is None:
+            raise EntityNotFound(
+                "analytics disabled (EngineConfig.analytics_devices == 0)")
+        return inst.analytics
+
+    async def analytics_scores(request: web.Request):
+        res = _analytics().score_all(update_stats=False)   # read-only poll
+        out = []
+        for did in np.nonzero(res["valid"])[0]:
+            info = inst.engine.devices.get(int(did))
+            if info is None:
+                continue
+            out.append({"device": info.token,
+                        "score": float(res["scores"][did]),
+                        "zscore": float(res["zscores"][did])})
+        return json_response({"numResults": len(out), "results": out,
+                              "anomalousTokens": res["anomalous_tokens"]})
+
+    async def analytics_train(request: web.Request):
+        body = await request.json() if request.can_read_body else {}
+        loss = _analytics().train_on_live(
+            batch_size=int(body.get("batchSize", 256)),
+            steps=int(body.get("steps", 1)))
+        import math
+
+        return json_response(
+            {"loss": None if math.isnan(loss) else loss})
+
+    async def analytics_detect(request: web.Request):
+        n = _analytics().emit_anomaly_alerts()
+        return json_response({"alertsEmitted": n})
+
+    r.add_get("/api/analytics/scores", analytics_scores)
+    r.add_post("/api/analytics/train", analytics_train)
+    r.add_post("/api/analytics/detect", analytics_detect)
 
     # --- batch event ingest (wire-level bulk path) ------------------------
     async def post_event_batch(request: web.Request):
