@@ -185,6 +185,16 @@ def _admin_set_device_active(state: PipelineState, device_id, active):
 
 
 @jax.jit
+def _admin_set_parent(state: PipelineState, device_id, parent_id):
+    reg = state.registry
+    return dataclasses.replace(
+        state, registry=dataclasses.replace(
+            reg, device_parent=reg.device_parent.at[device_id].set(parent_id)
+        )
+    )
+
+
+@jax.jit
 def _admin_update_device(state: PipelineState, device_id, type_id, area_id,
                          customer_id):
     reg = state.registry
@@ -327,6 +337,12 @@ class Engine:
                     area=req.extras.get("areaToken"),
                     customer=req.extras.get("customerToken"),
                 )
+                return
+            if req.type is RequestType.MAP_DEVICE:
+                parent = (req.extras.get("parentToken")
+                          or req.extras.get("parentHardwareId"))
+                if parent:
+                    self.map_device(req.device_token, parent)
                 return
             et = req.event_type
             if et is None:
@@ -709,6 +725,29 @@ class Engine:
                 return False
             self.state = _admin_set_device_active(self.state, jnp.int32(did), False)
             return True
+
+    def map_device(self, child_token: str, parent_token: str) -> DeviceInfo:
+        """Map a device under a gateway/composite parent (the reference's
+        MapDevice request + DeviceMappings REST path; the parent feeds
+        NestedDeviceSupport command routing and the on-device
+        device_parent column)."""
+        with self.lock:
+            self._sync_mirrors()
+            ctid = self.tokens.lookup(child_token)
+            cdid = self.token_device.get(ctid)
+            if cdid is None:
+                raise KeyError(f"device {child_token!r} not registered")
+            ptid = self.tokens.lookup(parent_token)
+            pdid = self.token_device.get(ptid)
+            if pdid is None:
+                raise KeyError(f"parent device {parent_token!r} not registered")
+            if cdid == pdid:
+                raise ValueError("device cannot be its own parent")
+            info = self.devices[cdid]
+            info.metadata = dict(info.metadata) | {"parentToken": parent_token}
+            self.state = _admin_set_parent(
+                self.state, jnp.int32(cdid), jnp.int32(pdid))
+            return info
 
     def update_device(self, token: str, device_type: str | None = None,
                       area: str | None = None, customer: str | None = None,

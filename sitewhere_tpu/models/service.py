@@ -180,8 +180,10 @@ class AnalyticsService:
 
         while stop_event is None or not stop_event.is_set():
             try:
-                self.train_on_live(steps=train_steps)
-                self.emit_anomaly_alerts()
+                # JAX compute off the event loop (engine.lock serializes)
+                await asyncio.to_thread(self.train_on_live,
+                                        steps=train_steps)
+                await asyncio.to_thread(self.emit_anomaly_alerts)
             except Exception:
                 import logging
 

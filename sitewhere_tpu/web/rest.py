@@ -744,7 +744,12 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return inst.analytics
 
     async def analytics_scores(request: web.Request):
-        res = _analytics().score_all(update_stats=False)   # read-only poll
+        import asyncio
+
+        # JAX compute off the event loop: compilation/scoring must not
+        # stall other requests or the outbound pump
+        res = await asyncio.to_thread(
+            _analytics().score_all, update_stats=False)   # read-only poll
         out = []
         for did in np.nonzero(res["valid"])[0]:
             info = inst.engine.devices.get(int(did))
@@ -757,17 +762,21 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
                               "anomalousTokens": res["anomalous_tokens"]})
 
     async def analytics_train(request: web.Request):
-        body = await request.json() if request.can_read_body else {}
-        loss = _analytics().train_on_live(
-            batch_size=int(body.get("batchSize", 256)),
-            steps=int(body.get("steps", 1)))
+        import asyncio
         import math
 
+        body = await request.json() if request.can_read_body else {}
+        loss = await asyncio.to_thread(
+            _analytics().train_on_live,
+            batch_size=int(body.get("batchSize", 256)),
+            steps=int(body.get("steps", 1)))
         return json_response(
             {"loss": None if math.isnan(loss) else loss})
 
     async def analytics_detect(request: web.Request):
-        n = _analytics().emit_anomaly_alerts()
+        import asyncio
+
+        n = await asyncio.to_thread(_analytics().emit_anomaly_alerts)
         return json_response({"alertsEmitted": n})
 
     r.add_get("/api/analytics/scores", analytics_scores)
@@ -857,6 +866,23 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_put("/api/devices/{token}", update_device)
 
+    async def map_device(request: web.Request):
+        """Map this device under a gateway/composite parent (reference:
+        Devices controller device-mapping path + MapDevice requests)."""
+        body = await request.json()
+        parent = body.get("parentToken")
+        if not parent:
+            raise ValueError("parentToken is required")
+        try:
+            info = inst.engine.map_device(request.match_info["token"], parent)
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return json_response({"token": info.token,
+                              "parentToken": info.metadata.get("parentToken")},
+                             status=201)
+
+    r.add_post("/api/devices/{token}/parent", map_device)
+
     def _store_update(store, fields: dict[str, str]):
         """PUT handler over an EntityStore: body camelCase key -> attr."""
         async def handler(request: web.Request):
@@ -926,27 +952,31 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
 
 class ServerHandle:
-    """Running REST server + background outbound pump."""
+    """Running REST server + background pumps (outbound, analytics)."""
 
-    def __init__(self, runner: web.AppRunner, port: int, pump_task):
+    def __init__(self, runner: web.AppRunner, port: int, tasks):
         self.runner = runner
         self.port = port
-        self._pump_task = pump_task
+        self._tasks = list(tasks)
 
     async def cleanup(self) -> None:
         import asyncio
 
-        self._pump_task.cancel()
-        try:
-            await self._pump_task
-        except (asyncio.CancelledError, Exception):
-            pass
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
         await self.runner.cleanup()
 
 
 async def start_server(instance: SiteWhereTpuInstance, host: str = "127.0.0.1",
-                       port: int = 0) -> ServerHandle:
-    """Start the REST gateway + background outbound pumps."""
+                       port: int = 0,
+                       analytics_interval_s: float = 5.0) -> ServerHandle:
+    """Start the REST gateway + background pumps (outbound; analytics when
+    the engine carries telemetry windows)."""
     import asyncio
 
     app = make_app(instance)
@@ -967,6 +997,10 @@ async def start_server(instance: SiteWhereTpuInstance, host: str = "127.0.0.1",
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()
-    task = asyncio.create_task(pump_loop())
+    tasks = [asyncio.create_task(pump_loop())]
+    if instance.analytics is not None:
+        # always-on analytics: train on live windows, score, inject alerts
+        tasks.append(asyncio.create_task(
+            instance.analytics.run(interval_s=analytics_interval_s)))
     bound = site._server.sockets[0].getsockname()[1]
-    return ServerHandle(runner, bound, task)
+    return ServerHandle(runner, bound, tasks)

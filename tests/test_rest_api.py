@@ -435,3 +435,39 @@ def test_batch_ingest_and_openapi(api):
     assert "/api/devices" in spec["paths"]
     assert "post" in spec["paths"]["/api/events/batch"]
     assert len(spec["paths"]) > 60
+
+
+def test_device_mapping_and_nested_routing(api):
+    call, inst, loop = api
+    call("POST", "/api/devices", {"token": "gw-1"})
+    call("POST", "/api/devices", {"token": "leaf-1"})
+
+    status, res = call("POST", "/api/devices/leaf-1/parent",
+                       {"parentToken": "gw-1"})
+    assert status == 201 and res["parentToken"] == "gw-1"
+    # unknown parent -> 404; self-parent -> 400
+    status, _ = call("POST", "/api/devices/leaf-1/parent",
+                     {"parentToken": "ghost"})
+    assert status == 404
+    status, _ = call("POST", "/api/devices/gw-1/parent",
+                     {"parentToken": "gw-1"})
+    assert status == 400
+
+    # MapDevice ingest envelope takes the same path
+    status, _ = call("POST", "/api/devices/leaf-1/events",
+                     {"type": "MapDevice",
+                      "request": {"parentToken": "gw-1"}})
+    assert status == 201
+
+    # nested command routing resolves to the gateway parent
+    from sitewhere_tpu.commands.routing import NestedDeviceSupport
+
+    nested = NestedDeviceSupport(inst.engine)
+    assert nested.resolve_target_token("leaf-1") == "gw-1"
+    # on-device parent column mirrors the mapping
+    import numpy as np
+
+    tid = inst.engine.tokens.lookup("leaf-1")
+    did = inst.engine.token_device[tid]
+    pdid = int(inst.engine.state.registry.device_parent[did])
+    assert inst.engine.devices[pdid].token == "gw-1"
