@@ -236,11 +236,13 @@ enum ReqType {
     RT_ALERT = 3,
     RT_STATE_CHANGE = 4,
     RT_ACK = 5,
+    RT_MAP = 6,   // MapDevice: routed to the host slow path like REGISTER
 };
 
 static int type_code(const char* s, int n) {
     if (n == 17 && !memcmp(s, "DeviceMeasurement", 17)) return RT_MEASUREMENT;
     if (n == 18 && !memcmp(s, "DeviceMeasurements", 18)) return RT_MEASUREMENT;
+    if (n == 9 && !memcmp(s, "MapDevice", 9)) return RT_MAP;
     if (n == 14 && !memcmp(s, "DeviceLocation", 14)) return RT_LOCATION;
     if (n == 11 && !memcmp(s, "DeviceAlert", 11)) return RT_ALERT;
     if (n == 14 && !memcmp(s, "RegisterDevice", 14)) return RT_REGISTER;
@@ -443,6 +445,108 @@ int32_t swtpu_decode_batch(
             ok_count++;
         }
         (void)in_request_done;
+    }
+    *out_collisions = collisions;
+    return ok_count;
+}
+
+// Batched decode of the compact flat BINARY wire format (the "protobuf"
+// ingest slot; framing defined by ingest/decoders.py encode_binary_request):
+//   u8 version(=1)  u8 type  u16le token_len  token  i64le event_ts(-1=now)
+//   type 1 measurement: u16le n { u16le name_len name f64le value }*
+//   type 2 location:    f64le lat lon elev (NaN = absent coordinate)
+//   type 3 alert:       u16le tlen type  u8 level  u16le mlen message
+//   type 4 register / 5 ack: header only
+// Outputs use the same contract as swtpu_decode_batch.
+int32_t swtpu_decode_binary_batch(
+    Decoder* d,
+    const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
+    int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
+    float* out_values, uint8_t* out_chmask,
+    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
+    // wire type id -> ReqType (ingest/decoders.py _BIN_TYPES)
+    static const int32_t WIRE2RT[6] = {RT_UNKNOWN, RT_MEASUREMENT,
+                                       RT_LOCATION, RT_ALERT, RT_REGISTER,
+                                       RT_ACK};
+    int32_t ok_count = 0;
+    int32_t collisions = 0;
+    for (int32_t i = 0; i < n_msgs; i++) {
+        out_rtype[i] = -1;
+        out_token[i] = -1;
+        out_ts[i] = -1;
+        out_aux0[i] = -1;
+        out_level[i] = 0;
+        memset(out_values + (size_t)i * channels, 0,
+               sizeof(float) * channels);
+        memset(out_chmask + (size_t)i * channels, 0, channels);
+
+        const uint8_t* p = (const uint8_t*)(buf + offsets[i]);
+        const uint8_t* end = (const uint8_t*)(buf + offsets[i + 1]);
+        auto need = [&](size_t n) { return (size_t)(end - p) >= n; };
+        auto u16 = [&]() { uint16_t v = (uint16_t)(p[0] | (p[1] << 8)); p += 2; return v; };
+
+        if (!need(4)) continue;
+        uint8_t ver = *p++;
+        uint8_t wire_type = *p++;
+        if (ver != 1 || wire_type == 0 || wire_type > 5) continue;
+        uint16_t tlen = u16();
+        if (!need((size_t)tlen + 8)) continue;
+        int32_t token = swtpu_intern(d->tokens, (const char*)p, tlen);
+        p += tlen;
+        int64_t ts;
+        memcpy(&ts, p, 8);
+        p += 8;
+        int32_t rtype = WIRE2RT[wire_type];
+        bool failed = false;
+
+        if (rtype == RT_MEASUREMENT) {
+            if (!need(2)) continue;
+            uint16_t n = u16();
+            for (uint16_t k = 0; k < n && !failed; k++) {
+                if (!need(2)) { failed = true; break; }
+                uint16_t nlen = u16();
+                if (!need((size_t)nlen + 8)) { failed = true; break; }
+                int32_t nid = swtpu_intern(d->names, (const char*)p, nlen);
+                p += nlen;
+                double v;
+                memcpy(&v, p, 8);
+                p += 8;
+                if (nid >= 0) {
+                    if (nid >= channels) collisions++;
+                    int ch = nid % channels;
+                    out_values[(size_t)i * channels + ch] = (float)v;
+                    out_chmask[(size_t)i * channels + ch] = 1;
+                }
+            }
+        } else if (rtype == RT_LOCATION) {
+            if (!need(24)) continue;
+            double lat, lon, elev;
+            memcpy(&lat, p, 8);
+            memcpy(&lon, p + 8, 8);
+            memcpy(&elev, p + 16, 8);
+            p += 24;
+            if (!std::isnan(lat) && !std::isnan(lon)) {
+                out_values[(size_t)i * channels + 0] = (float)lat;
+                out_values[(size_t)i * channels + 1] = (float)lon;
+                out_values[(size_t)i * channels + 2] =
+                    std::isnan(elev) ? 0.0f : (float)elev;
+                out_chmask[(size_t)i * channels + 0] = 1;
+                out_chmask[(size_t)i * channels + 1] = 1;
+                out_chmask[(size_t)i * channels + 2] = 1;
+            }
+        } else if (rtype == RT_ALERT) {
+            if (!need(2)) continue;
+            uint16_t tl = u16();
+            if (!need((size_t)tl + 1)) continue;
+            out_aux0[i] = swtpu_intern(d->alert_types, (const char*)p, tl);
+            p += tl;
+            out_level[i] = *p++;
+        }
+        if (failed) continue;
+        out_ts[i] = ts;
+        out_rtype[i] = rtype;
+        out_token[i] = token;
+        ok_count++;
     }
     *out_collisions = collisions;
     return ok_count;

@@ -479,41 +479,65 @@ class Engine:
         a summary with decode failures (failed-decode DLQ analog).
         Registration envelopes fall back to the per-request path (they carry
         string metadata the hot path doesn't extract)."""
+        from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
+
         if self._native_decoder is None:
-            # pure-Python fallback keeps the API uniform
-            from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
-
-            dec = JsonDeviceRequestDecoder()
-            failed = 0
-            for p in payloads:
-                try:
-                    for req in dec.decode(p, {}):
-                        req.tenant = tenant
-                        self.process(req)
-                except Exception:
-                    failed += 1
-            return {"decoded": len(payloads) - failed, "failed": failed}
-
-        from sitewhere_tpu.ingest.fast_decode import RT_REGISTER, RTYPE_TO_ETYPE
-
+            return self._ingest_python_fallback(
+                payloads, tenant, JsonDeviceRequestDecoder())
         res = self._native_decoder.decode(payloads)
+        return self._ingest_decoded(res, payloads, tenant,
+                                    JsonDeviceRequestDecoder())
+
+    def ingest_binary_batch(self, payloads: list[bytes],
+                            tenant: str = "default") -> dict:
+        """Fast path for the flat-binary wire format (the "protobuf" ingest
+        slot): one native C call decodes the whole batch."""
+        from sitewhere_tpu.ingest.decoders import BinaryEventDecoder
+
+        if self._native_decoder is None:
+            return self._ingest_python_fallback(
+                payloads, tenant, BinaryEventDecoder())
+        res = self._native_decoder.decode_binary(payloads)
+        return self._ingest_decoded(res, payloads, tenant,
+                                    BinaryEventDecoder())
+
+    def _ingest_python_fallback(self, payloads, tenant, dec) -> dict:
+        failed = 0
+        for p in payloads:
+            try:
+                for req in dec.decode(p, {}):
+                    req.tenant = tenant
+                    self.process(req)
+            except Exception:
+                failed += 1
+        return {"decoded": len(payloads) - failed, "failed": failed}
+
+    def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
+        """Stage a natively decoded SoA batch (shared by the JSON and binary
+        fast paths); registration envelopes re-decode on the slow path for
+        their string metadata."""
+        from sitewhere_tpu.ingest.fast_decode import (
+            RT_MAP,
+            RT_REGISTER,
+            RTYPE_TO_ETYPE,
+        )
+
         with self.lock:
             now = self.epoch.now_ms()
             base_ms = int(self.epoch.base_unix_s * 1000)
             etype = RTYPE_TO_ETYPE[np.clip(res.rtype, -1, 7)]
             ok = (res.rtype >= 0) & (etype >= 0)
-            regs = res.rtype == RT_REGISTER
+            # registration + mapping envelopes: slow path (string metadata)
+            regs = (res.rtype == RT_REGISTER) | (res.rtype == RT_MAP)
             failed = int(np.sum(res.rtype < 0))
-            # registration envelopes: slow path with full metadata
+            n_reg_ok = 0
             if np.any(regs):
-                from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
-
-                dec = JsonDeviceRequestDecoder()
                 for i in np.nonzero(regs)[0]:
                     try:
-                        for req in dec.decode(payloads[int(i)], {}):
+                        for req in reg_decoder.decode(payloads[int(i)], {}):
                             req.tenant = tenant
                             self.process(req)
+                        n_reg_ok += 1
                     except Exception:
                         failed += 1
             # relative int32 timestamps (absent -> now)
@@ -548,7 +572,7 @@ class Engine:
                         aux1=np.full(len(idxs), NULL_ID, np.int32),
                     ))
                 self.channel_map.collisions += res.collisions
-                return {"decoded": int(np.sum(ok)), "failed": failed,
+                return {"decoded": int(np.sum(ok)) + n_reg_ok, "failed": failed,
                         "staged": int(len(idxs))}
             staged = 0
             pos = 0
@@ -575,7 +599,7 @@ class Engine:
             if self._buf.full:
                 self.flush_async()
             self.channel_map.collisions += res.collisions
-            return {"decoded": int(np.sum(ok)), "failed": failed,
+            return {"decoded": int(np.sum(ok)) + n_reg_ok, "failed": failed,
                     "staged": staged}
 
     def maybe_flush(self) -> dict | None:
@@ -766,6 +790,13 @@ class Engine:
             if customer is not None:
                 info.customer = customer
             if metadata is not None:
+                # the gateway mapping lives in metadata AND the on-device
+                # parent column; a wholesale metadata replace must not
+                # silently desync them
+                if ("parentToken" in info.metadata
+                        and "parentToken" not in metadata):
+                    metadata = dict(metadata) | {
+                        "parentToken": info.metadata["parentToken"]}
                 info.metadata = metadata
             self.state = _admin_update_device(
                 self.state, jnp.int32(did),

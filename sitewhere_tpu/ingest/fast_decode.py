@@ -24,6 +24,7 @@ RT_LOCATION = 2
 RT_ALERT = 3
 RT_STATE_CHANGE = 4
 RT_ACK = 5
+RT_MAP = 6   # MapDevice envelopes take the host slow path (like REGISTER)
 
 # map native rtype -> core EventType ordinal (EventType in core/types.py)
 RTYPE_TO_ETYPE = np.full(8, -1, np.int32)
@@ -68,6 +69,15 @@ class NativeBatchDecoder:
         )
 
     def decode(self, payloads: list[bytes]) -> DecodedArrays:
+        """Batched JSON DeviceRequest decode."""
+        return self._decode(payloads, self.lib.swtpu_decode_batch)
+
+    def decode_binary(self, payloads: list[bytes]) -> DecodedArrays:
+        """Batched flat-binary decode (the "protobuf" ingest slot; wire
+        format of ingest/decoders.py encode_binary_request)."""
+        return self._decode(payloads, self.lib.swtpu_decode_binary_batch)
+
+    def _decode(self, payloads: list[bytes], fn) -> DecodedArrays:
         n = len(payloads)
         c = self.channels
         buf = b"".join(payloads)
@@ -85,7 +95,7 @@ class NativeBatchDecoder:
         def ptr(a, t):
             return a.ctypes.data_as(ctypes.POINTER(t))
 
-        n_ok = int(self.lib.swtpu_decode_batch(
+        n_ok = int(fn(
             self.handle, buf, ptr(offsets, ctypes.c_int64),
             np.int32(n), np.int32(c),
             ptr(rtype, ctypes.c_int32), ptr(token, ctypes.c_int32),

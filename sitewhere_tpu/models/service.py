@@ -62,6 +62,11 @@ class AnalyticsService:
         self._train = jax.jit(make_train_step(self.model, self.tx))
         self.threshold = threshold
         self.min_fill = min_fill if min_fill is not None else w
+        # train/score now run on worker threads (REST handlers +
+        # background loop); params/opt_state/stat updates must serialize
+        import threading
+
+        self._lock = threading.Lock()
         # running score statistics for the adaptive threshold (z-score)
         self._score_mean = 0.0
         self._score_m2 = 1.0
@@ -76,6 +81,10 @@ class AnalyticsService:
     def train_on_live(self, batch_size: int = 256, steps: int = 1) -> float:
         """Self-supervised training on the current (sufficiently filled)
         windows — 'normal' is whatever the fleet is doing."""
+        with self._lock:
+            return self._train_on_live(batch_size, steps)
+
+    def _train_on_live(self, batch_size: int, steps: int) -> float:
         wins = self._windows()
         data = snapshot_windows(wins)
         filled = np.asarray(wins.filled)
@@ -98,6 +107,10 @@ class AnalyticsService:
         """Score every analytics device; returns scores + anomalous tokens.
         ``update_stats=False`` makes the call read-only (dashboard polls
         must not drag the adaptive z-score baseline)."""
+        with self._lock:
+            return self._score_all(update_stats)
+
+    def _score_all(self, update_stats: bool) -> dict:
         wins = self._windows()
         data = snapshot_windows(wins)
         scores, valid, _ = _score_windows(
@@ -158,18 +171,19 @@ class AnalyticsService:
         import orbax.checkpoint as ocp
 
         directory = pathlib.Path(directory).absolute()
-        with ocp.StandardCheckpointer() as ckpt:
-            restored = ckpt.restore(directory / "model", {
-                "params": self.params,
-                "opt_state": self.opt_state,
-            })
-        self.params = restored["params"]
-        self.opt_state = restored["opt_state"]
-        meta = json.loads((directory / "analytics.json").read_text())
-        self._score_mean = meta["score_mean"]
-        self._score_m2 = meta["score_m2"]
-        self._score_n = meta["score_n"]
-        self.threshold = meta["threshold"]
+        with self._lock:
+            with ocp.StandardCheckpointer() as ckpt:
+                restored = ckpt.restore(directory / "model", {
+                    "params": self.params,
+                    "opt_state": self.opt_state,
+                })
+            self.params = restored["params"]
+            self.opt_state = restored["opt_state"]
+            meta = json.loads((directory / "analytics.json").read_text())
+            self._score_mean = meta["score_mean"]
+            self._score_m2 = meta["score_m2"]
+            self._score_n = meta["score_n"]
+            self.threshold = meta["threshold"]
 
     # ------------------------------------------------------ background loop
     async def run(self, interval_s: float = 5.0, train_steps: int = 1,

@@ -62,6 +62,8 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.POINTER(c.c_int32),            # out_level
         c.POINTER(c.c_int32),            # out_collisions
     ]
+    lib.swtpu_decode_binary_batch.restype = c.c_int32
+    lib.swtpu_decode_binary_batch.argtypes = lib.swtpu_decode_batch.argtypes
     return lib
 
 

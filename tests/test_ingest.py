@@ -551,3 +551,69 @@ def test_fair_mode_preserves_alert_levels():
         st = eng.get_device_state("al-1")
         assert st["recent_alerts"][0]["level"] == 2, (fair, st)
         assert st["recent_alerts"][0]["type"] == "fire"
+
+
+def test_native_binary_batch_decode():
+    """Binary wire format decodes natively and matches the Python decoder
+    on every event family."""
+    from sitewhere_tpu.core.types import AlertLevel
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.ingest.decoders import encode_binary_request
+    from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=1024, batch_capacity=16, channels=4))
+    payloads = [
+        encode_binary_request(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token="bb-1",
+            measurements={"temp": 21.5, "rpm": 900.0})),
+        encode_binary_request(DecodedRequest(
+            type=RequestType.DEVICE_LOCATION, device_token="bb-1",
+            latitude=33.7, longitude=-84.4, elevation=5.0)),
+        encode_binary_request(DecodedRequest(
+            type=RequestType.DEVICE_LOCATION, device_token="bb-1")),  # null coords
+        encode_binary_request(DecodedRequest(
+            type=RequestType.DEVICE_ALERT, device_token="bb-2",
+            alert_type="fire", alert_level=AlertLevel.CRITICAL)),
+        b"\x07garbage",
+    ]
+    res = eng.ingest_binary_batch(payloads)
+    assert res["failed"] == 1 and res["decoded"] == 4, res
+    eng.flush()
+    st = eng.get_device_state("bb-1")
+    assert st["measurements"]["temp"]["value"] == 21.5
+    assert st["measurements"]["rpm"]["value"] == 900.0
+    locs = st["recent_locations"]
+    assert len(locs) == 1 and locs[0]["latitude"] == pytest.approx(33.7, abs=1e-4)
+    st2 = eng.get_device_state("bb-2")
+    assert st2["recent_alerts"][0]["type"] == "fire"
+    assert st2["recent_alerts"][0]["level"] == int(AlertLevel.CRITICAL)
+
+    # registration envelope routes through the slow path
+    reg = encode_binary_request(DecodedRequest(
+        type=RequestType.REGISTER_DEVICE, device_token="bb-new"))
+    res = eng.ingest_binary_batch([reg])
+    assert res["decoded"] == 1
+    assert eng.get_device("bb-new") is not None
+
+
+def test_map_device_via_native_bulk_path():
+    """MapDevice envelopes in a native JSON bulk batch take the slow path
+    (parity with the pure-Python fallback)."""
+    from sitewhere_tpu.commands.routing import NestedDeviceSupport
+    from sitewhere_tpu.engine import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    eng.register_device("gw-b")
+    eng.register_device("leaf-b")
+    res = eng.ingest_json_batch([
+        b'{"deviceToken": "leaf-b", "type": "MapDevice",'
+        b' "request": {"parentToken": "gw-b"}}'])
+    assert res["failed"] == 0 and res["decoded"] == 1, res
+    assert NestedDeviceSupport(eng).resolve_target_token("leaf-b") == "gw-b"
+    # wholesale metadata update must not drop the mapping
+    eng.update_device("leaf-b", metadata={"rack": "r1"})
+    assert NestedDeviceSupport(eng).resolve_target_token("leaf-b") == "gw-b"
