@@ -542,7 +542,7 @@ int32_t swtpu_decode_binary_batch(
             p += tl;
             out_level[i] = *p++;
         }
-        if (failed) continue;
+        if (failed || token < 0) continue;   // interner-full = decode failure
         out_ts[i] = ts;
         out_rtype[i] = rtype;
         out_token[i] = token;

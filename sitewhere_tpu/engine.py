@@ -21,6 +21,7 @@ single-writer semantics the reference gets from Kafka partition ordering.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import threading
 import time
 from typing import Any
@@ -182,6 +183,20 @@ def _admin_set_device_active(state: PipelineState, device_id, active):
             reg, device_active=reg.device_active.at[device_id].set(active)
         )
     )
+
+
+@functools.partial(jax.jit, static_argnames=("t_cap",))
+def _tenant_event_counts(state: PipelineState, t_cap: int):
+    """Segment-sum per-device event counters by tenant: [t_cap, E].
+    ``t_cap`` is static (power-of-two bucket) so the program cache stays
+    small as tenants grow; the reduction is a one-hot matmul (MXU-friendly,
+    no scatter)."""
+    reg = state.registry
+    counts = state.device_state.event_counts              # [N, E]
+    tenant = jnp.where(reg.device_active, reg.device_tenant, -1)
+    t_ids = jnp.arange(t_cap)
+    onehot = (tenant[:, None] == t_ids[None, :]).astype(jnp.int32)  # [N, T]
+    return jnp.einsum("nt,ne->te", onehot, counts)
 
 
 @jax.jit
@@ -791,12 +806,30 @@ class Engine:
                 info.customer = customer
             if metadata is not None:
                 # the gateway mapping lives in metadata AND the on-device
-                # parent column; a wholesale metadata replace must not
-                # silently desync them
-                if ("parentToken" in info.metadata
-                        and "parentToken" not in metadata):
-                    metadata = dict(metadata) | {
-                        "parentToken": info.metadata["parentToken"]}
+                # parent column; keep the two views in lockstep:
+                #   key absent        -> preserve the existing mapping
+                #   key set to a token-> remap (on-device column follows)
+                #   key set to None   -> unmap (column cleared)
+                old_parent = info.metadata.get("parentToken")
+                metadata = dict(metadata)
+                if "parentToken" not in metadata and old_parent is not None:
+                    metadata["parentToken"] = old_parent
+                new_parent = metadata.get("parentToken")
+                if new_parent != old_parent:
+                    if new_parent is None:
+                        metadata.pop("parentToken", None)
+                        self.state = _admin_set_parent(
+                            self.state, jnp.int32(did), jnp.int32(NULL_ID))
+                    else:
+                        pdid = self.token_device.get(
+                            self.tokens.lookup(new_parent))
+                        if pdid is None:
+                            raise KeyError(
+                                f"parent device {new_parent!r} not registered")
+                        self.state = _admin_set_parent(
+                            self.state, jnp.int32(did), jnp.int32(pdid))
+                elif new_parent is None:
+                    metadata.pop("parentToken", None)
                 info.metadata = metadata
             self.state = _admin_update_device(
                 self.state, jnp.int32(did),
@@ -1122,6 +1155,25 @@ class Engine:
             self.state, newly = self._sweep(self.state, now, missing_ms)
             idxs = np.nonzero(np.asarray(newly))[0]
             return [self.devices[int(i)].token for i in idxs if int(i) in self.devices]
+
+    def tenant_metrics(self) -> dict[str, dict[str, int]]:
+        """Per-tenant event counts — one on-device segment-sum of the
+        per-device counters over the tenant column (the reference labels
+        every Prometheus metric per tenant via buildLabels())."""
+        with self.lock:
+            self._sync_mirrors()
+            n_tenants = len(self.tenants)
+            t_cap = max(64, 1 << max(0, n_tenants - 1).bit_length())
+            counts = np.asarray(_tenant_event_counts(self.state, t_cap))
+        out: dict[str, dict[str, int]] = {}
+        for tid in range(min(n_tenants, counts.shape[0])):
+            if not counts[tid].any():
+                continue
+            out[self.tenants.token(tid)] = {
+                EventType(e).name: int(counts[tid, e])
+                for e in range(counts.shape[1])
+            }
+        return out
 
     def metrics(self) -> dict:
         m = self.state.metrics

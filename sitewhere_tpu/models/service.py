@@ -150,15 +150,16 @@ class AnalyticsService:
         import orbax.checkpoint as ocp
 
         directory = pathlib.Path(directory).absolute()
-        with ocp.StandardCheckpointer() as ckpt:
-            ckpt.save(directory / "model", {
-                "params": self.params,
-                "opt_state": self.opt_state,
-            }, force=True)
-        meta = {"score_mean": float(self._score_mean),
-                "score_m2": float(self._score_m2),
-                "score_n": float(self._score_n),
-                "threshold": float(self.threshold)}
+        with self._lock:   # snapshot params/opt_state/stats from ONE step
+            with ocp.StandardCheckpointer() as ckpt:
+                ckpt.save(directory / "model", {
+                    "params": self.params,
+                    "opt_state": self.opt_state,
+                }, force=True)
+            meta = {"score_mean": float(self._score_mean),
+                    "score_m2": float(self._score_m2),
+                    "score_n": float(self._score_n),
+                    "threshold": float(self.threshold)}
         import json
 
         (directory / "analytics.json").write_text(json.dumps(meta))

@@ -169,8 +169,25 @@ REGISTRY = MetricsRegistry()
 def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
                           tenant: str = "all") -> None:
     """Push the engine's device-side counters into the registry (scrape-time
-    sync; the device counters are the source of truth)."""
+    sync; the device counters are the source of truth). Per-tenant event
+    counts export labeled, mirroring the reference's buildLabels() tenant
+    labeling on every metric."""
     reg = registry or REGISTRY
     for name, value in engine.metrics().items():
         reg.gauge(f"swtpu_engine_{name}",
                   f"engine counter {name}").set(value, tenant=tenant)
+    g = reg.gauge("swtpu_tenant_events",
+                  "persisted event count per tenant and type")
+    current: set[tuple] = set()
+    for ten, counts in engine.tenant_metrics().items():
+        for etype, n in counts.items():
+            if n:
+                g.set(n, tenant=ten, type=etype)
+                current.add(tuple(sorted({"tenant": ten,
+                                          "type": etype}.items())))
+    # a tenant that went quiet (devices deactivated) must scrape as 0, not
+    # freeze at its last nonzero sample
+    with g._lock:
+        stale = [k for k in g._values if k not in current]
+    for key in stale:
+        g.set(0, **dict(key))

@@ -357,3 +357,28 @@ def test_wal_legacy_and_midchain_corruption(tmp_path):
     out = list(IngestLog(tmp_path).replay())
     assert b"new-2" not in out and b"new-1" not in out
     assert out[:2] == [b"old-1", b"old-2"]
+
+
+def test_tenant_labeled_metrics():
+    """Per-tenant event counts via the on-device segment-sum, exported with
+    tenant labels (buildLabels() analog)."""
+    from sitewhere_tpu.utils.metrics import MetricsRegistry
+
+    engine = _engine()
+    for t, n in (("acme", 3), ("globex", 2)):
+        for i in range(n):
+            engine.process(DecodedRequest(
+                type=RequestType.DEVICE_MEASUREMENT,
+                device_token=f"{t}-{i}", tenant=t,
+                measurements={"v": 1.0}))
+    engine.flush()
+    tm = engine.tenant_metrics()
+    assert tm["acme"]["MEASUREMENT"] == 3
+    assert tm["globex"]["MEASUREMENT"] == 2
+    assert "default" not in tm  # no events there
+
+    reg = MetricsRegistry()
+    export_engine_metrics(engine, reg)
+    text = reg.expose_text()
+    assert 'swtpu_tenant_events{tenant="acme",type="MEASUREMENT"} 3' in text \
+        or 'swtpu_tenant_events{type="MEASUREMENT",tenant="acme"} 3' in text

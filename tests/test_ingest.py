@@ -617,3 +617,34 @@ def test_map_device_via_native_bulk_path():
     # wholesale metadata update must not drop the mapping
     eng.update_device("leaf-b", metadata={"rack": "r1"})
     assert NestedDeviceSupport(eng).resolve_target_token("leaf-b") == "gw-b"
+
+
+def test_update_device_parent_lockstep():
+    """metadata parentToken changes keep the on-device parent column in
+    lockstep: remap follows, explicit None unmaps."""
+    from sitewhere_tpu.commands.routing import NestedDeviceSupport
+    from sitewhere_tpu.core.types import NULL_ID
+    from sitewhere_tpu.engine import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    for t in ("gw1", "gw2", "leaf"):
+        eng.register_device(t)
+    eng.map_device("leaf", "gw1")
+    did = eng.token_device[eng.tokens.lookup("leaf")]
+
+    # remap via metadata update
+    eng.update_device("leaf", metadata={"parentToken": "gw2"})
+    assert NestedDeviceSupport(eng).resolve_target_token("leaf") == "gw2"
+    assert int(eng.state.registry.device_parent[did]) == \
+        eng.token_device[eng.tokens.lookup("gw2")]
+    # unknown parent rejected
+    import pytest as _pytest
+    with _pytest.raises(KeyError):
+        eng.update_device("leaf", metadata={"parentToken": "ghost"})
+    # explicit None unmaps both views
+    eng.update_device("leaf", metadata={"parentToken": None})
+    assert "parentToken" not in eng.get_device("leaf").metadata
+    assert int(eng.state.registry.device_parent[did]) == NULL_ID
+    assert NestedDeviceSupport(eng).resolve_target_token("leaf") == "leaf"
