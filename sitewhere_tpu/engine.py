@@ -85,6 +85,9 @@ class EngineConfig:
     use_native: bool = True            # C++ decode/interning data plane
     fair_tenancy: bool = False         # round-robin batch formation across
                                        # tenants (multi-tenant fairness)
+    assignment_triggers: bool = False  # emit STATE_CHANGE events on
+                                       # assignment create/status change
+                                       # (DeviceManagementTriggers analog)
     analytics_devices: int = 0         # HBM telemetry windows for [0, M)
     analytics_window: int = 128        # W timesteps per window
 
@@ -798,12 +801,9 @@ class Engine:
             if did is None:
                 raise KeyError(f"device {token!r} not registered")
             info = self.devices[did]
-            if device_type is not None:
-                info.device_type = device_type
-            if area is not None:
-                info.area = area
-            if customer is not None:
-                info.customer = customer
+            # validate EVERYTHING before mutating either view, so a failed
+            # update never leaves host and device state half-applied
+            parent_update = None   # (new metadata dict, parent did or NULL)
             if metadata is not None:
                 # the gateway mapping lives in metadata AND the on-device
                 # parent column; keep the two views in lockstep:
@@ -818,19 +818,32 @@ class Engine:
                 if new_parent != old_parent:
                     if new_parent is None:
                         metadata.pop("parentToken", None)
-                        self.state = _admin_set_parent(
-                            self.state, jnp.int32(did), jnp.int32(NULL_ID))
+                        parent_update = (metadata, NULL_ID)
                     else:
                         pdid = self.token_device.get(
                             self.tokens.lookup(new_parent))
                         if pdid is None:
                             raise KeyError(
                                 f"parent device {new_parent!r} not registered")
-                        self.state = _admin_set_parent(
-                            self.state, jnp.int32(did), jnp.int32(pdid))
-                elif new_parent is None:
-                    metadata.pop("parentToken", None)
-                info.metadata = metadata
+                        if pdid == did:
+                            raise ValueError(
+                                "device cannot be its own parent")
+                        parent_update = (metadata, pdid)
+                else:
+                    if new_parent is None:
+                        metadata.pop("parentToken", None)
+                    parent_update = (metadata, None)   # no column change
+            if device_type is not None:
+                info.device_type = device_type
+            if area is not None:
+                info.area = area
+            if customer is not None:
+                info.customer = customer
+            if parent_update is not None:
+                info.metadata, pdid = parent_update
+                if pdid is not None:
+                    self.state = _admin_set_parent(
+                        self.state, jnp.int32(did), jnp.int32(pdid))
             self.state = _admin_update_device(
                 self.state, jnp.int32(did),
                 jnp.int32(self.device_types.intern(info.device_type)),
@@ -894,9 +907,12 @@ class Engine:
                 jnp.int32(self.areas.intern(area) if area else NULL_ID),
                 jnp.int32(self.customers.intern(customer) if customer else NULL_ID),
             )
-            return self._record_assignment(
+            info = self._record_assignment(
                 aid, did, slot, token=token, asset=asset, area=area,
                 customer=customer, metadata=metadata)
+            self._assignment_trigger(device_token, "assignment.created",
+                                     info.tenant)
+            return info
 
     def get_assignment(self, token: str) -> AssignmentInfo | None:
         aid = self.assignment_tokens.get(token)
@@ -932,7 +948,27 @@ class Engine:
                     slots = self.device_slots[did]
                     self.device_slots[did] = [
                         NULL_ID if s == aid else s for s in slots]
+            self._assignment_trigger(
+                info.device_token, f"assignment.{status.name.lower()}",
+                info.tenant)
             return info
+
+    def _assignment_trigger(self, device_token: str, change: str,
+                            tenant: str) -> None:
+        """Emit a system STATE_CHANGE event on assignment lifecycle changes
+        (reference: DeviceManagementTriggers.java:30-62 pushes device
+        state-change events to Kafka on assignment create). Opt-in so event
+        streams stay pure device telemetry by default. Caller holds the
+        lock."""
+        if not self.config.assignment_triggers:
+            return
+        self.process(DecodedRequest(
+            type=RequestType.DEVICE_STATE_CHANGE,
+            device_token=device_token,
+            tenant=tenant,
+            attribute="assignment",
+            state_type=change,
+        ))
 
     def release_assignment(self, token: str) -> AssignmentInfo:
         """End an assignment (reference: Assignments controller

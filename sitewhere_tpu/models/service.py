@@ -150,16 +150,18 @@ class AnalyticsService:
         import orbax.checkpoint as ocp
 
         directory = pathlib.Path(directory).absolute()
-        with self._lock:   # snapshot params/opt_state/stats from ONE step
-            with ocp.StandardCheckpointer() as ckpt:
-                ckpt.save(directory / "model", {
-                    "params": self.params,
-                    "opt_state": self.opt_state,
-                }, force=True)
+        with self._lock:   # capture ONE step's view; pytrees are immutable,
+            params = self.params       # so refs suffice — the slow disk
+            opt_state = self.opt_state  # write happens outside the lock
             meta = {"score_mean": float(self._score_mean),
                     "score_m2": float(self._score_m2),
                     "score_n": float(self._score_n),
                     "threshold": float(self.threshold)}
+        with ocp.StandardCheckpointer() as ckpt:
+            ckpt.save(directory / "model", {
+                "params": params,
+                "opt_state": opt_state,
+            }, force=True)
         import json
 
         (directory / "analytics.json").write_text(json.dumps(meta))

@@ -270,3 +270,50 @@ def test_device_streams():
     assert stream.chunk_count == 3 and stream.total_bytes == 9
     with pytest.raises(EntityNotFound):
         sm.append_chunk("ghost", 1, b"x")
+
+
+def test_assignment_triggers_emit_state_changes():
+    """Opt-in DeviceManagementTriggers analog: assignment lifecycle emits
+    STATE_CHANGE events into the pipeline."""
+    from sitewhere_tpu.core.types import EventType
+    from sitewhere_tpu.engine import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4,
+        assignment_triggers=True))
+    eng.register_device("tr-1")
+    a = eng.create_assignment("tr-1", token="tr-1-x")
+    eng.release_assignment("tr-1-x")
+    eng.flush()
+    res = eng.query_events(device_token="tr-1",
+                           etype=EventType.STATE_CHANGE, limit=10)
+    assert res["total"] >= 2  # created + released (per active assignment)
+
+    # default engines stay trigger-free
+    eng2 = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    eng2.register_device("tr-2")
+    eng2.create_assignment("tr-2")
+    eng2.flush()
+    assert eng2.query_events(device_token="tr-2",
+                             etype=EventType.STATE_CHANGE)["total"] == 0
+
+
+def test_update_device_atomic_on_bad_parent():
+    """A failed update (unknown parent) must not half-apply host changes."""
+    import pytest as _pytest
+
+    from sitewhere_tpu.engine import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    eng.register_device("at-1", device_type="default")
+    with _pytest.raises(KeyError):
+        eng.update_device("at-1", device_type="other-type",
+                          metadata={"parentToken": "ghost"})
+    assert eng.get_device("at-1").device_type == "default"  # untouched
+    with _pytest.raises(ValueError):
+        eng.update_device("at-1", metadata={"parentToken": "at-1"})
