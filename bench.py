@@ -134,6 +134,27 @@ def main() -> None:
             f"host e2e pipelined (steady-state ingest): "
             f"{pstats.events_per_s:,.0f} ev/s"
         )
+        # binary wire format through the same host path (protobuf-slot)
+        from sitewhere_tpu.ingest.decoders import encode_binary_request
+        from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+        rng_b = np.random.default_rng(1)
+        bpay = [encode_binary_request(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT,
+            device_token=f"lg-{int(rng_b.integers(0, 10_000))}",
+            measurements={"engine.temperature": float(i % 80)}))
+            for i in range(8192)]
+        eng.ingest_binary_batch(bpay)  # warm
+        eng.flush()
+        t1 = time.perf_counter()
+        for _ in range(10):
+            eng.ingest_binary_batch(bpay)
+            if eng.staged_count:
+                eng.flush_async()
+        eng.drain()
+        jax.block_until_ready(eng.state.metrics.persisted)
+        dt = time.perf_counter() - t1
+        log(f"host e2e binary wire (pipelined): {10 * 8192 / dt:,.0f} ev/s")
     except Exception as e:  # diagnostic only
         log(f"host e2e diagnostic skipped: {e}")
 

@@ -399,6 +399,11 @@ class Engine:
                 aux0 = self.alert_types.intern(req.alert_type or "alert")
             elif et is EventType.COMMAND_RESPONSE and req.originating_event_id:
                 aux0 = self.event_ids.intern(req.originating_event_id)
+            elif et is EventType.STATE_CHANGE and (req.attribute or req.state_type):
+                # the change label travels in aux0 so consumers can tell
+                # e.g. assignment.created from assignment.released
+                aux0 = self.event_ids.intern(
+                    f"{req.attribute or ''}:{req.state_type or ''}")
             self._stage(et, token_id, tenant_id, ts, now, values, mask, aux0, req)
 
     def _stage(self, et, token_id, tenant_id, ts, now, values, mask, aux0, req):
@@ -796,13 +801,23 @@ class Engine:
                       metadata: dict | None = None) -> DeviceInfo:
         """Update device columns + host metadata (RdbDeviceManagement.updateDevice)."""
         with self.lock:
+            self._sync_mirrors()
             tid = self.tokens.lookup(token)
             did = self.token_device.get(tid)
             if did is None:
                 raise KeyError(f"device {token!r} not registered")
             info = self.devices[did]
-            # validate EVERYTHING before mutating either view, so a failed
-            # update never leaves host and device state half-applied
+            # validate EVERYTHING (including interning, which can exhaust
+            # capacity) before mutating either view, so a failed update
+            # never leaves host and device state half-applied
+            type_id = jnp.int32(self.device_types.intern(
+                device_type if device_type is not None else info.device_type))
+            new_area = area if area is not None else info.area
+            area_id = jnp.int32(
+                self.areas.intern(new_area) if new_area else NULL_ID)
+            new_customer = customer if customer is not None else info.customer
+            customer_id = jnp.int32(
+                self.customers.intern(new_customer) if new_customer else NULL_ID)
             parent_update = None   # (new metadata dict, parent did or NULL)
             if metadata is not None:
                 # the gateway mapping lives in metadata AND the on-device
@@ -845,11 +860,7 @@ class Engine:
                     self.state = _admin_set_parent(
                         self.state, jnp.int32(did), jnp.int32(pdid))
             self.state = _admin_update_device(
-                self.state, jnp.int32(did),
-                jnp.int32(self.device_types.intern(info.device_type)),
-                jnp.int32(self.areas.intern(info.area) if info.area else NULL_ID),
-                jnp.int32(self.customers.intern(info.customer) if info.customer else NULL_ID),
-            )
+                self.state, jnp.int32(did), type_id, area_id, customer_id)
             return info
 
     # ------------------------------------------------------------- assignments
@@ -1177,6 +1188,11 @@ class Engine:
                     ev["originatingEventId"] = (
                         self.event_ids.token(oid) if 0 <= oid < len(self.event_ids) else None
                     )
+                elif et is EventType.STATE_CHANGE:
+                    sid = int(res.aux[i, 0])
+                    if 0 <= sid < len(self.event_ids):
+                        attr, _, change = self.event_ids.token(sid).partition(":")
+                        ev["attribute"], ev["stateChange"] = attr, change
                 events.append(ev)
             return {"total": int(res.total), "events": events}
 

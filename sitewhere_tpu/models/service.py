@@ -67,6 +67,7 @@ class AnalyticsService:
         import threading
 
         self._lock = threading.Lock()
+        self._save_lock = threading.Lock()   # serializes checkpoint writes
         # running score statistics for the adaptive threshold (z-score)
         self._score_mean = 0.0
         self._score_m2 = 1.0
@@ -157,11 +158,12 @@ class AnalyticsService:
                     "score_m2": float(self._score_m2),
                     "score_n": float(self._score_n),
                     "threshold": float(self.threshold)}
-        with ocp.StandardCheckpointer() as ckpt:
-            ckpt.save(directory / "model", {
-                "params": params,
-                "opt_state": opt_state,
-            }, force=True)
+        with self._save_lock:   # concurrent saves must not interleave the
+            with ocp.StandardCheckpointer() as ckpt:   # delete-then-write
+                ckpt.save(directory / "model", {
+                    "params": params,
+                    "opt_state": opt_state,
+                }, force=True)
         import json
 
         (directory / "analytics.json").write_text(json.dumps(meta))

@@ -289,6 +289,9 @@ def test_assignment_triggers_emit_state_changes():
     res = eng.query_events(device_token="tr-1",
                            etype=EventType.STATE_CHANGE, limit=10)
     assert res["total"] >= 2  # created + released (per active assignment)
+    changes = {e.get("stateChange") for e in res["events"]}
+    assert {"assignment.created", "assignment.released"} <= changes
+    assert all(e.get("attribute") == "assignment" for e in res["events"])
 
     # default engines stay trigger-free
     eng2 = Engine(EngineConfig(
