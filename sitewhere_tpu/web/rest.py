@@ -566,6 +566,10 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         )
 
     r.add_post("/api/batch/command", create_batch)
+    r.add_get("/api/batch", lambda req: json_response(_paged(
+        inst.batch.operations.list(
+            page=int(req.query.get("page", 1)),
+            page_size=int(req.query.get("pageSize", 100))))))
     r.add_get("/api/batch/{token}", lambda req: json_response((lambda op: {
         "token": op.meta.token, "status": op.status,
         "operationType": op.operation_type, "counts": op.counts(),

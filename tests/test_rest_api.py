@@ -471,3 +471,16 @@ def test_device_mapping_and_nested_routing(api):
     did = inst.engine.token_device[tid]
     pdid = int(inst.engine.state.registry.device_parent[did])
     assert inst.engine.devices[pdid].token == "gw-1"
+
+
+def test_batch_operation_listing(api):
+    call, inst, loop = api
+    call("POST", "/api/devicetypes/default/commands",
+         {"token": "blink", "name": "blink"})
+    call("POST", "/api/devices", {"token": "bl-1"})
+    call("POST", "/api/batch/command",
+         {"token": "op-1", "deviceTokens": ["bl-1"], "commandToken": "blink"})
+    status, listing = call("GET", "/api/batch")
+    assert status == 200 and listing["numResults"] == 1
+    assert listing["results"][0]["token"] == "op-1"
+    assert listing["results"][0]["status"] == "Finished"
