@@ -93,6 +93,12 @@ class SiteWhereTpuInstance(LifecycleComponent):
         if self.config.index_events:
             self.add_connector(SearchIndexConnector("search-index", self.search_index))
 
+        # geofencing: zone entry/exit alerts over the location feed
+        from sitewhere_tpu.outbound.zones import ZoneMonitor
+
+        self.zone_monitor = ZoneMonitor(self.engine, self.device_management)
+        self.add_child(self.zone_monitor)
+
         # analytics (service-tpu-analytics analog) — live when the engine
         # carries HBM telemetry windows
         self.analytics = None
@@ -126,6 +132,7 @@ class SiteWhereTpuInstance(LifecycleComponent):
         """Drive command delivery + all connector hosts once (embedded mode;
         under the REST server these run as background tasks)."""
         n = await self.commands.pump()
+        n += await self.zone_monitor.pump()
         for host in self.connector_hosts:
             n += await host.pump()
         return n

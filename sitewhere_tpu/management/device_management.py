@@ -343,6 +343,8 @@ class DeviceManagement:
             raise EntityNotFound(f"area {area_token!r} not found")
         if len(bounds) < 3:
             raise ValueError("zone bounds require at least 3 vertices")
+        if len(bounds) > 16:   # geofence kernel vertex capacity
+            raise ValueError("zone bounds exceed 16 vertices")
         return self.zones.create(
             token, lambda m: Zone(meta=m, area_token=area_token, name=name,
                                   bounds=bounds, **kw)

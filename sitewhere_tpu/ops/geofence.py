@@ -1,0 +1,66 @@
+"""Zone geofencing: vectorized point-in-polygon on device.
+
+The reference stores zones as lat/lon polygon bounds on areas
+(service-device-management/.../Zones controller + RdbZone entity;
+SURVEY.md §2.5) — the platform's geofences. The reference repo itself
+never evaluates them (evaluation lived in downstream rule engines); here
+containment is a first-class batched kernel: every location event in a
+batch is tested against every zone in one [N x Z x V] ray-casting pass —
+MXU-free but fully vectorized, no per-event host loops.
+
+Zone storage is padded to a static vertex capacity V by REPEATING the
+first vertex: the wrap edge then degenerates to a zero-length segment
+that contributes no crossings, so polygons of any size share one shape.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_zones(polygons: list[list[tuple[float, float]]],
+               max_vertices: int = 16) -> tuple[np.ndarray, np.ndarray]:
+    """[(lat, lon), ...] polygons -> (verts [Z, V, 2] float32, valid [Z]).
+    Polygons beyond ``max_vertices`` raise; empty list packs a single
+    invalid row so downstream shapes stay static."""
+    z = max(1, len(polygons))
+    verts = np.zeros((z, max_vertices, 2), np.float32)
+    valid = np.zeros(z, bool)
+    for i, poly in enumerate(polygons):
+        if len(poly) < 3:
+            raise ValueError(f"zone {i}: a polygon needs >= 3 vertices")
+        if len(poly) > max_vertices:
+            raise ValueError(
+                f"zone {i}: {len(poly)} vertices > capacity {max_vertices}")
+        arr = np.asarray(poly, np.float32)
+        verts[i, :len(poly)] = arr
+        verts[i, len(poly):] = arr[0]      # pad = first vertex (degenerate)
+        valid[i] = True
+    return verts, valid
+
+
+@jax.jit
+def points_in_zones(points: jax.Array, verts: jax.Array,
+                    zone_valid: jax.Array) -> jax.Array:
+    """points [N, 2] (lat, lon) x zones [Z, V, 2] -> bool [N, Z].
+
+    Even-odd ray casting; the ray runs in +lon. Division-free edge test so
+    degenerate (padded) edges are exact no-ops.
+    """
+    a = verts                                   # [Z, V, 2]
+    b = jnp.roll(verts, -1, axis=1)             # [Z, V, 2] next vertex
+    py = points[:, None, None, 0]               # lat  [N, 1, 1]
+    px = points[:, None, None, 1]               # lon  [N, 1, 1]
+    ay, ax = a[None, :, :, 0], a[None, :, :, 1]   # [1, Z, V]
+    by, bx = b[None, :, :, 0], b[None, :, :, 1]
+
+    straddles = (ay > py) != (by > py)
+    # px < ax + (py - ay) * (bx - ax) / (by - ay), multiplied through by
+    # (by - ay) with sign-aware flip:
+    lhs = (px - ax) * (by - ay)
+    rhs = (bx - ax) * (py - ay)
+    crosses = straddles & jnp.where(by > ay, lhs < rhs, lhs > rhs)
+    inside = jnp.sum(crosses, axis=2) % 2 == 1    # [N, Z]
+    return inside & zone_valid[None, :]

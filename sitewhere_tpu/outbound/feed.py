@@ -39,6 +39,10 @@ class OutboundEvent:
     values: list[float]
     aux0: int
     aux1: int
+    # set only for LOCATION events that carried coordinates (vmask lane 0);
+    # a null-coord location event leaves these None — never null island
+    latitude: float | None = None
+    longitude: float | None = None
 
     def to_json_dict(self) -> dict[str, Any]:
         return {
@@ -123,9 +127,12 @@ class FeedConsumer:
             info = eng.devices.get(int(device[i]))
             et = EventType(int(etype[i]))
             meas = {}
+            lat = lon = None
             if et is EventType.MEASUREMENT:
                 for ch in np.nonzero(vmask[i])[0]:
                     meas[lane_names.get(int(ch), f"ch{ch}")] = float(values[i, ch])
+            elif et is EventType.LOCATION and vmask[i, 0]:
+                lat, lon = float(values[i, 0]), float(values[i, 1])
             out.append(
                 OutboundEvent(
                     event_id=base + i,
@@ -145,6 +152,8 @@ class FeedConsumer:
                     values=[float(v) for v in values[i]],
                     aux0=int(aux[i, 0]),
                     aux1=int(aux[i, 1]),
+                    latitude=lat,
+                    longitude=lon,
                 )
             )
         return out

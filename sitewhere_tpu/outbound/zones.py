@@ -1,0 +1,128 @@
+"""Zone monitor: geofence evaluation over the location-event feed.
+
+The reference persists zones (polygon bounds per area; Zones REST
+controller, RdbZone) as its geofences but leaves evaluation to external
+rule engines. Here evaluation is built in: a feed consumer batches the
+newly persisted LOCATION events, tests every point against every zone in
+one on-device ray-casting pass (ops/geofence.py), diffs each device's
+zone membership against its previous set, and injects zone.entered /
+zone.exited alerts back into the pipeline — downstream consumers (device
+state, connectors, command delivery) see them like any device alert,
+exactly how the analytics anomaly alerts flow.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.core.types import AlertLevel, EventType
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+from sitewhere_tpu.ops.geofence import pack_zones, points_in_zones
+from sitewhere_tpu.outbound.feed import FeedConsumer
+from sitewhere_tpu.utils.lifecycle import LifecycleComponent
+
+
+class ZoneMonitor(LifecycleComponent):
+    """Watches location events and raises zone entry/exit alerts."""
+
+    def __init__(self, engine, device_management,
+                 alert_level: AlertLevel = AlertLevel.WARNING,
+                 max_vertices: int = 16):
+        super().__init__("zone-monitor")
+        self.engine = engine
+        self.dm = device_management
+        self.alert_level = alert_level
+        self.max_vertices = max_vertices
+        self.consumer = FeedConsumer(engine, "zone-monitor",
+                                     start_from_latest=True)
+        # device_id -> frozenset of zone tokens currently containing it
+        self.membership: dict[int, frozenset[str]] = {}
+        self._zone_tokens: list[str] = []
+        self._verts = None
+        self._valid = None
+        self._zone_version = -1
+
+    def _refresh_zones(self) -> None:
+        """Rebuild the packed zone arrays when the zone store changed
+        (token set, identity, OR bounds — delete+recreate and in-place
+        bounds edits must both invalidate the cache)."""
+        zones = self.dm.zones.all()
+        version = tuple(sorted(
+            (z.meta.token, z.meta.id, tuple(map(tuple, z.bounds)))
+            for z in zones))
+        if version == self._zone_version:
+            return
+        self._zone_version = version
+        usable = []
+        tokens = []
+        for z in zones:
+            if len(z.bounds) > self.max_vertices:
+                # defense in depth (create_zone validates too): one bad zone
+                # must never poison the shared outbound pump
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "zone %s has %d vertices > capacity %d; skipping",
+                    z.meta.token, len(z.bounds), self.max_vertices)
+                continue
+            usable.append(list(z.bounds))
+            tokens.append(z.meta.token)
+        self._zone_tokens = tokens
+        verts, valid = pack_zones(usable, self.max_vertices)
+        self._verts = jnp.asarray(verts)
+        self._valid = jnp.asarray(valid)
+
+    async def pump(self) -> int:
+        """Evaluate newly persisted location events; returns alerts raised."""
+        self._refresh_zones()
+        events = self.consumer.poll()
+        locs = [e for e in events
+                if e.etype is EventType.LOCATION and e.latitude is not None]
+        raised = 0
+        if locs:
+            if self._zone_tokens:
+                # pad the point batch to a power-of-two bucket: the kernel
+                # is jitted, and a fresh trace per distinct batch size would
+                # stall the pump (static shapes, like every kernel here)
+                n = len(locs)
+                cap = max(8, 1 << (n - 1).bit_length())
+                pts = np.zeros((cap, 2), np.float32)
+                pts[:n] = [[e.latitude, e.longitude] for e in locs]
+                inside = np.asarray(points_in_zones(
+                    jnp.asarray(pts), self._verts, self._valid))[:n]
+            else:
+                inside = np.zeros((len(locs), 0), bool)
+            # latest location per device wins within the batch
+            latest: dict[int, int] = {}
+            for i, e in enumerate(locs):
+                latest[e.device_id] = i
+            for did, i in latest.items():
+                now_in = frozenset(
+                    tok for z, tok in enumerate(self._zone_tokens)
+                    if inside[i, z])
+                before = self.membership.get(did, frozenset())
+                if now_in == before:
+                    continue
+                self.membership[did] = now_in
+                token = locs[i].device_token
+                for ztok in sorted(now_in - before):
+                    self._alert(token, "zone.entered", ztok)
+                    raised += 1
+                for ztok in sorted(before - now_in):
+                    self._alert(token, "zone.exited", ztok)
+                    raised += 1
+        if events:
+            self.consumer.commit(events)
+        if raised:
+            self.engine.flush_async()
+        return raised
+
+    def _alert(self, device_token: str, kind: str, zone_token: str) -> None:
+        self.engine.process(DecodedRequest(
+            type=RequestType.DEVICE_ALERT,
+            device_token=device_token,
+            alert_type=f"{kind}:{zone_token}",
+            alert_level=self.alert_level,
+            alert_message=f"{kind} {zone_token}",
+        ))

@@ -483,6 +483,24 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         [_entity(z) for z in
          inst.device_management.zones_for_area(req.match_info["token"])]))
 
+    async def zone_contains(request: web.Request):
+        """On-device point-in-polygon test for one zone."""
+        import jax.numpy as jnp
+
+        from sitewhere_tpu.ops.geofence import pack_zones, points_in_zones
+
+        zone = inst.device_management.zones.get(request.match_info["token"])
+        lat = float(request.query["latitude"])
+        lon = float(request.query["longitude"])
+        verts, valid = pack_zones([list(zone.bounds)])
+        inside = points_in_zones(
+            jnp.asarray([[lat, lon]], jnp.float32),
+            jnp.asarray(verts), jnp.asarray(valid))
+        return json_response({"zone": zone.meta.token,
+                              "contains": bool(inside[0, 0])})
+
+    r.add_get("/api/zones/{token}/contains", zone_contains)
+
     async def create_customer_type(request: web.Request):
         body = await request.json()
         ct = inst.device_management.create_customer_type(body["token"], body["name"])
