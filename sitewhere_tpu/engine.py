@@ -50,6 +50,12 @@ from sitewhere_tpu.pipeline import (
 )
 
 
+# WAL record format tags (first byte of every logged payload): recovery
+# replays each record through the decoder that originally accepted it
+WAL_JSON = b"\x01"
+WAL_BINARY = b"\x02"
+
+
 class ChannelMap:
     """Measurement-name -> channel-index interner (per engine).
 
@@ -88,6 +94,8 @@ class EngineConfig:
     assignment_triggers: bool = False  # emit STATE_CHANGE events on
                                        # assignment create/status change
                                        # (DeviceManagementTriggers analog)
+    wal_dir: str | None = None         # write-ahead log directory; None
+                                       # disables the durability log
     analytics_devices: int = 0         # HBM telemetry windows for [0, M)
     analytics_window: int = 128        # W timesteps per window
 
@@ -329,6 +337,15 @@ class Engine:
         self._pending_outs: list[StepOutput] = []     # un-absorbed step outputs
         self._fair_queues: dict[int, list] = {}       # tenant_id -> staged rows
         self._fair_queued = 0
+        # durability: accepted payloads append to the WAL BEFORE staging,
+        # tagged by wire format so recovery replays each through the right
+        # decoder (utils/checkpoint.recover_engine)
+        self.wal = None
+        self._wal_local = threading.local()   # re-entrancy guard per thread
+        if c.wal_dir:
+            from sitewhere_tpu.utils.ingestlog import IngestLog
+
+            self.wal = IngestLog(c.wal_dir)
 
     @property
     def staged_count(self) -> int:
@@ -346,6 +363,17 @@ class Engine:
     def process(self, req: DecodedRequest) -> None:
         """Stage one decoded request; flushes when the batch fills."""
         with self.lock:
+            if self.wal is not None:
+                # per-request path (protocol receivers): log the request in
+                # the binary wire form when it carries one; unsupported
+                # types (streams, state-change triggers) are snapshot-only
+                from sitewhere_tpu.ingest.decoders import encode_binary_request
+
+                try:
+                    self._wal_append(WAL_BINARY,
+                                     [encode_binary_request(req)], req.tenant)
+                except KeyError:
+                    pass
             if req.type is RequestType.REGISTER_DEVICE:
                 self.register_device(
                     req.device_token,
@@ -504,12 +532,14 @@ class Engine:
         string metadata the hot path doesn't extract)."""
         from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
 
-        if self._native_decoder is None:
-            return self._ingest_python_fallback(
-                payloads, tenant, JsonDeviceRequestDecoder())
-        res = self._native_decoder.decode(payloads)
-        return self._ingest_decoded(res, payloads, tenant,
-                                    JsonDeviceRequestDecoder())
+        with self.lock:
+            self._wal_append(WAL_JSON, payloads, tenant)
+            if self._native_decoder is None:
+                return self._ingest_python_fallback(
+                    payloads, tenant, JsonDeviceRequestDecoder())
+            res = self._native_decoder.decode(payloads)
+            return self._ingest_decoded(res, payloads, tenant,
+                                        JsonDeviceRequestDecoder())
 
     def ingest_binary_batch(self, payloads: list[bytes],
                             tenant: str = "default") -> dict:
@@ -517,22 +547,52 @@ class Engine:
         slot): one native C call decodes the whole batch."""
         from sitewhere_tpu.ingest.decoders import BinaryEventDecoder
 
-        if self._native_decoder is None:
-            return self._ingest_python_fallback(
-                payloads, tenant, BinaryEventDecoder())
-        res = self._native_decoder.decode_binary(payloads)
-        return self._ingest_decoded(res, payloads, tenant,
-                                    BinaryEventDecoder())
+        with self.lock:
+            self._wal_append(WAL_BINARY, payloads, tenant)
+            if self._native_decoder is None:
+                return self._ingest_python_fallback(
+                    payloads, tenant, BinaryEventDecoder())
+            res = self._native_decoder.decode_binary(payloads)
+            return self._ingest_decoded(res, payloads, tenant,
+                                        BinaryEventDecoder())
+
+    def _wal_append(self, tag: bytes, payloads: list[bytes],
+                    tenant: str) -> None:
+        """Log accepted payloads. MUST be called under the engine lock so a
+        concurrent snapshot's watermark can never cover a record whose
+        events were not yet staged. No-op while replaying or while an outer
+        ingest path on this thread already logged the raw batch."""
+        if self.wal is None or getattr(self._wal_local, "depth", 0):
+            return
+        head = tag + tenant.encode() + b"\x00"
+        for p in payloads:
+            self.wal.append(head + p)
+
+    def _wal_suppress(self):
+        """Context manager: suppress WAL logging for nested process() calls
+        on THIS thread (their raw batch is already logged)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._wal_local.depth = getattr(self._wal_local, "depth", 0) + 1
+            try:
+                yield
+            finally:
+                self._wal_local.depth -= 1
+
+        return ctx()
 
     def _ingest_python_fallback(self, payloads, tenant, dec) -> dict:
         failed = 0
-        for p in payloads:
-            try:
-                for req in dec.decode(p, {}):
-                    req.tenant = tenant
-                    self.process(req)
-            except Exception:
-                failed += 1
+        with self._wal_suppress():   # the raw batch is already logged
+            for p in payloads:
+                try:
+                    for req in dec.decode(p, {}):
+                        req.tenant = tenant
+                        self.process(req)
+                except Exception:
+                    failed += 1
         return {"decoded": len(payloads) - failed, "failed": failed}
 
     def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
@@ -550,19 +610,26 @@ class Engine:
             base_ms = int(self.epoch.base_unix_s * 1000)
             etype = RTYPE_TO_ETYPE[np.clip(res.rtype, -1, 7)]
             ok = (res.rtype >= 0) & (etype >= 0)
-            # registration + mapping envelopes: slow path (string metadata)
-            regs = (res.rtype == RT_REGISTER) | (res.rtype == RT_MAP)
+            # registration / mapping / command-response envelopes take the
+            # slow path — they carry string payloads (extras, originating
+            # event ids) the SoA fast columns don't extract
+            from sitewhere_tpu.ingest.fast_decode import RT_ACK
+
+            regs = ((res.rtype == RT_REGISTER) | (res.rtype == RT_MAP)
+                    | (res.rtype == RT_ACK))
+            ok &= ~regs   # slow-path rows must not also stage via fast path
             failed = int(np.sum(res.rtype < 0))
             n_reg_ok = 0
             if np.any(regs):
-                for i in np.nonzero(regs)[0]:
-                    try:
-                        for req in reg_decoder.decode(payloads[int(i)], {}):
-                            req.tenant = tenant
-                            self.process(req)
-                        n_reg_ok += 1
-                    except Exception:
-                        failed += 1
+                with self._wal_suppress():   # raw batch already logged
+                    for i in np.nonzero(regs)[0]:
+                        try:
+                            for req in reg_decoder.decode(payloads[int(i)], {}):
+                                req.tenant = tenant
+                                self.process(req)
+                            n_reg_ok += 1
+                        except Exception:
+                            failed += 1
             # relative int32 timestamps (absent -> now)
             ts_rel = np.where(
                 res.ts_ms64 >= 0,

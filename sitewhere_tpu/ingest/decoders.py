@@ -253,6 +253,21 @@ def encode_binary_request(req: DecodedRequest) -> bytes:
         out += struct.pack("<H", len(tb)) + tb
         out += struct.pack("<B", int(req.alert_level))
         out += struct.pack("<H", len(mb)) + mb
+    elif req.type is RequestType.REGISTER_DEVICE:
+        # string extras (deviceTypeToken/areaToken/customerToken) must
+        # survive the wire or WAL replay loses registration fidelity
+        pairs = [(k, v) for k, v in (req.extras or {}).items()
+                 if isinstance(v, str)]
+        out += struct.pack("<H", len(pairs))
+        for k, v in pairs:
+            kb, vb = k.encode(), v.encode()
+            out += struct.pack("<H", len(kb)) + kb
+            out += struct.pack("<H", len(vb)) + vb
+    elif req.type is RequestType.ACKNOWLEDGE:
+        ob = (req.originating_event_id or "").encode()
+        rb = (req.response or "").encode()
+        out += struct.pack("<H", len(ob)) + ob
+        out += struct.pack("<H", len(rb)) + rb
     return out
 
 
@@ -305,6 +320,29 @@ class BinaryEventDecoder:
                 (ml,) = struct.unpack_from("<H", payload, off)
                 off += 2
                 req.alert_message = payload[off: off + ml].decode() or None
+            elif rtype is RequestType.REGISTER_DEVICE:
+                (n,) = struct.unpack_from("<H", payload, off)
+                off += 2
+                extras = {}
+                for _ in range(n):
+                    (kl,) = struct.unpack_from("<H", payload, off)
+                    off += 2
+                    key = payload[off: off + kl].decode()
+                    off += kl
+                    (vl,) = struct.unpack_from("<H", payload, off)
+                    off += 2
+                    extras[key] = payload[off: off + vl].decode()
+                    off += vl
+                req.extras = extras
+            elif rtype is RequestType.ACKNOWLEDGE:
+                (ol,) = struct.unpack_from("<H", payload, off)
+                off += 2
+                req.originating_event_id = (
+                    payload[off: off + ol].decode() or None)
+                off += ol
+                (rl,) = struct.unpack_from("<H", payload, off)
+                off += 2
+                req.response = payload[off: off + rl].decode() or None
             return [req]
         except (struct.error, UnicodeDecodeError, IndexError) as e:
             raise EventDecodeException(str(e)) from e

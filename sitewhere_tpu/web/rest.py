@@ -479,6 +479,8 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(_entity(zone), status=201)
 
     r.add_post("/api/zones", create_zone)
+    r.add_get("/api/zones", lambda req: json_response(
+        _paged(inst.device_management.zones.list())))
     r.add_get("/api/areas/{token}/zones", lambda req: json_response(
         [_entity(z) for z in
          inst.device_management.zones_for_area(req.match_info["token"])]))
@@ -996,9 +998,11 @@ class ServerHandle:
 
 async def start_server(instance: SiteWhereTpuInstance, host: str = "127.0.0.1",
                        port: int = 0,
-                       analytics_interval_s: float = 5.0) -> ServerHandle:
-    """Start the REST gateway + background pumps (outbound; analytics when
-    the engine carries telemetry windows)."""
+                       analytics_interval_s: float = 5.0,
+                       presence_interval_s: float = 600.0) -> ServerHandle:
+    """Start the REST gateway + background pumps (outbound pump, periodic
+    presence sweep, and analytics when the engine carries telemetry
+    windows)."""
     import asyncio
 
     app = make_app(instance)
@@ -1019,7 +1023,28 @@ async def start_server(instance: SiteWhereTpuInstance, host: str = "127.0.0.1",
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()
-    tasks = [asyncio.create_task(pump_loop())]
+    async def presence_loop():
+        # background presence scan (DevicePresenceManager.java:45-160 runs
+        # a periodic check-loop; default interval there is 10 minutes)
+        while True:
+            await asyncio.sleep(presence_interval_s)
+            try:
+                missing = await asyncio.to_thread(
+                    instance.engine.presence_sweep)
+                if missing:
+                    import logging
+
+                    logging.getLogger(__name__).info(
+                        "presence sweep: %d newly missing", len(missing))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception("presence sweep error")
+
+    tasks = [asyncio.create_task(pump_loop()),
+             asyncio.create_task(presence_loop())]
     if instance.analytics is not None:
         # always-on analytics: train on live windows, score, inject alerts
         tasks.append(asyncio.create_task(

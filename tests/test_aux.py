@@ -382,3 +382,51 @@ def test_tenant_labeled_metrics():
     text = reg.expose_text()
     assert 'swtpu_tenant_events{tenant="acme",type="MEASUREMENT"} 3' in text \
         or 'swtpu_tenant_events{type="MEASUREMENT",tenant="acme"} 3' in text
+
+
+def test_wired_wal_recovery_mixed_formats(tmp_path):
+    """EngineConfig.wal_dir wires durability into every ingest path; one
+    recover_engine call restores the snapshot and replays the tagged tail
+    (JSON bulk + binary bulk + per-request) through the right decoders."""
+    from sitewhere_tpu.ingest.decoders import encode_binary_request
+    from sitewhere_tpu.utils.checkpoint import recover_engine, save_engine
+
+    cfg = dict(device_capacity=64, token_capacity=128,
+               assignment_capacity=128, store_capacity=4096,
+               batch_capacity=16, channels=4,
+               wal_dir=str(tmp_path / "wal"))
+    engine = Engine(EngineConfig(**cfg))
+
+    def jrow(i):
+        return json.dumps({
+            "deviceToken": f"wx-{i % 2}", "type": "DeviceMeasurement",
+            "request": {"name": "a", "value": float(i)}}).encode()
+
+    engine.ingest_json_batch([jrow(i) for i in range(4)])
+    engine.flush()
+    save_engine(engine, tmp_path / "snap")   # writes the WAL watermark
+    # post-snapshot traffic across all three ingest paths, then "crash"
+    engine.ingest_json_batch([jrow(i) for i in range(4, 8)])
+    engine.ingest_binary_batch([encode_binary_request(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT, device_token="wx-0",
+        measurements={"b": 42.0}))])
+    engine.process(DecodedRequest(
+        type=RequestType.DEVICE_LOCATION, device_token="wx-1",
+        latitude=3.0, longitude=4.0))
+    engine.flush()
+    final = {t: engine.get_device_state(t) for t in ("wx-0", "wx-1")}
+    engine.wal.close()
+
+    restored = recover_engine(tmp_path / "snap")
+    for t in ("wx-0", "wx-1"):
+        got = restored.get_device_state(t)
+        assert got["event_counts"] == final[t]["event_counts"], t
+        # replayed no-eventDate events re-stamp at ingest time; values match
+        assert {k: v["value"] for k, v in got["measurements"].items()} == \
+            {k: v["value"] for k, v in final[t]["measurements"].items()}, t
+    assert restored.get_device_state("wx-1")["recent_locations"][0]["latitude"] == 3.0
+    # the recovered engine logs new traffic into the SAME wal
+    assert restored.wal is not None
+    restored.ingest_json_batch([jrow(99)])
+    restored.flush()
+    assert restored.get_device_state("wx-1")["measurements"]["a"]["value"] == 99.0

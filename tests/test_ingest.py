@@ -648,3 +648,37 @@ def test_update_device_parent_lockstep():
     assert "parentToken" not in eng.get_device("leaf").metadata
     assert int(eng.state.registry.device_parent[did]) == NULL_ID
     assert NestedDeviceSupport(eng).resolve_target_token("leaf") == "leaf"
+
+
+def test_binary_roundtrip_register_and_ack_fidelity():
+    """Registration extras and ACK linkage survive the binary wire (WAL
+    replay fidelity)."""
+    from sitewhere_tpu.ingest.decoders import (
+        BinaryEventDecoder,
+        encode_binary_request,
+    )
+
+    reg = DecodedRequest(
+        type=RequestType.REGISTER_DEVICE, device_token="fid-1",
+        extras={"deviceTypeToken": "meter", "areaToken": "plant"})
+    (back,) = BinaryEventDecoder().decode(encode_binary_request(reg), {})
+    assert back.extras == {"deviceTypeToken": "meter", "areaToken": "plant"}
+
+    ack = DecodedRequest(
+        type=RequestType.ACKNOWLEDGE, device_token="fid-1",
+        originating_event_id="inv-77", response="done")
+    (back,) = BinaryEventDecoder().decode(encode_binary_request(ack), {})
+    assert back.originating_event_id == "inv-77"
+    assert back.response == "done"
+
+    # bulk binary ACKs keep their linkage end to end (slow-path routing)
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    eng.register_device("fid-1")
+    res = eng.ingest_binary_batch([encode_binary_request(ack)])
+    assert res["decoded"] == 1 and res["failed"] == 0
+    eng.flush()
+    evs = eng.query_events(device_token="fid-1", limit=10)["events"]
+    resp = [e for e in evs if e["type"] == "COMMAND_RESPONSE"]
+    assert len(resp) == 1 and resp[0]["originatingEventId"] == "inv-77"
