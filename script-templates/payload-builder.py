@@ -1,0 +1,17 @@
+"""Scripted HTTP payload builder template.
+
+Binding contract (reference: script-templates/payload-builder/*.groovy,
+used by the HTTP outbound connector): define ``payload(event)`` returning
+the bytes to POST for one outbound event.
+"""
+
+import json
+
+
+def payload(event):
+    return json.dumps({
+        "device": event.device_token,
+        "type": event.etype.name,
+        "measurements": event.measurements,
+        "ts": event.ts_ms,
+    }).encode()

@@ -1,0 +1,10 @@
+"""Scripted HTTP URI builder template.
+
+Binding contract (reference: script-templates/uri-builder/*.groovy, used
+by the HTTP outbound connector): define ``uri(event)`` returning the
+target URL for one outbound event.
+"""
+
+
+def uri(event):
+    return f"https://example.invalid/ingest/{event.device_token}"

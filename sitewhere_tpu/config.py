@@ -213,7 +213,18 @@ def build_connector(spec: dict, engine):
                                  "topic", "sitewhere/outbound/{token}"),
                              qos=cfg.get("qos", 0), filters=filters)
     if ctype == "http":
-        return HttpConnector(cid, cfg["uri"], headers=cfg.get("headers"),
+        uri = cfg["uri"]
+        payload_builder = None
+        if isinstance(uri, dict):       # scripted uri-builder template
+            from sitewhere_tpu.utils.scripting import script_handle
+
+            uri = script_handle(uri, "uri")
+        if "payloadBuilder" in cfg:     # scripted payload-builder template
+            from sitewhere_tpu.utils.scripting import script_handle
+
+            payload_builder = script_handle(cfg["payloadBuilder"], "payload")
+        return HttpConnector(cid, uri, payload_builder=payload_builder,
+                             headers=cfg.get("headers"),
                              method=cfg.get("method", "POST"), filters=filters)
     if ctype == "scripted":
         from sitewhere_tpu.connectors.impl import ScriptedConnector

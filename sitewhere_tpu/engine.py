@@ -20,6 +20,7 @@ single-writer semantics the reference gets from Kafka partition ordering.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import threading
@@ -532,12 +533,16 @@ class Engine:
         string metadata the hot path doesn't extract)."""
         from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
 
-        with self.lock:
-            self._wal_append(WAL_JSON, payloads, tenant)
-            if self._native_decoder is None:
+        if self._native_decoder is None:
+            with self.lock:
+                self._wal_append(WAL_JSON, payloads, tenant)
                 return self._ingest_python_fallback(
                     payloads, tenant, JsonDeviceRequestDecoder())
-            res = self._native_decoder.decode(payloads)
+        # decode OUTSIDE the lock (concurrent receivers decode in parallel);
+        # log + stage atomically so a snapshot watermark can't split them
+        res = self._native_decoder.decode(payloads)
+        with self.lock:
+            self._wal_append(WAL_JSON, payloads, tenant)
             return self._ingest_decoded(res, payloads, tenant,
                                         JsonDeviceRequestDecoder())
 
@@ -547,12 +552,14 @@ class Engine:
         slot): one native C call decodes the whole batch."""
         from sitewhere_tpu.ingest.decoders import BinaryEventDecoder
 
-        with self.lock:
-            self._wal_append(WAL_BINARY, payloads, tenant)
-            if self._native_decoder is None:
+        if self._native_decoder is None:
+            with self.lock:
+                self._wal_append(WAL_BINARY, payloads, tenant)
                 return self._ingest_python_fallback(
                     payloads, tenant, BinaryEventDecoder())
-            res = self._native_decoder.decode_binary(payloads)
+        res = self._native_decoder.decode_binary(payloads)
+        with self.lock:
+            self._wal_append(WAL_BINARY, payloads, tenant)
             return self._ingest_decoded(res, payloads, tenant,
                                         BinaryEventDecoder())
 
@@ -567,21 +574,19 @@ class Engine:
         head = tag + tenant.encode() + b"\x00"
         for p in payloads:
             self.wal.append(head + p)
+        # push to the OS now: an accepted event must survive a process
+        # crash (fsync cadence stays the operator's sync() call)
+        self.wal.flush()
 
+    @contextlib.contextmanager
     def _wal_suppress(self):
-        """Context manager: suppress WAL logging for nested process() calls
-        on THIS thread (their raw batch is already logged)."""
-        import contextlib
-
-        @contextlib.contextmanager
-        def ctx():
-            self._wal_local.depth = getattr(self._wal_local, "depth", 0) + 1
-            try:
-                yield
-            finally:
-                self._wal_local.depth -= 1
-
-        return ctx()
+        """Suppress WAL logging for nested process() calls on THIS thread
+        (their raw batch is already logged)."""
+        self._wal_local.depth = getattr(self._wal_local, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            self._wal_local.depth -= 1
 
     def _ingest_python_fallback(self, payloads, tenant, dec) -> dict:
         failed = 0

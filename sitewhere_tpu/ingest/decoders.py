@@ -212,8 +212,10 @@ def split_json_array(raw: bytes) -> list[bytes]:
 # zero-copy batch packing:
 #   u8 version=1 | u8 type | u16 token_len | token utf8 | i64 ts_ms |
 #   u16 n_pairs | n_pairs * (u16 name_len | name | f64 value)      (measurement)
-#   f64 lat | f64 lon | f64 elev                                    (location)
+#   f64 lat | f64 lon | f64 elev  (NaN = absent coordinate)         (location)
 #   u16 type_len | type | u8 level | u16 msg_len | msg              (alert)
+#   u16 n_extras | n * (u16 klen | k | u16 vlen | v)  [optional]    (register)
+#   u16 orig_len | orig | u16 resp_len | resp         [optional]    (ack)
 
 _BIN_MAGIC_VERSION = 1
 _BIN_TYPES = {
@@ -320,7 +322,9 @@ class BinaryEventDecoder:
                 (ml,) = struct.unpack_from("<H", payload, off)
                 off += 2
                 req.alert_message = payload[off: off + ml].decode() or None
-            elif rtype is RequestType.REGISTER_DEVICE:
+            elif rtype is RequestType.REGISTER_DEVICE and off < len(payload):
+                # body optional: header-only frames (older encoders) decode
+                # with empty extras
                 (n,) = struct.unpack_from("<H", payload, off)
                 off += 2
                 extras = {}
@@ -334,7 +338,7 @@ class BinaryEventDecoder:
                     extras[key] = payload[off: off + vl].decode()
                     off += vl
                 req.extras = extras
-            elif rtype is RequestType.ACKNOWLEDGE:
+            elif rtype is RequestType.ACKNOWLEDGE and off < len(payload):
                 (ol,) = struct.unpack_from("<H", payload, off)
                 off += 2
                 req.originating_event_id = (

@@ -161,12 +161,19 @@ def recover_engine(snapshot_dir: str | pathlib.Path,
     snapshot_dir = pathlib.Path(snapshot_dir)
     engine = restore_engine(snapshot_dir)
     manifest = json.loads((snapshot_dir / "manifest.json").read_text())
-    wal_dir = wal_dir or engine.config.wal_dir
-    if wal_dir is None:
+    if wal_dir is None and engine.config.wal_dir is None:
         return engine
     # never re-log records while replaying them
     live_wal, engine.wal = engine.wal, None
-    wal = live_wal if live_wal is not None else IngestLog(wal_dir)
+    if wal_dir is not None and (
+        live_wal is None
+        or pathlib.Path(wal_dir).resolve() != live_wal.dir.resolve()
+    ):
+        # an explicitly named WAL (e.g. a copy on a recovery host) wins
+        # over the config-path log the restored engine opened
+        wal = IngestLog(wal_dir)
+    else:
+        wal = live_wal
 
     run_key: tuple | None = None
     run: list[bytes] = []
@@ -192,5 +199,6 @@ def recover_engine(snapshot_dir: str | pathlib.Path,
         run.append(rec[sep + 1:])
     flush_run()
     engine.flush()
-    engine.wal = wal
+    # future traffic logs to the engine's configured WAL, not a replay copy
+    engine.wal = live_wal if live_wal is not None else wal
     return engine

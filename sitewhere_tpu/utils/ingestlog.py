@@ -69,6 +69,11 @@ class IngestLog:
             self._fh.write(body)
             self._fh.flush()
 
+    def flush(self) -> None:
+        """Push buffered records to the OS (survives a process crash)."""
+        with self._lock:
+            self._fh.flush()
+
     def sync(self) -> None:
         with self._lock:
             self._fh.flush()

@@ -430,3 +430,41 @@ def test_wired_wal_recovery_mixed_formats(tmp_path):
     restored.ingest_json_batch([jrow(99)])
     restored.flush()
     assert restored.get_device_state("wx-1")["measurements"]["a"]["value"] == 99.0
+
+
+def test_http_connector_scripted_builders(tmp_path):
+    """uri-builder / payload-builder script templates bind through config
+    (the reference's last two Groovy template families)."""
+    from sitewhere_tpu.config import build_connector
+    from sitewhere_tpu.utils.scripting import ScriptManager
+
+    # repo-shipped templates resolve
+    mgr = ScriptManager("script-templates")
+    assert {"payload-builder.py", "uri-builder.py"} <= set(mgr.list_scripts())
+
+    uri_script = tmp_path / "u.py"
+    uri_script.write_text(
+        "def uri(event):\n"
+        "    return f'http://x.invalid/{event.device_token}'\n")
+    pay_script = tmp_path / "p.py"
+    pay_script.write_text(
+        "def payload(event):\n"
+        "    return event.device_token.upper().encode()\n")
+    engine = _engine()
+    conn = build_connector({
+        "id": "h", "type": "http",
+        "configuration": {
+            "uri": {"script": str(uri_script)},
+            "payloadBuilder": {"script": str(pay_script)},
+        },
+    }, engine)
+    from sitewhere_tpu.outbound.feed import OutboundEvent
+    from sitewhere_tpu.core.types import EventType
+
+    ev = OutboundEvent(event_id=1, etype=EventType.MEASUREMENT,
+                       device_token="dv-1", device_id=0, assignment_id=0,
+                       tenant="default", area_id=-1, asset_id=-1, ts_ms=1,
+                       received_ms=1, measurements={}, values=[], aux0=-1,
+                       aux1=-1)
+    assert conn.uri(ev) == "http://x.invalid/dv-1"
+    assert conn.payload_builder(ev) == b"DV-1"
