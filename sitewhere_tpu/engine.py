@@ -57,23 +57,37 @@ WAL_JSON = b"\x01"
 WAL_BINARY = b"\x02"
 
 
+class ChannelCapacityError(ValueError):
+    """Raised in strict channel mode when distinct measurement names exceed
+    the configured channel count (the config-time remedy for lane aliasing)."""
+
+
 class ChannelMap:
     """Measurement-name -> channel-index interner (per engine).
 
     The reference stores named measurements as rows; the TPU layout is a
     fixed-width channel vector, so names map to channel lanes. Beyond
-    ``channels`` distinct names, lanes are reused modulo with a collision
-    counter (visible in metrics) — capacity is a config knob."""
+    ``channels`` distinct names the behavior is the ``strict`` knob's call:
+    strict engines raise :class:`ChannelCapacityError` (no silent merging —
+    the operator sizes ``channels`` up), lenient engines reuse lanes modulo
+    with a collision counter surfaced in engine metrics, Prometheus
+    (`swtpu_engine_channel_collisions`), and the REST metrics endpoints."""
 
-    def __init__(self, channels: int, names=None):
+    def __init__(self, channels: int, names=None, strict: bool = False):
         self.channels = channels
         self.names = names if names is not None else TokenInterner(1 << 20)
         self.collisions = 0
+        self.strict = strict
 
     def channel_of(self, name: str) -> int:
         nid = self.names.intern(name)
         if nid >= self.channels:
             self.collisions += 1
+            if self.strict:
+                raise ChannelCapacityError(
+                    f"measurement name {name!r} exceeds channel capacity "
+                    f"{self.channels}; raise EngineConfig.channels or drop "
+                    "strict_channels")
         return nid % self.channels
 
 
@@ -90,6 +104,7 @@ class EngineConfig:
     default_device_type: str = "default"
     presence_missing_s: float = 8 * 3600.0  # DevicePresenceManager default 8h
     use_native: bool = True            # C++ decode/interning data plane
+    strict_channels: bool = False      # error (vs alias) past channel capacity
     fair_tenancy: bool = False         # round-robin batch formation across
                                        # tenants (multi-tenant fairness)
     assignment_triggers: bool = False  # emit STATE_CHANGE events on
@@ -298,11 +313,12 @@ class Engine:
             except (RuntimeError, OSError):
                 self._native_decoder = None
         if self._native_decoder is not None:
-            self.channel_map = ChannelMap(c.channels, self._native_decoder.names)
+            self.channel_map = ChannelMap(c.channels, self._native_decoder.names,
+                                          strict=c.strict_channels)
             self.alert_types = self._native_decoder.alert_types
         else:
             self.tokens = TokenInterner(c.token_capacity)
-            self.channel_map = ChannelMap(c.channels)
+            self.channel_map = ChannelMap(c.channels, strict=c.strict_channels)
             self.alert_types = TokenInterner(1 << 20)
         self.tenants = TokenInterner(1 << 16)
         self.tenants.intern("default")
@@ -541,6 +557,7 @@ class Engine:
         # decode OUTSIDE the lock (concurrent receivers decode in parallel);
         # log + stage atomically so a snapshot watermark can't split them
         res = self._native_decoder.decode(payloads)
+        self._check_strict_channels(res)
         with self.lock:
             self._wal_append(WAL_JSON, payloads, tenant)
             return self._ingest_decoded(res, payloads, tenant,
@@ -558,10 +575,24 @@ class Engine:
                 return self._ingest_python_fallback(
                     payloads, tenant, BinaryEventDecoder())
         res = self._native_decoder.decode_binary(payloads)
+        self._check_strict_channels(res)
         with self.lock:
             self._wal_append(WAL_BINARY, payloads, tenant)
             return self._ingest_decoded(res, payloads, tenant,
                                         BinaryEventDecoder())
+
+    def _check_strict_channels(self, res) -> None:
+        """Strict channel mode for the native fast path: the C++ decoder has
+        already interned names (lanes assigned modulo), so any collision in
+        the batch is a configuration error — reject the whole batch BEFORE
+        the WAL/staging so no aliased lane is ever persisted."""
+        if self.config.strict_channels and res.collisions:
+            self.channel_map.collisions += res.collisions
+            raise ChannelCapacityError(
+                f"{res.collisions} measurement lane collision(s) in batch: "
+                f"distinct names exceed channel capacity "
+                f"{self.config.channels}; raise EngineConfig.channels or "
+                "drop strict_channels")
 
     def _wal_append(self, tag: bytes, payloads: list[bytes],
                     tenant: str) -> None:

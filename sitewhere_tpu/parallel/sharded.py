@@ -210,21 +210,23 @@ class ShardedEngine:
         devices = np.asarray(res.device)
         assignments = np.asarray(res.assignment)
         tenants = np.asarray(res.tenant)
-        rows = []
-        for shard in range(self.n_shards):
-            for i in range(int(ns[shard])):
-                rows.append((int(ts[shard, i]), shard, i))
-        rows.sort(key=lambda r: -r[0])
+        # vectorized k-way merge of the per-shard pages: flatten the valid
+        # (shard, slot) pairs and argsort once — no per-row Python even at
+        # scatter-gather page sizes
+        valid = np.arange(ts.shape[1])[None, :] < ns[:, None]
+        s_idx, i_idx = np.nonzero(valid)
+        order = np.argsort(-ts[s_idx, i_idx], kind="stable")[:limit]
+        sel_s, sel_i = s_idx[order], i_idx[order]
         events = [
             {
-                "shard": shard,
-                "type": EventType(int(etypes[shard, i])).name,
-                "device": int(devices[shard, i]),
-                "assignmentId": int(assignments[shard, i]),
-                "tenant": int(tenants[shard, i]),
-                "eventDateMs": t,
+                "shard": int(s),
+                "type": EventType(int(etypes[s, i])).name,
+                "device": int(devices[s, i]),
+                "assignmentId": int(assignments[s, i]),
+                "tenant": int(tenants[s, i]),
+                "eventDateMs": int(ts[s, i]),
             }
-            for t, shard, i in rows[:limit]
+            for s, i in zip(sel_s, sel_i)
         ]
         return {"total": total, "events": events}
 

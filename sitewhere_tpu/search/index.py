@@ -2,11 +2,13 @@
 
 The reference's service-event-search is a thin passthrough to a Solr core
 fed by the Solr outbound connector (SolrSearchProvider.java:45-95 — raw query
-strings in, documents out; SURVEY.md §2.8). Here the index is embedded:
-an inverted index over event fields + a store-backed TPU filter scan, with a
-Solr-ish query surface (field:value clauses, ranges, boolean AND/OR) so the
-REST parity endpoint (/events/search) behaves like the reference's raw
-provider without a sidecar JVM.
+strings in, documents out; SURVEY.md §2.8). Here the index is embedded and
+host-side: a pure in-memory inverted index over outbound event documents,
+with a Solr-ish query surface (field:value clauses, ranges, implicit AND) so
+the REST parity endpoint (/events/search) behaves like the reference's raw
+provider without a sidecar JVM. Ad-hoc filtered scans over the HBM ring
+store are the separate `ops/query.py` path; this module never touches the
+device.
 """
 
 from __future__ import annotations
@@ -39,23 +41,30 @@ class EventSearchIndex:
         doc = event.to_json_dict()
         doc_id = event.event_id
         if len(self.docs) >= self.capacity and doc_id not in self.docs:
-            # drop the oldest (smallest id) — ring semantics like the store
-            oldest = min(self.docs)
-            self._remove(oldest)
+            # drop the oldest — ring semantics like the store. Insertion
+            # order == arrival order, so the dict's first key is oldest.
+            self._remove(next(iter(self.docs)))
         self.docs[doc_id] = doc
-        for field in ("type", "deviceToken", "tenant"):
-            self.postings[(field, str(doc[field]))].add(doc_id)
-        for name in doc["measurements"]:
-            self.postings[("measurement", name)].add(doc_id)
+        for key in self._keys_of(doc):
+            self.postings[key].add(doc_id)
+
+    @staticmethod
+    def _keys_of(doc: dict) -> list[tuple[str, str]]:
+        keys = [(f, str(doc[f])) for f in ("type", "deviceToken", "tenant")]
+        keys.extend(("measurement", name) for name in doc["measurements"])
+        return keys
 
     def _remove(self, doc_id: int) -> None:
+        """Evict one document — O(keys of that doc), not O(all postings)."""
         doc = self.docs.pop(doc_id, None)
         if doc is None:
             return
-        for key, ids in list(self.postings.items()):
-            ids.discard(doc_id)
-            if not ids:
-                del self.postings[key]
+        for key in self._keys_of(doc):
+            ids = self.postings.get(key)
+            if ids is not None:
+                ids.discard(doc_id)
+                if not ids:
+                    del self.postings[key]
 
     def search(self, query: str, max_results: int = 100) -> list[dict]:
         """Solr-flavored query: ``field:value`` clauses are ANDed;

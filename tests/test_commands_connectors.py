@@ -330,3 +330,28 @@ def test_undelivered_retry_targets_failed_destination():
         assert provider.delivered  # payload reached the local sink
 
     asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_search_index_eviction_keeps_postings_consistent():
+    """Ring eviction drops the oldest doc and its posting entries in
+    O(doc keys) — stale ids never match queries."""
+    from sitewhere_tpu.core.types import EventType
+    from sitewhere_tpu.outbound.feed import OutboundEvent
+
+    idx = EventSearchIndex(capacity=4)
+
+    def ev(i):
+        return OutboundEvent(
+            event_id=i, etype=EventType.MEASUREMENT,
+            device_token=f"d-{i % 2}", device_id=i % 2, assignment_id=i,
+            tenant="default", area_id=-1, asset_id=-1, ts_ms=i,
+            received_ms=i, measurements={f"m{i}": 1.0}, values=[],
+            aux0=-1, aux1=-1)
+
+    for i in range(6):
+        idx.add(ev(i))
+    assert sorted(idx.docs) == [2, 3, 4, 5]
+    assert idx.search("measurement:m0") == []
+    assert idx.search("measurement:m1") == []
+    assert ("measurement", "m0") not in idx.postings
+    assert [d["eventId"] for d in idx.search("deviceToken:d-0")] == [4, 2]

@@ -682,3 +682,65 @@ def test_binary_roundtrip_register_and_ack_fidelity():
     evs = eng.query_events(device_token="fid-1", limit=10)["events"]
     resp = [e for e in evs if e["type"] == "COMMAND_RESPONSE"]
     assert len(resp) == 1 and resp[0]["originatingEventId"] == "inv-77"
+
+
+def test_strict_channels_python_path():
+    """Strict channel mode: distinct measurement names beyond ``channels``
+    raise (no silent lane aliasing) on the per-request path."""
+    import pytest
+
+    from sitewhere_tpu.engine import ChannelCapacityError
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=2,
+        strict_channels=True, use_native=False))
+    eng.process(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT, device_token="sc-1",
+        measurements={"a": 1.0, "b": 2.0}))
+    with pytest.raises(ChannelCapacityError):
+        eng.process(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token="sc-1",
+            measurements={"c": 3.0}))
+    assert eng.metrics()["channel_collisions"] == 1
+
+
+def test_strict_channels_native_batch_rejected():
+    """Strict mode on the native fast path rejects the whole batch before
+    WAL/staging when the decode interned a name past capacity."""
+    import pytest
+
+    from sitewhere_tpu.engine import ChannelCapacityError
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=2,
+        strict_channels=True))
+    if eng._native_decoder is None:
+        pytest.skip("native library unavailable")
+    ok = eng.ingest_json_batch([measurement_json("sc-n", name="a"),
+                                measurement_json("sc-n", name="b")])
+    assert ok["failed"] == 0
+    with pytest.raises(ChannelCapacityError):
+        eng.ingest_json_batch([measurement_json("sc-n", name="c")])
+    assert eng.staged_count == 2  # rejected batch staged nothing
+
+
+def test_lenient_channels_roundtrip_within_capacity():
+    """With channels sized to the name population, every distinct name keeps
+    its own lane and round-trips through query_events."""
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=8))
+    names = [f"lane{i}" for i in range(8)]
+    for i, n in enumerate(names):
+        eng.process(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token="rt-1",
+            measurements={n: float(i)}))
+    eng.flush()
+    assert eng.channel_map.collisions == 0
+    evs = eng.query_events(device_token="rt-1", limit=20)["events"]
+    seen = {}
+    for e in evs:
+        seen.update(e.get("measurements", {}))
+    assert seen == {n: float(i) for i, n in enumerate(names)}
