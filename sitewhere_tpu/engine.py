@@ -276,6 +276,22 @@ def _admin_add_assignment(state: PipelineState, device_id, assignment_id, slot,
 
 
 @jax.jit
+def _admin_update_assignment(state: PipelineState, assignment_id, asset_id,
+                             area_id, customer_id):
+    """Update the hot assignment columns (REST PUT path; reference:
+    RdbDeviceManagement.updateDeviceAssignment via Assignments.java:144)."""
+    reg = state.registry
+    return dataclasses.replace(
+        state, registry=dataclasses.replace(
+            reg,
+            assignment_asset=reg.assignment_asset.at[assignment_id].set(asset_id),
+            assignment_area=reg.assignment_area.at[assignment_id].set(area_id),
+            assignment_customer=reg.assignment_customer.at[assignment_id].set(customer_id),
+        )
+    )
+
+
+@jax.jit
 def _admin_set_assignment_status(state: PipelineState, assignment_id, status, active):
     """Update assignment status; when deactivated (release), also detach it
     from its device's slot row so event expansion stops targeting it."""
@@ -380,6 +396,12 @@ class Engine:
     def process(self, req: DecodedRequest) -> None:
         """Stage one decoded request; flushes when the batch fills."""
         with self.lock:
+            if self.channel_map.strict and req.measurements:
+                # strict mode must reject BEFORE the WAL append so a refused
+                # event is never durable (recovery would otherwise replay a
+                # record the client saw rejected)
+                for name in req.measurements:
+                    self.channel_map.channel_of(name)
             if self.wal is not None:
                 # per-request path (protocol receivers): log the request in
                 # the binary wire form when it carries one; unsupported
@@ -551,6 +573,7 @@ class Engine:
 
         if self._native_decoder is None:
             with self.lock:
+                self._validate_strict_batch(payloads, JsonDeviceRequestDecoder())
                 self._wal_append(WAL_JSON, payloads, tenant)
                 return self._ingest_python_fallback(
                     payloads, tenant, JsonDeviceRequestDecoder())
@@ -571,6 +594,7 @@ class Engine:
 
         if self._native_decoder is None:
             with self.lock:
+                self._validate_strict_batch(payloads, BinaryEventDecoder())
                 self._wal_append(WAL_BINARY, payloads, tenant)
                 return self._ingest_python_fallback(
                     payloads, tenant, BinaryEventDecoder())
@@ -581,13 +605,31 @@ class Engine:
             return self._ingest_decoded(res, payloads, tenant,
                                         BinaryEventDecoder())
 
+    def _validate_strict_batch(self, payloads, dec) -> None:
+        """Strict pre-check for the Python-fallback batch paths: intern every
+        measurement name BEFORE the WAL append so a refused batch is never
+        durable (mirrors _check_strict_channels on the native path). Decode
+        failures are ignored here — they surface as `failed` counts on the
+        real pass. Caller holds the lock."""
+        if not self.channel_map.strict:
+            return
+        for p in payloads:
+            try:
+                reqs = dec.decode(p, {})
+            except Exception:
+                continue
+            for req in reqs:
+                for name in req.measurements or ():
+                    self.channel_map.channel_of(name)
+
     def _check_strict_channels(self, res) -> None:
         """Strict channel mode for the native fast path: the C++ decoder has
         already interned names (lanes assigned modulo), so any collision in
         the batch is a configuration error — reject the whole batch BEFORE
         the WAL/staging so no aliased lane is ever persisted."""
         if self.config.strict_channels and res.collisions:
-            self.channel_map.collisions += res.collisions
+            with self.lock:   # counter shared with concurrent ingest threads
+                self.channel_map.collisions += res.collisions
             raise ChannelCapacityError(
                 f"{res.collisions} measurement lane collision(s) in batch: "
                 f"distinct names exceed channel capacity "
@@ -627,6 +669,11 @@ class Engine:
                     for req in dec.decode(p, {}):
                         req.tenant = tenant
                         self.process(req)
+                except ChannelCapacityError:
+                    # config error, not a payload error — the strict contract
+                    # must not be swallowed into the failed-decode count
+                    # (pre-validation makes this unreachable, kept as a net)
+                    raise
                 except Exception:
                     failed += 1
         return {"decoded": len(payloads) - failed, "failed": failed}
@@ -1033,14 +1080,65 @@ class Engine:
         return self.assignments.get(aid) if aid is not None else None
 
     def list_assignments(self, device_token: str | None = None,
-                         status: str | None = None) -> list[AssignmentInfo]:
+                         status: str | None = None,
+                         area: str | None = None,
+                         asset: str | None = None,
+                         customer: str | None = None) -> list[AssignmentInfo]:
         with self.lock:
             out = [
                 a for a in self.assignments.values()
                 if (device_token is None or a.device_token == device_token)
                 and (status is None or a.status == status)
+                and (area is None or a.area == area)
+                and (asset is None or a.asset == asset)
+                and (customer is None or a.customer == customer)
             ]
             return sorted(out, key=lambda a: a.id)
+
+    def update_assignment(self, token: str, asset: str | None = None,
+                          area: str | None = None,
+                          customer: str | None = None,
+                          metadata: dict | None = None) -> AssignmentInfo:
+        """Update an assignment's association columns + host metadata
+        (reference: Assignments.java:144 PUT -> updateDeviceAssignment)."""
+        with self.lock:
+            self._sync_mirrors()
+            aid = self.assignment_tokens.get(token)
+            if aid is None:
+                raise KeyError(f"assignment {token!r} not found")
+            info = self.assignments[aid]
+            new_asset = asset if asset is not None else info.asset
+            new_area = area if area is not None else info.area
+            new_customer = customer if customer is not None else info.customer
+            # intern before mutating so a capacity error never half-applies
+            asset_id = jnp.int32(
+                self.assets.intern(new_asset) if new_asset else NULL_ID)
+            area_id = jnp.int32(
+                self.areas.intern(new_area) if new_area else NULL_ID)
+            customer_id = jnp.int32(
+                self.customers.intern(new_customer) if new_customer else NULL_ID)
+            self.state = _admin_update_assignment(
+                self.state, jnp.int32(aid), asset_id, area_id, customer_id)
+            info.asset, info.area, info.customer = new_asset, new_area, new_customer
+            if metadata is not None:
+                info.metadata = metadata
+            return info
+
+    def delete_assignment(self, token: str) -> bool:
+        """Delete an assignment (reference: Assignments.java DELETE ->
+        deleteDeviceAssignment): detach it on-device (release semantics) and
+        drop the host record. Persisted events that referenced the id stay
+        in the ring — like the reference, deletes don't rewrite history."""
+        with self.lock:
+            self._sync_mirrors()
+            aid = self.assignment_tokens.get(token)
+            if aid is None:
+                return False
+            if self.assignments[aid].status != "RELEASED":
+                self._set_assignment_status(token, DeviceAssignmentStatus.RELEASED)
+            del self.assignments[aid]
+            del self.assignment_tokens[token]
+            return True
 
     def _set_assignment_status(self, token: str,
                                status: DeviceAssignmentStatus) -> AssignmentInfo:

@@ -40,7 +40,11 @@ class EventSearchIndex:
     def add(self, event: OutboundEvent) -> None:
         doc = event.to_json_dict()
         doc_id = event.event_id
-        if len(self.docs) >= self.capacity and doc_id not in self.docs:
+        if doc_id in self.docs:
+            # re-delivered id (at-least-once feed): drop the old version's
+            # postings first so no stale key survives its doc
+            self._remove(doc_id)
+        elif len(self.docs) >= self.capacity:
             # drop the oldest — ring semantics like the store. Insertion
             # order == arrival order, so the dict's first key is oldest.
             self._remove(next(iter(self.docs)))

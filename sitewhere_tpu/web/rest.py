@@ -48,6 +48,17 @@ def json_response(data=None, *, status: int = 200, headers=None) -> web.Response
 PUBLIC_PATHS = ("/api/authapi/jwt", "/api/instance/health")
 
 
+def _sync(fn):
+    """Wrap a sync route function as a coroutine handler (aiohttp deprecates
+    bare-function handlers)."""
+
+    async def handler(request: web.Request) -> web.Response:
+        return fn(request)
+
+    return handler
+
+
+
 def _meta_dict(meta) -> dict:
     return {"token": meta.token, "id": meta.id, "createdDateMs": meta.created_ms,
             "updatedDateMs": meta.updated_ms, "metadata": meta.metadata}
@@ -152,12 +163,12 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
                                  headers={"X-Sitewhere-JWT": token})
 
     r.add_get("/api/authapi/jwt", get_jwt)
-    r.add_get("/api/instance/health", lambda req: json_response({"status": "UP"}))
+    r.add_get("/api/instance/health", _sync(lambda req: json_response({"status": "UP"})))
 
     # --- instance ---------------------------------------------------------
-    r.add_get("/api/instance", lambda req: json_response(inst.info()))
+    r.add_get("/api/instance", _sync(lambda req: json_response(inst.info())))
     r.add_get("/api/instance/metrics",
-              lambda req: json_response(inst.engine.metrics()))
+              _sync(lambda req: json_response(inst.engine.metrics())))
 
     async def prometheus_metrics(request: web.Request):
         from sitewhere_tpu.utils.metrics import REGISTRY, export_engine_metrics
@@ -268,10 +279,10 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(_entity(dt), status=201)
 
     r.add_post("/api/devicetypes", create_device_type)
-    r.add_get("/api/devicetypes", lambda req: json_response(
-        _paged(inst.device_management.device_types.list())))
-    r.add_get("/api/devicetypes/{token}", lambda req: json_response(
-        _entity(inst.device_management.device_types.get(req.match_info["token"]))))
+    r.add_get("/api/devicetypes", _sync(lambda req: json_response(
+        _paged(inst.device_management.device_types.list()))))
+    r.add_get("/api/devicetypes/{token}", _sync(lambda req: json_response(
+        _entity(inst.device_management.device_types.get(req.match_info["token"])))))
 
     async def create_status(request: web.Request):
         body = await request.json()
@@ -281,9 +292,9 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(_entity(st), status=201)
 
     r.add_post("/api/devicetypes/{token}/statuses", create_status)
-    r.add_get("/api/devicetypes/{token}/statuses", lambda req: json_response(
+    r.add_get("/api/devicetypes/{token}/statuses", _sync(lambda req: json_response(
         [_entity(s) for s in
-         inst.device_management.statuses_for_type(req.match_info["token"])]))
+         inst.device_management.statuses_for_type(req.match_info["token"])])))
 
     async def create_command(request: web.Request):
         body = await request.json()
@@ -301,9 +312,9 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(dataclasses.asdict(cmd), status=201)
 
     r.add_post("/api/devicetypes/{token}/commands", create_command)
-    r.add_get("/api/devicetypes/{token}/commands", lambda req: json_response(
+    r.add_get("/api/devicetypes/{token}/commands", _sync(lambda req: json_response(
         [dataclasses.asdict(c) for c in
-         inst.command_registry.list_for_type(req.match_info["token"])]))
+         inst.command_registry.list_for_type(req.match_info["token"])])))
 
     async def create_alarm(request: web.Request):
         body = await request.json()
@@ -324,9 +335,9 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(_entity(alarm, state=alarm.state.value))
 
     r.add_post("/api/devices/{token}/alarms", create_alarm)
-    r.add_get("/api/devices/{token}/alarms", lambda req: json_response(
+    r.add_get("/api/devices/{token}/alarms", _sync(lambda req: json_response(
         [_entity(a, state=a.state.value) for a in
-         inst.device_management.alarms_for_device(req.match_info["token"])]))
+         inst.device_management.alarms_for_device(req.match_info["token"])])))
     r.add_post("/api/alarms/{token}/{action}", alarm_transition)
 
     # --- command invocation ----------------------------------------------
@@ -346,10 +357,10 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         }, status=201)
 
     r.add_post("/api/devices/{token}/invocations", invoke_command)
-    r.add_get("/api/commands/undelivered", lambda req: json_response(
+    r.add_get("/api/commands/undelivered", _sync(lambda req: json_response(
         [{"invocationId": u.invocation.invocation_id,
           "destination": u.destination_id, "error": u.error}
-         for u in inst.commands.undelivered]))
+         for u in inst.commands.undelivered])))
 
     async def retry_undelivered(request: web.Request):
         return json_response(await inst.commands.retry_undelivered())
@@ -368,8 +379,8 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         })
 
     r.add_get("/api/invocations/{id}", get_invocation)
-    r.add_get("/api/invocations/{id}/responses", lambda req: json_response(
-        inst.commands.responses_for(int(req.match_info["id"]))))
+    r.add_get("/api/invocations/{id}/responses", _sync(lambda req: json_response(
+        inst.commands.responses_for(int(req.match_info["id"])))))
 
     # --- assignments ------------------------------------------------------
     def _assignment_json(a) -> dict:
@@ -423,17 +434,43 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         )
         return json_response(res)
 
+    async def update_assignment(request: web.Request):
+        """Update assignment associations/metadata (reference:
+        Assignments.java:144 PUT /assignments/{token})."""
+        body = await request.json()
+        try:
+            a = inst.engine.update_assignment(
+                request.match_info["token"],
+                asset=body.get("assetToken"), area=body.get("areaToken"),
+                customer=body.get("customerToken"),
+                metadata=body.get("metadata"),
+            )
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return json_response(_assignment_json(a))
+
+    async def delete_assignment(request: web.Request):
+        """Delete an assignment (reference: Assignments.java:262 DELETE)."""
+        if not inst.engine.delete_assignment(request.match_info["token"]):
+            raise EntityNotFound("assignment")
+        return json_response({"deleted": True})
+
     r.add_post("/api/assignments", create_assignment)
-    r.add_get("/api/assignments", lambda req: json_response(
+    r.add_get("/api/assignments", _sync(lambda req: json_response(
         [_assignment_json(a) for a in inst.engine.list_assignments(
             device_token=req.query.get("deviceToken"),
-            status=req.query.get("status"))]))
+            status=req.query.get("status"),
+            area=req.query.get("areaToken"),
+            asset=req.query.get("assetToken"),
+            customer=req.query.get("customerToken"))])))
     r.add_get("/api/assignments/{token}", get_assignment)
+    r.add_put("/api/assignments/{token}", update_assignment)
+    r.add_delete("/api/assignments/{token}", delete_assignment)
     r.add_post("/api/assignments/{token}/{action}", assignment_transition)
     r.add_get("/api/assignments/{token}/events", assignment_events)
-    r.add_get("/api/devices/{token}/assignments", lambda req: json_response(
+    r.add_get("/api/devices/{token}/assignments", _sync(lambda req: json_response(
         [_assignment_json(a) for a in inst.engine.list_assignments(
-            device_token=req.match_info["token"])]))
+            device_token=req.match_info["token"])])))
 
     # --- areas / customers / zones / groups -------------------------------
     async def create_area_type(request: web.Request):
@@ -460,15 +497,15 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         ]
 
     r.add_post("/api/areatypes", create_area_type)
-    r.add_get("/api/areatypes", lambda req: json_response(
-        _paged(inst.device_management.area_types.list())))
+    r.add_get("/api/areatypes", _sync(lambda req: json_response(
+        _paged(inst.device_management.area_types.list()))))
     r.add_post("/api/areas", create_area)
-    r.add_get("/api/areas", lambda req: json_response(
-        _paged(inst.device_management.areas.list())))
-    r.add_get("/api/areas/tree", lambda req: json_response(
-        _tree_json(inst.device_management.area_tree())))
-    r.add_get("/api/areas/{token}", lambda req: json_response(
-        _entity(inst.device_management.areas.get(req.match_info["token"]))))
+    r.add_get("/api/areas", _sync(lambda req: json_response(
+        _paged(inst.device_management.areas.list()))))
+    r.add_get("/api/areas/tree", _sync(lambda req: json_response(
+        _tree_json(inst.device_management.area_tree()))))
+    r.add_get("/api/areas/{token}", _sync(lambda req: json_response(
+        _entity(inst.device_management.areas.get(req.match_info["token"])))))
 
     async def create_zone(request: web.Request):
         body = await request.json()
@@ -479,11 +516,11 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(_entity(zone), status=201)
 
     r.add_post("/api/zones", create_zone)
-    r.add_get("/api/zones", lambda req: json_response(
-        _paged(inst.device_management.zones.list())))
-    r.add_get("/api/areas/{token}/zones", lambda req: json_response(
+    r.add_get("/api/zones", _sync(lambda req: json_response(
+        _paged(inst.device_management.zones.list()))))
+    r.add_get("/api/areas/{token}/zones", _sync(lambda req: json_response(
         [_entity(z) for z in
-         inst.device_management.zones_for_area(req.match_info["token"])]))
+         inst.device_management.zones_for_area(req.match_info["token"])])))
 
     async def zone_contains(request: web.Request):
         """On-device point-in-polygon test for one zone."""
@@ -518,10 +555,10 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_post("/api/customertypes", create_customer_type)
     r.add_post("/api/customers", create_customer)
-    r.add_get("/api/customers", lambda req: json_response(
-        _paged(inst.device_management.customers.list())))
-    r.add_get("/api/customers/tree", lambda req: json_response(
-        _tree_json(inst.device_management.customer_tree())))
+    r.add_get("/api/customers", _sync(lambda req: json_response(
+        _paged(inst.device_management.customers.list()))))
+    r.add_get("/api/customers/tree", _sync(lambda req: json_response(
+        _tree_json(inst.device_management.customer_tree()))))
 
     async def create_group(request: web.Request):
         body = await request.json()
@@ -538,16 +575,16 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response([dataclasses.asdict(e) for e in els], status=201)
 
     r.add_post("/api/devicegroups", create_group)
-    r.add_get("/api/devicegroups", lambda req: json_response(
-        _paged(inst.device_management.groups.list())))
+    r.add_get("/api/devicegroups", _sync(lambda req: json_response(
+        _paged(inst.device_management.groups.list()))))
     r.add_post("/api/devicegroups/{token}/elements", add_group_elements)
-    r.add_get("/api/devicegroups/{token}/elements", lambda req: json_response(
+    r.add_get("/api/devicegroups/{token}/elements", _sync(lambda req: json_response(
         [dataclasses.asdict(e) for e in
-         inst.device_management.group_elements(req.match_info["token"])]))
-    r.add_get("/api/devicegroups/{token}/devices", lambda req: json_response(
+         inst.device_management.group_elements(req.match_info["token"])])))
+    r.add_get("/api/devicegroups/{token}/devices", _sync(lambda req: json_response(
         inst.device_management.expand_group_devices(
             req.match_info["token"],
-            roles=req.query.getall("role", None))))
+            roles=req.query.getall("role", None)))))
 
     # --- assets -----------------------------------------------------------
     async def create_asset_type(request: web.Request):
@@ -563,9 +600,9 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_post("/api/assettypes", create_asset_type)
     r.add_post("/api/assets", create_asset)
-    r.add_get("/api/assets", lambda req: json_response(
+    r.add_get("/api/assets", _sync(lambda req: json_response(
         _paged(inst.assets.list_assets(
-            asset_type=req.query.get("assetType")))))
+            asset_type=req.query.get("assetType"))))))
 
     # --- batch ------------------------------------------------------------
     async def create_batch(request: web.Request):
@@ -585,17 +622,78 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
             status=201,
         )
 
+    async def list_batch_elements(request: web.Request):
+        """Paged element listing for one batch operation (reference:
+        BatchOperations.java:139 GET /batch/{operationToken}/elements)."""
+        op = inst.batch.operations.get(request.match_info["token"])
+        q = request.query
+        els = op.elements
+        if "status" in q:
+            els = [e for e in els if e.status.name == q["status"].upper()]
+        page = int(q.get("page", 1))
+        size = int(q.get("pageSize", 100))
+        lo = (page - 1) * size
+        return json_response({
+            "numResults": len(els), "page": page, "pageSize": size,
+            "results": [dataclasses.asdict(e) | {"status": e.status.name}
+                        for e in els[lo:lo + size]],
+        })
+
+    async def _run_batch_for(devices: list[str], body: dict) -> web.Response:
+        import uuid
+
+        if not devices:
+            raise ValueError("criteria matched no devices")
+        token = body.get("token") or f"batch-{uuid.uuid4().hex[:12]}"
+        inst.batch.create_operation(
+            token, "InvokeCommand", devices,
+            {"commandToken": body["commandToken"],
+             "parameterValues": body.get("parameterValues", {})},
+        )
+        op = await inst.batch.process_operation(token)
+        return json_response(
+            {"token": op.meta.token, "status": op.status,
+             "counts": op.counts()}, status=201)
+
+    async def batch_command_by_device_criteria(request: web.Request):
+        """Invoke a command on every device matching criteria (reference:
+        BatchOperations.java:188 POST /batch/command/criteria/device)."""
+        body = await request.json()
+        devices = [s.token for s in inst.device_management.list_devices(
+            page_size=1_000_000,
+            device_type=body.get("deviceTypeToken"),
+            tenant=body.get("tenant"),
+        ).results]
+        return await _run_batch_for(devices, body)
+
+    async def batch_command_by_assignment_criteria(request: web.Request):
+        """Invoke a command per assignment matching criteria (reference:
+        BatchOperations.java:224 POST /batch/command/criteria/assignment)."""
+        body = await request.json()
+        assignments = inst.engine.list_assignments(
+            status=body.get("status", "ACTIVE"),
+            area=body.get("areaToken"), asset=body.get("assetToken"),
+            customer=body.get("customerToken"))
+        # one element per assignment's device, deduped in arrival order
+        devices = list(dict.fromkeys(a.device_token for a in assignments))
+        return await _run_batch_for(devices, body)
+
     r.add_post("/api/batch/command", create_batch)
-    r.add_get("/api/batch", lambda req: json_response(_paged(
+    r.add_post("/api/batch/command/criteria/device",
+               batch_command_by_device_criteria)
+    r.add_post("/api/batch/command/criteria/assignment",
+               batch_command_by_assignment_criteria)
+    r.add_get("/api/batch", _sync(lambda req: json_response(_paged(
         inst.batch.operations.list(
             page=int(req.query.get("page", 1)),
-            page_size=int(req.query.get("pageSize", 100))))))
-    r.add_get("/api/batch/{token}", lambda req: json_response((lambda op: {
+            page_size=int(req.query.get("pageSize", 100)))))))
+    r.add_get("/api/batch/{token}", _sync(lambda req: json_response((lambda op: {
         "token": op.meta.token, "status": op.status,
         "operationType": op.operation_type, "counts": op.counts(),
         "elements": [dataclasses.asdict(e) | {"status": e.status.name}
                      for e in op.elements],
-    })(inst.batch.operations.get(req.match_info["token"]))))
+    })(inst.batch.operations.get(req.match_info["token"])))))
+    r.add_get("/api/batch/{token}/elements", list_batch_elements)
 
     # --- schedules --------------------------------------------------------
     async def create_schedule(request: web.Request):
@@ -616,11 +714,11 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(_entity(j), status=201)
 
     r.add_post("/api/schedules", create_schedule)
-    r.add_get("/api/schedules", lambda req: json_response(
-        _paged(inst.scheduler.schedules.list())))
+    r.add_get("/api/schedules", _sync(lambda req: json_response(
+        _paged(inst.scheduler.schedules.list()))))
     r.add_post("/api/jobs", create_job)
-    r.add_get("/api/jobs", lambda req: json_response(
-        _paged(inst.scheduler.jobs.list())))
+    r.add_get("/api/jobs", _sync(lambda req: json_response(
+        _paged(inst.scheduler.jobs.list()))))
 
     # --- labels -----------------------------------------------------------
     async def get_label(request: web.Request):
@@ -648,8 +746,8 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response({"numResults": len(docs), "results": docs})
 
     r.add_get("/api/search/events", search_events)
-    r.add_get("/api/search/providers", lambda req: json_response(
-        [dataclasses.asdict(p) for p in inst.search.list_providers()]))
+    r.add_get("/api/search/providers", _sync(lambda req: json_response(
+        [dataclasses.asdict(p) for p in inst.search.list_providers()])))
 
     # --- streams ----------------------------------------------------------
     async def create_stream(request: web.Request):
@@ -688,10 +786,10 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(_entity(t), status=201)
 
     r.add_post("/api/tenants", create_tenant)
-    r.add_get("/api/tenants", lambda req: json_response(
-        _paged(inst.tenants.tenants.list())))
-    r.add_get("/api/tenants/{token}", lambda req: json_response(
-        _entity(inst.tenants.tenants.get(req.match_info["token"]))))
+    r.add_get("/api/tenants", _sync(lambda req: json_response(
+        _paged(inst.tenants.tenants.list()))))
+    r.add_get("/api/tenants/{token}", _sync(lambda req: json_response(
+        _entity(inst.tenants.tenants.get(req.match_info["token"])))))
 
     # --- users ------------------------------------------------------------
     async def create_user(request: web.Request):
@@ -707,11 +805,11 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
             {"username": u.username, "roles": u.roles}, status=201)
 
     r.add_post("/api/users", create_user)
-    r.add_get("/api/users", lambda req: json_response(
+    r.add_get("/api/users", _sync(lambda req: json_response(
         [{"username": u.username, "roles": u.roles, "enabled": u.enabled}
-         for u in inst.users.users.values()]))
-    r.add_get("/api/users/{username}/authorities", lambda req: json_response(
-        inst.users.authorities_for(inst.users.users[req.match_info["username"]])))
+         for u in inst.users.users.values()])))
+    r.add_get("/api/users/{username}/authorities", _sync(lambda req: json_response(
+        inst.users.authorities_for(inst.users.users[req.match_info["username"]]))))
 
     def _user_json(u) -> dict:
         return {"username": u.username, "roles": u.roles, "enabled": u.enabled,
@@ -753,12 +851,12 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         inst.users.create_role(body["role"], body.get("authorities", []))
         return json_response({"role": body["role"]}, status=201)
 
-    r.add_get("/api/roles", lambda req: json_response(
+    r.add_get("/api/roles", _sync(lambda req: json_response(
         [{"role": name, "authorities": auths}
-         for name, auths in inst.users.roles.items()]))
+         for name, auths in inst.users.roles.items()])))
     r.add_post("/api/roles", create_role)
-    r.add_get("/api/authorities", lambda req: json_response(
-        sorted({a for auths in inst.users.roles.values() for a in auths})))
+    r.add_get("/api/authorities", _sync(lambda req: json_response(
+        sorted({a for auths in inst.users.roles.values() for a in auths}))))
 
     # --- analytics (service-tpu-analytics surface) ------------------------
     def _analytics():
@@ -959,14 +1057,14 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         r.add_delete(path, _store_delete(store))
     # GET-by-token for families that lacked it
     r.add_get("/api/areatypes/{token}", _store_get(dm.area_types))
-    r.add_get("/api/customertypes", lambda req: json_response(
-        _paged(dm.customer_types.list())))
+    r.add_get("/api/customertypes", _sync(lambda req: json_response(
+        _paged(dm.customer_types.list()))))
     r.add_get("/api/customertypes/{token}", _store_get(dm.customer_types))
     r.add_get("/api/customers/{token}", _store_get(dm.customers))
     r.add_get("/api/zones/{token}", _store_get(dm.zones))
     r.add_get("/api/devicegroups/{token}", _store_get(dm.groups))
-    r.add_get("/api/assettypes", lambda req: json_response(
-        _paged(inst.assets.asset_types.list())))
+    r.add_get("/api/assettypes", _sync(lambda req: json_response(
+        _paged(inst.assets.asset_types.list()))))
     r.add_get("/api/assettypes/{token}", _store_get(inst.assets.asset_types))
     r.add_get("/api/assets/{token}", _store_get(inst.assets.assets))
     r.add_get("/api/schedules/{token}", _store_get(inst.scheduler.schedules))

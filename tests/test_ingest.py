@@ -744,3 +744,59 @@ def test_lenient_channels_roundtrip_within_capacity():
     for e in evs:
         seen.update(e.get("measurements", {}))
     assert seen == {n: float(i) for i, n in enumerate(names)}
+
+
+def test_strict_channels_reject_precedes_wal(tmp_path):
+    """A strict rejection must never be durable: the WAL contains no record
+    for the refused request, so crash recovery replays cleanly."""
+    import pytest
+
+    from sitewhere_tpu.engine import ChannelCapacityError
+    from sitewhere_tpu.utils.checkpoint import recover_engine, save_engine
+
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=3,
+        strict_channels=True, use_native=False,
+        wal_dir=str(tmp_path / "wal")))
+    save_engine(eng, tmp_path / "snap")   # empty snapshot; WAL replays all
+    eng.process(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT, device_token="wr-1",
+        measurements={"a": 1.0}))
+    with pytest.raises(ChannelCapacityError):
+        eng.process(DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token="wr-1",
+            measurements={"b": 2.0, "c": 3.0, "d": 4.0}))
+    with pytest.raises(ChannelCapacityError):
+        eng.ingest_json_batch([measurement_json("wr-1", name="e")])
+    eng.flush()
+    assert eng.metrics()["persisted"] == 1
+    eng.wal.close()
+    # recovery must not raise (no refused record is durable) and must see
+    # only the accepted row
+    eng2 = recover_engine(tmp_path / "snap")
+    eng2.flush()
+    assert eng2.metrics()["persisted"] == 1
+
+
+def test_search_index_readd_purges_stale_postings():
+    """Re-delivered event ids (at-least-once feed) replace their old posting
+    keys — stale keys never crash a later search."""
+    from sitewhere_tpu.core.types import EventType
+    from sitewhere_tpu.outbound.feed import OutboundEvent
+    from sitewhere_tpu.search.index import EventSearchIndex
+
+    idx = EventSearchIndex(capacity=4)
+
+    def ev(i, name):
+        return OutboundEvent(
+            event_id=i, etype=EventType.MEASUREMENT, device_token="d-0",
+            device_id=0, assignment_id=i, tenant="default", area_id=-1,
+            asset_id=-1, ts_ms=i, received_ms=i, measurements={name: 1.0},
+            values=[], aux0=-1, aux1=-1)
+
+    idx.add(ev(1, "old"))
+    idx.add(ev(1, "new"))       # same id, changed content
+    assert idx.search("measurement:old") == []
+    assert [d["eventId"] for d in idx.search("measurement:new")] == [1]
+    assert ("measurement", "old") not in idx.postings

@@ -484,3 +484,79 @@ def test_batch_operation_listing(api):
     assert status == 200 and listing["numResults"] == 1
     assert listing["results"][0]["token"] == "op-1"
     assert listing["results"][0]["status"] == "Finished"
+
+
+def test_assignment_put_delete_over_rest(api):
+    call, inst, loop = api
+    call("POST", "/api/devices", {"token": "ap-1"})
+    status, a = call("POST", "/api/assignments",
+                     {"deviceToken": "ap-1", "token": "ap-1-extra"})
+    assert status == 201
+    # PUT updates associations + metadata
+    status, a = call("PUT", "/api/assignments/ap-1-extra",
+                     {"areaToken": "plant-a", "assetToken": "pump-7",
+                      "metadata": {"k": "v"}})
+    assert status == 200
+    assert a["areaToken"] == "plant-a" and a["assetToken"] == "pump-7"
+    assert a["metadata"] == {"k": "v"}
+    # criteria filters on the listing surface see the update
+    status, listing = call("GET", "/api/assignments",
+                           params={"assetToken": "pump-7"})
+    assert status == 200 and [x["token"] for x in listing] == ["ap-1-extra"]
+    # DELETE removes it; device keeps its default assignment
+    status, body = call("DELETE", "/api/assignments/ap-1-extra")
+    assert status == 200 and body["deleted"]
+    status, _ = call("GET", "/api/assignments/ap-1-extra")
+    assert status == 404
+    status, listing = call("GET", "/api/assignments",
+                           params={"deviceToken": "ap-1"})
+    assert status == 200 and len(listing) == 1
+    # PUT on a missing assignment -> 404
+    status, _ = call("PUT", "/api/assignments/nope", {"areaToken": "x"})
+    assert status == 404
+
+
+def test_batch_elements_and_criteria_over_rest(api):
+    call, inst, loop = api
+    call("POST", "/api/devicetypes", {"token": "valve", "name": "Valve"})
+    call("POST", "/api/devicetypes", {"token": "pump", "name": "Pump"})
+    for i in range(3):
+        call("POST", "/api/devices",
+             {"token": f"bv-{i}", "deviceTypeToken": "valve"})
+    call("POST", "/api/devices", {"token": "bp-0", "deviceTypeToken": "pump"})
+    call("POST", "/api/devicetypes/valve/commands",
+         {"token": "close", "name": "close"})
+    call("POST", "/api/devicetypes/pump/commands",
+         {"token": "close", "name": "close"})
+
+    # by device criteria: only the valves
+    status, op = call("POST", "/api/batch/command/criteria/device",
+                      {"deviceTypeToken": "valve", "commandToken": "close"})
+    assert status == 201
+    assert op["counts"] == {"SUCCEEDED": 3} or op["counts"].get("SUCCEEDED") == 3
+
+    # element listing is paged + filterable by status
+    status, els = call("GET", f"/api/batch/{op['token']}/elements")
+    assert status == 200 and els["numResults"] == 3
+    assert {e["device_token"] for e in els["results"]} == {"bv-0", "bv-1", "bv-2"}
+    status, els = call("GET", f"/api/batch/{op['token']}/elements",
+                       params={"status": "failed"})
+    assert status == 200 and els["numResults"] == 0
+    status, page2 = call("GET", f"/api/batch/{op['token']}/elements",
+                         params={"page": "2", "pageSize": "2"})
+    assert page2["numResults"] == 3 and len(page2["results"]) == 1
+
+    # by assignment criteria: area-scoped
+    call("PUT", "/api/assignments/" +
+         inst.engine.list_assignments(device_token="bp-0")[0].token,
+         {"areaToken": "zone-9"})
+    status, op2 = call("POST", "/api/batch/command/criteria/assignment",
+                       {"areaToken": "zone-9", "commandToken": "close"})
+    assert status == 201
+    status, els = call("GET", f"/api/batch/{op2['token']}/elements")
+    assert {e["device_token"] for e in els["results"]} == {"bp-0"}
+
+    # criteria matching nothing -> 400
+    status, _ = call("POST", "/api/batch/command/criteria/device",
+                     {"deviceTypeToken": "nonexistent", "commandToken": "close"})
+    assert status == 400
