@@ -81,6 +81,17 @@ int32_t swtpu_interner_lookup(Interner* in, const char* s, int32_t n) {
 
 int32_t swtpu_interner_size(Interner* in) { return (int32_t)in->strings.size(); }
 
+// roll back to the first n entries (rejected-batch cleanup). Safe with
+// linear probing because only the TAIL of insertion order is removed:
+// every surviving entry was inserted before any removed one, so its probe
+// chain never depended on a removed slot.
+void swtpu_interner_truncate(Interner* in, int32_t n) {
+    if (n < 0 || n >= (int32_t)in->strings.size()) return;
+    for (auto& s : in->slots)
+        if (s >= n) s = -1;
+    in->strings.resize(n);
+}
+
 // copy string #id into out (cap bytes); returns length or -1
 int32_t swtpu_interner_get(Interner* in, int32_t id, char* out, int32_t cap) {
     if (id < 0 || id >= (int32_t)in->strings.size()) return -1;

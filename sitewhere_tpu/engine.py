@@ -90,6 +90,150 @@ class ChannelMap:
                     "strict_channels")
         return nid % self.channels
 
+    def validate(self, names) -> None:
+        """Strict-mode capacity check WITHOUT interning: a rejected
+        request must not consume lanes, so names only intern once the
+        request is accepted (channel_of on the staging pass)."""
+        if not self.strict:
+            return
+        unseen: set[str] = set()
+        for name in names:
+            nid = self.names.lookup(name)
+            if nid < 0:
+                unseen.add(name)
+            elif nid >= self.channels:
+                self.collisions += 1
+                raise ChannelCapacityError(
+                    f"measurement name {name!r} exceeds channel capacity "
+                    f"{self.channels}; raise EngineConfig.channels or drop "
+                    "strict_channels")
+        if len(self.names) + len(unseen) > self.channels:
+            self.collisions += 1
+            raise ChannelCapacityError(
+                f"{len(unseen)} new measurement name(s) would exceed channel "
+                f"capacity {self.channels}; raise EngineConfig.channels or "
+                "drop strict_channels")
+
+
+class IngestHostMixin:
+    """WAL durability + strict-channel machinery shared by the single-node
+    ``Engine`` and the mesh ``DistributedEngine`` — one implementation so
+    durability and strictness semantics can never diverge between them.
+    Hosts provide: ``lock``, ``wal``, ``_wal_local``, ``channel_map``,
+    ``config.strict_channels``, ``process()``, ``_ingest_decoded()``."""
+
+    def _wal_append(self, tag: bytes, payloads: list[bytes],
+                    tenant: str) -> None:
+        """Log accepted payloads. MUST be called under the engine lock so a
+        concurrent snapshot's watermark can never cover a record whose
+        events were not yet staged. No-op while replaying or while an outer
+        ingest path on this thread already logged the raw batch."""
+        if self.wal is None or getattr(self._wal_local, "depth", 0):
+            return
+        head = tag + tenant.encode() + b"\x00"
+        for p in payloads:
+            self.wal.append(head + p)
+        # push to the OS now: an accepted event must survive a process
+        # crash (fsync cadence stays the operator's sync() call)
+        self.wal.flush()
+
+    @contextlib.contextmanager
+    def _wal_suppress(self):
+        """Suppress WAL logging for nested process() calls on THIS thread
+        (their raw batch is already logged)."""
+        self._wal_local.depth = getattr(self._wal_local, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            self._wal_local.depth -= 1
+
+    def _ingest_batch(self, payloads: list[bytes], tenant: str, tag: bytes,
+                      dec, native_fn) -> dict:
+        """Common batch-ingest skeleton: strict validation -> WAL -> stage.
+        ``native_fn`` is the native SoA decoder call (None = Python path)."""
+        if native_fn is None:
+            with self.lock:
+                predecoded = self._strict_predecode(payloads, dec)
+                self._wal_append(tag, payloads, tenant)
+                return self._ingest_python_fallback(payloads, tenant, dec,
+                                                    predecoded)
+        if self.config.strict_channels:
+            # strict serializes the native decode under the lock so a
+            # rejected batch can roll back the names it interned without
+            # clobbering a concurrent batch's newly-interned names
+            with self.lock:
+                names_before = len(self.channel_map.names)
+                res = native_fn(payloads)
+                self._check_strict_native(res, names_before)
+                self._wal_append(tag, payloads, tenant)
+                return self._ingest_decoded(res, payloads, tenant, dec)
+        # lenient fast path: decode OUTSIDE the lock (concurrent receivers
+        # decode in parallel); log + stage atomically
+        res = native_fn(payloads)
+        with self.lock:
+            self._wal_append(tag, payloads, tenant)
+            return self._ingest_decoded(res, payloads, tenant, dec)
+
+    def _strict_predecode(self, payloads, dec):
+        """Strict pre-pass for the Python-fallback path: decode ONCE and
+        validate channel capacity without interning, so a rejected batch
+        never leaks lanes. Returns per-payload request lists (None entries
+        = decode failures) for reuse by _ingest_python_fallback; None when
+        strict mode is off. Caller holds the lock."""
+        if not self.channel_map.strict:
+            return None
+        decoded: list[list | None] = []
+        names: list[str] = []
+        for p in payloads:
+            try:
+                reqs = dec.decode(p, {})
+            except Exception:
+                decoded.append(None)   # counted failed on the ingest pass
+                continue
+            decoded.append(reqs)
+            for req in reqs:
+                names.extend(req.measurements or ())
+        self.channel_map.validate(names)
+        return decoded
+
+    def _check_strict_native(self, res, names_before: int) -> None:
+        """Strict native path: the C++ decoder interned names during decode;
+        on any collision the whole batch is rejected BEFORE WAL/staging and
+        the names it added roll back (interner truncate), so a refused
+        batch never leaks lanes. Caller holds the lock."""
+        if not self.config.strict_channels or not res.collisions:
+            return
+        self.channel_map.names.truncate(names_before)
+        self.channel_map.collisions += res.collisions
+        raise ChannelCapacityError(
+            f"{res.collisions} measurement lane collision(s) in batch: "
+            f"distinct names exceed channel capacity "
+            f"{self.config.channels}; raise channels or drop strict_channels")
+
+    def _ingest_python_fallback(self, payloads, tenant, dec,
+                                predecoded=None) -> dict:
+        """Per-request staging; reuses the strict pre-pass's decode when
+        present (no double decode under the lock)."""
+        failed = 0
+        with self._wal_suppress():   # the raw batch is already logged
+            if predecoded is not None:
+                for reqs in predecoded:
+                    if reqs is None:
+                        failed += 1
+                        continue
+                    for req in reqs:
+                        req.tenant = tenant
+                        self.process(req)
+            else:
+                for p in payloads:
+                    try:
+                        for req in dec.decode(p, {}):
+                            req.tenant = tenant
+                            self.process(req)
+                    except Exception:
+                        failed += 1
+        return {"decoded": len(payloads) - failed, "failed": failed}
+
 
 @dataclasses.dataclass
 class EngineConfig:
@@ -308,7 +452,7 @@ def _admin_set_assignment_status(state: PipelineState, assignment_id, status, ac
     return dataclasses.replace(state, registry=reg)
 
 
-class Engine:
+class Engine(IngestHostMixin):
     """Single-node engine instance."""
 
     def __init__(self, config: EngineConfig | None = None):
@@ -398,10 +542,9 @@ class Engine:
         with self.lock:
             if self.channel_map.strict and req.measurements:
                 # strict mode must reject BEFORE the WAL append so a refused
-                # event is never durable (recovery would otherwise replay a
-                # record the client saw rejected)
-                for name in req.measurements:
-                    self.channel_map.channel_of(name)
+                # event is never durable — and WITHOUT interning, so the
+                # refused names don't leak channel lanes
+                self.channel_map.validate(req.measurements)
             if self.wal is not None:
                 # per-request path (protocol receivers): log the request in
                 # the binary wire form when it carries one; unsupported
@@ -571,20 +714,9 @@ class Engine:
         string metadata the hot path doesn't extract)."""
         from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
 
-        if self._native_decoder is None:
-            with self.lock:
-                self._validate_strict_batch(payloads, JsonDeviceRequestDecoder())
-                self._wal_append(WAL_JSON, payloads, tenant)
-                return self._ingest_python_fallback(
-                    payloads, tenant, JsonDeviceRequestDecoder())
-        # decode OUTSIDE the lock (concurrent receivers decode in parallel);
-        # log + stage atomically so a snapshot watermark can't split them
-        res = self._native_decoder.decode(payloads)
-        self._check_strict_channels(res)
-        with self.lock:
-            self._wal_append(WAL_JSON, payloads, tenant)
-            return self._ingest_decoded(res, payloads, tenant,
-                                        JsonDeviceRequestDecoder())
+        return self._ingest_batch(
+            payloads, tenant, WAL_JSON, JsonDeviceRequestDecoder(),
+            self._native_decoder.decode if self._native_decoder else None)
 
     def ingest_binary_batch(self, payloads: list[bytes],
                             tenant: str = "default") -> dict:
@@ -592,91 +724,10 @@ class Engine:
         slot): one native C call decodes the whole batch."""
         from sitewhere_tpu.ingest.decoders import BinaryEventDecoder
 
-        if self._native_decoder is None:
-            with self.lock:
-                self._validate_strict_batch(payloads, BinaryEventDecoder())
-                self._wal_append(WAL_BINARY, payloads, tenant)
-                return self._ingest_python_fallback(
-                    payloads, tenant, BinaryEventDecoder())
-        res = self._native_decoder.decode_binary(payloads)
-        self._check_strict_channels(res)
-        with self.lock:
-            self._wal_append(WAL_BINARY, payloads, tenant)
-            return self._ingest_decoded(res, payloads, tenant,
-                                        BinaryEventDecoder())
-
-    def _validate_strict_batch(self, payloads, dec) -> None:
-        """Strict pre-check for the Python-fallback batch paths: intern every
-        measurement name BEFORE the WAL append so a refused batch is never
-        durable (mirrors _check_strict_channels on the native path). Decode
-        failures are ignored here — they surface as `failed` counts on the
-        real pass. Caller holds the lock."""
-        if not self.channel_map.strict:
-            return
-        for p in payloads:
-            try:
-                reqs = dec.decode(p, {})
-            except Exception:
-                continue
-            for req in reqs:
-                for name in req.measurements or ():
-                    self.channel_map.channel_of(name)
-
-    def _check_strict_channels(self, res) -> None:
-        """Strict channel mode for the native fast path: the C++ decoder has
-        already interned names (lanes assigned modulo), so any collision in
-        the batch is a configuration error — reject the whole batch BEFORE
-        the WAL/staging so no aliased lane is ever persisted."""
-        if self.config.strict_channels and res.collisions:
-            with self.lock:   # counter shared with concurrent ingest threads
-                self.channel_map.collisions += res.collisions
-            raise ChannelCapacityError(
-                f"{res.collisions} measurement lane collision(s) in batch: "
-                f"distinct names exceed channel capacity "
-                f"{self.config.channels}; raise EngineConfig.channels or "
-                "drop strict_channels")
-
-    def _wal_append(self, tag: bytes, payloads: list[bytes],
-                    tenant: str) -> None:
-        """Log accepted payloads. MUST be called under the engine lock so a
-        concurrent snapshot's watermark can never cover a record whose
-        events were not yet staged. No-op while replaying or while an outer
-        ingest path on this thread already logged the raw batch."""
-        if self.wal is None or getattr(self._wal_local, "depth", 0):
-            return
-        head = tag + tenant.encode() + b"\x00"
-        for p in payloads:
-            self.wal.append(head + p)
-        # push to the OS now: an accepted event must survive a process
-        # crash (fsync cadence stays the operator's sync() call)
-        self.wal.flush()
-
-    @contextlib.contextmanager
-    def _wal_suppress(self):
-        """Suppress WAL logging for nested process() calls on THIS thread
-        (their raw batch is already logged)."""
-        self._wal_local.depth = getattr(self._wal_local, "depth", 0) + 1
-        try:
-            yield
-        finally:
-            self._wal_local.depth -= 1
-
-    def _ingest_python_fallback(self, payloads, tenant, dec) -> dict:
-        failed = 0
-        with self._wal_suppress():   # the raw batch is already logged
-            for p in payloads:
-                try:
-                    for req in dec.decode(p, {}):
-                        req.tenant = tenant
-                        self.process(req)
-                except ChannelCapacityError:
-                    # config error, not a payload error — the strict contract
-                    # must not be swallowed into the failed-decode count
-                    # (pre-validation makes this unreachable, kept as a net)
-                    raise
-                except Exception:
-                    failed += 1
-        return {"decoded": len(payloads) - failed, "failed": failed}
+        return self._ingest_batch(
+            payloads, tenant, WAL_BINARY, BinaryEventDecoder(),
+            self._native_decoder.decode_binary if self._native_decoder
+            else None)
 
     def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
         """Stage a natively decoded SoA batch (shared by the JSON and binary

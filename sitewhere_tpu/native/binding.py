@@ -40,6 +40,7 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.swtpu_interner_size.argtypes = [c.c_void_p]
     lib.swtpu_interner_get.restype = c.c_int32
     lib.swtpu_interner_get.argtypes = [c.c_void_p, c.c_int32, c.c_char_p, c.c_int32]
+    lib.swtpu_interner_truncate.argtypes = [c.c_void_p, c.c_int32]
     lib.swtpu_decoder_create.restype = c.c_void_p
     lib.swtpu_decoder_create.argtypes = [c.c_void_p, c.c_int32, c.c_int32]
     lib.swtpu_decoder_destroy.argtypes = [c.c_void_p]
@@ -142,6 +143,11 @@ class NativeInterner:
         if tid >= len(self._tokens):
             self._sync()
         return self._tokens[tid]
+
+    def truncate(self, n: int) -> None:
+        """Roll back to the first ``n`` entries (rejected-batch cleanup)."""
+        self.lib.swtpu_interner_truncate(self.handle, n)
+        del self._tokens[n:]
 
     def items(self):
         self._sync()

@@ -1,0 +1,1114 @@
+"""DistributedEngine: the full product runtime over the sharded ICI mesh.
+
+``ShardedEngine`` (parallel/sharded.py) proves the collectives: it runs the
+fused pipeline over a mesh, but consumes pre-interned integer batches. This
+module is the *product* on top — everything the single-node ``Engine``
+(engine.py) offers, running against stacked per-shard state:
+
+  * string device tokens, interned once (native C++ interner when available)
+    and hash-routed to an owning shard — the host-side analog of the
+    reference's token-keyed Kafka partitioner
+    (service-event-sources/.../manager/EventSourcesManager.java:183);
+  * per-shard staging buffers feeding ONE stacked jit step (shard_map over
+    the mesh), so every shard's fused pipeline runs in the same XLA program;
+  * WAL durability + snapshot/recovery of the stacked state (the reference
+    leans on Kafka offsets + k8s restarts, SURVEY.md §5.4/5.5);
+  * admin CRUD, event queries, device-state reads, and presence sweeps
+    served from the sharded state — the surface the REST gateway
+    (web/rest.py) binds to, mirroring how the reference's REST controllers
+    fan out to per-partition services over gRPC;
+  * fair multi-tenant batch formation per shard.
+
+Token routing: the global interner hands out dense ids; shard
+``gid % n_shards`` owns the token and its local id is ``gid // n_shards``
+(round-robin => balanced shards by construction). Global device ids are
+``local_id * n_shards + shard`` — bijective, so host mirrors stay flat
+dicts like the single-node engine's.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sitewhere_tpu.core.events import EpochBase, EventBatch
+from sitewhere_tpu.core.registry import MAX_ACTIVE_ASSIGNMENTS, TokenInterner
+from sitewhere_tpu.core.types import (
+    AUX_LANES,
+    DEFAULT_VALUE_CHANNELS,
+    NULL_ID,
+    DeviceAssignmentStatus,
+    EventType,
+    PresenceState,
+)
+from sitewhere_tpu.engine import (
+    WAL_BINARY,
+    WAL_JSON,
+    AssignmentInfo,
+    ChannelMap,
+    DeviceInfo,
+    IngestHostMixin,
+)
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+from sitewhere_tpu.parallel.sharded import ShardedEngine, _stacked_query
+from sitewhere_tpu.pipeline import PipelineConfig, PipelineState, StepOutput
+
+
+@dataclasses.dataclass
+class DistributedConfig:
+    """Per-shard capacities + the host-side engine knobs (EngineConfig
+    analog). Global token capacity is n_shards * token_capacity_per_shard."""
+
+    n_shards: int | None = None            # default: all local devices
+    device_capacity_per_shard: int = 1 << 14
+    token_capacity_per_shard: int = 1 << 15
+    assignment_capacity_per_shard: int = 1 << 15
+    store_capacity_per_shard: int = 1 << 16
+    channels: int = DEFAULT_VALUE_CHANNELS
+    batch_capacity_per_shard: int = 2048
+    flush_interval_s: float = 0.05
+    auto_register: bool = True
+    default_device_type: str = "default"
+    presence_missing_s: float = 8 * 3600.0
+    use_native: bool = True
+    strict_channels: bool = False
+    fair_tenancy: bool = False
+    wal_dir: str | None = None
+
+
+class _StackedBuffer:
+    """Host staging for all shards at once: [S, B, ...] numpy arrays with a
+    per-shard fill count. ``emit()`` converts to ONE stacked EventBatch (one
+    host->device transfer for the whole mesh step, not one per shard)."""
+
+    def __init__(self, n_shards: int, capacity: int, channels: int):
+        self.n_shards = n_shards
+        self.capacity = capacity
+        self.channels = channels
+        self._alloc()
+
+    def _alloc(self) -> None:
+        s, b, c = self.n_shards, self.capacity, self.channels
+        self.counts = np.zeros(s, np.int64)
+        self.etype = np.zeros((s, b), np.int32)
+        self.token_id = np.full((s, b), NULL_ID, np.int32)
+        self.tenant_id = np.full((s, b), NULL_ID, np.int32)
+        self.ts_ms = np.zeros((s, b), np.int32)
+        self.received_ms = np.zeros((s, b), np.int32)
+        self.values = np.zeros((s, b, c), np.float32)
+        self.vmask = np.zeros((s, b, c), np.bool_)
+        self.aux = np.full((s, b, AUX_LANES), NULL_ID, np.int32)
+
+    def total(self) -> int:
+        return int(self.counts.sum())
+
+    def room(self, shard: int) -> int:
+        return self.capacity - int(self.counts[shard])
+
+    def append_row(self, shard: int, etype: int, local_token: int,
+                   tenant_id: int, ts: int, recv: int,
+                   values: np.ndarray | None, vmask: np.ndarray | None,
+                   aux0: int, aux1: int) -> bool:
+        i = int(self.counts[shard])
+        if i >= self.capacity:
+            return False
+        self.etype[shard, i] = etype
+        self.token_id[shard, i] = local_token
+        self.tenant_id[shard, i] = tenant_id
+        self.ts_ms[shard, i] = ts
+        self.received_ms[shard, i] = recv
+        if vmask is not None:
+            self.values[shard, i] = values
+            self.vmask[shard, i] = vmask
+        self.aux[shard, i, 0] = aux0
+        self.aux[shard, i, 1] = aux1
+        self.counts[shard] = i + 1
+        return True
+
+    def emit(self) -> EventBatch:
+        s, b = self.n_shards, self.capacity
+        valid = np.arange(b)[None, :] < self.counts[:, None]
+        batch = EventBatch(
+            valid=jnp.asarray(valid),
+            etype=jnp.asarray(self.etype),
+            token_id=jnp.asarray(self.token_id),
+            tenant_id=jnp.asarray(self.tenant_id),
+            ts_ms=jnp.asarray(self.ts_ms),
+            received_ms=jnp.asarray(self.received_ms),
+            values=jnp.asarray(self.values),
+            vmask=jnp.asarray(self.vmask),
+            aux=jnp.asarray(self.aux),
+            seq=jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32), (s, b)),
+        )
+        self._alloc()
+        return batch
+
+
+class _FairChunk:
+    """A run of staged rows for one (shard, tenant) awaiting fair batch
+    formation (engine.py _FairChunk analog, shard-local)."""
+
+    __slots__ = ("etype", "token", "ts", "recv", "values", "vmask",
+                 "aux0", "aux1", "pos")
+
+    def __init__(self, etype, token, ts, recv, values, vmask, aux0, aux1):
+        self.etype = etype
+        self.token = token
+        self.ts = ts
+        self.recv = recv
+        self.values = values
+        self.vmask = vmask
+        self.aux0 = aux0
+        self.aux1 = aux1
+        self.pos = 0
+
+    @property
+    def remaining(self) -> int:
+        return len(self.etype) - self.pos
+
+
+# --------------------------------------------------------------------------
+# admin-path jit updaters over the STACKED state (leading shard axis). Used
+# on the REST/API path only; the hot path registers on-device in the step.
+@jax.jit
+def _admin_create_device_stacked(state: PipelineState, shard, token_local,
+                                 did, aid, type_id, tenant_id, area_id,
+                                 customer_id):
+    reg = state.registry
+    reg = dataclasses.replace(
+        reg,
+        token_to_device=reg.token_to_device.at[shard, token_local].set(did),
+        device_active=reg.device_active.at[shard, did].set(True),
+        device_type=reg.device_type.at[shard, did].set(type_id),
+        device_tenant=reg.device_tenant.at[shard, did].set(tenant_id),
+        device_area=reg.device_area.at[shard, did].set(area_id),
+        device_customer=reg.device_customer.at[shard, did].set(customer_id),
+        device_assignments=reg.device_assignments.at[shard, did, 0].set(aid),
+        assignment_active=reg.assignment_active.at[shard, aid].set(True),
+        assignment_status=reg.assignment_status.at[shard, aid].set(
+            jnp.int32(DeviceAssignmentStatus.ACTIVE)),
+        assignment_device=reg.assignment_device.at[shard, aid].set(did),
+        assignment_area=reg.assignment_area.at[shard, aid].set(area_id),
+        assignment_customer=reg.assignment_customer.at[shard, aid].set(customer_id),
+    )
+    return dataclasses.replace(
+        state,
+        registry=reg,
+        next_device=state.next_device.at[shard].max(did + 1),
+        next_assignment=state.next_assignment.at[shard].max(aid + 1),
+    )
+
+
+@jax.jit
+def _admin_set_device_active_stacked(state: PipelineState, shard, did, active):
+    reg = state.registry
+    return dataclasses.replace(
+        state, registry=dataclasses.replace(
+            reg, device_active=reg.device_active.at[shard, did].set(active)))
+
+
+@jax.jit
+def _admin_set_parent_stacked(state: PipelineState, shard, did, parent_did):
+    reg = state.registry
+    return dataclasses.replace(
+        state, registry=dataclasses.replace(
+            reg, device_parent=reg.device_parent.at[shard, did].set(parent_did)))
+
+
+@jax.jit
+def _admin_add_assignment_stacked(state: PipelineState, shard, did, aid, slot,
+                                  asset_id, area_id, customer_id):
+    reg = state.registry
+    reg = dataclasses.replace(
+        reg,
+        device_assignments=reg.device_assignments.at[shard, did, slot].set(aid),
+        assignment_active=reg.assignment_active.at[shard, aid].set(True),
+        assignment_status=reg.assignment_status.at[shard, aid].set(
+            jnp.int32(DeviceAssignmentStatus.ACTIVE)),
+        assignment_device=reg.assignment_device.at[shard, aid].set(did),
+        assignment_asset=reg.assignment_asset.at[shard, aid].set(asset_id),
+        assignment_area=reg.assignment_area.at[shard, aid].set(area_id),
+        assignment_customer=reg.assignment_customer.at[shard, aid].set(customer_id),
+    )
+    return dataclasses.replace(
+        state, registry=reg,
+        next_assignment=state.next_assignment.at[shard].max(aid + 1))
+
+
+@jax.jit
+def _admin_set_assignment_status_stacked(state: PipelineState, shard, aid,
+                                         status, active):
+    reg = state.registry
+    did = reg.assignment_device[shard, aid]
+    row = reg.device_assignments[shard, did]
+    new_row = jnp.where((row == aid) & ~active, jnp.int32(NULL_ID), row)
+    reg = dataclasses.replace(
+        reg,
+        assignment_status=reg.assignment_status.at[shard, aid].set(status),
+        assignment_active=reg.assignment_active.at[shard, aid].set(active),
+        device_assignments=reg.device_assignments.at[shard, did].set(new_row),
+    )
+    return dataclasses.replace(state, registry=reg)
+
+
+class DistributedEngine(IngestHostMixin):
+    """Multi-shard product engine: one object per host serving the whole
+    mesh. All mutations serialize through one lock (single-writer semantics,
+    like the single-node engine); the step itself is one stacked jit. WAL
+    and strict-channel behavior come from IngestHostMixin — identical
+    semantics to the single-node Engine by construction."""
+
+    def __init__(self, config: DistributedConfig | None = None):
+        self.config = c = config or DistributedConfig()
+        self.sharded = ShardedEngine(
+            n_shards=c.n_shards,
+            device_capacity_per_shard=c.device_capacity_per_shard,
+            token_capacity_per_shard=c.token_capacity_per_shard,
+            assignment_capacity_per_shard=c.assignment_capacity_per_shard,
+            store_capacity_per_shard=c.store_capacity_per_shard,
+            channels=c.channels,
+            config=PipelineConfig(auto_register=c.auto_register,
+                                  default_device_type=0),
+        )
+        self.n_shards = self.sharded.n_shards
+        self.epoch = EpochBase()
+        self.lock = threading.RLock()
+        token_capacity = c.token_capacity_per_shard * self.n_shards
+        self._native_decoder = None
+        if c.use_native:
+            try:
+                from sitewhere_tpu.ingest.fast_decode import NativeBatchDecoder
+                from sitewhere_tpu.native.binding import NativeInterner
+
+                self.tokens = NativeInterner(token_capacity)
+                self._native_decoder = NativeBatchDecoder(self.tokens, c.channels)
+            except (RuntimeError, OSError):
+                self._native_decoder = None
+        if self._native_decoder is not None:
+            self.channel_map = ChannelMap(c.channels, self._native_decoder.names,
+                                          strict=c.strict_channels)
+            self.alert_types = self._native_decoder.alert_types
+        else:
+            self.tokens = TokenInterner(token_capacity)
+            self.channel_map = ChannelMap(c.channels, strict=c.strict_channels)
+            self.alert_types = TokenInterner(1 << 20)
+        self.tenants = TokenInterner(1 << 16)
+        self.tenants.intern("default")
+        self.device_types = TokenInterner(1 << 16)
+        self.device_types.intern(c.default_device_type)
+        self.areas = TokenInterner(1 << 16)
+        self.customers = TokenInterner(1 << 16)
+        self.assets = TokenInterner(1 << 16)
+        self.event_ids = TokenInterner(1 << 22)
+
+        self._buf = _StackedBuffer(self.n_shards, c.batch_capacity_per_shard,
+                                   c.channels)
+        self._last_flush = time.monotonic()
+        # host mirrors — flat dicts over GLOBAL ids (local * n_shards + shard)
+        self.devices: dict[int, DeviceInfo] = {}
+        self.token_device: dict[int, int] = {}        # gid -> global did
+        self.assignments: dict[int, AssignmentInfo] = {}
+        self.assignment_tokens: dict[str, int] = {}
+        self.device_slots: dict[int, list[int]] = {}
+        self._next_device = np.zeros(self.n_shards, np.int64)   # per shard
+        self._next_assignment = np.zeros(self.n_shards, np.int64)
+        self.dead_letters: list[str] = []             # unregistered tokens
+        self.outputs: list[dict] = []
+        self._pending_outs: list[StepOutput] = []
+        self._pending_tenant_fixups: list[tuple[int, int, int]] = []
+        # fair tenancy: per-shard {tenant_id: deque[_FairChunk]}
+        self._fair_queues: list[dict[int, collections.deque]] = [
+            {} for _ in range(self.n_shards)]
+        self._fair_queued = np.zeros(self.n_shards, np.int64)
+        self.wal = None
+        self._wal_local = threading.local()
+        if c.wal_dir:
+            from sitewhere_tpu.utils.ingestlog import IngestLog
+
+            self.wal = IngestLog(c.wal_dir)
+
+    # ---------------------------------------------------------------- routing
+    def _route(self, gid: int) -> tuple[int, int]:
+        """(shard, local_token) for a global interner id."""
+        return gid % self.n_shards, gid // self.n_shards
+
+    def _gdid(self, shard: int, local_did: int) -> int:
+        return local_did * self.n_shards + shard
+
+    def _split_gdid(self, gdid: int) -> tuple[int, int]:
+        return gdid % self.n_shards, gdid // self.n_shards
+
+    @property
+    def state(self) -> PipelineState:
+        return self.sharded.state
+
+    @property
+    def staged_count(self) -> int:
+        return self._buf.total() + int(self._fair_queued.sum())
+
+    def _sync_mirrors(self) -> None:
+        while self._buf.total() or self._fair_queued.sum():
+            self.flush_async()
+        if self._pending_outs:
+            self.drain()
+
+    # ---------------------------------------------------------------- ingest
+    def process(self, req: DecodedRequest) -> None:
+        """Stage one decoded request (slow path / protocol receivers)."""
+        with self.lock:
+            if self.channel_map.strict and req.measurements:
+                # reject BEFORE the WAL append and WITHOUT interning
+                self.channel_map.validate(req.measurements)
+            if self.wal is not None:
+                from sitewhere_tpu.ingest.decoders import encode_binary_request
+
+                try:
+                    self._wal_append(WAL_BINARY,
+                                     [encode_binary_request(req)], req.tenant)
+                except KeyError:
+                    pass
+            if req.type is RequestType.REGISTER_DEVICE:
+                self.register_device(
+                    req.device_token,
+                    device_type=req.extras.get("deviceTypeToken",
+                                               self.config.default_device_type),
+                    tenant=req.tenant,
+                    area=req.extras.get("areaToken"),
+                    customer=req.extras.get("customerToken"),
+                )
+                return
+            if req.type is RequestType.MAP_DEVICE:
+                parent = (req.extras.get("parentToken")
+                          or req.extras.get("parentHardwareId"))
+                if parent:
+                    self.map_device(req.device_token, parent)
+                return
+            et = req.event_type
+            if et is None:
+                return
+            now = self.epoch.now_ms()
+            if req.event_ts_ms is not None:
+                base_ms = int(self.epoch.base_unix_s * 1000)
+                ts = int(np.clip(req.event_ts_ms - base_ms,
+                                 -(2**31) + 1, 2**31 - 1))
+            else:
+                ts = now
+            gid = self.tokens.intern(req.device_token)
+            tenant_id = self.tenants.intern(req.tenant)
+            values = np.zeros(self.config.channels, np.float32)
+            mask = np.zeros(self.config.channels, np.bool_)
+            aux0 = NULL_ID
+            if et is EventType.MEASUREMENT and req.measurements:
+                for name, val in req.measurements.items():
+                    ch = self.channel_map.channel_of(name)
+                    values[ch] = val
+                    mask[ch] = True
+            elif et is EventType.LOCATION:
+                if req.latitude is not None and req.longitude is not None:
+                    values[0], values[1] = req.latitude, req.longitude
+                    values[2] = req.elevation or 0.0
+                    mask[:3] = True
+            elif et is EventType.ALERT:
+                values[0] = float(int(req.alert_level))
+                mask[0] = True
+                aux0 = self.alert_types.intern(req.alert_type or "alert")
+            elif et is EventType.COMMAND_RESPONSE and req.originating_event_id:
+                aux0 = self.event_ids.intern(req.originating_event_id)
+            elif et is EventType.STATE_CHANGE and (req.attribute or req.state_type):
+                aux0 = self.event_ids.intern(
+                    f"{req.attribute or ''}:{req.state_type or ''}")
+            aux1 = (self.event_ids.intern(req.alternate_id)
+                    if req.alternate_id is not None else NULL_ID)
+            shard, local = self._route(gid)
+            has_vals = mask.any()
+            if self.config.fair_tenancy:
+                i32 = np.int32
+                self._fair_enqueue(shard, tenant_id, _FairChunk(
+                    etype=np.array([et], i32),
+                    token=np.array([local], i32),
+                    ts=np.array([ts], i32),
+                    recv=np.array([now], i32),
+                    values=values[None].copy() if has_vals else None,
+                    vmask=mask[None].copy() if has_vals else None,
+                    aux0=np.array([aux0], i32),
+                    aux1=np.array([aux1], i32),
+                ))
+                return
+            if not self._buf.append_row(shard, et, local, tenant_id, ts, now,
+                                        values if has_vals else None,
+                                        mask if has_vals else None, aux0, aux1):
+                self.flush_async()
+                self._buf.append_row(shard, et, local, tenant_id, ts, now,
+                                     values if has_vals else None,
+                                     mask if has_vals else None, aux0, aux1)
+            if self._buf.room(shard) == 0:
+                self.flush_async()
+
+    def ingest_json_batch(self, payloads: list[bytes],
+                          tenant: str = "default") -> dict:
+        """Fast path: one native decode call for the batch, vectorized
+        shard routing + staging (no per-event Python)."""
+        from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
+
+        return self._ingest_batch(
+            payloads, tenant, WAL_JSON, JsonDeviceRequestDecoder(),
+            self._native_decoder.decode if self._native_decoder else None)
+
+    def ingest_binary_batch(self, payloads: list[bytes],
+                            tenant: str = "default") -> dict:
+        from sitewhere_tpu.ingest.decoders import BinaryEventDecoder
+
+        return self._ingest_batch(
+            payloads, tenant, WAL_BINARY, BinaryEventDecoder(),
+            self._native_decoder.decode_binary if self._native_decoder
+            else None)
+
+    def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
+        """Stage a natively decoded SoA batch, grouped by owning shard with
+        one argsort (the vectorized Kafka-partitioner hop)."""
+        from sitewhere_tpu.ingest.fast_decode import (
+            RT_ACK,
+            RT_MAP,
+            RT_REGISTER,
+            RTYPE_TO_ETYPE,
+        )
+
+        with self.lock:
+            now = self.epoch.now_ms()
+            base_ms = int(self.epoch.base_unix_s * 1000)
+            etype = RTYPE_TO_ETYPE[np.clip(res.rtype, -1, 7)]
+            ok = (res.rtype >= 0) & (etype >= 0)
+            regs = ((res.rtype == RT_REGISTER) | (res.rtype == RT_MAP)
+                    | (res.rtype == RT_ACK))
+            ok &= ~regs
+            failed = int(np.sum(res.rtype < 0))
+            n_reg_ok = 0
+            if np.any(regs):
+                with self._wal_suppress():
+                    for i in np.nonzero(regs)[0]:
+                        try:
+                            for req in reg_decoder.decode(payloads[int(i)], {}):
+                                req.tenant = tenant
+                                self.process(req)
+                            n_reg_ok += 1
+                        except Exception:
+                            failed += 1
+            ts_rel = np.where(
+                res.ts_ms64 >= 0,
+                np.clip(res.ts_ms64 - base_ms, -(2**31) + 1, 2**31 - 1),
+                now,
+            ).astype(np.int32)
+            values = res.values
+            alert_rows = ok & (etype == int(EventType.ALERT))
+            if np.any(alert_rows):
+                values = values.copy()
+                values[alert_rows, 0] = res.level[alert_rows]
+            idxs = np.nonzero(ok)[0]
+            tenant_id = self.tenants.intern(tenant)
+            gids = res.token_id[idxs]
+            shards = gids % self.n_shards
+            locals_ = gids // self.n_shards
+            order = np.argsort(shards, kind="stable")
+            sidx, sshard, slocal = idxs[order], shards[order], locals_[order]
+            bounds = np.searchsorted(sshard, np.arange(self.n_shards + 1))
+            staged = 0
+            for s in range(self.n_shards):
+                rows = sidx[bounds[s]:bounds[s + 1]]
+                toks = slocal[bounds[s]:bounds[s + 1]]
+                if not len(rows):
+                    continue
+                if self.config.fair_tenancy:
+                    self._fair_enqueue(s, tenant_id, _FairChunk(
+                        etype=etype[rows],
+                        token=toks.astype(np.int32),
+                        ts=ts_rel[rows],
+                        recv=np.full(len(rows), now, np.int32),
+                        values=values[rows],
+                        vmask=res.chmask[rows],
+                        aux0=res.aux0[rows],
+                        aux1=np.full(len(rows), NULL_ID, np.int32),
+                    ))
+                    staged += len(rows)
+                    continue
+                pos = 0
+                b = self._buf
+                while pos < len(rows):
+                    room = b.room(s)
+                    if room == 0:
+                        self.flush_async()
+                        room = b.capacity
+                    chunk = rows[pos:pos + room]
+                    tchunk = toks[pos:pos + room]
+                    lo = int(b.counts[s])
+                    hi = lo + len(chunk)
+                    b.etype[s, lo:hi] = etype[chunk]
+                    b.token_id[s, lo:hi] = tchunk
+                    b.tenant_id[s, lo:hi] = tenant_id
+                    b.ts_ms[s, lo:hi] = ts_rel[chunk]
+                    b.received_ms[s, lo:hi] = now
+                    b.values[s, lo:hi] = values[chunk]
+                    b.vmask[s, lo:hi] = res.chmask[chunk]
+                    b.aux[s, lo:hi, 0] = res.aux0[chunk]
+                    b.counts[s] = hi
+                    staged += len(chunk)
+                    pos += len(chunk)
+                if b.room(s) == 0:
+                    self.flush_async()
+            self.channel_map.collisions += res.collisions
+            return {"decoded": int(np.sum(ok)) + n_reg_ok, "failed": failed,
+                    "staged": staged}
+
+    # ----------------------------------------------------------- fair tenancy
+    def _fair_enqueue(self, shard: int, tenant_id: int, chunk: _FairChunk) -> None:
+        q = self._fair_queues[shard].get(tenant_id)
+        if q is None:
+            q = self._fair_queues[shard][tenant_id] = collections.deque()
+        q.append(chunk)
+        self._fair_queued[shard] += chunk.remaining
+        if self._fair_queued[shard] >= self.config.batch_capacity_per_shard:
+            self.flush_async()
+
+    def fair_backlog(self, tenant: str) -> int:
+        with self.lock:
+            tid = self.tenants.lookup(tenant)
+            return sum(
+                c.remaining
+                for queues in self._fair_queues
+                for c in queues.get(tid, ()))
+
+    def _form_fair_batch(self, shard: int) -> None:
+        """Quota-sliced per-shard batch formation across tenants (engine.py
+        _form_fair_batch per shard). Caller holds the lock."""
+        b = self._buf
+        queues = self._fair_queues[shard]
+        while self._fair_queued[shard] and b.room(shard):
+            active = [t for t, q in queues.items() if q]
+            if not active:
+                break
+            quota = max(1, b.room(shard) // len(active))
+            for tid in active:
+                q = queues[tid]
+                take = quota
+                while take > 0 and q and b.room(shard):
+                    ch = q[0]
+                    k = min(take, ch.remaining, b.room(shard))
+                    lo = int(b.counts[shard])
+                    hi, p = lo + k, ch.pos
+                    b.etype[shard, lo:hi] = ch.etype[p:p + k]
+                    b.token_id[shard, lo:hi] = ch.token[p:p + k]
+                    b.tenant_id[shard, lo:hi] = tid
+                    b.ts_ms[shard, lo:hi] = ch.ts[p:p + k]
+                    b.received_ms[shard, lo:hi] = ch.recv[p:p + k]
+                    if ch.values is not None:
+                        b.values[shard, lo:hi] = ch.values[p:p + k]
+                        b.vmask[shard, lo:hi] = ch.vmask[p:p + k]
+                    b.aux[shard, lo:hi, 0] = ch.aux0[p:p + k]
+                    b.aux[shard, lo:hi, 1] = ch.aux1[p:p + k]
+                    b.counts[shard] = hi
+                    ch.pos += k
+                    take -= k
+                    self._fair_queued[shard] -= k
+                    if ch.remaining == 0:
+                        q.popleft()
+        for tid in [t for t, q in queues.items() if not q]:
+            del queues[tid]
+
+    # ------------------------------------------------------------------ step
+    def maybe_flush(self) -> dict | None:
+        with self.lock:
+            expired = (time.monotonic() - self._last_flush
+                       >= self.config.flush_interval_s)
+            if (self._buf.total() or self._fair_queued.sum()) and expired:
+                return self.flush()
+            if self._pending_outs and expired:
+                return self.drain()[-1]
+            return None
+
+    def flush(self) -> dict:
+        from sitewhere_tpu.utils.tracing import stage
+
+        with self.lock, stage("sharded_step"):
+            self.flush_async()
+            while self._fair_queued.sum():
+                self.flush_async()
+            return self.drain()[-1]
+
+    def flush_async(self) -> None:
+        """Dispatch one stacked step (no host sync); outputs queue for
+        drain()."""
+        with self.lock:
+            if self._fair_queued.sum():
+                for s in range(self.n_shards):
+                    if self._fair_queued[s]:
+                        self._form_fair_batch(s)
+            if not self._buf.total():
+                return
+            batch = self._buf.emit()
+            out = self.sharded.step(batch)
+            self._pending_outs.append(out)
+            self._last_flush = time.monotonic()
+
+    def drain(self) -> list[dict]:
+        with self.lock:
+            if not self._pending_outs:
+                return [{"found": 0, "missed": 0, "registered": 0,
+                         "persisted": 0, "new_tokens": [], "dead_tokens": []}]
+            outs, self._pending_outs = self._pending_outs, []
+            outs = jax.device_get(outs)
+            summaries = [self._absorb_output(o) for o in outs]
+            self._mirror_new_device_tenants()
+            return summaries
+
+    def _absorb_output(self, out: StepOutput) -> dict:
+        """Mirror one stacked step output: per-shard device-side allocation
+        order == compacted new_tokens order, exactly like the single-node
+        engine's contract."""
+        new_all: list[str] = []
+        dead_all: list[str] = []
+        for s in range(self.n_shards):
+            toks = [int(t) for t in np.asarray(out.new_tokens[s]) if t != NULL_ID]
+            for local_tok in toks:
+                gid = local_tok * self.n_shards + s
+                did = int(self._next_device[s])
+                aid = int(self._next_assignment[s])
+                self._next_device[s] += 1
+                self._next_assignment[s] += 1
+                gdid = self._gdid(s, did)
+                self.token_device[gid] = gdid
+                token = self.tokens.token(gid)
+                self.devices[gdid] = DeviceInfo(
+                    token=token,
+                    device_type=self.config.default_device_type,
+                    tenant="default",     # fixed up from device column below
+                    auto_registered=True,
+                )
+                self._pending_tenant_fixups.append((gdid, s, did))
+                self._record_assignment(self._gdid(s, aid), gdid, slot=0)
+                new_all.append(token)
+            for t in np.asarray(out.dead_tokens[s]):
+                if int(t) != NULL_ID:
+                    dead_all.append(self.tokens.token(
+                        int(t) * self.n_shards + s))
+        self.dead_letters.extend(dead_all)
+        summary = {
+            "found": int(np.sum(out.n_found)),
+            "missed": int(np.sum(out.n_missed)),
+            "registered": int(np.sum(out.n_registered)),
+            "persisted": int(np.sum(out.n_persisted)),
+            "new_tokens": new_all,
+            "dead_tokens": dead_all,
+        }
+        self.outputs.append(summary)
+        del self.outputs[:-256]
+        return summary
+
+    def _mirror_new_device_tenants(self) -> None:
+        """One gather for every auto-registered device's tenant column
+        (instead of a device->host transfer per device)."""
+        if not self._pending_tenant_fixups:
+            return
+        fix, self._pending_tenant_fixups = self._pending_tenant_fixups, []
+        sh = jnp.asarray([f[1] for f in fix], jnp.int32)
+        dd = jnp.asarray([f[2] for f in fix], jnp.int32)
+        tens = np.asarray(jax.device_get(
+            self.state.registry.device_tenant[sh, dd]))
+        for (gdid, _, _), ten in zip(fix, tens):
+            if int(ten) != NULL_ID:
+                info = self.devices.get(gdid)
+                if info is not None:
+                    info.tenant = self.tenants.token(int(ten))
+                    aid = (self.device_slots.get(gdid) or [NULL_ID])[0]
+                    if aid != NULL_ID and aid in self.assignments:
+                        self.assignments[aid].tenant = info.tenant
+
+    # ------------------------------------------------------------------ admin
+    def register_device(self, token: str, device_type: str | None = None,
+                        tenant: str = "default", area: str | None = None,
+                        customer: str | None = None,
+                        metadata: dict | None = None) -> int:
+        """API-path device creation (get-or-create); returns the GLOBAL
+        device id."""
+        with self.lock:
+            self._sync_mirrors()
+            gid = self.tokens.intern(token)
+            existing = self.token_device.get(gid)
+            if existing is not None:
+                return existing
+            shard, local_tok = self._route(gid)
+            did = int(self._next_device[shard])
+            aid = int(self._next_assignment[shard])
+            if did >= self.config.device_capacity_per_shard:
+                raise RuntimeError(f"device capacity exhausted on shard {shard}")
+            self._next_device[shard] += 1
+            self._next_assignment[shard] += 1
+            type_name = device_type or self.config.default_device_type
+            self.sharded.state = _admin_create_device_stacked(
+                self.sharded.state,
+                jnp.int32(shard), jnp.int32(local_tok),
+                jnp.int32(did), jnp.int32(aid),
+                jnp.int32(self.device_types.intern(type_name)),
+                jnp.int32(self.tenants.intern(tenant)),
+                jnp.int32(self.areas.intern(area) if area else NULL_ID),
+                jnp.int32(self.customers.intern(customer) if customer else NULL_ID),
+            )
+            gdid = self._gdid(shard, did)
+            self.token_device[gid] = gdid
+            self.devices[gdid] = DeviceInfo(
+                token=token, device_type=type_name, tenant=tenant,
+                area=area, customer=customer, metadata=metadata or {},
+            )
+            self._record_assignment(self._gdid(shard, aid), gdid, slot=0,
+                                    area=area, customer=customer)
+            return gdid
+
+    def delete_device(self, token: str) -> bool:
+        with self.lock:
+            self._sync_mirrors()
+            gid = self.tokens.lookup(token)
+            gdid = self.token_device.get(gid)
+            if gdid is None:
+                return False
+            shard, did = self._split_gdid(gdid)
+            self.sharded.state = _admin_set_device_active_stacked(
+                self.sharded.state, jnp.int32(shard), jnp.int32(did), False)
+            return True
+
+    def map_device(self, child_token: str, parent_token: str) -> DeviceInfo:
+        """Gateway/composite mapping. The on-device parent column is
+        shard-local, so it is set only when parent and child land on the
+        same shard; the host mirror always records the mapping (command
+        routing uses the mirror)."""
+        with self.lock:
+            self._sync_mirrors()
+            cgid = self.tokens.lookup(child_token)
+            cdid = self.token_device.get(cgid)
+            if cdid is None:
+                raise KeyError(f"device {child_token!r} not registered")
+            pgid = self.tokens.lookup(parent_token)
+            pdid = self.token_device.get(pgid)
+            if pdid is None:
+                raise KeyError(f"parent device {parent_token!r} not registered")
+            if cdid == pdid:
+                raise ValueError("device cannot be its own parent")
+            info = self.devices[cdid]
+            info.metadata = dict(info.metadata) | {"parentToken": parent_token}
+            cs, cd = self._split_gdid(cdid)
+            ps, pd = self._split_gdid(pdid)
+            if cs == ps:
+                self.sharded.state = _admin_set_parent_stacked(
+                    self.sharded.state, jnp.int32(cs), jnp.int32(cd),
+                    jnp.int32(pd))
+            return info
+
+    def _record_assignment(self, gaid: int, gdid: int, slot: int,
+                           token: str | None = None, asset: str | None = None,
+                           area: str | None = None, customer: str | None = None,
+                           metadata: dict | None = None) -> AssignmentInfo:
+        dev = self.devices[gdid]
+        tok = token or f"{dev.token}:a{gaid}"
+        info = AssignmentInfo(
+            token=tok, id=gaid, device_token=dev.token, tenant=dev.tenant,
+            asset=asset, area=area or dev.area,
+            customer=customer or dev.customer,
+            metadata=metadata or {}, created_ms=self.epoch.now_ms(),
+        )
+        self.assignments[gaid] = info
+        self.assignment_tokens[tok] = gaid
+        slots = self.device_slots.setdefault(
+            gdid, [NULL_ID] * MAX_ACTIVE_ASSIGNMENTS)
+        slots[slot] = gaid
+        return info
+
+    def create_assignment(self, device_token: str, token: str | None = None,
+                          asset: str | None = None, area: str | None = None,
+                          customer: str | None = None,
+                          metadata: dict | None = None) -> AssignmentInfo:
+        with self.lock:
+            self._sync_mirrors()
+            gid = self.tokens.lookup(device_token)
+            gdid = self.token_device.get(gid)
+            if gdid is None:
+                raise KeyError(f"device {device_token!r} not registered")
+            if token is not None and token in self.assignment_tokens:
+                raise ValueError(f"assignment token {token!r} already exists")
+            slots = self.device_slots.setdefault(
+                gdid, [NULL_ID] * MAX_ACTIVE_ASSIGNMENTS)
+            try:
+                slot = slots.index(NULL_ID)
+            except ValueError:
+                raise ValueError(
+                    f"device {device_token!r} already has "
+                    f"{MAX_ACTIVE_ASSIGNMENTS} active assignments") from None
+            shard, did = self._split_gdid(gdid)
+            aid = int(self._next_assignment[shard])
+            if aid >= self.config.assignment_capacity_per_shard:
+                raise RuntimeError("assignment capacity exhausted")
+            self._next_assignment[shard] += 1
+            self.sharded.state = _admin_add_assignment_stacked(
+                self.sharded.state, jnp.int32(shard), jnp.int32(did),
+                jnp.int32(aid), jnp.int32(slot),
+                jnp.int32(self.assets.intern(asset) if asset else NULL_ID),
+                jnp.int32(self.areas.intern(area) if area else NULL_ID),
+                jnp.int32(self.customers.intern(customer) if customer else NULL_ID),
+            )
+            return self._record_assignment(
+                self._gdid(shard, aid), gdid, slot, token=token, asset=asset,
+                area=area, customer=customer, metadata=metadata)
+
+    def get_assignment(self, token: str) -> AssignmentInfo | None:
+        aid = self.assignment_tokens.get(token)
+        return self.assignments.get(aid) if aid is not None else None
+
+    def list_assignments(self, device_token: str | None = None,
+                         status: str | None = None) -> list[AssignmentInfo]:
+        with self.lock:
+            out = [
+                a for a in self.assignments.values()
+                if (device_token is None or a.device_token == device_token)
+                and (status is None or a.status == status)
+            ]
+            return sorted(out, key=lambda a: a.id)
+
+    def release_assignment(self, token: str) -> AssignmentInfo:
+        with self.lock:
+            self._sync_mirrors()
+            gaid = self.assignment_tokens.get(token)
+            if gaid is None:
+                raise KeyError(f"assignment {token!r} not found")
+            shard, aid = self._split_gdid(gaid)
+            self.sharded.state = _admin_set_assignment_status_stacked(
+                self.sharded.state, jnp.int32(shard), jnp.int32(aid),
+                jnp.int32(DeviceAssignmentStatus.RELEASED), False)
+            info = self.assignments[gaid]
+            info.status = "RELEASED"
+            info.released_ms = self.epoch.now_ms()
+            gdid = self.token_device.get(self.tokens.lookup(info.device_token))
+            if gdid is not None and gdid in self.device_slots:
+                self.device_slots[gdid] = [
+                    NULL_ID if a == gaid else a
+                    for a in self.device_slots[gdid]]
+            return info
+
+    # ------------------------------------------------------------------ queries
+    def get_device(self, token: str) -> DeviceInfo | None:
+        if self._pending_outs:
+            with self.lock:
+                self._sync_mirrors()
+        gid = self.tokens.lookup(token)
+        gdid = self.token_device.get(gid)
+        return self.devices.get(gdid) if gdid is not None else None
+
+    def get_device_state(self, token: str) -> dict | None:
+        """One device's aggregated state from its owning shard."""
+        from sitewhere_tpu.core.state import RECENT_DEPTH
+
+        with self.lock:
+            self._sync_mirrors()
+            gid = self.tokens.lookup(token)
+            gdid = self.token_device.get(gid)
+            if gdid is None:
+                return None
+            shard, d = self._split_gdid(gdid)
+            ds = self.state.device_state
+            # slice this device's rows in one device_get
+            row = jax.device_get({
+                "presence": ds.presence[shard, d],
+                "last": ds.last_interaction_ms[shard, d],
+                "meas_last": ds.meas_last[shard, d],
+                "meas_last_ms": ds.meas_last_ms[shard, d],
+                "recent_loc": ds.recent_loc[shard, d],
+                "recent_loc_ms": ds.recent_loc_ms[shard, d],
+                "recent_loc_valid": ds.recent_loc_valid[shard, d],
+                "recent_alert_level": ds.recent_alert_level[shard, d],
+                "recent_alert_type": ds.recent_alert_type[shard, d],
+                "recent_alert_ms": ds.recent_alert_ms[shard, d],
+                "recent_alert_valid": ds.recent_alert_valid[shard, d],
+                "event_counts": ds.event_counts[shard, d],
+            })
+            chans = {}
+            for name, nid in self.channel_map.names.items():
+                ch = nid % self.config.channels
+                ts = int(row["meas_last_ms"][ch])
+                if ts > -(2**31) + 10:
+                    chans[name] = {"value": float(row["meas_last"][ch]),
+                                   "ts_ms": ts}
+            recent_locs = [
+                {
+                    "latitude": float(row["recent_loc"][r, 0]),
+                    "longitude": float(row["recent_loc"][r, 1]),
+                    "elevation": float(row["recent_loc"][r, 2]),
+                    "ts_ms": int(row["recent_loc_ms"][r]),
+                }
+                for r in range(RECENT_DEPTH)
+                if bool(row["recent_loc_valid"][r])
+            ]
+            recent_alerts = [
+                {
+                    "level": int(row["recent_alert_level"][r]),
+                    "type": self.alert_types.token(int(row["recent_alert_type"][r])),
+                    "ts_ms": int(row["recent_alert_ms"][r]),
+                }
+                for r in range(RECENT_DEPTH)
+                if bool(row["recent_alert_valid"][r])
+            ]
+            return {
+                "device": self.devices[gdid].token,
+                "shard": shard,
+                "presence": PresenceState(int(row["presence"])).name,
+                "last_interaction_ms": int(row["last"]),
+                "measurements": chans,
+                "recent_locations": recent_locs,
+                "recent_alerts": recent_alerts,
+                "event_counts": {
+                    EventType(e).name: int(row["event_counts"][e])
+                    for e in range(6)
+                },
+            }
+
+    def query_events(self, device_token: str | None = None,
+                     etype: EventType | None = None,
+                     tenant: str | None = None,
+                     since_ms: int | None = None,
+                     until_ms: int | None = None,
+                     limit: int = 100) -> dict:
+        """Global newest-first query: every shard scans its ring on its own
+        device (vmapped filter + top-k), host merges the per-shard pages
+        with one vectorized argsort (scatter-gather across partitions)."""
+        with self.lock:
+            self._sync_mirrors()
+            dev_filter = NULL_ID
+            shard_filter = None
+            if device_token is not None:
+                gid = self.tokens.lookup(device_token)
+                gdid = self.token_device.get(gid, None)
+                if gdid is None:
+                    return {"total": 0, "events": []}
+                shard_filter, dev_filter = self._split_gdid(gdid)
+            res = _stacked_query(
+                self.state.store,
+                jnp.int32(int(etype) if etype is not None else NULL_ID),
+                jnp.int32(self.tenants.lookup(tenant)
+                          if tenant is not None else NULL_ID),
+                jnp.int32(since_ms if since_ms is not None else -(2**31)),
+                jnp.int32(until_ms if until_ms is not None else 2**31 - 1),
+                limit=limit,
+                device=jnp.int32(dev_filter),
+                device_shard=(jnp.int32(shard_filter)
+                              if shard_filter is not None else None),
+            )
+            res = jax.device_get(res)
+            ns = np.asarray(res.n)
+            ts = np.asarray(res.ts_ms)
+            valid = np.arange(ts.shape[1])[None, :] < ns[:, None]
+            s_idx, i_idx = np.nonzero(valid)
+            order = np.argsort(-ts[s_idx, i_idx], kind="stable")[:limit]
+            sel_s, sel_i = s_idx[order], i_idx[order]
+            lane_names: dict[int, str] = {}
+            for name, nid in self.channel_map.names.items():
+                lane_names.setdefault(nid % self.config.channels, name)
+            events = []
+            for s, i in zip(sel_s, sel_i):
+                et = EventType(int(res.etype[s, i]))
+                gdid = self._gdid(int(s), int(res.device[s, i]))
+                info = self.devices.get(gdid)
+                ev = {
+                    "type": et.name,
+                    "deviceToken": info.token if info else None,
+                    "shard": int(s),
+                    "assignmentId": self._gdid(int(s), int(res.assignment[s, i])),
+                    "eventDateMs": int(res.ts_ms[s, i]),
+                    "receivedDateMs": int(res.received_ms[s, i]),
+                }
+                if et is EventType.MEASUREMENT:
+                    ev["measurements"] = {
+                        lane_names.get(int(c), f"ch{c}"):
+                            float(res.values[s, i, c])
+                        for c in np.nonzero(res.vmask[s, i])[0]
+                    }
+                elif et is EventType.LOCATION:
+                    if res.vmask[s, i, 0]:
+                        ev["latitude"] = float(res.values[s, i, 0])
+                        ev["longitude"] = float(res.values[s, i, 1])
+                        ev["elevation"] = float(res.values[s, i, 2])
+                    else:
+                        ev["latitude"] = ev["longitude"] = ev["elevation"] = None
+                elif et is EventType.ALERT:
+                    ev["level"] = int(res.values[s, i, 0])
+                    atype = int(res.aux[s, i, 0])
+                    ev["alertType"] = (
+                        self.alert_types.token(atype)
+                        if 0 <= atype < len(self.alert_types) else None)
+                events.append(ev)
+            return {"total": int(np.sum(np.asarray(res.total))),
+                    "events": events}
+
+    def search_device_states(self, last_interaction_before_ms: int | None = None,
+                             presence: str | None = None,
+                             limit: int = 100) -> list[dict]:
+        """Vectorized device-state search over the stacked state columns."""
+        with self.lock:
+            self._sync_mirrors()
+            ds = self.state.device_state
+            last = np.asarray(jax.device_get(ds.last_interaction_ms))
+            pres = np.asarray(jax.device_get(ds.presence))
+            n_per = self._next_device
+            mask = (np.arange(last.shape[1])[None, :] < n_per[:, None])
+            if last_interaction_before_ms is not None:
+                mask &= last < last_interaction_before_ms
+            if presence is not None:
+                mask &= pres == int(PresenceState[presence.upper()])
+            out = []
+            for s, d in zip(*np.nonzero(mask)):
+                if len(out) >= limit:
+                    break
+                info = self.devices.get(self._gdid(int(s), int(d)))
+                if info is None:
+                    continue
+                out.append({
+                    "device": info.token,
+                    "deviceType": info.device_type,
+                    "tenant": info.tenant,
+                    "shard": int(s),
+                    "presence": PresenceState(int(pres[s, d])).name,
+                    "lastInteractionMs": int(last[s, d]),
+                })
+            return out
+
+    def presence_sweep(self) -> list[str]:
+        """Mark stale devices MISSING on every shard; returns their tokens."""
+        with self.lock:
+            self._sync_mirrors()
+            pairs = self.sharded.presence_sweep(
+                self.epoch.now_ms(),
+                int(self.config.presence_missing_s * 1000))
+            out = []
+            for s, d in pairs:
+                info = self.devices.get(self._gdid(s, d))
+                if info is not None:
+                    out.append(info.token)
+            return out
+
+    def metrics(self) -> dict:
+        m = self.sharded.global_metrics()
+        m["channel_collisions"] = self.channel_map.collisions
+        m["staged"] = self.staged_count
+        m["n_shards"] = self.n_shards
+        m["devices"] = int(self._next_device.sum())
+        return m
+
+    def shard_metrics(self) -> list[dict]:
+        """Per-shard counters (the per-partition consumer-lag analog)."""
+        mm = jax.device_get(self.state.metrics)
+        fields = [f.name for f in dataclasses.fields(mm)]
+        return [
+            {name: int(np.asarray(getattr(mm, name))[s]) for name in fields}
+            | {"devices": int(self._next_device[s])}
+            for s in range(self.n_shards)
+        ]

@@ -310,17 +310,26 @@ class ShardedEngine:
 
 
 @functools.partial(jax.jit, static_argnames=("limit",))
-def _stacked_query(store, etype, tenant, t0, t1, *, limit):
+def _stacked_query(store, etype, tenant, t0, t1, *, limit, device=None,
+                   device_shard=None):
     """Per-shard ring query vmapped over the stacked shard axis; XLA keeps
     each shard's scan on its own device (no cross-shard traffic until the
-    host merges the top pages)."""
+    host merges the top pages). ``device``/``device_shard`` restrict the
+    scan to one device row on its owning shard (other shards match
+    nothing)."""
     from sitewhere_tpu.ops.query import query_store
 
-    def one(st):
-        return query_store(st, jnp.int32(NULL_ID), etype, tenant, t0, t1,
-                           limit=limit)
+    n_shards = jax.tree_util.tree_leaves(store)[0].shape[0]
 
-    return jax.vmap(one)(store)
+    def one(st, sidx):
+        dev = jnp.int32(NULL_ID) if device is None else device
+        if device_shard is not None:
+            # -2 is matched by no store row (valid rows have device >= 0,
+            # and padding rows are masked by store.valid)
+            dev = jnp.where(sidx == device_shard, dev, jnp.int32(-2))
+        return query_store(st, dev, etype, tenant, t0, t1, limit=limit)
+
+    return jax.vmap(one)(store, jnp.arange(n_shards, dtype=jnp.int32))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

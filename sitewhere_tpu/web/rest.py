@@ -630,7 +630,7 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         els = op.elements
         if "status" in q:
             els = [e for e in els if e.status.name == q["status"].upper()]
-        page = int(q.get("page", 1))
+        page = max(1, int(q.get("page", 1)))
         size = int(q.get("pageSize", 100))
         lo = (page - 1) * size
         return json_response({

@@ -1,0 +1,214 @@
+"""DistributedEngine: the product runtime over the sharded mesh.
+
+End-to-end cases the VERDICT asked for: string-token JSON ingest routed by
+token hash, sharded step, queries/state reads from stacked state, admin
+CRUD, fair tenancy, and (in test_distributed_durability.py) WAL recovery.
+Runs on the virtual 8-device CPU mesh from conftest.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.types import EventType
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+from sitewhere_tpu.parallel.distributed import (
+    DistributedConfig,
+    DistributedEngine,
+)
+
+
+def small_config(**kw) -> DistributedConfig:
+    base = dict(
+        n_shards=4,
+        device_capacity_per_shard=64,
+        token_capacity_per_shard=128,
+        assignment_capacity_per_shard=128,
+        store_capacity_per_shard=512,
+        channels=4,
+        batch_capacity_per_shard=64,
+        use_native=True,
+    )
+    base.update(kw)
+    return DistributedConfig(**base)
+
+
+def meas_payload(token: str, temp: float, ts_ms: int | None = None) -> bytes:
+    req = {
+        "deviceToken": token,
+        "type": "DeviceMeasurements",
+        "request": {"measurements": {"temp.celsius": temp}},
+    }
+    if ts_ms is not None:
+        req["request"]["eventDate"] = ts_ms
+    return json.dumps(req).encode()
+
+
+@pytest.fixture
+def engine():
+    return DistributedEngine(small_config())
+
+
+def test_json_ingest_routes_across_shards(engine):
+    payloads = [meas_payload(f"dev-{i}", 20.0 + i) for i in range(32)]
+    summary = engine.ingest_json_batch(payloads)
+    assert summary["decoded"] == 32 and summary["failed"] == 0
+    out = engine.flush()
+    assert out["registered"] == 32
+    m = engine.metrics()
+    assert m["found"] == 32 and m["persisted"] == 32
+    # round-robin interning: every shard owns some devices
+    per_shard = [s["devices"] for s in engine.shard_metrics()]
+    assert all(n > 0 for n in per_shard)
+    assert sum(per_shard) == 32
+
+
+def test_device_state_readback(engine):
+    engine.ingest_json_batch([meas_payload("dev-a", 21.5, ts_ms=None)])
+    engine.flush()
+    st = engine.get_device_state("dev-a")
+    assert st is not None
+    assert st["presence"] == "PRESENT"
+    assert st["measurements"]["temp.celsius"]["value"] == pytest.approx(21.5)
+    assert st["event_counts"]["MEASUREMENT"] == 1
+    info = engine.get_device("dev-a")
+    assert info is not None and info.auto_registered
+
+
+def test_query_events_global_merge(engine):
+    base_ms = int(engine.epoch.base_unix_s * 1000)
+    payloads = [
+        meas_payload(f"dev-{i}", float(i), ts_ms=base_ms + i * 1000)
+        for i in range(16)
+    ]
+    engine.ingest_json_batch(payloads)
+    engine.flush()
+    res = engine.query_events(limit=8)
+    assert res["total"] == 16
+    assert len(res["events"]) == 8
+    # newest-first across ALL shards
+    ts = [e["eventDateMs"] for e in res["events"]]
+    assert ts == sorted(ts, reverse=True)
+    assert res["events"][0]["deviceToken"] == "dev-15"
+    # per-device filter hits only the owning shard
+    one = engine.query_events(device_token="dev-3")
+    assert one["total"] == 1
+    assert one["events"][0]["measurements"]["temp.celsius"] == pytest.approx(3.0)
+
+
+def test_admin_register_and_slow_path(engine):
+    gdid = engine.register_device("adm-1", tenant="acme", area="plant")
+    assert engine.get_device("adm-1").tenant == "acme"
+    # same token again -> same id (get-or-create)
+    assert engine.register_device("adm-1") == gdid
+    # events for the admin-registered device flow through its shard
+    engine.process(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT,
+        device_token="adm-1",
+        tenant="acme",
+        measurements={"pressure": 3.5},
+    ))
+    out = engine.flush()
+    assert out["found"] == 1 and out["registered"] == 0
+    st = engine.get_device_state("adm-1")
+    assert st["measurements"]["pressure"]["value"] == pytest.approx(3.5)
+
+
+def test_assignment_lifecycle(engine):
+    engine.register_device("asg-1", tenant="t1")
+    a = engine.create_assignment("asg-1", token="asg-1:extra", asset="pump")
+    assert engine.get_assignment("asg-1:extra").asset == "pump"
+    assert len(engine.list_assignments(device_token="asg-1")) == 2
+    rel = engine.release_assignment("asg-1:extra")
+    assert rel.status == "RELEASED"
+    # events now expand only to the remaining active assignment
+    engine.process(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT, device_token="asg-1",
+        tenant="t1", measurements={"x": 1.0}))
+    out = engine.flush()
+    assert out["persisted"] == 1
+
+
+def test_map_device_cross_and_same_shard(engine):
+    # interning order makes dev ids 0..n round-robin: 0 and n_shards land
+    # on shard 0 (same shard); 0 and 1 land on different shards
+    toks = [f"map-{i}" for i in range(engine.n_shards + 1)]
+    for t in toks:
+        engine.register_device(t)
+    info = engine.map_device(toks[engine.n_shards], toks[0])  # same shard
+    assert info.metadata["parentToken"] == toks[0]
+    info2 = engine.map_device(toks[1], toks[0])               # cross shard
+    assert info2.metadata["parentToken"] == toks[0]
+    with pytest.raises(ValueError):
+        engine.map_device(toks[0], toks[0])
+
+
+def test_dead_letters_without_auto_register():
+    eng = DistributedEngine(small_config(auto_register=False))
+    eng.ingest_json_batch([meas_payload("ghost-1", 1.0)])
+    out = eng.flush()
+    assert out["missed"] == 1 and out["registered"] == 0
+    assert "ghost-1" in eng.dead_letters
+
+
+def test_presence_sweep_marks_missing():
+    eng = DistributedEngine(small_config(presence_missing_s=0.0))
+    eng.ingest_json_batch([meas_payload(f"pres-{i}", 1.0) for i in range(8)])
+    eng.flush()
+    import time
+
+    time.sleep(0.01)
+    tokens = eng.presence_sweep()
+    assert set(tokens) == {f"pres-{i}" for i in range(8)}
+    states = eng.search_device_states(presence="MISSING")
+    assert len(states) == 8
+
+
+def test_fair_tenancy_quota():
+    eng = DistributedEngine(small_config(fair_tenancy=True,
+                                         batch_capacity_per_shard=32))
+    # tenant A floods, tenant B trickles — B's events must still land
+    for i in range(64):
+        eng.ingest_json_batch([meas_payload(f"a-{i}", 1.0)], tenant="bulk")
+    for i in range(4):
+        eng.ingest_json_batch([meas_payload(f"b-{i}", 2.0)], tenant="tiny")
+    eng.flush()
+    assert eng.fair_backlog("bulk") == 0 and eng.fair_backlog("tiny") == 0
+    m = eng.metrics()
+    assert m["persisted"] == 68
+    assert eng.get_device_state("b-0") is not None
+
+
+def test_binary_wire_ingest(engine):
+    from sitewhere_tpu.ingest.decoders import encode_binary_request
+
+    reqs = [
+        DecodedRequest(
+            type=RequestType.DEVICE_MEASUREMENT, device_token=f"bin-{i}",
+            tenant="default", measurements={"v": float(i)})
+        for i in range(8)
+    ]
+    payloads = [encode_binary_request(r) for r in reqs]
+    summary = engine.ingest_binary_batch(payloads)
+    assert summary["decoded"] == 8
+    engine.flush()
+    assert engine.metrics()["persisted"] == 8
+    assert engine.get_device_state("bin-3")["measurements"]["v"]["value"] == 3.0
+
+
+def test_multi_batch_steady_state(engine):
+    """Many async flushes, mirrors sync lazily — totals must reconcile."""
+    rng = np.random.default_rng(1)
+    total = 0
+    for _ in range(6):
+        n = int(rng.integers(10, 40))
+        payloads = [meas_payload(f"ss-{rng.integers(0, 50)}", 1.0)
+                    for _ in range(n)]
+        engine.ingest_json_batch(payloads)
+        engine.flush_async()
+        total += n
+    engine.flush()
+    m = engine.metrics()
+    assert m["persisted"] == total
+    assert m["processed"] == total

@@ -724,6 +724,11 @@ def test_strict_channels_native_batch_rejected():
     with pytest.raises(ChannelCapacityError):
         eng.ingest_json_batch([measurement_json("sc-n", name="c")])
     assert eng.staged_count == 2  # rejected batch staged nothing
+    # the rejected batch's names rolled back (no lane leak): the interner
+    # holds exactly the accepted names, and re-sending them still works
+    assert len(eng.channel_map.names) == 2
+    ok2 = eng.ingest_json_batch([measurement_json("sc-n", name="a")])
+    assert ok2["failed"] == 0 and eng.staged_count == 3
 
 
 def test_lenient_channels_roundtrip_within_capacity():
@@ -767,16 +772,22 @@ def test_strict_channels_reject_precedes_wal(tmp_path):
         eng.process(DecodedRequest(
             type=RequestType.DEVICE_MEASUREMENT, device_token="wr-1",
             measurements={"b": 2.0, "c": 3.0, "d": 4.0}))
-    with pytest.raises(ChannelCapacityError):
-        eng.ingest_json_batch([measurement_json("wr-1", name="e")])
+    # the refusal left no trace: "b".."d" never interned, so a later
+    # within-capacity name is ACCEPTED (lane-leak regression guard)
+    ok = eng.ingest_json_batch([measurement_json("wr-1", name="e")])
+    assert ok["failed"] == 0
+    with pytest.raises(ChannelCapacityError):   # 2 used + 3 new > 3
+        eng.ingest_json_batch([measurement_json("wr-1", name="f"),
+                               measurement_json("wr-1", name="g"),
+                               measurement_json("wr-1", name="h")])
     eng.flush()
-    assert eng.metrics()["persisted"] == 1
+    assert eng.metrics()["persisted"] == 2
     eng.wal.close()
     # recovery must not raise (no refused record is durable) and must see
-    # only the accepted row
+    # only the accepted rows
     eng2 = recover_engine(tmp_path / "snap")
     eng2.flush()
-    assert eng2.metrics()["persisted"] == 1
+    assert eng2.metrics()["persisted"] == 2
 
 
 def test_search_index_readd_purges_stale_postings():
