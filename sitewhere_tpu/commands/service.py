@@ -94,17 +94,15 @@ class CommandDeliveryService(LifecycleComponent):
         while len(self.history) > self.HISTORY_LIMIT:
             self.history.pop(next(iter(self.history)))
         # persist through the pipeline; aux0 carries the invocation id
-        from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+        from sitewhere_tpu.core.types import NULL_ID
 
         with self.engine.lock:
             token_id = self.engine.tokens.intern(device_token)
             tenant_id = self.engine.tenants.intern(tenant)
             now = self.engine.epoch.now_ms()
-            self.engine._stage(
-                EventType.COMMAND_INVOCATION, token_id, tenant_id, inv.ts_ms,
-                now, None, None, inv.invocation_id,
-                DecodedRequest(type=RequestType.ACKNOWLEDGE,
-                               device_token=device_token),
+            self.engine._stage_row(
+                int(EventType.COMMAND_INVOCATION), token_id, tenant_id,
+                inv.ts_ms, now, None, None, inv.invocation_id, NULL_ID,
             )
         return inv
 

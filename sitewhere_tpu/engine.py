@@ -234,6 +234,138 @@ class IngestHostMixin:
                         failed += 1
         return {"decoded": len(payloads) - failed, "failed": failed}
 
+    def process(self, req) -> None:
+        """Stage one decoded request (the per-request / protocol-receiver
+        path); flushes when the staging batch fills. Registration and
+        mapping envelopes take the admin path; event requests convert to
+        one staged SoA row via the engine's ``_stage_row``."""
+        from sitewhere_tpu.ingest.requests import RequestType
+
+        with self.lock:
+            if self.channel_map.strict and req.measurements:
+                # strict mode must reject BEFORE the WAL append so a refused
+                # event is never durable — and WITHOUT interning, so the
+                # refused names don't leak channel lanes
+                self.channel_map.validate(req.measurements)
+            if self.wal is not None:
+                # per-request path: log the request in the binary wire form
+                # when it carries one; unsupported types are snapshot-only
+                from sitewhere_tpu.ingest.decoders import encode_binary_request
+
+                try:
+                    self._wal_append(WAL_BINARY,
+                                     [encode_binary_request(req)], req.tenant)
+                except KeyError:
+                    pass
+            if req.type is RequestType.REGISTER_DEVICE:
+                self.register_device(
+                    req.device_token,
+                    device_type=req.extras.get("deviceTypeToken",
+                                               self.config.default_device_type),
+                    tenant=req.tenant,
+                    area=req.extras.get("areaToken"),
+                    customer=req.extras.get("customerToken"),
+                )
+                return
+            if req.type is RequestType.MAP_DEVICE:
+                parent = (req.extras.get("parentToken")
+                          or req.extras.get("parentHardwareId"))
+                if parent:
+                    self.map_device(req.device_token, parent)
+                return
+            et = req.event_type
+            if et is None:
+                return
+            now = self.epoch.now_ms()
+            # wire timestamps are absolute unix ms; device arrays carry int32
+            # ms relative to the engine epoch base
+            if req.event_ts_ms is not None:
+                base_ms = int(self.epoch.base_unix_s * 1000)
+                ts = int(np.clip(req.event_ts_ms - base_ms,
+                                 -(2**31) + 1, 2**31 - 1))
+            else:
+                ts = now
+            token_id = self.tokens.intern(req.device_token)
+            tenant_id = self.tenants.intern(req.tenant)
+            channels = self.config.channels
+            values = np.zeros(channels, np.float32)
+            mask = np.zeros(channels, np.bool_)
+            aux0 = NULL_ID
+            if et is EventType.MEASUREMENT and req.measurements:
+                for name, val in req.measurements.items():
+                    ch = self.channel_map.channel_of(name)
+                    values[ch] = val
+                    mask[ch] = True
+            elif et is EventType.LOCATION:
+                # lanes only when coordinates were provided — a location
+                # request with null coords persists with no location lanes
+                # (native decoder parity; no null-island (0,0) rows)
+                if req.latitude is not None and req.longitude is not None:
+                    values[0], values[1] = req.latitude, req.longitude
+                    values[2] = req.elevation or 0.0
+                    mask[:3] = True
+            elif et is EventType.ALERT:
+                values[0] = float(int(req.alert_level))
+                mask[0] = True
+                aux0 = self.alert_types.intern(req.alert_type or "alert")
+            elif et is EventType.COMMAND_RESPONSE and req.originating_event_id:
+                aux0 = self.event_ids.intern(req.originating_event_id)
+            elif et is EventType.STATE_CHANGE and (req.attribute or req.state_type):
+                # the change label travels in aux0 so consumers can tell
+                # e.g. assignment.created from assignment.released
+                aux0 = self.event_ids.intern(
+                    f"{req.attribute or ''}:{req.state_type or ''}")
+            aux1 = (self.event_ids.intern(req.alternate_id)
+                    if req.alternate_id is not None else NULL_ID)
+            self._stage_row(int(et), token_id, tenant_id, ts, now,
+                            values, mask, aux0, aux1)
+
+    def _decode_prologue(self, res, payloads, tenant, reg_decoder,
+                         now: int, base_ms: int):
+        """Shared post-processing of a native SoA decode: map request types
+        to event types, re-route registration/mapping/ack envelopes through
+        the per-request path (they carry string payloads the fast columns
+        don't extract), relativize timestamps, and fold alert levels into
+        values lane 0. Returns (etype, ok, ts_rel, values, failed,
+        n_reg_ok). Caller holds the lock."""
+        from sitewhere_tpu.ingest.fast_decode import (
+            RT_ACK,
+            RT_MAP,
+            RT_REGISTER,
+            RTYPE_TO_ETYPE,
+        )
+
+        etype = RTYPE_TO_ETYPE[np.clip(res.rtype, -1, 7)]
+        ok = (res.rtype >= 0) & (etype >= 0)
+        regs = ((res.rtype == RT_REGISTER) | (res.rtype == RT_MAP)
+                | (res.rtype == RT_ACK))
+        ok &= ~regs   # slow-path rows must not also stage via fast path
+        failed = int(np.sum(res.rtype < 0))
+        n_reg_ok = 0
+        if np.any(regs):
+            with self._wal_suppress():   # raw batch already logged
+                for i in np.nonzero(regs)[0]:
+                    try:
+                        for req in reg_decoder.decode(payloads[int(i)], {}):
+                            req.tenant = tenant
+                            self.process(req)
+                        n_reg_ok += 1
+                    except Exception:
+                        failed += 1
+        # relative int32 timestamps (absent -> now)
+        ts_rel = np.where(
+            res.ts_ms64 >= 0,
+            np.clip(res.ts_ms64 - base_ms, -(2**31) + 1, 2**31 - 1),
+            now,
+        ).astype(np.int32)
+        values = res.values
+        # alert rows carry their level in values[:, 0]
+        alert_rows = ok & (etype == int(EventType.ALERT))
+        if np.any(alert_rows):
+            values = values.copy()
+            values[alert_rows, 0] = res.level[alert_rows]
+        return etype, ok, ts_rel, values, failed, n_reg_ok
+
 
 @dataclasses.dataclass
 class EngineConfig:
@@ -537,91 +669,10 @@ class Engine(IngestHostMixin):
             self.drain()
 
     # ------------------------------------------------------------------ ingest
-    def process(self, req: DecodedRequest) -> None:
-        """Stage one decoded request; flushes when the batch fills."""
-        with self.lock:
-            if self.channel_map.strict and req.measurements:
-                # strict mode must reject BEFORE the WAL append so a refused
-                # event is never durable — and WITHOUT interning, so the
-                # refused names don't leak channel lanes
-                self.channel_map.validate(req.measurements)
-            if self.wal is not None:
-                # per-request path (protocol receivers): log the request in
-                # the binary wire form when it carries one; unsupported
-                # types (streams, state-change triggers) are snapshot-only
-                from sitewhere_tpu.ingest.decoders import encode_binary_request
-
-                try:
-                    self._wal_append(WAL_BINARY,
-                                     [encode_binary_request(req)], req.tenant)
-                except KeyError:
-                    pass
-            if req.type is RequestType.REGISTER_DEVICE:
-                self.register_device(
-                    req.device_token,
-                    device_type=req.extras.get("deviceTypeToken",
-                                               self.config.default_device_type),
-                    tenant=req.tenant,
-                    area=req.extras.get("areaToken"),
-                    customer=req.extras.get("customerToken"),
-                )
-                return
-            if req.type is RequestType.MAP_DEVICE:
-                parent = (req.extras.get("parentToken")
-                          or req.extras.get("parentHardwareId"))
-                if parent:
-                    self.map_device(req.device_token, parent)
-                return
-            et = req.event_type
-            if et is None:
-                return
-            now = self.epoch.now_ms()
-            # wire timestamps are absolute unix ms; device arrays carry int32
-            # ms relative to the engine epoch base
-            if req.event_ts_ms is not None:
-                base_ms = int(self.epoch.base_unix_s * 1000)
-                ts = int(np.clip(req.event_ts_ms - base_ms, -(2**31) + 1, 2**31 - 1))
-            else:
-                ts = now
-            token_id = self.tokens.intern(req.device_token)
-            tenant_id = self.tenants.intern(req.tenant)
-            values = np.zeros(self.config.channels, np.float32)
-            mask = np.zeros(self.config.channels, np.bool_)
-            aux0 = NULL_ID
-            if et is EventType.MEASUREMENT and req.measurements:
-                for name, val in req.measurements.items():
-                    ch = self.channel_map.channel_of(name)
-                    values[ch] = val
-                    mask[ch] = True
-                self._stage(et, token_id, tenant_id, ts, now, values, mask, aux0, req)
-                return
-            if et is EventType.LOCATION:
-                # lanes only when coordinates were provided — a location
-                # request with null coords persists with no location lanes
-                # (native decoder parity; no null-island (0,0) rows)
-                if req.latitude is not None and req.longitude is not None:
-                    values[0], values[1] = req.latitude, req.longitude
-                    values[2] = req.elevation or 0.0
-                    mask[:3] = True
-            elif et is EventType.ALERT:
-                values[0] = float(int(req.alert_level))
-                mask[0] = True
-                aux0 = self.alert_types.intern(req.alert_type or "alert")
-            elif et is EventType.COMMAND_RESPONSE and req.originating_event_id:
-                aux0 = self.event_ids.intern(req.originating_event_id)
-            elif et is EventType.STATE_CHANGE and (req.attribute or req.state_type):
-                # the change label travels in aux0 so consumers can tell
-                # e.g. assignment.created from assignment.released
-                aux0 = self.event_ids.intern(
-                    f"{req.attribute or ''}:{req.state_type or ''}")
-            self._stage(et, token_id, tenant_id, ts, now, values, mask, aux0, req)
-
-    def _stage(self, et, token_id, tenant_id, ts, now, values, mask, aux0, req):
-        aux1 = (
-            self.event_ids.intern(req.alternate_id)
-            if req.alternate_id is not None
-            else NULL_ID
-        )
+    def _stage_row(self, et, token_id, tenant_id, ts, now, values, mask,
+                   aux0, aux1):
+        """Stage one converted event row (called by the mixin's process());
+        flushes when the batch fills. Caller holds the lock."""
         if self.config.fair_tenancy:
             i32 = np.int32
             has_vals = mask is not None and (mask.any() or values.any())
@@ -733,49 +784,12 @@ class Engine(IngestHostMixin):
         """Stage a natively decoded SoA batch (shared by the JSON and binary
         fast paths); registration envelopes re-decode on the slow path for
         their string metadata."""
-        from sitewhere_tpu.ingest.fast_decode import (
-            RT_MAP,
-            RT_REGISTER,
-            RTYPE_TO_ETYPE,
-        )
-
         with self.lock:
             now = self.epoch.now_ms()
             base_ms = int(self.epoch.base_unix_s * 1000)
-            etype = RTYPE_TO_ETYPE[np.clip(res.rtype, -1, 7)]
-            ok = (res.rtype >= 0) & (etype >= 0)
-            # registration / mapping / command-response envelopes take the
-            # slow path — they carry string payloads (extras, originating
-            # event ids) the SoA fast columns don't extract
-            from sitewhere_tpu.ingest.fast_decode import RT_ACK
-
-            regs = ((res.rtype == RT_REGISTER) | (res.rtype == RT_MAP)
-                    | (res.rtype == RT_ACK))
-            ok &= ~regs   # slow-path rows must not also stage via fast path
-            failed = int(np.sum(res.rtype < 0))
-            n_reg_ok = 0
-            if np.any(regs):
-                with self._wal_suppress():   # raw batch already logged
-                    for i in np.nonzero(regs)[0]:
-                        try:
-                            for req in reg_decoder.decode(payloads[int(i)], {}):
-                                req.tenant = tenant
-                                self.process(req)
-                            n_reg_ok += 1
-                        except Exception:
-                            failed += 1
-            # relative int32 timestamps (absent -> now)
-            ts_rel = np.where(
-                res.ts_ms64 >= 0,
-                np.clip(res.ts_ms64 - base_ms, -(2**31) + 1, 2**31 - 1),
-                now,
-            ).astype(np.int32)
-            values = res.values
-            # alert rows carry their level in values[:, 0]
-            alert_rows = ok & (etype == int(EventType.ALERT))
-            if np.any(alert_rows):
-                values = values.copy()
-                values[alert_rows, 0] = res.level[alert_rows]
+            etype, ok, ts_rel, values, failed, n_reg_ok = \
+                self._decode_prologue(res, payloads, tenant, reg_decoder,
+                                      now, base_ms)
             idxs = np.nonzero(ok)[0]
             tenant_id = self.tenants.intern(tenant)
             if self.config.fair_tenancy:
@@ -1383,7 +1397,11 @@ class Engine(IngestHostMixin):
                 dev = self.token_device.get(tid, NULL_ID)
                 if dev == NULL_ID:
                     return {"total": 0, "events": []}
-            ten = self.tenants.lookup(tenant) if tenant is not None else NULL_ID
+            ten = NULL_ID
+            if tenant is not None:
+                ten = self.tenants.lookup(tenant)
+                if ten == NULL_ID:   # unknown tenant matches NOTHING —
+                    return {"total": 0, "events": []}   # never all tenants
             imin, imax = -(2**31), 2**31 - 1
             res = query_store(
                 self.state.store,

@@ -56,7 +56,6 @@ from sitewhere_tpu.engine import (
     DeviceInfo,
     IngestHostMixin,
 )
-from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
 from sitewhere_tpu.parallel.sharded import ShardedEngine, _stacked_query
 from sitewhere_tpu.pipeline import PipelineConfig, PipelineState, StepOutput
 
@@ -360,96 +359,36 @@ class DistributedEngine(IngestHostMixin):
             self.drain()
 
     # ---------------------------------------------------------------- ingest
-    def process(self, req: DecodedRequest) -> None:
-        """Stage one decoded request (slow path / protocol receivers)."""
-        with self.lock:
-            if self.channel_map.strict and req.measurements:
-                # reject BEFORE the WAL append and WITHOUT interning
-                self.channel_map.validate(req.measurements)
-            if self.wal is not None:
-                from sitewhere_tpu.ingest.decoders import encode_binary_request
-
-                try:
-                    self._wal_append(WAL_BINARY,
-                                     [encode_binary_request(req)], req.tenant)
-                except KeyError:
-                    pass
-            if req.type is RequestType.REGISTER_DEVICE:
-                self.register_device(
-                    req.device_token,
-                    device_type=req.extras.get("deviceTypeToken",
-                                               self.config.default_device_type),
-                    tenant=req.tenant,
-                    area=req.extras.get("areaToken"),
-                    customer=req.extras.get("customerToken"),
-                )
-                return
-            if req.type is RequestType.MAP_DEVICE:
-                parent = (req.extras.get("parentToken")
-                          or req.extras.get("parentHardwareId"))
-                if parent:
-                    self.map_device(req.device_token, parent)
-                return
-            et = req.event_type
-            if et is None:
-                return
-            now = self.epoch.now_ms()
-            if req.event_ts_ms is not None:
-                base_ms = int(self.epoch.base_unix_s * 1000)
-                ts = int(np.clip(req.event_ts_ms - base_ms,
-                                 -(2**31) + 1, 2**31 - 1))
-            else:
-                ts = now
-            gid = self.tokens.intern(req.device_token)
-            tenant_id = self.tenants.intern(req.tenant)
-            values = np.zeros(self.config.channels, np.float32)
-            mask = np.zeros(self.config.channels, np.bool_)
-            aux0 = NULL_ID
-            if et is EventType.MEASUREMENT and req.measurements:
-                for name, val in req.measurements.items():
-                    ch = self.channel_map.channel_of(name)
-                    values[ch] = val
-                    mask[ch] = True
-            elif et is EventType.LOCATION:
-                if req.latitude is not None and req.longitude is not None:
-                    values[0], values[1] = req.latitude, req.longitude
-                    values[2] = req.elevation or 0.0
-                    mask[:3] = True
-            elif et is EventType.ALERT:
-                values[0] = float(int(req.alert_level))
-                mask[0] = True
-                aux0 = self.alert_types.intern(req.alert_type or "alert")
-            elif et is EventType.COMMAND_RESPONSE and req.originating_event_id:
-                aux0 = self.event_ids.intern(req.originating_event_id)
-            elif et is EventType.STATE_CHANGE and (req.attribute or req.state_type):
-                aux0 = self.event_ids.intern(
-                    f"{req.attribute or ''}:{req.state_type or ''}")
-            aux1 = (self.event_ids.intern(req.alternate_id)
-                    if req.alternate_id is not None else NULL_ID)
-            shard, local = self._route(gid)
-            has_vals = mask.any()
-            if self.config.fair_tenancy:
-                i32 = np.int32
-                self._fair_enqueue(shard, tenant_id, _FairChunk(
-                    etype=np.array([et], i32),
-                    token=np.array([local], i32),
-                    ts=np.array([ts], i32),
-                    recv=np.array([now], i32),
-                    values=values[None].copy() if has_vals else None,
-                    vmask=mask[None].copy() if has_vals else None,
-                    aux0=np.array([aux0], i32),
-                    aux1=np.array([aux1], i32),
-                ))
-                return
-            if not self._buf.append_row(shard, et, local, tenant_id, ts, now,
-                                        values if has_vals else None,
-                                        mask if has_vals else None, aux0, aux1):
-                self.flush_async()
-                self._buf.append_row(shard, et, local, tenant_id, ts, now,
-                                     values if has_vals else None,
-                                     mask if has_vals else None, aux0, aux1)
-            if self._buf.room(shard) == 0:
-                self.flush_async()
+    # process() comes from IngestHostMixin; it converts the request to one
+    # SoA row and calls _stage_row, which routes it to its owning shard.
+    def _stage_row(self, et, token_id, tenant_id, ts, now, values, mask,
+                   aux0, aux1):
+        """Stage one converted event row into its owning shard's buffer
+        (``token_id`` is the GLOBAL interner id). Caller holds the lock."""
+        shard, local = self._route(token_id)
+        has_vals = mask is not None and mask.any()
+        if self.config.fair_tenancy:
+            i32 = np.int32
+            self._fair_enqueue(shard, tenant_id, _FairChunk(
+                etype=np.array([et], i32),
+                token=np.array([local], i32),
+                ts=np.array([ts], i32),
+                recv=np.array([now], i32),
+                values=values[None].copy() if has_vals else None,
+                vmask=mask[None].copy() if has_vals else None,
+                aux0=np.array([aux0], i32),
+                aux1=np.array([aux1], i32),
+            ))
+            return
+        if not self._buf.append_row(shard, et, local, tenant_id, ts, now,
+                                    values if has_vals else None,
+                                    mask if has_vals else None, aux0, aux1):
+            self.flush_async()
+            self._buf.append_row(shard, et, local, tenant_id, ts, now,
+                                 values if has_vals else None,
+                                 mask if has_vals else None, aux0, aux1)
+        if self._buf.room(shard) == 0:
+            self.flush_async()
 
     def ingest_json_batch(self, payloads: list[bytes],
                           tenant: str = "default") -> dict:
@@ -473,43 +412,12 @@ class DistributedEngine(IngestHostMixin):
     def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
         """Stage a natively decoded SoA batch, grouped by owning shard with
         one argsort (the vectorized Kafka-partitioner hop)."""
-        from sitewhere_tpu.ingest.fast_decode import (
-            RT_ACK,
-            RT_MAP,
-            RT_REGISTER,
-            RTYPE_TO_ETYPE,
-        )
-
         with self.lock:
             now = self.epoch.now_ms()
             base_ms = int(self.epoch.base_unix_s * 1000)
-            etype = RTYPE_TO_ETYPE[np.clip(res.rtype, -1, 7)]
-            ok = (res.rtype >= 0) & (etype >= 0)
-            regs = ((res.rtype == RT_REGISTER) | (res.rtype == RT_MAP)
-                    | (res.rtype == RT_ACK))
-            ok &= ~regs
-            failed = int(np.sum(res.rtype < 0))
-            n_reg_ok = 0
-            if np.any(regs):
-                with self._wal_suppress():
-                    for i in np.nonzero(regs)[0]:
-                        try:
-                            for req in reg_decoder.decode(payloads[int(i)], {}):
-                                req.tenant = tenant
-                                self.process(req)
-                            n_reg_ok += 1
-                        except Exception:
-                            failed += 1
-            ts_rel = np.where(
-                res.ts_ms64 >= 0,
-                np.clip(res.ts_ms64 - base_ms, -(2**31) + 1, 2**31 - 1),
-                now,
-            ).astype(np.int32)
-            values = res.values
-            alert_rows = ok & (etype == int(EventType.ALERT))
-            if np.any(alert_rows):
-                values = values.copy()
-                values[alert_rows, 0] = res.level[alert_rows]
+            etype, ok, ts_rel, values, failed, n_reg_ok = \
+                self._decode_prologue(res, payloads, tenant, reg_decoder,
+                                      now, base_ms)
             idxs = np.nonzero(ok)[0]
             tenant_id = self.tenants.intern(tenant)
             gids = res.token_id[idxs]
@@ -991,11 +899,15 @@ class DistributedEngine(IngestHostMixin):
                 if gdid is None:
                     return {"total": 0, "events": []}
                 shard_filter, dev_filter = self._split_gdid(gdid)
+            ten = NULL_ID
+            if tenant is not None:
+                ten = self.tenants.lookup(tenant)
+                if ten == NULL_ID:   # unknown tenant matches NOTHING —
+                    return {"total": 0, "events": []}   # never all tenants
             res = _stacked_query(
                 self.state.store,
                 jnp.int32(int(etype) if etype is not None else NULL_ID),
-                jnp.int32(self.tenants.lookup(tenant)
-                          if tenant is not None else NULL_ID),
+                jnp.int32(ten),
                 jnp.int32(since_ms if since_ms is not None else -(2**31)),
                 jnp.int32(until_ms if until_ms is not None else 2**31 - 1),
                 limit=limit,
@@ -1112,3 +1024,170 @@ class DistributedEngine(IngestHostMixin):
             | {"devices": int(self._next_device[s])}
             for s in range(self.n_shards)
         ]
+
+    # ------------------------------------------------------------- durability
+    def total_cursor(self) -> int:
+        """Sum of per-shard absolute store cursors — monotone under appends,
+        so it serves as the WAL watermark for the whole mesh."""
+        st = self.state.store
+        epochs = np.asarray(jax.device_get(st.epoch))
+        cursors = np.asarray(jax.device_get(st.cursor))
+        return int(np.sum(epochs.astype(np.int64)
+                          * self.config.store_capacity_per_shard
+                          + cursors))
+
+    def save(self, directory) -> dict:
+        """Full mesh snapshot: stacked device state + host mirrors +
+        interners. Pairs with the WAL for exact crash recovery
+        (recover_distributed)."""
+        import json
+        import pathlib
+
+        directory = pathlib.Path(directory)
+        with self.lock:
+            self._sync_mirrors()
+            manifest = self.sharded.save(directory)
+            cursor = self.total_cursor()
+            host = {
+                "format": 1,
+                "config": dataclasses.asdict(self.config),
+                "n_shards": self.n_shards,
+                "epoch_base_unix_s": self.epoch.base_unix_s,
+                "store_cursor": cursor,
+                "next_device": [int(x) for x in self._next_device],
+                "next_assignment": [int(x) for x in self._next_assignment],
+                "tokens": [self.tokens.token(i)
+                           for i in range(len(self.tokens))],
+                "tenants": [self.tenants.token(i)
+                            for i in range(len(self.tenants))],
+                "device_types": [self.device_types.token(i)
+                                 for i in range(len(self.device_types))],
+                "channel_names": [self.channel_map.names.token(i)
+                                  for i in range(len(self.channel_map.names))],
+                "alert_types": [self.alert_types.token(i)
+                                for i in range(len(self.alert_types))],
+                "areas": [self.areas.token(i) for i in range(len(self.areas))],
+                "customers": [self.customers.token(i)
+                              for i in range(len(self.customers))],
+                "assets": [self.assets.token(i)
+                           for i in range(len(self.assets))],
+                "event_ids": [self.event_ids.token(i)
+                              for i in range(len(self.event_ids))],
+                "token_device": {str(k): v for k, v in self.token_device.items()},
+                "devices": {str(d): dataclasses.asdict(i)
+                            for d, i in self.devices.items()},
+                "assignments": {str(a): dataclasses.asdict(i)
+                                for a, i in self.assignments.items()},
+                "device_slots": {str(k): v
+                                 for k, v in self.device_slots.items()},
+                "dead_letters": self.dead_letters[-4096:],
+            }
+            (directory / "host_distributed.json").write_text(json.dumps(host))
+            if self.wal is not None:
+                self.wal.append_watermark(cursor)
+                self.wal.sync()
+            manifest["store_cursor"] = cursor
+            return manifest
+
+
+def restore_distributed(directory) -> DistributedEngine:
+    """Reconstruct a DistributedEngine from a snapshot directory (same
+    shard count; use :func:`reshard_snapshot` to change it first)."""
+    import json
+    import pathlib
+
+    directory = pathlib.Path(directory)
+    host = json.loads((directory / "host_distributed.json").read_text())
+    config = DistributedConfig(**host["config"])
+    config.n_shards = host["n_shards"]
+    eng = DistributedEngine(config)
+    eng.sharded.restore(directory)
+    eng.epoch = EpochBase(host["epoch_base_unix_s"])
+    eng._next_device = np.asarray(host["next_device"], np.int64)
+    eng._next_assignment = np.asarray(host["next_assignment"], np.int64)
+    for tok in host["tokens"]:
+        eng.tokens.intern(tok)
+    for t in host["tenants"]:
+        eng.tenants.intern(t)
+    for t in host["device_types"]:
+        eng.device_types.intern(t)
+    for n in host["channel_names"]:
+        eng.channel_map.names.intern(n)
+    for a in host["alert_types"]:
+        eng.alert_types.intern(a)
+    for a in host["areas"]:
+        eng.areas.intern(a)
+    for cst in host["customers"]:
+        eng.customers.intern(cst)
+    for a in host["assets"]:
+        eng.assets.intern(a)
+    for e in host["event_ids"]:
+        eng.event_ids.intern(e)
+    eng.token_device = {int(k): v for k, v in host["token_device"].items()}
+    eng.devices = {int(k): DeviceInfo(**v)
+                   for k, v in host["devices"].items()}
+    eng.assignments = {int(k): AssignmentInfo(**v)
+                       for k, v in host["assignments"].items()}
+    eng.assignment_tokens = {i.token: a for a, i in eng.assignments.items()}
+    eng.device_slots = {int(k): list(v)
+                        for k, v in host["device_slots"].items()}
+    eng.dead_letters = list(host["dead_letters"])
+    return eng
+
+
+def recover_distributed(snapshot_dir, wal_dir=None) -> DistributedEngine:
+    """Crash recovery for the mesh engine: restore the snapshot, replay the
+    WAL tail past its watermark through the wire format that accepted each
+    record (at-least-once; the sharded state merge is timestamp-idempotent
+    like the single-node path)."""
+    import json
+    import pathlib
+
+    from sitewhere_tpu.utils.ingestlog import IngestLog
+
+    snapshot_dir = pathlib.Path(snapshot_dir)
+    eng = restore_distributed(snapshot_dir)
+    host = json.loads((snapshot_dir / "host_distributed.json").read_text())
+    if wal_dir is None and eng.config.wal_dir is None:
+        return eng
+    live_wal, eng.wal = eng.wal, None
+    foreign = wal_dir is not None and (
+        live_wal is None
+        or pathlib.Path(wal_dir).resolve() != live_wal.dir.resolve()
+    )
+    if foreign:
+        # recovery from a preserved copy: replay READ-ONLY, never append
+        wal = IngestLog(wal_dir, readonly=True)
+    else:
+        wal = live_wal
+
+    run_key: tuple | None = None
+    run: list[bytes] = []
+
+    def flush_run():
+        nonlocal run
+        if not run:
+            return
+        tag, tenant = run_key
+        if tag == WAL_JSON:
+            eng.ingest_json_batch(run, tenant=tenant)
+        else:
+            eng.ingest_binary_batch(run, tenant=tenant)
+        run = []
+
+    for rec in wal.replay(after_cursor=host["store_cursor"]):
+        tag = rec[:1]
+        sep = rec.index(b"\x00", 1)
+        key = (tag, rec[1:sep].decode())
+        if key != run_key or len(run) >= 4096:
+            flush_run()
+            run_key = key
+        run.append(rec[sep + 1:])
+    flush_run()
+    eng.flush()
+    # future traffic logs to the engine's configured WAL, never the
+    # read-only replay copy
+    if foreign:
+        wal.close()
+    eng.wal = live_wal
+    return eng

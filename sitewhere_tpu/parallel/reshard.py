@@ -1,0 +1,289 @@
+"""Elastic re-sharding: transform an N-shard snapshot into an M-shard one.
+
+The reference recovers from lost/added workers via Kafka consumer-group
+rebalancing — partitions reassign to the surviving consumers and the durable
+topics replay (SURVEY.md §5.4). Here shard state lives in HBM arrays, so
+elasticity is a host-side permutation: every token's owner is a pure
+function of its interner id (``gid % n_shards``), so changing the shard
+count moves each device, its assignments, its aggregated state rows, and
+its persisted events to the new owner — all as vectorized numpy scatters
+over the snapshot, no mesh required. Restore the result with
+``restore_distributed`` on the new mesh size.
+
+Notes:
+  * Per-shard ring stores are re-packed in (old-shard, append-order); when
+    a new shard's merged events exceed its ring capacity the OLDEST drop,
+    exactly like live ring overwrite.
+  * Outbound feed offsets are per-ring positions and do not survive a
+    reshard; consumers restart from the rebuilt rings (the Kafka analog:
+    a rebalance resets to the committed group offset of a NEW partition
+    map, which the reference also cannot carry over).
+  * Pair a reshard with a fresh WAL directory: the old WAL's watermark
+    refers to the old cursor line and is preserved in the host manifest,
+    so recovery replays the same tail, but new watermarks should not be
+    appended to the old log.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import numpy as np
+
+from sitewhere_tpu.core.types import NULL_ID
+
+
+def _load(src: pathlib.Path) -> tuple[dict, dict]:
+    host = json.loads((src / "host_distributed.json").read_text())
+    data = dict(np.load(src / "sharded_state.npz"))
+    return host, data
+
+
+def reshard_snapshot(src_dir, dst_dir, n_shards_new: int) -> dict:
+    """Rewrite the snapshot at ``src_dir`` for ``n_shards_new`` shards into
+    ``dst_dir``; returns the new host manifest."""
+    src, dst = pathlib.Path(src_dir), pathlib.Path(dst_dir)
+    dst.mkdir(parents=True, exist_ok=True)
+    host, data = _load(src)
+    s_old = host["n_shards"]
+    m = n_shards_new
+    cfg = host["config"]
+    n_cap = cfg["device_capacity_per_shard"]
+    g_cap = cfg["assignment_capacity_per_shard"]
+    c_cap = cfg["store_capacity_per_shard"]
+    t_cap = cfg["token_capacity_per_shard"]
+
+    tokens: list[str] = host["tokens"]
+    token_gid = {t: i for i, t in enumerate(tokens)}
+    if len(tokens) > m * t_cap:
+        raise ValueError(
+            f"{len(tokens)} tokens exceed new global capacity {m * t_cap}")
+
+    # ---- device map: old (shard, local) -> new (shard, local) -------------
+    # New locals allocate in old-global-id order per new shard, so the
+    # mapping is deterministic and dense.
+    next_dev = np.zeros(m, np.int64)
+    dev_old_s, dev_old_d, dev_new_s, dev_new_d = [], [], [], []
+    dmap = np.full((s_old, n_cap), NULL_ID, np.int64)      # -> new local did
+    dshard = np.full((s_old, n_cap), NULL_ID, np.int64)    # -> new shard
+    gdid_map: dict[int, int] = {}                          # old gdid -> new
+    for gid_str, old_gdid in sorted(host["token_device"].items(),
+                                    key=lambda kv: kv[1]):
+        gid = int(gid_str)
+        so, do = old_gdid % s_old, old_gdid // s_old
+        sn = gid % m
+        dn = int(next_dev[sn])
+        next_dev[sn] += 1
+        if dn >= n_cap:
+            raise ValueError(
+                f"shard {sn} would exceed device capacity {n_cap}")
+        dev_old_s.append(so)
+        dev_old_d.append(do)
+        dev_new_s.append(sn)
+        dev_new_d.append(dn)
+        dmap[so, do] = dn
+        dshard[so, do] = sn
+        gdid_map[old_gdid] = dn * m + sn
+    dev_old_s = np.asarray(dev_old_s, np.int64)
+    dev_old_d = np.asarray(dev_old_d, np.int64)
+    dev_new_s = np.asarray(dev_new_s, np.int64)
+    dev_new_d = np.asarray(dev_new_d, np.int64)
+
+    # ---- assignment map (assignment shard == its device's new shard) ------
+    next_asg = np.zeros(m, np.int64)
+    asg_old_s, asg_old_a, asg_new_s, asg_new_a = [], [], [], []
+    amap = np.full((s_old, g_cap), NULL_ID, np.int64)
+    gaid_map: dict[int, int] = {}
+    for gaid_str in sorted(host["assignments"], key=int):
+        gaid = int(gaid_str)
+        info = host["assignments"][gaid_str]
+        so, ao = gaid % s_old, gaid // s_old
+        gid = token_gid.get(info["device_token"])
+        if gid is None:
+            continue
+        sn = gid % m
+        an = int(next_asg[sn])
+        next_asg[sn] += 1
+        if an >= g_cap:
+            raise ValueError(
+                f"shard {sn} would exceed assignment capacity {g_cap}")
+        asg_old_s.append(so)
+        asg_old_a.append(ao)
+        asg_new_s.append(sn)
+        asg_new_a.append(an)
+        amap[so, ao] = an
+        gaid_map[gaid] = an * m + sn
+    asg_old_s = np.asarray(asg_old_s, np.int64)
+    asg_old_a = np.asarray(asg_old_a, np.int64)
+    asg_new_s = np.asarray(asg_new_s, np.int64)
+    asg_new_a = np.asarray(asg_new_a, np.int64)
+
+    def remap_values(vals: np.ndarray, old_shard: np.ndarray,
+                     table: np.ndarray) -> np.ndarray:
+        """Translate shard-local id VALUES (e.g. assignment ids stored in
+        device rows) through ``table[old_shard, value]``; NULL passes."""
+        ok = vals != NULL_ID
+        out = np.full_like(vals, NULL_ID)
+        sh = np.broadcast_to(old_shard.reshape((-1,) + (1,) * (vals.ndim - 1)),
+                             vals.shape)
+        out[ok] = table[sh[ok], vals[ok]]
+        return out
+
+    out: dict[str, np.ndarray] = {}
+
+    # ---- registry + device_state leaves -----------------------------------
+    old_shard_col = np.arange(s_old)
+    for key, arr in data.items():
+        if key in (".next_device", ".next_assignment") or \
+           key.startswith(".metrics.") or key.startswith(".store."):
+            continue
+        if key.endswith("token_to_device"):
+            new = np.full((m, t_cap), NULL_ID, arr.dtype)
+            gids = np.asarray([int(g) for g in host["token_device"]], np.int64)
+            if len(gids):
+                new_d = np.asarray(
+                    [gdid_map[host["token_device"][str(g)]] // m
+                     for g in gids], np.int64)
+                new[gids % m, gids // m] = new_d.astype(arr.dtype)
+            out[key] = new
+            continue
+        if key.startswith(".registry.device") or key.startswith(".device_state."):
+            fill = (np.zeros((), arr.dtype) if arr.dtype == np.bool_
+                    else _fill_like(key, arr))
+            new = np.full((m,) + arr.shape[1:], fill, arr.dtype)
+            vals = arr[dev_old_s, dev_old_d]
+            if key.endswith("device_assignments"):
+                vals = remap_values(vals.astype(np.int64), dev_old_s,
+                                    amap).astype(arr.dtype)
+            elif key.endswith("device_parent"):
+                # parent column is shard-local; it survives only when the
+                # parent moved to the same new shard as the child
+                vals = vals.astype(np.int64)
+                ok = vals != NULL_ID
+                same = np.zeros_like(ok)
+                same[ok] = dshard[dev_old_s[ok], vals[ok]] == dev_new_s[ok]
+                moved = remap_values(vals, dev_old_s, dmap)
+                vals = np.where(ok & same, moved, NULL_ID).astype(arr.dtype)
+            new[dev_new_s, dev_new_d] = vals
+            out[key] = new
+            continue
+        if key.startswith(".registry.assignment"):
+            fill = _fill_like(key, arr)
+            new = np.full((m,) + arr.shape[1:], fill, arr.dtype)
+            vals = arr[asg_old_s, asg_old_a]
+            if key.endswith("assignment_device"):
+                vals = remap_values(vals.astype(np.int64), asg_old_s,
+                                    dmap).astype(arr.dtype)
+            new[asg_new_s, asg_new_a] = vals
+            out[key] = new
+            continue
+        raise ValueError(f"unhandled snapshot leaf {key!r}")
+
+    # ---- event ring re-pack ----------------------------------------------
+    store_keys = [k for k in data if k.startswith(".store.")
+                  and k not in (".store.cursor", ".store.epoch")]
+    rows_per_new: list[list[dict]] = [[] for _ in range(m)]
+    for so in range(s_old):
+        cursor = int(data[".store.cursor"][so])
+        epoch = int(data[".store.epoch"][so])
+        order = (np.concatenate([np.arange(cursor, c_cap),
+                                 np.arange(cursor)])
+                 if epoch > 0 else np.arange(cursor))
+        valid = data[".store.valid"][so][order]
+        order = order[valid]
+        if not len(order):
+            continue
+        devs = data[".store.device"][so][order].astype(np.int64)
+        new_s = np.where(devs != NULL_ID, dshard[so, devs], NULL_ID)
+        cols = {k: data[k][so][order] for k in store_keys}
+        cols[".store.device"] = remap_values(devs, np.full_like(devs, so),
+                                             dmap)
+        asgs = data[".store.assignment"][so][order].astype(np.int64)
+        cols[".store.assignment"] = remap_values(
+            asgs, np.full_like(asgs, so), amap)
+        for sn in range(m):
+            sel = new_s == sn
+            if np.any(sel):
+                rows_per_new[sn].append(
+                    {k: v[sel] for k, v in cols.items()})
+    new_cursor = np.zeros(m, np.int32)
+    new_epoch = np.zeros(m, np.int32)
+    for k in store_keys:
+        out[k] = np.zeros((m,) + data[k].shape[1:], data[k].dtype)
+        if k in (".store.device", ".store.assignment", ".store.tenant",
+                 ".store.area", ".store.asset", ".store.aux"):
+            out[k][:] = NULL_ID
+    for sn in range(m):
+        if not rows_per_new[sn]:
+            continue
+        merged = {k: np.concatenate([c[k] for c in rows_per_new[sn]])
+                  for k in store_keys}
+        n = len(merged[".store.valid"])
+        if n > c_cap:                      # ring overflow: oldest drop
+            merged = {k: v[n - c_cap:] for k, v in merged.items()}
+            n = c_cap
+        for k in store_keys:
+            out[k][sn, :n] = merged[k]
+        new_cursor[sn] = n % c_cap
+        new_epoch[sn] = n // c_cap
+    out[".store.cursor"] = new_cursor
+    out[".store.epoch"] = new_epoch
+
+    # ---- counters + metrics ----------------------------------------------
+    out[".next_device"] = next_dev.astype(data[".next_device"].dtype)
+    out[".next_assignment"] = next_asg.astype(data[".next_assignment"].dtype)
+    for key in data:
+        if key.startswith(".metrics."):
+            # per-shard attribution doesn't survive a reshard; keep the
+            # global totals exact by folding them onto shard 0
+            new = np.zeros(m, data[key].dtype)
+            new[0] = data[key].sum()
+            out[key] = new
+
+    np.savez_compressed(dst / "sharded_state.npz", **out)
+
+    # ---- manifests --------------------------------------------------------
+    sharded_manifest = json.loads((src / "sharded_manifest.json").read_text())
+    sharded_manifest["n_shards"] = m
+    (dst / "sharded_manifest.json").write_text(json.dumps(sharded_manifest))
+
+    host["n_shards"] = m
+    # wal_dir is dropped: the resharded engine must NOT append watermarks
+    # into the original live WAL (its cursor line no longer matches);
+    # attach a fresh WAL explicitly after restore
+    host["config"] = dict(cfg, n_shards=m, wal_dir=None)
+    host["next_device"] = [int(x) for x in next_dev]
+    host["next_assignment"] = [int(x) for x in next_asg]
+    host["token_device"] = {
+        g: gdid_map[old] for g, old in host["token_device"].items()}
+    host["devices"] = {
+        str(gdid_map[int(k)]): v for k, v in host["devices"].items()
+        if int(k) in gdid_map}
+    new_assignments = {}
+    for k, v in host["assignments"].items():
+        if int(k) in gaid_map:
+            v = dict(v, id=gaid_map[int(k)])
+            new_assignments[str(gaid_map[int(k)])] = v
+    host["assignments"] = new_assignments
+    host["device_slots"] = {
+        str(gdid_map[int(k)]): [gaid_map.get(a, NULL_ID) if a != NULL_ID
+                                else NULL_ID for a in v]
+        for k, v in host["device_slots"].items() if int(k) in gdid_map}
+    (dst / "host_distributed.json").write_text(json.dumps(host))
+    return host
+
+
+def _fill_like(key: str, arr: np.ndarray):
+    """Empty-row fill matching the zeros() initializers of the state
+    dataclasses (NULL for id lanes, INT32_MIN for timestamp lanes)."""
+    if arr.dtype == np.bool_:
+        return False
+    if arr.dtype == np.float32:
+        return 0.0
+    if key.endswith("_ms") or "last_interaction" in key:
+        return np.iinfo(np.int32).min
+    if "presence" in key or "event_counts" in key or "status" in key \
+            or key.endswith("etype"):
+        return 0
+    return NULL_ID

@@ -165,13 +165,15 @@ def recover_engine(snapshot_dir: str | pathlib.Path,
         return engine
     # never re-log records while replaying them
     live_wal, engine.wal = engine.wal, None
-    if wal_dir is not None and (
+    foreign = wal_dir is not None and (
         live_wal is None
         or pathlib.Path(wal_dir).resolve() != live_wal.dir.resolve()
-    ):
+    )
+    if foreign:
         # an explicitly named WAL (e.g. a copy on a recovery host) wins
-        # over the config-path log the restored engine opened
-        wal = IngestLog(wal_dir)
+        # over the config-path log the restored engine opened — opened
+        # READ-ONLY so the preserved copy stays byte-identical
+        wal = IngestLog(wal_dir, readonly=True)
     else:
         wal = live_wal
 
@@ -199,6 +201,9 @@ def recover_engine(snapshot_dir: str | pathlib.Path,
         run.append(rec[sep + 1:])
     flush_run()
     engine.flush()
-    # future traffic logs to the engine's configured WAL, not a replay copy
-    engine.wal = live_wal if live_wal is not None else wal
+    # future traffic logs to the engine's configured WAL, never the
+    # read-only replay copy
+    if foreign:
+        wal.close()
+    engine.wal = live_wal
     return engine

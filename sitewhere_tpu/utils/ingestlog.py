@@ -30,9 +30,14 @@ _MAGIC = b"SWAL1\n"   # segment format marker; absent = legacy length-only
 
 class IngestLog:
     def __init__(self, directory: str | pathlib.Path,
-                 segment_bytes: int = 64 << 20):
+                 segment_bytes: int = 64 << 20, readonly: bool = False):
+        """``readonly`` opens the log for replay only: no tail segment is
+        created and appends raise — the mode for forensic/recovery copies
+        that must stay byte-identical."""
         self.dir = pathlib.Path(directory)
-        self.dir.mkdir(parents=True, exist_ok=True)
+        self.readonly = readonly
+        if not readonly:
+            self.dir.mkdir(parents=True, exist_ok=True)
         self.segment_bytes = segment_bytes
         self._lock = threading.Lock()
         existing = sorted(self.dir.glob("segment-*.log"))
@@ -40,7 +45,8 @@ class IngestLog:
             int(existing[-1].stem.split("-")[1]) + 1 if existing else 0
         )
         self._fh = None
-        self._open_segment()
+        if not readonly:
+            self._open_segment()
 
     def _open_segment(self) -> None:
         if self._fh is not None:
@@ -51,6 +57,8 @@ class IngestLog:
             self._fh.write(_MAGIC)
 
     def append(self, payload: bytes) -> None:
+        if self.readonly:
+            raise RuntimeError("read-only ingest log")
         with self._lock:
             self._fh.write(struct.pack("<II", len(payload),
                                        zlib.crc32(payload)))
@@ -62,6 +70,8 @@ class IngestLog:
 
     def append_watermark(self, store_cursor: int) -> None:
         """Record that all payloads so far are reflected at this cursor."""
+        if self.readonly:
+            raise RuntimeError("read-only ingest log")
         body = json.dumps({"cursor": store_cursor}).encode()
         with self._lock:
             self._fh.write(struct.pack("<I", _WATERMARK))
@@ -72,10 +82,13 @@ class IngestLog:
     def flush(self) -> None:
         """Push buffered records to the OS (survives a process crash)."""
         with self._lock:
-            self._fh.flush()
+            if self._fh is not None:
+                self._fh.flush()
 
     def sync(self) -> None:
         with self._lock:
+            if self._fh is None:
+                return
             self._fh.flush()
             import os
 
