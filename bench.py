@@ -1,10 +1,21 @@
-"""Headline benchmark: decoded device events/sec/chip through the full fused
-pipeline (lookup -> registration -> expansion -> persistence -> windowed
-state merge) on real TPU hardware.
+"""Headline benchmark: decoded device events/sec/chip through the FULL host
+path — JSON wire bytes -> C++ batch decode -> staging -> scan-chunked fused
+TPU pipeline (lookup -> registration -> expansion -> persistence -> windowed
+state merge) -> state merge completed — under steady pipelined load on real
+TPU hardware.
 
 Baseline (BASELINE.md): north-star 1,000,000 decoded events/sec sustained
 inbound -> device-state on a v5e-8 pod => 125,000 events/sec/chip.
-``vs_baseline`` = measured events/sec/chip / 125,000.
+``vs_baseline`` = measured events/sec/chip / 125,000. The headline is the
+wire-facing host e2e number (what a deployment actually sustains); the
+device-only fused-step rate is logged as a diagnostic upper bound.
+
+Methodology note: on remote-tunnel runtimes, the FIRST device->host readback
+permanently downshifts the transfer stream (~100x slower dispatch rounds),
+so all e2e measurements run readback-free (completion via block_until_ready
+barriers) BEFORE any reporting readback. Latency numbers come from a
+latency-tuned engine config (small batch/chunk); throughput from the
+throughput config — standard tuning split.
 
 Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 """
@@ -28,15 +39,77 @@ def main() -> None:
 
     from sitewhere_tpu.core.events import EventBatch
     from sitewhere_tpu.core.types import EventType, NULL_ID
-    from sitewhere_tpu.pipeline import PipelineConfig, PipelineState, make_pipeline_step
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.loadgen import run_engine_load
+    from sitewhere_tpu.pipeline import (
+        PipelineConfig,
+        PipelineState,
+        make_pipeline_step,
+    )
 
+    log(f"devices: {jax.devices()}")
+
+    # ------------------------------------------------------------------
+    # PHASE 1 — clean-stream e2e runs (NO device->host readback anywhere).
+    # ------------------------------------------------------------------
+    t0 = time.perf_counter()
+    eng = Engine(EngineConfig(
+        device_capacity=1 << 15, token_capacity=1 << 16,
+        assignment_capacity=1 << 16, store_capacity=1 << 18,
+        batch_capacity=8192, scan_chunk=8,
+    ))
+    pstats = run_engine_load(eng, n_batches=64, batch_size=8192,
+                             n_devices=10_000, warmup_batches=9,
+                             pipelined=True)
+    host_eps = pstats.events_per_s
+    host_p50, host_p99 = pstats.latency_p50_ms, pstats.latency_p99_ms
+    log(f"host e2e pipelined warm+run: {time.perf_counter() - t0:.1f}s")
+
+    # latency-tuned config: small batches, shallow chunks
+    lat_eng = Engine(EngineConfig(
+        device_capacity=1 << 15, token_capacity=1 << 16,
+        assignment_capacity=1 << 16, store_capacity=1 << 16,
+        batch_capacity=2048, scan_chunk=2,
+    ))
+    lstats = run_engine_load(lat_eng, n_batches=64, batch_size=2048,
+                             n_devices=10_000, warmup_batches=3,
+                             pipelined=True)
+    lat_p50, lat_p99 = lstats.latency_p50_ms, lstats.latency_p99_ms
+
+    # binary wire format through the same host path (protobuf-slot)
+    from sitewhere_tpu.ingest.decoders import encode_binary_request
+    from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+    beng = Engine(EngineConfig(
+        device_capacity=1 << 15, token_capacity=1 << 16,
+        assignment_capacity=1 << 16, store_capacity=1 << 18,
+        batch_capacity=8192, scan_chunk=8,
+    ))
+    rng_b = np.random.default_rng(1)
+    bpay = [encode_binary_request(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT,
+        device_token=f"lg-{int(rng_b.integers(0, 10_000))}",
+        measurements={"engine.temperature": float(i % 80)}))
+        for i in range(8192)]
+    for _ in range(9):
+        beng.ingest_binary_batch(bpay)  # warm + compile
+    beng.barrier()
+    t1 = time.perf_counter()
+    for _ in range(32):
+        beng.ingest_binary_batch(bpay)
+        if beng.staged_count:
+            beng.flush_async()
+    beng.barrier()
+    bin_eps = 32 * 8192 / (time.perf_counter() - t1)
+
+    # Device-only fused-step diagnostic (upper bound): batches pre-staged
+    # on device, one step per dispatch. Still readback-free (phase 1).
     BATCH = 32768
     CHANNELS = 8
     N_DEVICES = 131072
     STEPS = 30
     WARMUP = 5
 
-    log(f"devices: {jax.devices()}")
     state = PipelineState.create(
         device_capacity=N_DEVICES,
         token_capacity=2 * N_DEVICES,
@@ -45,15 +118,13 @@ def main() -> None:
         channels=CHANNELS,
     )
     step = make_pipeline_step(PipelineConfig(auto_register=True))
-
-    # Realistic single-tenant telemetry mix (BASELINE config #1-3): 70%
-    # measurements, 20% locations, 10% alerts over N_DEVICES devices.
     rng = np.random.default_rng(0)
 
     def make_batch(i: int) -> EventBatch:
         tok = rng.integers(0, N_DEVICES, BATCH).astype(np.int32)
         ety = rng.choice(
-            [EventType.MEASUREMENT] * 7 + [EventType.LOCATION] * 2 + [EventType.ALERT],
+            [EventType.MEASUREMENT] * 7 + [EventType.LOCATION] * 2
+            + [EventType.ALERT],
             BATCH,
         ).astype(np.int32)
         ts = (i * 1000 + rng.integers(0, 1000, BATCH)).astype(np.int32)
@@ -73,14 +144,12 @@ def main() -> None:
             seq=jnp.arange(BATCH, dtype=jnp.int32),
         )
 
-    # Pre-stage batches on device so we measure the pipeline, not host RNG.
     batches = [jax.block_until_ready(make_batch(i)) for i in range(8)]
-
     t0 = time.perf_counter()
     for i in range(WARMUP):
         state, out = step(state, batches[i % len(batches)])
     jax.block_until_ready(out)
-    log(f"warmup+compile: {time.perf_counter() - t0:.1f}s")
+    dev_compile_s = time.perf_counter() - t0
 
     lat = []
     t_start = time.perf_counter()
@@ -90,77 +159,43 @@ def main() -> None:
         jax.block_until_ready(out)
         lat.append(time.perf_counter() - t1)
     elapsed = time.perf_counter() - t_start
-
     events = STEPS * BATCH
     lat_ms = sorted(1000 * l for l in lat)
-    p50 = lat_ms[len(lat_ms) // 2]
-    p99 = lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))]
-    # Headline = sustained wall-clock throughput (what BASELINE.md defines);
-    # the median-step rate is logged as a diagnostic for the chip's
-    # dispatch-jitter-free capability.
+    dp50 = lat_ms[len(lat_ms) // 2]
+    dp99 = lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))]
     eps = events / elapsed
-    m = state.metrics
+
+    # ------------------------------------------------------------------
+    # PHASE 2 — reporting (readbacks permitted from here on).
+    # ------------------------------------------------------------------
+    eng.flush()
+    m = eng.metrics()
+    expected = (64 + 9) * 8192
     log(
-        f"{events} events in {elapsed:.3f}s -> {eps:,.0f} ev/s/chip sustained; "
-        f"median-step capability {BATCH / (p50 / 1000):,.0f} ev/s; "
-        f"step p50={p50:.2f}ms p99={p99:.2f}ms; "
-        f"found={int(m.found)} registered={int(m.registered)} persisted={int(m.persisted)}"
+        f"host e2e pipelined (json, batch=8192, scan_chunk=8): "
+        f"{host_eps:,.0f} ev/s; chunk-completion latency "
+        f"p50={host_p50:.1f}ms p99={host_p99:.1f}ms; "
+        f"persisted={m['persisted']} (expected {expected}) "
+        f"native={eng._native_decoder is not None}"
+    )
+    log(
+        f"host e2e latency-tuned (batch=2048, scan_chunk=2): "
+        f"{lstats.events_per_s:,.0f} ev/s; "
+        f"p50={lat_p50:.1f}ms p99={lat_p99:.1f}ms"
+    )
+    log(f"host e2e binary wire (pipelined): {bin_eps:,.0f} ev/s")
+    if m["persisted"] != expected:
+        log(f"WARNING: persisted {m['persisted']} != expected {expected}")
+    dm = state.metrics
+    log(
+        f"device-only fused step (warmup+compile {dev_compile_s:.1f}s): "
+        f"{eps:,.0f} ev/s/chip sustained; "
+        f"median-step capability {BATCH / (dp50 / 1000):,.0f} ev/s; "
+        f"step p50={dp50:.2f}ms p99={dp99:.2f}ms; "
+        f"found={int(dm.found)} persisted={int(dm.persisted)}"
     )
 
-    # Diagnostic (stderr): full HOST path — JSON bytes -> C++ decode ->
-    # staging -> fused step -> state merged. This is the wire-facing
-    # inbound->device-state latency of BASELINE.md (target p99 < 50 ms).
-    try:
-        from sitewhere_tpu.engine import Engine, EngineConfig
-        from sitewhere_tpu.loadgen import run_engine_load
-
-        eng = Engine(EngineConfig(
-            device_capacity=1 << 15, token_capacity=1 << 16,
-            assignment_capacity=1 << 16, store_capacity=1 << 17,
-            batch_capacity=8192,
-        ))
-        stats = run_engine_load(eng, n_batches=20, batch_size=8192,
-                                n_devices=10_000)
-        log(
-            f"host e2e sync (json->decode->state visible): "
-            f"{stats.events_per_s:,.0f} ev/s, "
-            f"p50={stats.latency_p50_ms:.1f}ms p99={stats.latency_p99_ms:.1f}ms "
-            f"(batch=8192, native={eng._native_decoder is not None})"
-        )
-        pstats = run_engine_load(eng, n_batches=20, batch_size=8192,
-                                 n_devices=10_000, warmup_batches=1,
-                                 pipelined=True)
-        log(
-            f"host e2e pipelined (steady-state ingest): "
-            f"{pstats.events_per_s:,.0f} ev/s"
-        )
-        # binary wire format through the same host path (protobuf-slot)
-        from sitewhere_tpu.ingest.decoders import encode_binary_request
-        from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
-
-        rng_b = np.random.default_rng(1)
-        bpay = [encode_binary_request(DecodedRequest(
-            type=RequestType.DEVICE_MEASUREMENT,
-            device_token=f"lg-{int(rng_b.integers(0, 10_000))}",
-            measurements={"engine.temperature": float(i % 80)}))
-            for i in range(8192)]
-        eng.ingest_binary_batch(bpay)  # warm
-        eng.flush()
-        t1 = time.perf_counter()
-        for _ in range(10):
-            eng.ingest_binary_batch(bpay)
-            if eng.staged_count:
-                eng.flush_async()
-        eng.drain()
-        jax.block_until_ready(eng.state.metrics.persisted)
-        dt = time.perf_counter() - t1
-        log(f"host e2e binary wire (pipelined): {10 * 8192 / dt:,.0f} ev/s")
-    except Exception as e:  # diagnostic only
-        log(f"host e2e diagnostic skipped: {e}")
-
-    # Diagnostic (stderr): analytics scoring path (BASELINE config #4) —
-    # anomaly score on 100-sensor windows, windows/s on the chip. Purely
-    # informational: never let its failure eat the headline JSON line.
+    # analytics scoring diagnostic (BASELINE config #4)
     try:
         from sitewhere_tpu.models.anomaly import AnomalyConfig, AnomalyModel
 
@@ -187,10 +222,16 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "decoded device events/sec/chip (inbound->device-state)",
-                "value": round(eps),
+                "metric": ("decoded device events/sec/chip "
+                           "(wire->decode->state, host e2e pipelined)"),
+                "value": round(host_eps),
                 "unit": "events/s/chip",
-                "vs_baseline": round(eps / baseline_per_chip, 3),
+                "vs_baseline": round(host_eps / baseline_per_chip, 3),
+                "latency_p50_ms": round(lat_p50, 1),
+                "latency_p99_ms": round(lat_p99, 1),
+                "throughput_cfg_latency_p99_ms": round(host_p99, 1),
+                "binary_wire_events_per_s": round(bin_eps),
+                "device_step_events_per_s": round(eps),
             }
         )
     )

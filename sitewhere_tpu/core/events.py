@@ -73,6 +73,65 @@ class EventBatch:
         )
 
 
+def pack_batches(batches: list[EventBatch]) -> np.ndarray:
+    """Pack numpy-backed EventBatches into ONE contiguous uint8 array
+    [K, row_bytes]. A remote-chip tunnel charges per-transfer overhead, so
+    one large buffer beats 10 per-field arrays by an order of magnitude;
+    the device side un-packs with free bitcasts (:func:`unpack_batch`)."""
+    rows = []
+    for b in batches:
+        rows.append(np.concatenate([
+            np.ascontiguousarray(b.valid).view(np.uint8).ravel(),
+            np.ascontiguousarray(b.etype).view(np.uint8).ravel(),
+            np.ascontiguousarray(b.token_id).view(np.uint8).ravel(),
+            np.ascontiguousarray(b.tenant_id).view(np.uint8).ravel(),
+            np.ascontiguousarray(b.ts_ms).view(np.uint8).ravel(),
+            np.ascontiguousarray(b.received_ms).view(np.uint8).ravel(),
+            np.ascontiguousarray(b.values).view(np.uint8).ravel(),
+            np.ascontiguousarray(b.vmask).view(np.uint8).ravel(),
+            np.ascontiguousarray(b.aux).view(np.uint8).ravel(),
+        ]))
+    return np.stack(rows)
+
+
+def unpack_batch(row, capacity: int, channels: int) -> EventBatch:
+    """Inverse of :func:`pack_batches` for one packed row — jnp bitcasts and
+    reshapes only (fused away by XLA), run INSIDE the consuming jit."""
+    from sitewhere_tpu.core.types import AUX_LANES
+
+    b, c = capacity, channels
+    off = 0
+
+    def take(nbytes):
+        nonlocal off
+        part = jax.lax.dynamic_slice_in_dim(row, off, nbytes)
+        off += nbytes
+        return part
+
+    def as_i32(part, shape):
+        return jax.lax.bitcast_convert_type(
+            part.reshape(shape + (4,)), jnp.int32).reshape(shape)
+
+    def as_f32(part, shape):
+        return jax.lax.bitcast_convert_type(
+            part.reshape(shape + (4,)), jnp.float32).reshape(shape)
+
+    valid = take(b).astype(jnp.bool_)
+    etype = as_i32(take(4 * b), (b,))
+    token_id = as_i32(take(4 * b), (b,))
+    tenant_id = as_i32(take(4 * b), (b,))
+    ts_ms = as_i32(take(4 * b), (b,))
+    received_ms = as_i32(take(4 * b), (b,))
+    values = as_f32(take(4 * b * c), (b, c))
+    vmask = take(b * c).reshape(b, c).astype(jnp.bool_)
+    aux = as_i32(take(4 * b * AUX_LANES), (b, AUX_LANES))
+    return EventBatch(
+        valid=valid, etype=etype, token_id=token_id, tenant_id=tenant_id,
+        ts_ms=ts_ms, received_ms=received_ms, values=values, vmask=vmask,
+        aux=aux, seq=jnp.arange(b, dtype=jnp.int32),
+    )
+
+
 class EpochBase:
     """Host-side epoch base for int32 millisecond timestamps.
 
@@ -157,21 +216,27 @@ class HostEventBuffer:
         return True
 
     def emit(self) -> EventBatch:
-        """Produce an EventBatch from the staged rows and reset the buffer."""
+        """Produce an EventBatch from the staged rows and reset the buffer.
+
+        The batch is NUMPY-backed: the jit dispatch transfers all leaves in
+        one grouped host->device hop, which is markedly cheaper than
+        per-field ``jnp.asarray`` round trips when the chip sits behind a
+        network tunnel. The buffer re-allocates, so the emitted arrays are
+        never aliased by later staging."""
         n = self._n
         valid = np.zeros(self.capacity, np.bool_)
         valid[:n] = True
         batch = EventBatch(
-            valid=jnp.asarray(valid),
-            etype=jnp.asarray(self.etype),
-            token_id=jnp.asarray(self.token_id),
-            tenant_id=jnp.asarray(self.tenant_id),
-            ts_ms=jnp.asarray(self.ts_ms),
-            received_ms=jnp.asarray(self.received_ms),
-            values=jnp.asarray(self.values),
-            vmask=jnp.asarray(self.vmask),
-            aux=jnp.asarray(self.aux),
-            seq=jnp.arange(self.capacity, dtype=jnp.int32),
+            valid=valid,
+            etype=self.etype,
+            token_id=self.token_id,
+            tenant_id=self.tenant_id,
+            ts_ms=self.ts_ms,
+            received_ms=self.received_ms,
+            values=self.values,
+            vmask=self.vmask,
+            aux=self.aux,
+            seq=np.arange(self.capacity, dtype=np.int32),
         )
         self._n = 0
         self._alloc()

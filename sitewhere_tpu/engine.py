@@ -46,6 +46,7 @@ from sitewhere_tpu.pipeline import (
     PipelineConfig,
     PipelineState,
     StepOutput,
+    make_packed_scan_step,
     make_pipeline_step,
     make_presence_sweep,
 )
@@ -113,6 +114,38 @@ class ChannelMap:
                 f"{len(unseen)} new measurement name(s) would exceed channel "
                 f"capacity {self.channels}; raise EngineConfig.channels or "
                 "drop strict_channels")
+
+
+def _merge_summaries(summaries: list[dict]) -> dict:
+    """Fold per-lane drain summaries into one (counts sum, token lists
+    concatenate) — the summary a flush() caller sees."""
+    out = {"found": 0, "missed": 0, "registered": 0, "persisted": 0,
+           "new_tokens": [], "dead_tokens": []}
+    for s in summaries:
+        for k in ("found", "missed", "registered", "persisted"):
+            out[k] += s[k]
+        out["new_tokens"].extend(s["new_tokens"])
+        out["dead_tokens"].extend(s["dead_tokens"])
+    return out
+
+
+def _empty_host_batch(capacity: int, channels: int):
+    """All-invalid numpy EventBatch (tail-chunk padding for scan dispatch)."""
+    from sitewhere_tpu.core.events import EventBatch
+    from sitewhere_tpu.core.types import AUX_LANES
+
+    return EventBatch(
+        valid=np.zeros(capacity, np.bool_),
+        etype=np.zeros(capacity, np.int32),
+        token_id=np.full(capacity, NULL_ID, np.int32),
+        tenant_id=np.full(capacity, NULL_ID, np.int32),
+        ts_ms=np.zeros(capacity, np.int32),
+        received_ms=np.zeros(capacity, np.int32),
+        values=np.zeros((capacity, channels), np.float32),
+        vmask=np.zeros((capacity, channels), np.bool_),
+        aux=np.full((capacity, AUX_LANES), NULL_ID, np.int32),
+        seq=np.arange(capacity, dtype=np.int32),
+    )
 
 
 class IngestHostMixin:
@@ -388,6 +421,17 @@ class EngineConfig:
                                        # (DeviceManagementTriggers analog)
     wal_dir: str | None = None         # write-ahead log directory; None
                                        # disables the durability log
+    scan_chunk: int = 1                # >1: dispatch K emitted batches as
+                                       # ONE lax.scan program (amortizes
+                                       # dispatch/transfer per chunk; adds
+                                       # up to K-1 batches of latency)
+    dispatch_depth: int = 1            # outstanding device programs before
+                                       # the dispatcher waits. 1 (default)
+                                       # is safe on remote-tunnel runtimes,
+                                       # where stacked outstanding programs
+                                       # degrade pathologically; colocated
+                                       # chips can raise it for host/device
+                                       # overlap
     analytics_devices: int = 0         # HBM telemetry windows for [0, M)
     analytics_window: int = 128        # W timesteps per window
 
@@ -629,6 +673,12 @@ class Engine(IngestHostMixin):
         self._step = make_pipeline_step(
             PipelineConfig(auto_register=c.auto_register, default_device_type=0)
         )
+        self._scan_step = make_packed_scan_step(
+            PipelineConfig(auto_register=c.auto_register, default_device_type=0),
+            c.batch_capacity, c.channels,
+        )
+        self._staged_batches: list = []   # emitted host batches awaiting a
+                                          # scan-chunk dispatch
         self._sweep = make_presence_sweep()
         self._buf = HostEventBuffer(c.batch_capacity, c.channels)
         self._last_flush = time.monotonic()
@@ -658,13 +708,16 @@ class Engine(IngestHostMixin):
 
     @property
     def staged_count(self) -> int:
-        return len(self._buf) + self._fair_queued
+        return (len(self._buf) + self._fair_queued
+                + sum(int(np.sum(b.valid)) for b in self._staged_batches))
 
     def _sync_mirrors(self) -> None:
         """Make host mirrors current: run any staged batch and absorb any
         pending async outputs. Caller holds the lock."""
         while len(self._buf) or self._fair_queued:
             self.flush_async()
+        if self._staged_batches:
+            self._dispatch_staged(all_batches=True)
         if self._pending_outs:
             self.drain()
 
@@ -847,30 +900,37 @@ class Engine(IngestHostMixin):
         with self.lock:
             expired = (time.monotonic() - self._last_flush
                        >= self.config.flush_interval_s)
-            if (len(self._buf) or self._fair_queued) and expired:
+            if (len(self._buf) or self._fair_queued
+                    or self._staged_batches) and expired:
                 return self.flush()
             if self._pending_outs and expired:
-                return self.drain()[-1]
+                return _merge_summaries(self.drain())
             return None
 
     def flush(self) -> dict:
-        """Run one pipeline step on the staged batch and sync host mirrors."""
+        """Run the staged work through the pipeline and sync host mirrors;
+        returns the AGGREGATE summary of everything drained (a flush may
+        cover several scan lanes, including empty padding lanes)."""
         from sitewhere_tpu.utils.tracing import stage
 
         with self.lock, stage("pipeline_step"):
             self.flush_async()
             while self._fair_queued:   # fair mode: one batch per dispatch
                 self.flush_async()
-            return self.drain()[-1]
+            self._dispatch_staged(all_batches=True)
+            return _merge_summaries(self.drain())
 
     def flush_async(self) -> None:
-        """Dispatch a step on the staged batch with NO host synchronization:
+        """Dispatch a step on the staged batch WITHOUT a mirror readback:
         the step output queues for :meth:`drain`. This is the steady-state
-        ingest path — back-to-back batches pipeline on device while the host
-        stages the next one (SURVEY.md §7 'avoid Python in the steady-state
-        loop'); host mirrors lag until the next drain/flush, which every
-        host-facing query performs first. No-op on an empty buffer (never
-        dispatches a zero-event device step)."""
+        ingest path; host mirrors lag until the next drain/flush, which
+        every host-facing query performs first. Outstanding device programs
+        are bounded by ``dispatch_depth`` (the dispatcher may wait for an
+        older program — never a readback). No-op on an empty buffer.
+
+        With ``scan_chunk > 1``, emitted batches accumulate and dispatch as
+        ONE ``lax.scan`` program per chunk — one transfer group + one
+        dispatch per K batches, the remote-chip amortizer."""
         with self.lock:
             # drain fair queues whenever rows are queued (even if the flag
             # was toggled off afterwards — queued rows must never strand)
@@ -879,28 +939,101 @@ class Engine(IngestHostMixin):
             if not len(self._buf):
                 return
             batch = self._buf.emit()
-            self.state, out = self._step(self.state, batch)
-            self._pending_outs.append(out)
+            if self.config.scan_chunk > 1:
+                self._staged_batches.append(batch)
+                self._dispatch_staged(all_batches=False)
+            else:
+                self.state, out = self._step(self.state, batch)
+                self._enqueue_out(out)
             self._last_flush = time.monotonic()
 
+    def _dispatch_staged(self, all_batches: bool) -> None:
+        """Dispatch accumulated batches as scanned K-chunks (one packed
+        transfer + one program per chunk). With ``all_batches`` a partial
+        tail chunk is PADDED with empty batches to K rather than dispatched
+        through the single-step program: the steady-state loop must run ONE
+        compiled program, because alternating programs over the donated
+        state forces repeated state relayout/conversion — catastrophically
+        slow on remote-tunnel runtimes. Empty padding batches are free
+        (valid=False rows, zero-count outputs)."""
+        from sitewhere_tpu.core.events import pack_batches
+
+        k = self.config.scan_chunk
+        while self._staged_batches:
+            if len(self._staged_batches) < k and not all_batches:
+                return
+            chunk, self._staged_batches = (self._staged_batches[:k],
+                                           self._staged_batches[k:])
+            while len(chunk) < k:   # pad the tail chunk with empty batches
+                chunk.append(_empty_host_batch(self.config.batch_capacity,
+                                               self.config.channels))
+            self.state, outs = self._scan_step(self.state,
+                                               pack_batches(chunk))
+            self._enqueue_out(outs)
+
+    def _enqueue_out(self, out: StepOutput) -> None:
+        """Queue a step output for drain, bounding outstanding device
+        programs to ``dispatch_depth``. At the default depth 1 the wait
+        lands on the just-dispatched program — deliberate for remote-tunnel
+        runtimes, where stacking outstanding programs degrades
+        pathologically (multi-second sync penalties); a completed-program
+        wait costs ~the step itself. Colocated deployments raise the depth
+        to overlap host staging with device execution."""
+        self._pending_outs.append(out)
+        d = max(1, self.config.dispatch_depth)
+        if len(self._pending_outs) >= d:
+            jax.block_until_ready(self._pending_outs[-d].n_persisted)
+
+    def barrier(self) -> None:
+        """Dispatch ALL staged work and wait for completion WITHOUT any
+        device->host readback. On remote-tunnel runtimes a single readback
+        can permanently downshift the transfer stream (measured: dispatch
+        rounds go from ~7ms to ~800ms after the first device_get), so the
+        steady-state ingest loop synchronizes with this barrier and defers
+        drain() — which does read — to reporting boundaries."""
+        with self.lock:
+            while len(self._buf) or self._fair_queued:
+                self.flush_async()
+            self._dispatch_staged(all_batches=True)
+            if self._pending_outs:
+                jax.block_until_ready(self._pending_outs[-1].n_persisted)
+
     def drain(self) -> list[dict]:
-        """Absorb every queued step output into the host mirrors (one
-        device->host transfer for the whole backlog); returns summaries."""
+        """Absorb every queued step output into the host mirrors. ONLY the
+        scalar counters are fetched for the whole backlog; the [B]-sized
+        token lists stay on device and are sliced to their actual lengths
+        for the (rare) steps that registered or dead-lettered — readback
+        bytes stay proportional to real occurrences, never batch capacity.
+        (Readback is the expensive direction through a remote-chip tunnel;
+        bulk array fetches there turn sub-ms steps into seconds.)"""
         with self.lock:
             if not self._pending_outs:
                 return [{"found": 0, "missed": 0, "registered": 0,
                          "persisted": 0, "new_tokens": [], "dead_tokens": []}]
             outs, self._pending_outs = self._pending_outs, []
-            outs = jax.device_get(outs)
-            return [self._absorb_output(o) for o in outs]
+            scalars = jax.device_get([
+                (o.n_found, o.n_missed, o.n_registered, o.n_persisted)
+                for o in outs])
+            summaries = []
+            for out, s in zip(outs, scalars):
+                if np.ndim(s[0]) == 0:           # single step
+                    summaries.append(self._absorb_output(
+                        out, *(int(x) for x in s)))
+                else:                             # scanned chunk: [K] lanes
+                    for kk in range(np.shape(s[0])[0]):
+                        sub = jax.tree_util.tree_map(lambda x: x[kk], out)
+                        summaries.append(self._absorb_output(
+                            sub, *(int(x[kk]) for x in s)))
+            return summaries
 
-    def _absorb_output(self, out: StepOutput) -> dict:
-        # ``out`` is already host-resident: drain() device_gets the whole
-        # pending backlog in ONE transfer — per-leaf np.asarray/int() reads
-        # would each cost a full round trip (~100ms+ when the chip sits
-        # behind a network tunnel), turning a sub-ms step into a
-        # seconds-long flush.
-        new_tokens = [int(t) for t in np.asarray(out.new_tokens) if t != NULL_ID]
+    def _absorb_output(self, out: StepOutput, n_found: int, n_missed: int,
+                       n_registered: int, n_persisted: int) -> dict:
+        # token lists are front-compacted on device: fetch exactly the
+        # occupied prefix (zero fetches in the common no-registration case)
+        new_tokens = []
+        if n_registered:
+            new_tokens = [int(t) for t in
+                          jax.device_get(out.new_tokens[:n_registered])]
         # mirror device-side auto-registration: allocation order == list order
         new_dids = []
         new_aids = []
@@ -913,7 +1046,8 @@ class Engine(IngestHostMixin):
             new_dids.append(did)
             new_aids.append(aid)
         if new_dids:
-            tenants = np.asarray(self.state.registry.device_tenant[np.asarray(new_dids)])
+            tenants = np.asarray(jax.device_get(
+                self.state.registry.device_tenant[np.asarray(new_dids)]))
             for tid, did, aid, ten in zip(new_tokens, new_dids, new_aids, tenants):
                 tenant = self.tenants.token(int(ten)) if int(ten) != NULL_ID else "default"
                 self.devices[did] = DeviceInfo(
@@ -923,13 +1057,15 @@ class Engine(IngestHostMixin):
                     auto_registered=True,
                 )
                 self._record_assignment(aid, did, slot=0)
-        dead = [int(t) for t in np.asarray(out.dead_tokens) if t != NULL_ID]
+        dead = []
+        if n_missed:
+            dead = [int(t) for t in jax.device_get(out.dead_tokens[:n_missed])]
         self.dead_letters.extend(dead)
         summary = {
-            "found": int(out.n_found),
-            "missed": int(out.n_missed),
-            "registered": int(out.n_registered),
-            "persisted": int(out.n_persisted),
+            "found": n_found,
+            "missed": n_missed,
+            "registered": n_registered,
+            "persisted": n_persisted,
             "new_tokens": new_tokens,
             "dead_tokens": dead,
         }

@@ -71,16 +71,21 @@ def _percentiles(lat_ms: list[float]) -> tuple[float, float, float]:
 def run_engine_load(engine, n_batches: int = 50, batch_size: int = 4096,
                     n_devices: int = 10_000, seed: int = 0,
                     warmup_batches: int = 3,
-                    pipelined: bool = False) -> LoadStats:
+                    pipelined: bool = False,
+                    sample_every: int = 8) -> LoadStats:
     """Drive the full host path: JSON bytes → native decode → staged → fused
     step → device state.
 
     pipelined=False — per-batch latency = submit → flush return (state
     merged and visible on the host), the inbound→device-state span of
     SURVEY.md §3.2-3.3.
-    pipelined=True — steady-state throughput: batches dispatch with
-    ``flush_async`` (no host sync inside the loop) and mirrors drain once
-    at the end; latency percentiles then cover only the submit span.
+    pipelined=True — steady-state throughput: batches dispatch as scanned
+    chunks; every chunk dispatch is completion-synchronous inside the
+    engine (depth-1), so each batch's e2e latency — submit → its chunk's
+    state merge completed — is observed WITHOUT any device->host readback
+    (readbacks permanently downshift remote-tunnel transfer streams). The
+    timed window ends at a readback-free ``barrier()``; mirror drain is
+    teardown/reporting, not ingest.
     """
     rng = np.random.default_rng(seed)
     toks = [f"lg-{i}" for i in range(n_devices)]
@@ -90,31 +95,45 @@ def run_engine_load(engine, n_batches: int = 50, batch_size: int = 4096,
         return [generate_measurements_message(toks[d], b * batch_size + i)
                 for i, d in enumerate(picks)]
 
-    for w in range(warmup_batches):          # compile + interner warm
+    for w in range(warmup_batches):          # compile + interner warm:
         engine.ingest_json_batch(make_batch(w))
+        if not pipelined:
+            engine.flush()
+    if pipelined:
+        # warmup compiles the scan-chunk program (incl. the padded-tail
+        # shape) without a mirror readback
+        engine.barrier()
+    else:
         engine.flush()
 
     # pre-build payloads so the generator itself stays out of the timing
     prebuilt = [make_batch(b) for b in range(n_batches)]
     latencies: list[float] = []
     decoded = failed = 0
+    submits: list[float] = []
     t0 = time.perf_counter()
-    for payloads in prebuilt:
+    for i, payloads in enumerate(prebuilt):
         s0 = time.perf_counter()
         res = engine.ingest_json_batch(payloads)
         if pipelined:
+            submits.append(s0)
             if engine.staged_count:
                 engine.flush_async()
+            if engine.staged_count == 0:
+                # the chunk holding every pending submit just completed
+                # (dispatch blocks until the state merge finished)
+                done = time.perf_counter()
+                latencies.extend((done - s) * 1e3 for s in submits)
+                submits.clear()
         else:
             engine.flush()                    # state merged on return
-        latencies.append((time.perf_counter() - s0) * 1e3)
+            latencies.append((time.perf_counter() - s0) * 1e3)
         decoded += res["decoded"]
         failed += res["failed"]
     if pipelined:
-        engine.drain()
-        import jax
-
-        jax.block_until_ready(engine.state.metrics.persisted)
+        engine.barrier()                      # tail chunk, no readback
+        done = time.perf_counter()
+        latencies.extend((done - s) * 1e3 for s in submits)
     wall = time.perf_counter() - t0
     p50, p99, mx = _percentiles(latencies)
     sent = n_batches * batch_size

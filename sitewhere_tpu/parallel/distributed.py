@@ -134,17 +134,19 @@ class _StackedBuffer:
     def emit(self) -> EventBatch:
         s, b = self.n_shards, self.capacity
         valid = np.arange(b)[None, :] < self.counts[:, None]
+        # numpy-backed: the sharded jit dispatch transfers all leaves in one
+        # grouped hop (no per-field device round trips)
         batch = EventBatch(
-            valid=jnp.asarray(valid),
-            etype=jnp.asarray(self.etype),
-            token_id=jnp.asarray(self.token_id),
-            tenant_id=jnp.asarray(self.tenant_id),
-            ts_ms=jnp.asarray(self.ts_ms),
-            received_ms=jnp.asarray(self.received_ms),
-            values=jnp.asarray(self.values),
-            vmask=jnp.asarray(self.vmask),
-            aux=jnp.asarray(self.aux),
-            seq=jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32), (s, b)),
+            valid=valid,
+            etype=self.etype,
+            token_id=self.token_id,
+            tenant_id=self.tenant_id,
+            ts_ms=self.ts_ms,
+            received_ms=self.received_ms,
+            values=self.values,
+            vmask=self.vmask,
+            aux=self.aux,
+            seq=np.broadcast_to(np.arange(b, dtype=np.int32), (s, b)).copy(),
         )
         self._alloc()
         return batch
@@ -564,52 +566,67 @@ class DistributedEngine(IngestHostMixin):
             self._last_flush = time.monotonic()
 
     def drain(self) -> list[dict]:
+        """Absorb queued stacked outputs. Only the [S] scalar counter lanes
+        are fetched for the whole backlog; per-shard token lists stay on
+        device and are sliced to their actual lengths only for shards that
+        registered or dead-lettered (readback bytes proportional to real
+        occurrences — bulk readback is the expensive direction through a
+        remote-chip tunnel)."""
         with self.lock:
             if not self._pending_outs:
                 return [{"found": 0, "missed": 0, "registered": 0,
                          "persisted": 0, "new_tokens": [], "dead_tokens": []}]
             outs, self._pending_outs = self._pending_outs, []
-            outs = jax.device_get(outs)
-            summaries = [self._absorb_output(o) for o in outs]
+            scalars = jax.device_get([
+                (o.n_found, o.n_missed, o.n_registered, o.n_persisted)
+                for o in outs])
+            summaries = [self._absorb_output(o, s)
+                         for o, s in zip(outs, scalars)]
             self._mirror_new_device_tenants()
             return summaries
 
-    def _absorb_output(self, out: StepOutput) -> dict:
+    def _absorb_output(self, out: StepOutput, scalars) -> dict:
         """Mirror one stacked step output: per-shard device-side allocation
         order == compacted new_tokens order, exactly like the single-node
         engine's contract."""
+        n_found_s, n_missed_s, n_reg_s, n_pers_s = (
+            np.asarray(x) for x in scalars)
         new_all: list[str] = []
         dead_all: list[str] = []
         for s in range(self.n_shards):
-            toks = [int(t) for t in np.asarray(out.new_tokens[s]) if t != NULL_ID]
-            for local_tok in toks:
-                gid = local_tok * self.n_shards + s
-                did = int(self._next_device[s])
-                aid = int(self._next_assignment[s])
-                self._next_device[s] += 1
-                self._next_assignment[s] += 1
-                gdid = self._gdid(s, did)
-                self.token_device[gid] = gdid
-                token = self.tokens.token(gid)
-                self.devices[gdid] = DeviceInfo(
-                    token=token,
-                    device_type=self.config.default_device_type,
-                    tenant="default",     # fixed up from device column below
-                    auto_registered=True,
-                )
-                self._pending_tenant_fixups.append((gdid, s, did))
-                self._record_assignment(self._gdid(s, aid), gdid, slot=0)
-                new_all.append(token)
-            for t in np.asarray(out.dead_tokens[s]):
-                if int(t) != NULL_ID:
-                    dead_all.append(self.tokens.token(
-                        int(t) * self.n_shards + s))
+            k = int(n_reg_s[s])
+            if k:
+                toks = jax.device_get(out.new_tokens[s, :k])
+                for local_tok in (int(t) for t in toks):
+                    gid = local_tok * self.n_shards + s
+                    did = int(self._next_device[s])
+                    aid = int(self._next_assignment[s])
+                    self._next_device[s] += 1
+                    self._next_assignment[s] += 1
+                    gdid = self._gdid(s, did)
+                    self.token_device[gid] = gdid
+                    token = self.tokens.token(gid)
+                    self.devices[gdid] = DeviceInfo(
+                        token=token,
+                        device_type=self.config.default_device_type,
+                        tenant="default",  # fixed up from device column below
+                        auto_registered=True,
+                    )
+                    self._pending_tenant_fixups.append((gdid, s, did))
+                    self._record_assignment(self._gdid(s, aid), gdid, slot=0)
+                    new_all.append(token)
+            dk = min(int(n_missed_s[s]), out.dead_tokens.shape[1])
+            if dk:
+                for t in jax.device_get(out.dead_tokens[s, :dk]):
+                    if int(t) != NULL_ID:
+                        dead_all.append(self.tokens.token(
+                            int(t) * self.n_shards + s))
         self.dead_letters.extend(dead_all)
         summary = {
-            "found": int(np.sum(out.n_found)),
-            "missed": int(np.sum(out.n_missed)),
-            "registered": int(np.sum(out.n_registered)),
-            "persisted": int(np.sum(out.n_persisted)),
+            "found": int(n_found_s.sum()),
+            "missed": int(n_missed_s.sum()),
+            "registered": int(n_reg_s.sum()),
+            "persisted": int(n_pers_s.sum()),
             "new_tokens": new_all,
             "dead_tokens": dead_all,
         }
@@ -1139,55 +1156,17 @@ def recover_distributed(snapshot_dir, wal_dir=None) -> DistributedEngine:
     """Crash recovery for the mesh engine: restore the snapshot, replay the
     WAL tail past its watermark through the wire format that accepted each
     record (at-least-once; the sharded state merge is timestamp-idempotent
-    like the single-node path)."""
+    like the single-node path). The replay mechanism is shared with
+    recover_engine (utils/checkpoint.replay_wal_into)."""
     import json
     import pathlib
 
-    from sitewhere_tpu.utils.ingestlog import IngestLog
+    from sitewhere_tpu.utils.checkpoint import replay_wal_into
 
     snapshot_dir = pathlib.Path(snapshot_dir)
     eng = restore_distributed(snapshot_dir)
     host = json.loads((snapshot_dir / "host_distributed.json").read_text())
     if wal_dir is None and eng.config.wal_dir is None:
         return eng
-    live_wal, eng.wal = eng.wal, None
-    foreign = wal_dir is not None and (
-        live_wal is None
-        or pathlib.Path(wal_dir).resolve() != live_wal.dir.resolve()
-    )
-    if foreign:
-        # recovery from a preserved copy: replay READ-ONLY, never append
-        wal = IngestLog(wal_dir, readonly=True)
-    else:
-        wal = live_wal
-
-    run_key: tuple | None = None
-    run: list[bytes] = []
-
-    def flush_run():
-        nonlocal run
-        if not run:
-            return
-        tag, tenant = run_key
-        if tag == WAL_JSON:
-            eng.ingest_json_batch(run, tenant=tenant)
-        else:
-            eng.ingest_binary_batch(run, tenant=tenant)
-        run = []
-
-    for rec in wal.replay(after_cursor=host["store_cursor"]):
-        tag = rec[:1]
-        sep = rec.index(b"\x00", 1)
-        key = (tag, rec[1:sep].decode())
-        if key != run_key or len(run) >= 4096:
-            flush_run()
-            run_key = key
-        run.append(rec[sep + 1:])
-    flush_run()
-    eng.flush()
-    # future traffic logs to the engine's configured WAL, never the
-    # read-only replay copy
-    if foreign:
-        wal.close()
-    eng.wal = live_wal
+    replay_wal_into(eng, host["store_cursor"], wal_dir)
     return eng

@@ -262,6 +262,26 @@ def make_pipeline_step(config: PipelineConfig):
 
 
 @functools.cache
+def make_packed_scan_step(config: PipelineConfig, capacity: int,
+                          channels: int):
+    """Like :func:`make_pipeline_scan_step`, but the K batches arrive as ONE
+    contiguous ``uint8[K, row_bytes]`` buffer (core/events.pack_batches) —
+    a single host->device transfer per chunk instead of 10 per batch, the
+    decisive factor when the chip sits behind a per-transfer-overhead
+    tunnel. Unpacking is bitcast/reshape only, fused into the step."""
+    from sitewhere_tpu.core.events import unpack_batch
+
+    def multi(state: PipelineState, packed):
+        def body(st, row):
+            return pipeline_step(st, unpack_batch(row, capacity, channels),
+                                 config)
+
+        return jax.lax.scan(body, state, packed)
+
+    return jax.jit(multi, donate_argnums=(0, 1))
+
+
+@functools.cache
 def make_presence_sweep():
     """Compiled presence sweep (DevicePresenceManager analog)."""
 

@@ -148,21 +148,18 @@ def restore_engine(directory: str | pathlib.Path) -> Engine:
     return engine
 
 
-def recover_engine(snapshot_dir: str | pathlib.Path,
-                   wal_dir: str | pathlib.Path | None = None) -> Engine:
-    """Full crash recovery: restore the snapshot, then replay the WAL tail
-    past its watermark — each record through the wire format that
-    originally accepted it (engine.py WAL_JSON/WAL_BINARY tags). The
-    result converges to the pre-crash state (at-least-once; the state
-    merge is timestamp-idempotent)."""
-    from sitewhere_tpu.engine import WAL_BINARY, WAL_JSON
+def replay_wal_into(engine, after_cursor: int,
+                    wal_dir: str | pathlib.Path | None) -> None:
+    """Shared WAL-replay mechanism for both engines (single-node and
+    distributed — identical recovery semantics by construction): resolve
+    the live vs an explicitly named (foreign, read-only) log, group
+    records into per-(wire-format, tenant) runs, feed them through the
+    ingest path that originally accepted them, and re-attach the live WAL.
+    ``engine`` provides wal / ingest_json_batch / ingest_binary_batch /
+    flush."""
+    from sitewhere_tpu.engine import WAL_BINARY, WAL_JSON  # noqa: F401
     from sitewhere_tpu.utils.ingestlog import IngestLog
 
-    snapshot_dir = pathlib.Path(snapshot_dir)
-    engine = restore_engine(snapshot_dir)
-    manifest = json.loads((snapshot_dir / "manifest.json").read_text())
-    if wal_dir is None and engine.config.wal_dir is None:
-        return engine
     # never re-log records while replaying them
     live_wal, engine.wal = engine.wal, None
     foreign = wal_dir is not None and (
@@ -191,7 +188,7 @@ def recover_engine(snapshot_dir: str | pathlib.Path,
             engine.ingest_binary_batch(run, tenant=tenant)
         run = []
 
-    for rec in wal.replay(after_cursor=manifest["store_cursor"]):
+    for rec in wal.replay(after_cursor=after_cursor):
         tag = rec[:1]
         sep = rec.index(b"\x00", 1)
         key = (tag, rec[1:sep].decode())
@@ -206,4 +203,19 @@ def recover_engine(snapshot_dir: str | pathlib.Path,
     if foreign:
         wal.close()
     engine.wal = live_wal
+
+
+def recover_engine(snapshot_dir: str | pathlib.Path,
+                   wal_dir: str | pathlib.Path | None = None) -> Engine:
+    """Full crash recovery: restore the snapshot, then replay the WAL tail
+    past its watermark — each record through the wire format that
+    originally accepted it (engine.py WAL_JSON/WAL_BINARY tags). The
+    result converges to the pre-crash state (at-least-once; the state
+    merge is timestamp-idempotent)."""
+    snapshot_dir = pathlib.Path(snapshot_dir)
+    engine = restore_engine(snapshot_dir)
+    manifest = json.loads((snapshot_dir / "manifest.json").read_text())
+    if wal_dir is None and engine.config.wal_dir is None:
+        return engine
+    replay_wal_into(engine, manifest["store_cursor"], wal_dir)
     return engine

@@ -237,3 +237,38 @@ def test_out_of_range_tokens_dead_letter():
     assert int(out.n_missed) == 4
     dead = sorted(int(t) for t in np.asarray(out.dead_tokens) if t != NULL_ID)
     assert dead == sorted([-5, 999999, 64, 2**30])
+
+
+def test_pack_unpack_roundtrip():
+    """pack_batches/unpack_batch must be an exact bit-level inverse (the
+    packed single-transfer path feeds the same pipeline as per-field
+    batches)."""
+    import jax
+
+    from sitewhere_tpu.core.events import (
+        EventBatch,
+        pack_batches,
+        unpack_batch,
+    )
+
+    rng = np.random.default_rng(3)
+    B, C = 64, 4
+    batch = EventBatch(
+        valid=rng.random(B) < 0.8,
+        etype=rng.integers(0, 6, B).astype(np.int32),
+        token_id=rng.integers(-1, 1000, B).astype(np.int32),
+        tenant_id=rng.integers(0, 5, B).astype(np.int32),
+        ts_ms=rng.integers(-(2**31), 2**31 - 1, B).astype(np.int32),
+        received_ms=rng.integers(0, 2**31 - 1, B).astype(np.int32),
+        values=rng.standard_normal((B, C)).astype(np.float32),
+        vmask=rng.random((B, C)) < 0.5,
+        aux=rng.integers(-1, 100, (B, 2)).astype(np.int32),
+        seq=np.arange(B, dtype=np.int32),
+    )
+    packed = pack_batches([batch, batch])
+    assert packed.shape[0] == 2 and packed.dtype == np.uint8
+    out = jax.jit(lambda p: unpack_batch(p[0], B, C))(packed)
+    for name in ("valid", "etype", "token_id", "tenant_id", "ts_ms",
+                 "received_ms", "values", "vmask", "aux", "seq"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(out, name)), getattr(batch, name), err_msg=name)
