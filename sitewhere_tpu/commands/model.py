@@ -60,6 +60,7 @@ class SystemCommandType(enum.Enum):
     REGISTRATION_ACK = "RegistrationAck"
     REGISTRATION_FAILED = "RegistrationFailed"
     DEVICE_STREAM_ACK = "DeviceStreamAck"
+    DEVICE_STREAM_DATA = "DeviceStreamData"   # chunk delivery to the device
 
 
 _invocation_ids = itertools.count(1)

@@ -42,6 +42,15 @@ class CommandRegistry:
     def get(self, token: str) -> DeviceCommand | None:
         return self._by_token.get(token)
 
+    def update(self, token: str, apply) -> DeviceCommand:
+        """Mutate one command definition in place (REST PUT path; reference:
+        DeviceTypes.java PUT /{token}/commands/{commandToken})."""
+        cmd = self._by_token.get(token)
+        if cmd is None:
+            raise KeyError(f"unknown command {token!r}")
+        apply(cmd)
+        return cmd
+
     def delete(self, token: str) -> bool:
         return self._by_token.pop(token, None) is not None
 

@@ -30,16 +30,25 @@ from sitewhere_tpu.core.types import AUX_LANES, DEFAULT_VALUE_CHANNELS, NULL_ID
 @dataclasses.dataclass(frozen=True)
 class EventStore:
     """Ring buffer of persisted events. S = capacity (power of two), C = value
-    channels. ``cursor`` counts total events ever written; row i of logical
-    event k is k % S."""
+    channels, A = tenant arenas.
 
-    cursor: jax.Array       # int32[] total writes (wraps with epoch)
-    epoch: jax.Array        # int32[] increments on cursor wrap
+    With ``arenas == 1`` (default) the whole store is one ring. With
+    ``arenas > 1`` the rows partition into A equal sub-rings and every event
+    appends into arena ``tenant_id % A`` — hard per-tenant retention
+    isolation: one tenant's burst can only evict that arena's rows, never
+    another arena's (the per-tenant-HBM-arena answer to the reference's
+    engine-per-tenant isolation, InboundProcessingMicroservice.java:84-86).
+    ``cursor[a]``/``epoch[a]`` track arena a's write position; row i of
+    arena a's logical event k is a*(S/A) + k % (S/A)."""
+
+    cursor: jax.Array       # int32[A] per-arena writes (wraps with epoch)
+    epoch: jax.Array        # int32[A] increments on cursor wrap
     etype: jax.Array        # int32[S]
     device: jax.Array       # int32[S]
     assignment: jax.Array   # int32[S]
     tenant: jax.Array       # int32[S]
     area: jax.Array         # int32[S]
+    customer: jax.Array     # int32[S]
     asset: jax.Array        # int32[S]
     ts_ms: jax.Array        # int32[S]
     received_ms: jax.Array  # int32[S]
@@ -52,19 +61,31 @@ class EventStore:
     def capacity(self) -> int:
         return self.etype.shape[0]
 
+    @property
+    def arenas(self) -> int:
+        return self.cursor.shape[0]
+
+    @property
+    def arena_capacity(self) -> int:
+        return self.capacity // self.arenas
+
     @staticmethod
-    def zeros(capacity: int, channels: int = DEFAULT_VALUE_CHANNELS) -> "EventStore":
+    def zeros(capacity: int, channels: int = DEFAULT_VALUE_CHANNELS,
+              arenas: int = 1) -> "EventStore":
         assert capacity & (capacity - 1) == 0, "capacity must be a power of two"
+        assert arenas >= 1 and capacity % arenas == 0, \
+            "arenas must divide capacity"
         s, c = capacity, channels
         i32 = jnp.int32
         return EventStore(
-            cursor=jnp.zeros((), i32),
-            epoch=jnp.zeros((), i32),
+            cursor=jnp.zeros((arenas,), i32),
+            epoch=jnp.zeros((arenas,), i32),
             etype=jnp.zeros((s,), i32),
             device=jnp.full((s,), NULL_ID, i32),
             assignment=jnp.full((s,), NULL_ID, i32),
             tenant=jnp.full((s,), NULL_ID, i32),
             area=jnp.full((s,), NULL_ID, i32),
+            customer=jnp.full((s,), NULL_ID, i32),
             asset=jnp.full((s,), NULL_ID, i32),
             ts_ms=jnp.zeros((s,), i32),
             received_ms=jnp.zeros((s,), i32),

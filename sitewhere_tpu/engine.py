@@ -434,6 +434,11 @@ class EngineConfig:
                                        # overlap
     analytics_devices: int = 0         # HBM telemetry windows for [0, M)
     analytics_window: int = 128        # W timesteps per window
+    tenant_arenas: int = 1             # >1: partition the event ring into
+                                       # per-tenant-hash arenas — one
+                                       # tenant's burst can only evict its
+                                       # own arena's rows (hard retention
+                                       # isolation)
 
 
 @dataclasses.dataclass
@@ -669,6 +674,7 @@ class Engine(IngestHostMixin):
             c.store_capacity, c.channels,
             analytics_devices=c.analytics_devices,
             analytics_window=c.analytics_window,
+            store_arenas=c.tenant_arenas,
         )
         self._step = make_pipeline_step(
             PipelineConfig(auto_register=c.auto_register, default_device_type=0)
@@ -1518,11 +1524,17 @@ class Engine(IngestHostMixin):
         limit: int = 100,
         assignment_id: int | None = None,
         aux0: int | None = None,
+        area: str | None = None,
+        customer: str | None = None,
+        alternate_id: str | None = None,
     ) -> dict:
         """Filtered, newest-first event query over the HBM ring store — the
         REST listDeviceEvents/searchDeviceEvents surface (TPU-side scan,
-        only the top rows travel to the host). ``assignment_id`` / ``aux0``
-        filter on-device so the limit applies after filtering."""
+        only the top rows travel to the host). All filters apply on-device
+        so the limit applies after filtering; ``area``/``customer`` cover
+        the reference's per-area/per-customer event rollups
+        (Areas.java /{token}/measurements..., Customers.java ditto) and
+        ``alternate_id`` the /events/alternate/{id} lookup."""
         from sitewhere_tpu.ops.query import query_store
 
         with self.lock:
@@ -1538,6 +1550,19 @@ class Engine(IngestHostMixin):
                 ten = self.tenants.lookup(tenant)
                 if ten == NULL_ID:   # unknown tenant matches NOTHING —
                     return {"total": 0, "events": []}   # never all tenants
+            area_id = customer_id = aux1 = None
+            if area is not None:
+                area_id = self.areas.lookup(area)
+                if area_id == NULL_ID:
+                    return {"total": 0, "events": []}
+            if customer is not None:
+                customer_id = self.customers.lookup(customer)
+                if customer_id == NULL_ID:
+                    return {"total": 0, "events": []}
+            if alternate_id is not None:
+                aux1 = self.event_ids.lookup(alternate_id)
+                if aux1 == NULL_ID:
+                    return {"total": 0, "events": []}
             imin, imax = -(2**31), 2**31 - 1
             res = query_store(
                 self.state.store,
@@ -1550,6 +1575,10 @@ class Engine(IngestHostMixin):
                 assignment=(jnp.int32(assignment_id)
                             if assignment_id is not None else None),
                 aux0=jnp.int32(aux0) if aux0 is not None else None,
+                aux1=jnp.int32(aux1) if aux1 is not None else None,
+                area=jnp.int32(area_id) if area_id is not None else None,
+                customer=(jnp.int32(customer_id)
+                          if customer_id is not None else None),
             )
             n = int(res.n)
             lane_names: dict[int, str] = {}
@@ -1601,6 +1630,49 @@ class Engine(IngestHostMixin):
                         ev["attribute"], ev["stateChange"] = attr, change
                 events.append(ev)
             return {"total": int(res.total), "events": events}
+
+    def get_event(self, event_id: int) -> dict | None:
+        """Fetch one persisted event by its absolute store position — the
+        stable event id handed out by the outbound feed and the
+        /api/events/id/{eventId} lookup (reference: DeviceEvents.java
+        getDeviceEventById). Returns None when the id was never written or
+        its ring slot has been overwritten."""
+        from sitewhere_tpu.ops.readback import arena_cursor, read_range
+
+        with self.lock:
+            self._sync_mirrors()
+            store = self.state.store
+            if event_id < 0:
+                return None
+            arena = event_id % store.arenas
+            pos = event_id // store.arenas
+            head = arena_cursor(store, arena)
+            if not (max(0, head - store.arena_capacity) <= pos < head):
+                return None
+            sl = jax.device_get(read_range(
+                store, jnp.int32(pos % store.arena_capacity), 1,
+                arena=arena))
+            if not bool(sl.valid[0]):
+                return None
+            et = EventType(int(sl.etype[0]))
+            info = self.devices.get(int(sl.device[0]))
+            ev = {
+                "eventId": event_id,
+                "type": et.name,
+                "deviceToken": info.token if info else None,
+                "assignmentId": int(sl.assignment[0]),
+                "eventDateMs": int(sl.ts_ms[0]),
+                "receivedDateMs": int(sl.received_ms[0]),
+            }
+            if et is EventType.MEASUREMENT:
+                lane_names: dict[int, str] = {}
+                for name, nid in self.channel_map.names.items():
+                    lane_names.setdefault(nid % self.config.channels, name)
+                ev["measurements"] = {
+                    lane_names.get(int(c), f"ch{c}"): float(sl.values[0, c])
+                    for c in np.nonzero(np.asarray(sl.vmask[0]))[0]
+                }
+            return ev
 
     def presence_sweep(self) -> list[str]:
         """Mark stale devices MISSING; returns their tokens (notification

@@ -23,6 +23,7 @@ logger = logging.getLogger(__name__)
 
 # control packet types
 CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
+PUBREC, PUBREL, PUBCOMP = 5, 6, 7
 SUBSCRIBE, SUBACK, UNSUBSCRIBE, UNSUBACK = 8, 9, 10, 11
 PINGREQ, PINGRESP, DISCONNECT = 12, 13, 14
 
@@ -118,7 +119,13 @@ def topic_matches(pattern: str, topic: str) -> bool:
 
 
 class MqttClient:
-    """Minimal asyncio MQTT 3.1.1 client (QoS 0/1)."""
+    """Minimal asyncio MQTT 3.1.1 client (QoS 0/1/2).
+
+    QoS 2 implements both halves of the exactly-once handshake
+    (reference parity: MqttInboundEventReceiver.java:111-120 maps
+    EXACTLY_ONCE): outbound PUBLISH -> PUBREC -> PUBREL -> PUBCOMP, and
+    inbound PUBLISH(qos2) deduplicated by packet id until the sender's
+    PUBREL releases it."""
 
     def __init__(self, host: str, port: int, client_id: str = "sitewhere-tpu",
                  username: str | None = None, password: str | None = None,
@@ -128,18 +135,26 @@ class MqttClient:
         self.username, self.password = username, password
         self.keepalive = keepalive
         self.on_message: Callable[[str, bytes], Any] | None = None
+        self.on_disconnect: Callable[[], Any] | None = None
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._packet_id = 0
         self._task: asyncio.Task | None = None
         self._acks: dict[int, asyncio.Future] = {}
         self._ping_task: asyncio.Task | None = None
+        self._inbound_qos2: set[int] = set()   # pids seen, awaiting PUBREL
+        self._closing = False
 
     def _next_id(self) -> int:
         self._packet_id = self._packet_id % 0xFFFF + 1
         return self._packet_id
 
     async def connect(self) -> None:
+        # fresh session state (clean-session connect; also reused by the
+        # receiver's reconnect path)
+        self._closing = False
+        self._acks.clear()
+        self._inbound_qos2.clear()
         self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
         self._writer.write(encode_connect(self.client_id, self.keepalive,
                                           self.username, self.password))
@@ -163,22 +178,47 @@ class MqttClient:
                 ptype, flags, body = await read_packet(self._reader)
                 if ptype == PUBLISH:
                     topic, payload, qos, pid = decode_publish(flags, body)
+                    deliver = True
                     if qos == 1:
                         self._writer.write(
                             encode_packet(PUBACK, 0, pid.to_bytes(2, "big"))
                         )
                         await self._writer.drain()
-                    if self.on_message is not None:
+                    elif qos == 2:
+                        # exactly-once receive: a redelivered PUBLISH with
+                        # the same pid (sender never saw our PUBREC) must
+                        # not reach the application twice
+                        deliver = pid not in self._inbound_qos2
+                        self._inbound_qos2.add(pid)
+                        self._writer.write(
+                            encode_packet(PUBREC, 0, pid.to_bytes(2, "big"))
+                        )
+                        await self._writer.drain()
+                    if deliver and self.on_message is not None:
                         res = self.on_message(topic, payload)
                         if asyncio.iscoroutine(res):
                             await res
-                elif ptype in (PUBACK, SUBACK, UNSUBACK):
+                elif ptype == PUBREL:
+                    pid = int.from_bytes(body[:2], "big")
+                    self._inbound_qos2.discard(pid)
+                    self._writer.write(
+                        encode_packet(PUBCOMP, 0, pid.to_bytes(2, "big")))
+                    await self._writer.drain()
+                elif ptype in (PUBACK, PUBREC, PUBCOMP, SUBACK, UNSUBACK):
                     pid = int.from_bytes(body[:2], "big")
                     fut = self._acks.pop(pid, None)
                     if fut is not None and not fut.done():
                         fut.set_result(body)
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
+        finally:
+            if not self._closing and self.on_disconnect is not None:
+                res = self.on_disconnect()
+                if asyncio.iscoroutine(res):
+                    try:
+                        await res
+                    except Exception:   # reconnect failures are the
+                        pass            # scheduler's problem, not ours
 
     async def subscribe(self, topic: str, qos: int = 0) -> None:
         pid = self._next_id()
@@ -195,10 +235,19 @@ class MqttClient:
             self._acks[pid] = fut
         self._writer.write(encode_publish(topic, payload, qos, pid))
         await self._writer.drain()
-        if qos:
-            await asyncio.wait_for(fut, 10)
+        if qos == 1:
+            await asyncio.wait_for(fut, 10)          # PUBACK
+        elif qos == 2:
+            await asyncio.wait_for(fut, 10)          # PUBREC
+            fut2 = asyncio.get_running_loop().create_future()
+            self._acks[pid] = fut2
+            self._writer.write(
+                encode_packet(PUBREL, 0x02, pid.to_bytes(2, "big")))
+            await self._writer.drain()
+            await asyncio.wait_for(fut2, 10)         # PUBCOMP
 
     async def disconnect(self) -> None:
+        self._closing = True
         for t in (self._ping_task, self._task):
             if t is not None:
                 t.cancel()
@@ -252,14 +301,31 @@ class MqttBroker:
             writer.write(encode_packet(CONNACK, 0, b"\x00\x00"))
             await writer.drain()
             self._subs[writer] = []
+            # per-connection exactly-once inbox: PUBLISH(qos2) parks here
+            # until its PUBREL; redeliveries with the same pid overwrite
+            # (never fan out twice)
+            pending_qos2: dict[int, tuple[str, bytes]] = {}
             while True:
                 ptype, flags, body = await read_packet(reader)
                 if ptype == PUBLISH:
                     topic, payload, qos, pid = decode_publish(flags, body)
-                    if qos:
+                    if qos == 1:
                         writer.write(encode_packet(PUBACK, 0, pid.to_bytes(2, "big")))
                         await writer.drain()
-                    await self._fanout(topic, payload)
+                        await self._fanout(topic, payload)
+                    elif qos == 2:
+                        pending_qos2[pid] = (topic, payload)
+                        writer.write(encode_packet(PUBREC, 0, pid.to_bytes(2, "big")))
+                        await writer.drain()
+                    else:
+                        await self._fanout(topic, payload)
+                elif ptype == PUBREL:
+                    pid = int.from_bytes(body[:2], "big")
+                    parked = pending_qos2.pop(pid, None)
+                    writer.write(encode_packet(PUBCOMP, 0, pid.to_bytes(2, "big")))
+                    await writer.drain()
+                    if parked is not None:
+                        await self._fanout(*parked)
                 elif ptype == SUBSCRIBE:
                     pid = int.from_bytes(body[:2], "big")
                     off, grants = 2, []
@@ -269,7 +335,7 @@ class MqttBroker:
                         qos = body[off + 2 + tlen]
                         off += 3 + tlen
                         self._subs[writer].append(topic)
-                        grants.append(min(qos, 1))
+                        grants.append(min(qos, 2))
                     writer.write(
                         encode_packet(SUBACK, 0, pid.to_bytes(2, "big") + bytes(grants))
                     )
@@ -298,21 +364,60 @@ class MqttBroker:
 
 class MqttEventReceiver(InboundEventReceiver):
     """Subscribe to a broker topic and submit payloads to the event source
-    (reference: sources/mqtt/MqttInboundEventReceiver.java)."""
+    (reference: sources/mqtt/MqttInboundEventReceiver.java). A dropped
+    connection schedules reconnect attempts with exponential backoff and
+    re-subscribes — the reference receiver's scheduled-reconnect behavior."""
 
     def __init__(self, host: str, port: int, topic: str = "sitewhere/input/#",
                  qos: int = 0, client_id: str = "sw-ingest",
-                 username: str | None = None, password: str | None = None):
+                 username: str | None = None, password: str | None = None,
+                 reconnect_initial_s: float = 0.2,
+                 reconnect_max_s: float = 30.0):
         super().__init__(f"mqtt:{topic}")
         self.topic, self.qos = topic, qos
         self.client = MqttClient(host, port, client_id, username, password)
+        self.reconnect_initial_s = reconnect_initial_s
+        self.reconnect_max_s = reconnect_max_s
+        self.reconnects = 0            # successful re-connections (metrics)
+        self._stopping = False
+        self._reconnect_task: asyncio.Task | None = None
 
     async def on_start(self) -> None:
         self.client.on_message = lambda topic, payload: self.submit(
             payload, {"topic": topic}
         )
+        self.client.on_disconnect = self._schedule_reconnect
         await self.client.connect()
         await self.client.subscribe(self.topic, self.qos)
 
+    def _schedule_reconnect(self) -> None:
+        if self._stopping or (
+            self._reconnect_task is not None and not self._reconnect_task.done()
+        ):
+            return
+        self._reconnect_task = asyncio.get_running_loop().create_task(
+            self._reconnect_loop())
+
+    async def _reconnect_loop(self) -> None:
+        delay = self.reconnect_initial_s
+        while not self._stopping:
+            await asyncio.sleep(delay)
+            try:
+                await self.client.connect()
+                await self.client.subscribe(self.topic, self.qos)
+                self.reconnects += 1
+                logger.info("mqtt receiver %s reconnected", self.name)
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # any handshake failure (refused, half-open CONNACK ->
+                # IncompleteReadError/IndexError, timeout) just backs off;
+                # a dead reconnect loop would strand the receiver forever
+                delay = min(delay * 2, self.reconnect_max_s)
+
     async def on_stop(self) -> None:
+        self._stopping = True
+        if self._reconnect_task is not None:
+            self._reconnect_task.cancel()
         await self.client.disconnect()

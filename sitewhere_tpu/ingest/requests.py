@@ -30,6 +30,7 @@ class RequestType(enum.Enum):
     ACKNOWLEDGE = "Acknowledge"          # command response
     DEVICE_STREAM = "DeviceStream"
     DEVICE_STREAM_DATA = "DeviceStreamData"
+    SEND_DEVICE_STREAM_DATA = "SendDeviceStreamData"
     MAP_DEVICE = "MapDevice"             # nested-device mapping
 
 

@@ -52,9 +52,12 @@ class SiteWhereTpuInstance(LifecycleComponent):
         self.config = config or InstanceConfig()
         self.engine = Engine(self.config.engine)
 
-        # ingest edge
+        # ingest edge: device-initiated stream commands peel off to the
+        # stream service (reference routes them through the device command
+        # path, DeviceStreamManager.java:36-80); everything else hits the
+        # engine's staging path
         self.event_sources = EventSourcesManager(
-            on_event_request=self.engine.process,
+            on_event_request=self._route_device_request,
             on_registration_request=self.engine.process,
         )
         self.add_child(self.event_sources)
@@ -99,6 +102,11 @@ class SiteWhereTpuInstance(LifecycleComponent):
         self.zone_monitor = ZoneMonitor(self.engine, self.device_management)
         self.add_child(self.zone_monitor)
 
+        # device-initiated stream commands -> stream store + downlink acks
+        from sitewhere_tpu.management.streams import DeviceStreamService
+
+        self.stream_service = DeviceStreamService(self.streams, self.commands)
+
         # analytics (service-tpu-analytics analog) — live when the engine
         # carries HBM telemetry windows
         self.analytics = None
@@ -119,6 +127,14 @@ class SiteWhereTpuInstance(LifecycleComponent):
     # --- wiring helpers ---------------------------------------------------
     def add_source(self, source: InboundEventSource) -> InboundEventSource:
         return self.event_sources.add_source(source)
+
+    def _route_device_request(self, req) -> None:
+        """Ingest dispatch: stream commands to the stream service,
+        everything else to the engine."""
+        if self.stream_service.handles(req):
+            self.stream_service.handle_request(req)
+        else:
+            self.engine.process(req)
 
     def add_connector(self, connector: OutboundConnector,
                       start_from_latest: bool = False) -> ConnectorHost:

@@ -75,6 +75,7 @@ class ExpandedEvents(NamedTuple):
     device: jax.Array      # int32[B*A]
     assignment: jax.Array  # int32[B*A]
     area: jax.Array        # int32[B*A]
+    customer: jax.Array    # int32[B*A]
     asset: jax.Array       # int32[B*A]
     source_row: jax.Array  # int32[B*A] row in the original batch
 
@@ -91,6 +92,7 @@ def expand_assignments(reg: RegistryTables, res: LookupResult) -> ExpandedEvents
         device=jnp.where(live, device, NULL_ID),
         assignment=jnp.where(live, asn, NULL_ID),
         area=jnp.where(live, reg.assignment_area[safe], NULL_ID),
+        customer=jnp.where(live, reg.assignment_customer[safe], NULL_ID),
         asset=jnp.where(live, reg.assignment_asset[safe], NULL_ID),
         source_row=source_row,
     )

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from sitewhere_tpu.core.store import EventStore
-from sitewhere_tpu.ops.segment import compact_valid_front
+from sitewhere_tpu.ops.segment import lex_argsort, segment_ranks
 
 
 class PersistResult(NamedTuple):
@@ -32,6 +32,7 @@ def append_events(
     assignment: jax.Array,  # int32[E]
     tenant: jax.Array,      # int32[E]
     area: jax.Array,        # int32[E]
+    customer: jax.Array,    # int32[E]
     asset: jax.Array,       # int32[E]
     ts_ms: jax.Array,       # int32[E]
     received_ms: jax.Array, # int32[E]
@@ -39,47 +40,68 @@ def append_events(
     vmask: jax.Array,       # bool[E, C]
     aux: jax.Array,         # int32[E, AUX]
 ) -> PersistResult:
-    """Append up to E events at the ring cursor. E may exceed remaining ring
-    space; the ring wraps (oldest rows overwritten), mirroring retention-policy
-    expiry in the reference's InfluxDB backend (INFLUX_RETENTION_POLICY
-    override, InfluxDbDeviceEventManagement.java)."""
+    """Append up to E events at each arena's ring cursor. Rows route to
+    arena ``tenant % A`` (A=1: the single shared ring). E may exceed an
+    arena's remaining space; that arena wraps (oldest rows overwritten),
+    mirroring retention-policy expiry in the reference's InfluxDB backend
+    (INFLUX_RETENTION_POLICY override, InfluxDbDeviceEventManagement.java).
+    With multiple arenas this is the hard per-tenant retention guarantee:
+    a burst only wraps its own arena."""
     s = store.capacity
+    a_n = store.arenas
+    acap = store.arena_capacity
     e = valid.shape[0]
-    # With e <= s the positions (cursor+rank) % s are distinct, so the single
-    # scatter below is well-defined. A batch larger than the whole ring would
-    # alias slots inside one scatter (order-undefined in XLA); sizes are
-    # static, so reject that configuration at trace time.
-    if e > s:
+    # With e <= acap the positions within one arena are distinct, so the
+    # single scatter below is well-defined. A batch larger than one arena
+    # could alias slots inside one scatter (order-undefined in XLA); sizes
+    # are static, so reject that configuration at trace time.
+    if e > acap:
         raise ValueError(
-            f"expanded batch ({e} rows) exceeds event-store capacity ({s}); "
-            "allocate store_capacity >= batch_capacity * MAX_ACTIVE_ASSIGNMENTS"
+            f"expanded batch ({e} rows) exceeds per-arena event-store "
+            f"capacity ({acap}); allocate store_capacity >= "
+            "batch_capacity * MAX_ACTIVE_ASSIGNMENTS * arenas"
         )
 
-    # Stable-compact valid rows to the front so padding never lands in the ring.
-    n, perm = compact_valid_front(valid)
+    # Route each valid row to its tenant's arena, group rows by arena
+    # (stable: batch order preserved within an arena), rank within group.
+    arena = jnp.where(valid & (tenant >= 0), tenant % a_n,
+                      jnp.where(valid, 0, a_n))   # a_n = padding sentinel
+    sorted_keys, perm = lex_argsort([arena])
+    s_arena = sorted_keys[0]
+    rank, _ = segment_ranks(s_arena)
     c_valid = valid[perm]
     c_etype = etype[perm]
     c_device = device[perm]
     c_assignment = assignment[perm]
     c_tenant = tenant[perm]
     c_area = area[perm]
+    c_customer = customer[perm]
     c_asset = asset[perm]
     c_ts = ts_ms[perm]
     c_recv = received_ms[perm]
     c_values = values[perm]
     c_vmask = vmask[perm]
     c_aux = aux[perm]
-    rank = jnp.arange(e, dtype=jnp.int32)
-    pos = jnp.where(c_valid, (store.cursor + rank) % s, s)  # s = out of bounds -> dropped
+    arena_safe = jnp.clip(s_arena, 0, a_n - 1)
+    cur = store.cursor[arena_safe]
+    pos = jnp.where(s_arena < a_n,
+                    arena_safe * acap + (cur + rank) % acap,
+                    s)   # s = out of bounds -> dropped
+    # per-arena appended counts: one-hot sum (sentinel rows drop out)
+    counts = jnp.sum(
+        (s_arena[:, None] == jnp.arange(a_n)[None, :]).astype(jnp.int32),
+        axis=0)
+    n = jnp.sum(c_valid.astype(jnp.int32))
 
     new = EventStore(
-        cursor=(store.cursor + n) % jnp.int32(s),
-        epoch=store.epoch + (store.cursor + n) // jnp.int32(s),
+        cursor=(store.cursor + counts) % jnp.int32(acap),
+        epoch=store.epoch + (store.cursor + counts) // jnp.int32(acap),
         etype=store.etype.at[pos].set(c_etype, mode="drop"),
         device=store.device.at[pos].set(c_device, mode="drop"),
         assignment=store.assignment.at[pos].set(c_assignment, mode="drop"),
         tenant=store.tenant.at[pos].set(c_tenant, mode="drop"),
         area=store.area.at[pos].set(c_area, mode="drop"),
+        customer=store.customer.at[pos].set(c_customer, mode="drop"),
         asset=store.asset.at[pos].set(c_asset, mode="drop"),
         ts_ms=store.ts_ms.at[pos].set(c_ts, mode="drop"),
         received_ms=store.received_ms.at[pos].set(c_recv, mode="drop"),

@@ -27,6 +27,7 @@ class QueryResult(NamedTuple):
     assignment: jax.Array
     tenant: jax.Array
     area: jax.Array
+    customer: jax.Array
     ts_ms: jax.Array
     received_ms: jax.Array
     values: jax.Array   # float32[limit, C]
@@ -45,6 +46,9 @@ def query_store(
     limit: int = 100,
     assignment: jax.Array | None = None,  # int32[] filter (NULL_ID = any)
     aux0: jax.Array | None = None,        # int32[] filter on aux[:, 0]
+    aux1: jax.Array | None = None,        # int32[] filter on aux[:, 1]
+    area: jax.Array | None = None,        # int32[] filter (NULL_ID = any)
+    customer: jax.Array | None = None,    # int32[] filter (NULL_ID = any)
 ) -> QueryResult:
     """Newest-first filtered query over the whole ring."""
     m = store.valid
@@ -55,6 +59,12 @@ def query_store(
         m &= (assignment == NULL_ID) | (store.assignment == assignment)
     if aux0 is not None:
         m &= (aux0 == NULL_ID) | (store.aux[:, 0] == aux0)
+    if aux1 is not None:
+        m &= (aux1 == NULL_ID) | (store.aux[:, 1] == aux1)
+    if area is not None:
+        m &= (area == NULL_ID) | (store.area == area)
+    if customer is not None:
+        m &= (customer == NULL_ID) | (store.customer == customer)
     m &= (store.ts_ms >= t0) & (store.ts_ms <= t1)
     total = jnp.sum(m.astype(jnp.int32))
     # sort newest first: key = (-match, -ts)
@@ -70,6 +80,7 @@ def query_store(
         assignment=store.assignment[top],
         tenant=store.tenant[top],
         area=store.area[top],
+        customer=store.customer[top],
         ts_ms=store.ts_ms[top],
         received_ms=store.received_ms[top],
         values=store.values[top],

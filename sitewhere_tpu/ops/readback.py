@@ -27,6 +27,7 @@ class StoreSlice(NamedTuple):
     assignment: jax.Array
     tenant: jax.Array
     area: jax.Array
+    customer: jax.Array
     asset: jax.Array
     ts_ms: jax.Array
     received_ms: jax.Array
@@ -36,17 +37,21 @@ class StoreSlice(NamedTuple):
     valid: jax.Array
 
 
-@functools.partial(jax.jit, static_argnames=("count",))
-def read_range(store: EventStore, start: jax.Array, count: int) -> StoreSlice:
-    """Gather ``count`` rows beginning at absolute position ``start % S``."""
-    s = store.capacity
-    idx = (start + jnp.arange(count, dtype=jnp.int32)) % s
+@functools.partial(jax.jit, static_argnames=("count", "arena"))
+def read_range(store: EventStore, start: jax.Array, count: int,
+               arena: int = 0) -> StoreSlice:
+    """Gather ``count`` rows of one arena beginning at its arena-local
+    position ``start % (S/A)`` (arena 0 of a 1-arena store = the whole
+    ring, the classic behavior)."""
+    s = store.arena_capacity
+    idx = arena * s + (start + jnp.arange(count, dtype=jnp.int32)) % s
     return StoreSlice(
         etype=store.etype[idx],
         device=store.device[idx],
         assignment=store.assignment[idx],
         tenant=store.tenant[idx],
         area=store.area[idx],
+        customer=store.customer[idx],
         asset=store.asset[idx],
         ts_ms=store.ts_ms[idx],
         received_ms=store.received_ms[idx],
@@ -58,5 +63,16 @@ def read_range(store: EventStore, start: jax.Array, count: int) -> StoreSlice:
 
 
 def absolute_cursor(store: EventStore) -> int:
-    """Total events ever written (epoch * capacity + cursor)."""
-    return int(store.epoch) * store.capacity + int(store.cursor)
+    """Total events ever written, summed over arenas — monotone under
+    appends, the durable-watermark scalar."""
+    import numpy as np
+
+    epochs = np.asarray(jax.device_get(store.epoch)).astype(np.int64)
+    cursors = np.asarray(jax.device_get(store.cursor)).astype(np.int64)
+    return int(np.sum(epochs * store.arena_capacity + cursors))
+
+
+def arena_cursor(store: EventStore, arena: int) -> int:
+    """One arena's absolute write count (epoch*arena_capacity + cursor)."""
+    return (int(store.epoch[arena]) * store.arena_capacity
+            + int(store.cursor[arena]))

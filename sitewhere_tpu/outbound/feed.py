@@ -18,7 +18,7 @@ from typing import Any
 import numpy as np
 
 from sitewhere_tpu.core.types import NULL_ID, EventType
-from sitewhere_tpu.ops.readback import absolute_cursor, read_range
+from sitewhere_tpu.ops.readback import read_range
 
 
 @dataclasses.dataclass
@@ -39,6 +39,7 @@ class OutboundEvent:
     values: list[float]
     aux0: int
     aux1: int
+    customer_id: int = NULL_ID
     # set only for LOCATION events that carried coordinates (vmask lane 0);
     # a null-coord location event leaves these None — never null island
     latitude: float | None = None
@@ -61,21 +62,40 @@ class OutboundEvent:
 
 
 class FeedConsumer:
-    """One consumer group over the engine's event store."""
+    """One consumer group over the engine's event store.
+
+    With tenant arenas each arena is an independent sub-ring (its own
+    write order), so the consumer keeps one committed offset per arena —
+    the per-partition consumer-group offsets of the reference, with the
+    arena as the partition. Event ids encode (arena, position) as
+    ``position * arenas + arena``; with one arena (the default) ids are
+    plain positions, unchanged."""
 
     def __init__(self, engine, group_id: str, max_batch: int = 1024,
                  start_from_latest: bool = False):
+        from sitewhere_tpu.ops.readback import arena_cursor
+
         self.engine = engine
         self.group_id = group_id
         self.max_batch = max_batch
-        self.offset = (
-            absolute_cursor(engine.state.store) if start_from_latest else 0
-        )
+        store = engine.state.store
+        self.arenas = store.arenas
+        self.offsets = [
+            arena_cursor(store, a) if start_from_latest else 0
+            for a in range(self.arenas)
+        ]
         self.lag_lost = 0  # events overwritten before we consumed them
 
+    @property
+    def offset(self) -> int:
+        """Total committed events across arenas (monotone)."""
+        return sum(self.offsets)
+
     def poll(self) -> list[OutboundEvent]:
-        """Fetch newly persisted events past the committed offset (does not
+        """Fetch newly persisted events past the committed offsets (does not
         commit — call ``commit(events)`` after successful processing)."""
+        from sitewhere_tpu.ops.readback import arena_cursor
+
         # async flushes may have advanced the store past the host mirrors;
         # drain under the engine lock so no flush_async can slip between the
         # mirror sync and the store-head read (else _enrich would see events
@@ -84,29 +104,38 @@ class FeedConsumer:
             if self.engine._pending_outs:
                 self.engine.drain()
             store = self.engine.state.store
-        head = absolute_cursor(store)
-        if head <= self.offset:
-            return []
-        # ring overwrite: oldest retained position is head - capacity
-        oldest = max(0, head - store.capacity)
-        if self.offset < oldest:
-            self.lag_lost += oldest - self.offset
-            self.offset = oldest
-        count = min(head - self.offset, self.max_batch)
-        sl = read_range(store, np.int32(self.offset % store.capacity), count)
-        return self._enrich(sl, self.offset, count)
+        acap = store.arena_capacity
+        out: list[OutboundEvent] = []
+        for a in range(self.arenas):
+            head = arena_cursor(store, a)
+            if head <= self.offsets[a]:
+                continue
+            # ring overwrite: oldest retained position is head - arena cap
+            oldest = max(0, head - acap)
+            if self.offsets[a] < oldest:
+                self.lag_lost += oldest - self.offsets[a]
+                self.offsets[a] = oldest
+            count = min(head - self.offsets[a], self.max_batch)
+            sl = read_range(store, np.int32(self.offsets[a] % acap), count,
+                            arena=a)
+            out.extend(self._enrich(sl, self.offsets[a], count, a))
+        return out
 
     def commit(self, events: list[OutboundEvent]) -> None:
-        if events:
-            self.offset = max(self.offset, events[-1].event_id + 1)
+        for ev in events:
+            a = ev.event_id % self.arenas
+            pos = ev.event_id // self.arenas
+            self.offsets[a] = max(self.offsets[a], pos + 1)
 
-    def _enrich(self, sl, base: int, count: int) -> list[OutboundEvent]:
+    def _enrich(self, sl, base: int, count: int,
+                arena: int = 0) -> list[OutboundEvent]:
         eng = self.engine
         etype = np.asarray(sl.etype[:count])
         device = np.asarray(sl.device[:count])
         assignment = np.asarray(sl.assignment[:count])
         tenant = np.asarray(sl.tenant[:count])
         area = np.asarray(sl.area[:count])
+        customer = np.asarray(sl.customer[:count])
         asset = np.asarray(sl.asset[:count])
         ts = np.asarray(sl.ts_ms[:count])
         recv = np.asarray(sl.received_ms[:count])
@@ -135,7 +164,7 @@ class FeedConsumer:
                 lat, lon = float(values[i, 0]), float(values[i, 1])
             out.append(
                 OutboundEvent(
-                    event_id=base + i,
+                    event_id=(base + i) * self.arenas + arena,
                     etype=et,
                     device_token=info.token if info else f"#{int(device[i])}",
                     device_id=int(device[i]),
@@ -145,6 +174,7 @@ class FeedConsumer:
                         if int(tenant[i]) != NULL_ID else "default"
                     ),
                     area_id=int(area[i]),
+                    customer_id=int(customer[i]),
                     asset_id=int(asset[i]),
                     ts_ms=int(ts[i]),
                     received_ms=int(recv[i]),

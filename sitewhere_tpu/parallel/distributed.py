@@ -1047,11 +1047,10 @@ class DistributedEngine(IngestHostMixin):
         """Sum of per-shard absolute store cursors — monotone under appends,
         so it serves as the WAL watermark for the whole mesh."""
         st = self.state.store
-        epochs = np.asarray(jax.device_get(st.epoch))
+        epochs = np.asarray(jax.device_get(st.epoch))   # [S, A]
         cursors = np.asarray(jax.device_get(st.cursor))
-        return int(np.sum(epochs.astype(np.int64)
-                          * self.config.store_capacity_per_shard
-                          + cursors))
+        acap = self.config.store_capacity_per_shard // epochs.shape[-1]
+        return int(np.sum(epochs.astype(np.int64) * acap + cursors))
 
     def save(self, directory) -> dict:
         """Full mesh snapshot: stacked device state + host mirrors +

@@ -183,50 +183,64 @@ def reshard_snapshot(src_dir, dst_dir, n_shards_new: int) -> dict:
     # ---- event ring re-pack ----------------------------------------------
     store_keys = [k for k in data if k.startswith(".store.")
                   and k not in (".store.cursor", ".store.epoch")]
+    n_arenas = data[".store.cursor"].shape[-1]
+    acap = c_cap // n_arenas
     rows_per_new: list[list[dict]] = [[] for _ in range(m)]
     for so in range(s_old):
-        cursor = int(data[".store.cursor"][so])
-        epoch = int(data[".store.epoch"][so])
-        order = (np.concatenate([np.arange(cursor, c_cap),
-                                 np.arange(cursor)])
-                 if epoch > 0 else np.arange(cursor))
-        valid = data[".store.valid"][so][order]
-        order = order[valid]
-        if not len(order):
-            continue
-        devs = data[".store.device"][so][order].astype(np.int64)
-        new_s = np.where(devs != NULL_ID, dshard[so, devs], NULL_ID)
-        cols = {k: data[k][so][order] for k in store_keys}
-        cols[".store.device"] = remap_values(devs, np.full_like(devs, so),
-                                             dmap)
-        asgs = data[".store.assignment"][so][order].astype(np.int64)
-        cols[".store.assignment"] = remap_values(
-            asgs, np.full_like(asgs, so), amap)
-        for sn in range(m):
-            sel = new_s == sn
-            if np.any(sel):
-                rows_per_new[sn].append(
-                    {k: v[sel] for k, v in cols.items()})
-    new_cursor = np.zeros(m, np.int32)
-    new_epoch = np.zeros(m, np.int32)
+        # linearize each arena's sub-ring in its own append order
+        for a in range(n_arenas):
+            cursor = int(data[".store.cursor"][so][a])
+            epoch = int(data[".store.epoch"][so][a])
+            local = (np.concatenate([np.arange(cursor, acap),
+                                     np.arange(cursor)])
+                     if epoch > 0 else np.arange(cursor))
+            order = a * acap + local
+            valid = data[".store.valid"][so][order]
+            order = order[valid]
+            if not len(order):
+                continue
+            devs = data[".store.device"][so][order].astype(np.int64)
+            new_s = np.where(devs != NULL_ID, dshard[so, devs], NULL_ID)
+            cols = {k: data[k][so][order] for k in store_keys}
+            cols[".store.device"] = remap_values(devs, np.full_like(devs, so),
+                                                 dmap)
+            asgs = data[".store.assignment"][so][order].astype(np.int64)
+            cols[".store.assignment"] = remap_values(
+                asgs, np.full_like(asgs, so), amap)
+            for sn in range(m):
+                sel = new_s == sn
+                if np.any(sel):
+                    rows_per_new[sn].append(
+                        {k: v[sel] for k, v in cols.items()})
+    new_cursor = np.zeros((m, n_arenas), np.int32)
+    new_epoch = np.zeros((m, n_arenas), np.int32)
     for k in store_keys:
         out[k] = np.zeros((m,) + data[k].shape[1:], data[k].dtype)
         if k in (".store.device", ".store.assignment", ".store.tenant",
-                 ".store.area", ".store.asset", ".store.aux"):
+                 ".store.area", ".store.customer", ".store.asset",
+                 ".store.aux"):
             out[k][:] = NULL_ID
     for sn in range(m):
         if not rows_per_new[sn]:
             continue
         merged = {k: np.concatenate([c[k] for c in rows_per_new[sn]])
                   for k in store_keys}
-        n = len(merged[".store.valid"])
-        if n > c_cap:                      # ring overflow: oldest drop
-            merged = {k: v[n - c_cap:] for k, v in merged.items()}
-            n = c_cap
-        for k in store_keys:
-            out[k][sn, :n] = merged[k]
-        new_cursor[sn] = n % c_cap
-        new_epoch[sn] = n // c_cap
+        # re-derive each row's arena from its tenant (content-addressed)
+        tenants = merged[".store.tenant"].astype(np.int64)
+        arenas = np.where(tenants >= 0, tenants % n_arenas, 0)
+        for a in range(n_arenas):
+            sel = arenas == a
+            n = int(sel.sum())
+            if not n:
+                continue
+            sub = {k: v[sel] for k, v in merged.items()}
+            if n > acap:                   # arena overflow: oldest drop
+                sub = {k: v[n - acap:] for k, v in sub.items()}
+                n = acap
+            for k in store_keys:
+                out[k][sn, a * acap:a * acap + n] = sub[k]
+            new_cursor[sn, a] = n % acap
+            new_epoch[sn, a] = n // acap
     out[".store.cursor"] = new_cursor
     out[".store.epoch"] = new_epoch
 

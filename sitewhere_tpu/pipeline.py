@@ -87,13 +87,14 @@ class PipelineState:
         next_assignment: int = 0,
         analytics_devices: int = 0,
         analytics_window: int = 128,
+        store_arenas: int = 1,
     ) -> "PipelineState":
         return PipelineState(
             registry=bootstrap
             if bootstrap is not None
             else RegistryTables.zeros(device_capacity, token_capacity, assignment_capacity),
             device_state=DeviceStateStore.zeros(device_capacity, channels),
-            store=EventStore.zeros(store_capacity, channels),
+            store=EventStore.zeros(store_capacity, channels, store_arenas),
             next_device=jnp.asarray(next_device, jnp.int32),
             next_assignment=jnp.asarray(next_assignment, jnp.int32),
             metrics=PipelineMetrics.zeros(),
@@ -188,6 +189,7 @@ def pipeline_step(
         assignment=exp.assignment,
         tenant=batch.tenant_id[src],
         area=exp.area,
+        customer=exp.customer,
         asset=exp.asset,
         ts_ms=batch.ts_ms[src],
         received_ms=batch.received_ms[src],

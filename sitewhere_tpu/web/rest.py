@@ -214,6 +214,15 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_post("/api/devices", create_device)
     r.add_get("/api/devices", list_devices)
+    # literal /summaries must precede the dynamic /{token} route; compute
+    # only pageSize summaries, not one per registered device
+    import itertools as _it
+
+    r.add_get("/api/devices/summaries", _sync(lambda req: json_response(
+        [dataclasses.asdict(
+            inst.device_management.get_device_summary(i.token))
+         for i in _it.islice(inst.engine.devices.values(),
+                             int(req.query.get("pageSize", 100)))])))
     r.add_get("/api/devices/{token}", get_device)
     r.add_delete("/api/devices/{token}", delete_device)
 
@@ -1055,6 +1064,208 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     ]:
         r.add_put(path, _store_update(store, fields))
         r.add_delete(path, _store_delete(store))
+    # ---- per-command / per-status CRUD (reference: DeviceTypes.java
+    # /{token}/commands/{commandToken} and /{token}/statuses/{statusToken})
+    def _find_status(request):
+        st = inst.device_management.statuses.get(
+            request.match_info["statusToken"])
+        if st.device_type != request.match_info["token"]:
+            raise EntityNotFound(
+                f"status {st.token!r} not in type "
+                f"{request.match_info['token']!r}")
+        return st
+
+    async def get_type_command(request: web.Request):
+        cmd = inst.command_registry.get(request.match_info["commandToken"])
+        if cmd is None or cmd.device_type != request.match_info["token"]:
+            raise EntityNotFound("unknown command")
+        return json_response(dataclasses.asdict(cmd))
+
+    async def update_type_command(request: web.Request):
+        body = await request.json()
+        # 404 on wrong device type BEFORE mutating (a rejected update must
+        # not change state)
+        existing = inst.command_registry.get(request.match_info["commandToken"])
+        if existing is None or existing.device_type != request.match_info["token"]:
+            raise EntityNotFound("unknown command")
+
+        def apply(c):
+            for key in ("name", "namespace", "description"):
+                if key in body:
+                    setattr(c, key, body[key])
+            if "parameters" in body:
+                c.parameters = tuple(
+                    CommandParameter(p["name"],
+                                     ParameterType(p.get("type", "String")),
+                                     p.get("required", False))
+                    for p in body["parameters"])
+
+        cmd = inst.command_registry.update(
+            request.match_info["commandToken"], apply)
+        return json_response(dataclasses.asdict(cmd))
+
+    async def delete_type_command(request: web.Request):
+        cmd = inst.command_registry.get(request.match_info["commandToken"])
+        if cmd is None or cmd.device_type != request.match_info["token"]:
+            raise EntityNotFound("unknown command")
+        inst.command_registry.delete(cmd.token)
+        return json_response({"deleted": True})
+
+    async def get_type_status(request: web.Request):
+        return json_response(_entity(_find_status(request)))
+
+    async def update_type_status(request: web.Request):
+        body = await request.json()
+        _find_status(request)   # 404 on wrong type BEFORE mutating
+
+        def apply(s):
+            for key in ("name", "code", "backgroundColor", "foregroundColor",
+                        "borderColor", "icon"):
+                attr = {"backgroundColor": "background_color",
+                        "foregroundColor": "foreground_color",
+                        "borderColor": "border_color"}.get(key, key)
+                if key in body and hasattr(s, attr):
+                    setattr(s, attr, body[key])
+
+        st = inst.device_management.statuses.update(
+            request.match_info["statusToken"], apply)
+        return json_response(_entity(st))
+
+    async def delete_type_status(request: web.Request):
+        _find_status(request)
+        inst.device_management.statuses.delete(
+            request.match_info["statusToken"])
+        return json_response({"deleted": True})
+
+    r.add_get("/api/devicetypes/{token}/commands/{commandToken}",
+              get_type_command)
+    r.add_put("/api/devicetypes/{token}/commands/{commandToken}",
+              update_type_command)
+    r.add_delete("/api/devicetypes/{token}/commands/{commandToken}",
+                 delete_type_command)
+    r.add_get("/api/devicetypes/{token}/statuses/{statusToken}",
+              get_type_status)
+    r.add_put("/api/devicetypes/{token}/statuses/{statusToken}",
+              update_type_status)
+    r.add_delete("/api/devicetypes/{token}/statuses/{statusToken}",
+                 delete_type_status)
+
+    # ---- device-group element removal (reference: DeviceGroups.java
+    # DELETE /{groupToken}/elements/{elementId} and /elements)
+    async def delete_group_element(request: web.Request):
+        ok = inst.device_management.remove_group_element(
+            request.match_info["token"],
+            int(request.match_info["elementId"]))
+        if not ok:
+            raise EntityNotFound("unknown group element")
+        return json_response({"deleted": True})
+
+    async def delete_group_elements(request: web.Request):
+        body = await request.json()
+        removed = sum(
+            inst.device_management.remove_group_element(
+                request.match_info["token"], int(eid))
+            for eid in body)
+        return json_response({"deleted": removed})
+
+    r.add_delete("/api/devicegroups/{token}/elements/{elementId}",
+                 delete_group_element)
+    r.add_delete("/api/devicegroups/{token}/elements", delete_group_elements)
+
+    # ---- event lookups by id / alternate id (reference: DeviceEvents.java)
+    async def get_event_by_id(request: web.Request):
+        ev = inst.engine.get_event(int(request.match_info["eventId"]))
+        if ev is None:
+            raise EntityNotFound("unknown or expired event id")
+        return json_response(ev)
+
+    async def get_event_by_alternate(request: web.Request):
+        res = inst.engine.query_events(
+            alternate_id=request.match_info["alternateId"], limit=1)
+        if not res["events"]:
+            raise EntityNotFound("no event with that alternate id")
+        return json_response(res["events"][0])
+
+    r.add_get("/api/events/id/{eventId}", get_event_by_id)
+    r.add_get("/api/events/alternate/{alternateId}", get_event_by_alternate)
+
+    # ---- per-area / per-customer event rollups + assignment listings
+    # (reference: Areas.java /{token}/measurements..., Customers.java ditto)
+    _ROLLUPS = {
+        "measurements": EventType.MEASUREMENT,
+        "locations": EventType.LOCATION,
+        "alerts": EventType.ALERT,
+        "invocations": EventType.COMMAND_INVOCATION,
+        "responses": EventType.COMMAND_RESPONSE,
+        "statechanges": EventType.STATE_CHANGE,
+    }
+
+    def _rollup(kind: str):
+        async def handler(request: web.Request):
+            et = _ROLLUPS.get(request.match_info["etype"])
+            if et is None:
+                raise EntityNotFound("unknown event rollup")
+            res = inst.engine.query_events(
+                **{kind: request.match_info["token"]}, etype=et,
+                limit=int(request.query.get("pageSize", 100)))
+            return json_response({"numResults": res["total"],
+                                  "results": res["events"]})
+
+        return handler
+
+    # literal /assignments must register BEFORE the {etype} wildcard (aiohttp
+    # resolves in registration order)
+    r.add_get("/api/areas/{token}/assignments", _sync(lambda req: json_response(
+        [dataclasses.asdict(a) for a in
+         inst.engine.list_assignments(area=req.match_info["token"])])))
+    r.add_get("/api/customers/{token}/assignments", _sync(lambda req: json_response(
+        [dataclasses.asdict(a) for a in
+         inst.engine.list_assignments(customer=req.match_info["token"])])))
+    r.add_get("/api/areas/{token}/{etype}", _rollup("area"))
+    r.add_get("/api/customers/{token}/{etype}", _rollup("customer"))
+
+    # ---- device group/role listings + parent mappings (reference:
+    # Devices.java /group/{token}, /grouprole/{role}, /{deviceToken}/mappings;
+    # /summaries registers early, before the /{token} dynamic route)
+    r.add_get("/api/devices/group/{token}", _sync(lambda req: json_response(
+        dm.expand_group_devices(req.match_info["token"]))))
+    r.add_get("/api/devices/grouprole/{role}", _sync(lambda req: json_response(
+        sorted({tok for g in dm.groups.all()
+                if req.match_info["role"] in (g.roles or [])
+                for tok in dm.expand_group_devices(g.meta.token)}))))
+
+    async def get_device_mappings(request: web.Request):
+        info = inst.engine.get_device(request.match_info["token"])
+        if info is None:
+            raise EntityNotFound("unknown device")
+        parent = info.metadata.get("parentToken")
+        return json_response({"parentToken": parent} if parent else {})
+
+    async def delete_device_mapping(request: web.Request):
+        info = inst.engine.update_device(
+            request.match_info["token"], metadata={"parentToken": None})
+        return json_response({"parentToken": None,
+                              "deviceToken": info.token})
+
+    r.add_get("/api/devices/{token}/mappings", get_device_mappings)
+    r.add_delete("/api/devices/{token}/mappings", delete_device_mapping)
+
+    # ---- invocation summary (reference: CommandInvocations.java
+    # /id/{id}/summary — invocation + its responses in one view)
+    async def get_invocation_summary(request: web.Request):
+        inv_id = int(request.match_info["id"])
+        inv = inst.commands.history.get(inv_id)
+        if inv is None:
+            raise EntityNotFound("unknown invocation")
+        responses = inst.engine.query_events(
+            etype=EventType.COMMAND_RESPONSE, aux0=inv_id, limit=100)
+        return json_response({
+            "invocation": dataclasses.asdict(inv),
+            "responses": responses["events"],
+        })
+
+    r.add_get("/api/invocations/{id}/summary", get_invocation_summary)
+
     # GET-by-token for families that lacked it
     r.add_get("/api/areatypes/{token}", _store_get(dm.area_types))
     r.add_get("/api/customertypes", _sync(lambda req: json_response(

@@ -859,3 +859,176 @@ def test_scan_chunk_remainder_dispatches_on_flush():
     out = eng.flush()
     assert eng.staged_count == 0
     assert eng.metrics()["persisted"] == 24
+
+
+def test_mqtt_qos2_exactly_once():
+    """QoS 2 publish completes the 4-way handshake and delivers exactly
+    once, even when the PUBLISH is redelivered with the same packet id
+    (reference: MqttInboundEventReceiver QoS EXACTLY_ONCE)."""
+    from sitewhere_tpu.ingest.mqtt import (
+        CONNACK,
+        PUBCOMP,
+        PUBREC,
+        MqttBroker,
+        MqttClient,
+        encode_connect,
+        encode_packet,
+        encode_publish,
+        read_packet,
+    )
+
+    async def run():
+        broker = MqttBroker()
+        await broker.start()
+        got: list[bytes] = []
+        sub = MqttClient("127.0.0.1", broker.bound_port, "sub")
+        sub.on_message = lambda t, p: got.append(p)
+        await sub.connect()
+        await sub.subscribe("q2/#", qos=2)
+
+        # happy path: client API QoS 2 publish
+        pub = MqttClient("127.0.0.1", broker.bound_port, "pub")
+        await pub.connect()
+        await pub.publish("q2/a", b"one", qos=2)
+        await asyncio.sleep(0.2)
+        assert got == [b"one"]
+
+        # duplicate PUBLISH with the same pid before PUBREL: raw wire drive
+        reader, writer = await asyncio.open_connection(
+            "127.0.0.1", broker.bound_port)
+        writer.write(encode_connect("raw"))
+        await writer.drain()
+        ptype, _, _ = await read_packet(reader)
+        assert ptype == CONNACK
+        pkt = encode_publish("q2/b", b"two", qos=2, packet_id=7)
+        writer.write(pkt)
+        await writer.drain()
+        assert (await read_packet(reader))[0] == PUBREC
+        writer.write(pkt)                      # redelivery, same pid
+        await writer.drain()
+        assert (await read_packet(reader))[0] == PUBREC
+        writer.write(encode_packet(6, 0x02, (7).to_bytes(2, "big")))  # PUBREL
+        await writer.drain()
+        assert (await read_packet(reader))[0] == PUBCOMP
+        await asyncio.sleep(0.2)
+        assert got == [b"one", b"two"]          # exactly once
+        writer.close()
+        await pub.disconnect()
+        await sub.disconnect()
+        await broker.stop()
+
+    asyncio.run(run())
+
+
+def test_mqtt_client_inbound_qos2_dedup():
+    """The CLIENT side of the exactly-once handshake: a server redelivering
+    PUBLISH(qos2, same pid) before PUBREL reaches on_message once; the
+    client answers PUBREC and PUBCOMP."""
+    from sitewhere_tpu.ingest.mqtt import (
+        CONNACK,
+        CONNECT,
+        PUBCOMP,
+        PUBREC,
+        PUBREL,
+        SUBACK,
+        SUBSCRIBE,
+        MqttClient,
+        encode_packet,
+        encode_publish,
+        read_packet,
+    )
+
+    async def run():
+        seen: list[bytes] = []
+        replies: list[int] = []
+
+        async def server(reader, writer):
+            ptype, _, _ = await read_packet(reader)
+            assert ptype == CONNECT
+            writer.write(encode_packet(CONNACK, 0, b"\x00\x00"))
+            ptype, _, body = await read_packet(reader)
+            assert ptype == SUBSCRIBE
+            writer.write(encode_packet(SUBACK, 0, body[:2] + b"\x02"))
+            # redeliver the same qos2 packet twice, then release
+            pkt = encode_publish("t/1", b"payload", qos=2, packet_id=9)
+            writer.write(pkt)
+            await writer.drain()
+            ptype, _, _ = await read_packet(reader)
+            replies.append(ptype)               # PUBREC
+            writer.write(pkt)                   # dup before PUBREL
+            await writer.drain()
+            ptype, _, _ = await read_packet(reader)
+            replies.append(ptype)               # PUBREC again
+            writer.write(encode_packet(PUBREL, 0x02, (9).to_bytes(2, "big")))
+            await writer.drain()
+            ptype, _, _ = await read_packet(reader)
+            replies.append(ptype)               # PUBCOMP
+            writer.close()   # 3.12: wait_closed() blocks on open transports
+
+        srv = await asyncio.start_server(server, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        cli = MqttClient("127.0.0.1", port, "c")
+        cli.on_message = lambda t, p: seen.append(p)
+        await cli.connect()
+        await cli.subscribe("t/#", qos=2)
+        await asyncio.sleep(0.3)
+        assert seen == [b"payload"]
+        assert replies == [PUBREC, PUBREC, PUBCOMP]
+        await cli.disconnect()
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(run())
+
+
+def test_mqtt_receiver_reconnects_after_broker_restart():
+    """A dropped broker connection triggers the receiver's scheduled
+    reconnect (exponential backoff) and re-subscription — events flow
+    again without operator action."""
+    from sitewhere_tpu.ingest.mqtt import MqttBroker, MqttClient, MqttEventReceiver
+
+    async def run():
+        broker = MqttBroker()
+        await broker.start()
+        port = broker.bound_port
+        engine = _mini_engine()
+        mgr = _wire(engine)
+        recv = MqttEventReceiver("127.0.0.1", port,
+                                 topic="sitewhere/input/#",
+                                 reconnect_initial_s=0.05)
+        mgr.add_source(InboundEventSource("mqtt", JsonDeviceRequestDecoder(),
+                                          [recv]))
+        await mgr.initialize()
+        await mgr.start()
+        try:
+            pub = MqttClient("127.0.0.1", port, "p1")
+            await pub.connect()
+            await pub.publish("sitewhere/input/a", measurement_json("rc-1"))
+            await asyncio.sleep(0.2)
+            await pub.disconnect()
+            # broker dies and comes back on the same port
+            await broker.stop()
+            broker2 = MqttBroker(port=port)
+            for _ in range(50):
+                try:
+                    await broker2.start()
+                    break
+                except OSError:
+                    await asyncio.sleep(0.05)
+            for _ in range(100):    # wait for the receiver to reconnect
+                if recv.reconnects:
+                    break
+                await asyncio.sleep(0.05)
+            assert recv.reconnects == 1
+            pub2 = MqttClient("127.0.0.1", port, "p2")
+            await pub2.connect()
+            await pub2.publish("sitewhere/input/b", measurement_json("rc-2"))
+            await asyncio.sleep(0.3)
+            await pub2.disconnect()
+            await broker2.stop()
+        finally:
+            await mgr.stop()
+        engine.flush()
+        assert engine.metrics()["registered"] == 2   # rc-1 AND rc-2 arrived
+
+    asyncio.run(run())

@@ -198,7 +198,7 @@ def test_store_ring_wraps(rng):
     assert int(store.valid.sum()) == 64  # full ring after wrap
     total = sum(int(o.n_persisted) for o in outs)
     assert total > 64  # actually wrapped
-    assert int(store.epoch) * 64 + int(store.cursor) == total
+    assert (int(store.epoch[0]) * 64 + int(store.cursor[0])) == total
 
 
 def test_store_rejects_oversized_batch():
@@ -213,7 +213,7 @@ def test_store_rejects_oversized_batch():
     step = make_pipeline_step(PipelineConfig(auto_register=True))
     buf = HostEventBuffer(16, CHANNELS)  # expands to 64 rows > 32 capacity
     buf.append(0, 0, 0, 1, 1, values=[1.0])
-    with pytest.raises(ValueError, match="exceeds event-store capacity"):
+    with pytest.raises(ValueError, match="exceeds per-arena event-store"):
         step(state, buf.emit())
 
 

@@ -560,3 +560,144 @@ def test_batch_elements_and_criteria_over_rest(api):
     status, _ = call("POST", "/api/batch/command/criteria/device",
                      {"deviceTypeToken": "nonexistent", "commandToken": "close"})
     assert status == 400
+
+
+def test_command_status_crud_per_token(api):
+    """GET/PUT/DELETE for commands and statuses under their device type
+    (reference: DeviceTypes.java /{token}/commands/{commandToken},
+    /{token}/statuses/{statusToken})."""
+    call, inst, loop = api
+    call("POST", "/api/devicetypes", json_body={"token": "dt-1", "name": "DT"})
+    s, _ = call("POST", "/api/devicetypes/dt-1/commands", json_body={
+        "token": "cmd-1", "name": "reboot",
+        "parameters": [{"name": "delay", "type": "Int64"}]})
+    assert s == 201
+    s, body = call("GET", "/api/devicetypes/dt-1/commands/cmd-1")
+    assert s == 200 and body["name"] == "reboot"
+    s, body = call("PUT", "/api/devicetypes/dt-1/commands/cmd-1",
+                   json_body={"description": "restart the device"})
+    assert s == 200 and body["description"] == "restart the device"
+    # wrong device type -> 404
+    s, _ = call("GET", "/api/devicetypes/other/commands/cmd-1")
+    assert s == 404
+    s, body = call("DELETE", "/api/devicetypes/dt-1/commands/cmd-1")
+    assert s == 200 and body["deleted"]
+    s, _ = call("GET", "/api/devicetypes/dt-1/commands/cmd-1")
+    assert s == 404
+
+    s, _ = call("POST", "/api/devicetypes/dt-1/statuses", json_body={
+        "token": "st-1", "code": "ok", "name": "OK"})
+    assert s == 201
+    s, body = call("GET", "/api/devicetypes/dt-1/statuses/st-1")
+    assert s == 200
+    s, body = call("PUT", "/api/devicetypes/dt-1/statuses/st-1",
+                   json_body={"name": "All good"})
+    assert s == 200 and body["name"] == "All good"
+    s, body = call("DELETE", "/api/devicetypes/dt-1/statuses/st-1")
+    assert s == 200 and body["deleted"]
+    s, _ = call("GET", "/api/devicetypes/dt-1/statuses/st-1")
+    assert s == 404
+
+
+def test_group_element_delete(api):
+    call, inst, loop = api
+    call("POST", "/api/devices", json_body={"token": "ge-1"})
+    call("POST", "/api/devices", json_body={"token": "ge-2"})
+    call("POST", "/api/devicegroups", json_body={"token": "g-1", "name": "G"})
+    s, els = call("POST", "/api/devicegroups/g-1/elements", json_body={
+        "elements": [{"device": "ge-1"}, {"device": "ge-2"}]})
+    assert s == 201
+    ids = [e["element_id"] for e in els]
+    s, body = call("DELETE", f"/api/devicegroups/g-1/elements/{ids[0]}")
+    assert s == 200 and body["deleted"]
+    s, body = call("GET", "/api/devicegroups/g-1/elements")
+    assert len(body) == 1
+    s, body = call("DELETE", "/api/devicegroups/g-1/elements",
+                   json_body=[ids[1]])
+    assert s == 200 and body["deleted"] == 1
+    s, _ = call("DELETE", f"/api/devicegroups/g-1/elements/{ids[0]}")
+    assert s == 404
+
+
+def test_event_lookup_by_id_and_alternate(api):
+    call, inst, loop = api
+    call("POST", "/api/devices/ev-1/events", json_body={
+        "deviceToken": "ev-1", "type": "DeviceMeasurement",
+        "request": {"name": "temp", "value": 7.5, "alternateId": "alt-99"}})
+    inst.engine.flush()
+    s, body = call("GET", "/api/events/alternate/alt-99")
+    assert s == 200 and body["measurements"]["temp"] == 7.5
+    s, _ = call("GET", "/api/events/alternate/no-such")
+    assert s == 404
+    s, body = call("GET", "/api/events/id/0")
+    assert s == 200 and body["type"] == "MEASUREMENT"
+    s, _ = call("GET", "/api/events/id/999999")
+    assert s == 404
+
+
+def test_area_customer_event_rollups(api):
+    """Per-area and per-customer event rollups come from the on-device
+    area/customer store lanes (reference: Areas.java:{token}/measurements)."""
+    call, inst, loop = api
+    call("POST", "/api/areatypes", json_body={"token": "at", "name": "AT"})
+    call("POST", "/api/areas", json_body={
+        "token": "plant", "areaType": "at", "name": "Plant"})
+    call("POST", "/api/customertypes", json_body={"token": "ct", "name": "CT"})
+    call("POST", "/api/customers", json_body={
+        "token": "acme", "customerType": "ct", "name": "ACME"})
+    inst.engine.register_device("roll-1", area="plant", customer="acme")
+    inst.engine.register_device("roll-2")   # no area/customer
+    for tok in ("roll-1", "roll-2"):
+        call("POST", f"/api/devices/{tok}/events", json_body={
+            "deviceToken": tok, "type": "DeviceMeasurement",
+            "request": {"name": "t", "value": 1.0}})
+    inst.engine.flush()
+    s, body = call("GET", "/api/areas/plant/measurements")
+    assert s == 200 and body["numResults"] == 1
+    assert body["results"][0]["deviceToken"] == "roll-1"
+    s, body = call("GET", "/api/customers/acme/measurements")
+    assert s == 200 and body["numResults"] == 1
+    s, body = call("GET", "/api/areas/plant/alerts")
+    assert s == 200 and body["numResults"] == 0
+    s, body = call("GET", "/api/areas/plant/assignments")
+    assert s == 200 and len(body) == 1
+    s, _ = call("GET", "/api/areas/plant/bogus")
+    assert s == 404
+
+
+def test_device_summaries_group_listings_mappings(api):
+    call, inst, loop = api
+    call("POST", "/api/devices", json_body={"token": "sum-1"})
+    call("POST", "/api/devices", json_body={"token": "sum-2"})
+    s, body = call("GET", "/api/devices/summaries")
+    assert s == 200 and len(body) >= 2
+    call("POST", "/api/devicegroups", json_body={
+        "token": "sg", "name": "SG", "roles": ["prod"]})
+    call("POST", "/api/devicegroups/sg/elements",
+         json_body={"elements": [{"device": "sum-1", "roles": ["prod"]}]})
+    s, body = call("GET", "/api/devices/group/sg")
+    assert s == 200 and body == ["sum-1"]
+    s, body = call("GET", "/api/devices/grouprole/prod")
+    assert s == 200 and body == ["sum-1"]
+    # parent mappings
+    call("POST", "/api/devices/sum-2/parent", json_body={"parentToken": "sum-1"})
+    s, body = call("GET", "/api/devices/sum-2/mappings")
+    assert s == 200 and body["parentToken"] == "sum-1"
+    s, body = call("DELETE", "/api/devices/sum-2/mappings")
+    assert s == 200 and body["parentToken"] is None
+    s, body = call("GET", "/api/devices/sum-2/mappings")
+    assert s == 200 and body == {}
+
+
+def test_invocation_summary(api):
+    call, inst, loop = api
+    call("POST", "/api/devices", json_body={"token": "is-1"})
+    call("POST", "/api/devicetypes/default/commands", json_body={
+        "token": "ping", "name": "ping"})
+    s, inv = call("POST", "/api/devices/is-1/invocations",
+                  json_body={"commandToken": "ping"})
+    assert s in (200, 201)
+    inv_id = inv["invocationId"] if "invocationId" in inv else inv.get("id")
+    s, body = call("GET", f"/api/invocations/{inv_id}/summary")
+    assert s == 200 and body["invocation"]["command_token"] == "ping"
+    assert isinstance(body["responses"], list)
