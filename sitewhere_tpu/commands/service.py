@@ -62,7 +62,8 @@ class CommandDeliveryService(LifecycleComponent):
         # retained history for the CommandInvocations controller queries,
         # bounded FIFO so long-running instances don't grow without bound
         self.history: dict[int, CommandInvocation] = {}
-        self.consumer = FeedConsumer(engine, "command-delivery", start_from_latest=True)
+        self.consumer = engine.make_feed_consumer("command-delivery",
+                                                  start_from_latest=True)
         self.delivered_count = 0
 
     def add_destination(self, dest: CommandDestination) -> CommandDestination:

@@ -125,9 +125,9 @@ class ConnectorHost(LifecycleComponent):
         self.engine = engine
         self.connector = connector
         self.add_child(connector)
-        self.consumer = FeedConsumer(
-            engine, f"connector.{connector.connector_id}", max_batch,
-            start_from_latest,
+        self.consumer = engine.make_feed_consumer(
+            f"connector.{connector.connector_id}", max_batch=max_batch,
+            start_from_latest=start_from_latest,
         )
         self._task: asyncio.Task | None = None
         self.poll_interval_s = 0.05

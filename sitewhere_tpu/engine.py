@@ -1674,6 +1674,17 @@ class Engine(IngestHostMixin):
                 }
             return ev
 
+    def make_feed_consumer(self, group_id: str, max_batch: int = 1024,
+                           start_from_latest: bool = False):
+        """Factory for outbound consumers over this engine's event store —
+        the single constructor the outbound services (connectors, command
+        delivery, zone monitor) use, so the same wiring works against the
+        single-node and the distributed engine."""
+        from sitewhere_tpu.outbound.feed import FeedConsumer
+
+        return FeedConsumer(self, group_id, max_batch=max_batch,
+                            start_from_latest=start_from_latest)
+
     def presence_sweep(self) -> list[str]:
         """Mark stale devices MISSING; returns their tokens (notification
         hook — PresenceNotificationStrategies.SendOnce analog)."""

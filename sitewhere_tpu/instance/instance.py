@@ -47,10 +47,14 @@ class InstanceConfig:
 
 
 class SiteWhereTpuInstance(LifecycleComponent):
-    def __init__(self, config: InstanceConfig | None = None):
+    def __init__(self, config: InstanceConfig | None = None, engine=None):
+        """``engine`` may be a pre-built engine — in particular a
+        DistributedEngine, so the whole product surface (REST, outbound
+        feeds, command delivery, management) serves from the sharded mesh
+        state instead of the single-node engine."""
         super().__init__("sitewhere-tpu-instance")
         self.config = config or InstanceConfig()
-        self.engine = Engine(self.config.engine)
+        self.engine = engine if engine is not None else Engine(self.config.engine)
 
         # ingest edge: device-initiated stream commands peel off to the
         # stream service (reference routes them through the device command

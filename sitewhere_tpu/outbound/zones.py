@@ -34,8 +34,8 @@ class ZoneMonitor(LifecycleComponent):
         self.dm = device_management
         self.alert_level = alert_level
         self.max_vertices = max_vertices
-        self.consumer = FeedConsumer(engine, "zone-monitor",
-                                     start_from_latest=True)
+        self.consumer = engine.make_feed_consumer("zone-monitor",
+                                                  start_from_latest=True)
         # device_id -> frozenset of zone tokens currently containing it
         self.membership: dict[int, frozenset[str]] = {}
         self._zone_tokens: list[str] = []

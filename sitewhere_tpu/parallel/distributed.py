@@ -216,6 +216,19 @@ def _admin_set_device_active_stacked(state: PipelineState, shard, did, active):
 
 
 @jax.jit
+def _admin_update_device_stacked(state: PipelineState, shard, did, type_id,
+                                 area_id, customer_id):
+    reg = state.registry
+    return dataclasses.replace(
+        state, registry=dataclasses.replace(
+            reg,
+            device_type=reg.device_type.at[shard, did].set(type_id),
+            device_area=reg.device_area.at[shard, did].set(area_id),
+            device_customer=reg.device_customer.at[shard, did].set(customer_id),
+        ))
+
+
+@jax.jit
 def _admin_set_parent_stacked(state: PipelineState, shard, did, parent_did):
     reg = state.registry
     return dataclasses.replace(
@@ -787,17 +800,57 @@ class DistributedEngine(IngestHostMixin):
                 self._gdid(shard, aid), gdid, slot, token=token, asset=asset,
                 area=area, customer=customer, metadata=metadata)
 
+    def update_device(self, token: str, device_type: str | None = None,
+                      area: str | None = None, customer: str | None = None,
+                      metadata: dict | None = None) -> DeviceInfo:
+        """Update device columns + host metadata on the owning shard
+        (Engine.update_device parity for the REST surface)."""
+        with self.lock:
+            self._sync_mirrors()
+            gid = self.tokens.lookup(token)
+            gdid = self.token_device.get(gid)
+            if gdid is None:
+                raise KeyError(f"device {token!r} not registered")
+            info = self.devices[gdid]
+            shard, did = self._split_gdid(gdid)
+            type_id = jnp.int32(self.device_types.intern(
+                device_type if device_type is not None else info.device_type))
+            new_area = area if area is not None else info.area
+            area_id = jnp.int32(
+                self.areas.intern(new_area) if new_area else NULL_ID)
+            new_customer = customer if customer is not None else info.customer
+            customer_id = jnp.int32(
+                self.customers.intern(new_customer) if new_customer else NULL_ID)
+            self.sharded.state = _admin_update_device_stacked(
+                self.sharded.state, jnp.int32(shard), jnp.int32(did),
+                type_id, area_id, customer_id)
+            if device_type is not None:
+                info.device_type = device_type
+            if area is not None:
+                info.area = area
+            if customer is not None:
+                info.customer = customer
+            if metadata is not None:
+                info.metadata = metadata
+            return info
+
     def get_assignment(self, token: str) -> AssignmentInfo | None:
         aid = self.assignment_tokens.get(token)
         return self.assignments.get(aid) if aid is not None else None
 
     def list_assignments(self, device_token: str | None = None,
-                         status: str | None = None) -> list[AssignmentInfo]:
+                         status: str | None = None,
+                         area: str | None = None,
+                         asset: str | None = None,
+                         customer: str | None = None) -> list[AssignmentInfo]:
         with self.lock:
             out = [
                 a for a in self.assignments.values()
                 if (device_token is None or a.device_token == device_token)
                 and (status is None or a.status == status)
+                and (area is None or a.area == area)
+                and (asset is None or a.asset == asset)
+                and (customer is None or a.customer == customer)
             ]
             return sorted(out, key=lambda a: a.id)
 
@@ -902,10 +955,18 @@ class DistributedEngine(IngestHostMixin):
                      tenant: str | None = None,
                      since_ms: int | None = None,
                      until_ms: int | None = None,
-                     limit: int = 100) -> dict:
+                     limit: int = 100,
+                     assignment_id: int | None = None,
+                     aux0: int | None = None,
+                     area: str | None = None,
+                     customer: str | None = None,
+                     alternate_id: str | None = None) -> dict:
         """Global newest-first query: every shard scans its ring on its own
         device (vmapped filter + top-k), host merges the per-shard pages
-        with one vectorized argsort (scatter-gather across partitions)."""
+        with one vectorized argsort (scatter-gather across partitions).
+        Filter surface matches Engine.query_events so the REST gateway
+        serves identically from the sharded state. (``assignment_id`` is a
+        GLOBAL id; its shard-local row filters on the owning shard.)"""
         with self.lock:
             self._sync_mirrors()
             dev_filter = NULL_ID
@@ -921,6 +982,26 @@ class DistributedEngine(IngestHostMixin):
                 ten = self.tenants.lookup(tenant)
                 if ten == NULL_ID:   # unknown tenant matches NOTHING —
                     return {"total": 0, "events": []}   # never all tenants
+            area_id = customer_id = aux1 = None
+            if area is not None:
+                area_id = self.areas.lookup(area)
+                if area_id == NULL_ID:
+                    return {"total": 0, "events": []}
+            if customer is not None:
+                customer_id = self.customers.lookup(customer)
+                if customer_id == NULL_ID:
+                    return {"total": 0, "events": []}
+            if alternate_id is not None:
+                aux1 = self.event_ids.lookup(alternate_id)
+                if aux1 == NULL_ID:
+                    return {"total": 0, "events": []}
+            if assignment_id is not None:
+                # global assignment id -> its owning shard's local row;
+                # restrict the scan to that shard like the device filter
+                a_shard, a_local = self._split_gdid(assignment_id)
+                if shard_filter is not None and shard_filter != a_shard:
+                    return {"total": 0, "events": []}
+                shard_filter = a_shard
             res = _stacked_query(
                 self.state.store,
                 jnp.int32(int(etype) if etype is not None else NULL_ID),
@@ -931,6 +1012,11 @@ class DistributedEngine(IngestHostMixin):
                 device=jnp.int32(dev_filter),
                 device_shard=(jnp.int32(shard_filter)
                               if shard_filter is not None else None),
+                aux0=jnp.int32(aux0) if aux0 is not None else None,
+                aux1=jnp.int32(aux1) if aux1 is not None else None,
+                area=jnp.int32(area_id) if area_id is not None else None,
+                customer=(jnp.int32(customer_id)
+                          if customer_id is not None else None),
             )
             res = jax.device_get(res)
             ns = np.asarray(res.n)
@@ -1024,6 +1110,12 @@ class DistributedEngine(IngestHostMixin):
                     out.append(info.token)
             return out
 
+    def make_feed_consumer(self, group_id: str, max_batch: int = 1024,
+                           start_from_latest: bool = False):
+        """Outbound consumer over the per-shard rings (Engine parity)."""
+        return DistributedFeedConsumer(self, group_id, max_batch=max_batch,
+                                       start_from_latest=start_from_latest)
+
     def metrics(self) -> dict:
         m = self.sharded.global_metrics()
         m["channel_collisions"] = self.channel_map.collisions
@@ -1104,6 +1196,108 @@ class DistributedEngine(IngestHostMixin):
                 self.wal.sync()
             manifest["store_cursor"] = cursor
             return manifest
+
+
+class DistributedFeedConsumer:
+    """Outbound consumer group over the mesh engine's per-shard rings —
+    the per-partition consumer-group analog (one committed offset per
+    (shard, arena) sub-ring). Event ids encode (position, shard, arena)
+    so commits are exact and ids stay unique across the mesh."""
+
+    def __init__(self, engine: DistributedEngine, group_id: str,
+                 max_batch: int = 1024, start_from_latest: bool = False):
+        self.engine = engine
+        self.group_id = group_id
+        self.max_batch = max_batch
+        store = engine.state.store
+        self.n_shards = engine.n_shards
+        self.arenas = store.cursor.shape[-1]
+        self._parts = self.n_shards * self.arenas
+        self.offsets = np.zeros((self.n_shards, self.arenas), np.int64)
+        if start_from_latest:
+            self.offsets[:] = self._heads(store)
+        self.lag_lost = 0
+
+    def _heads(self, store) -> np.ndarray:
+        acap = self.engine.config.store_capacity_per_shard // self.arenas
+        ep = np.asarray(jax.device_get(store.epoch)).astype(np.int64)
+        cu = np.asarray(jax.device_get(store.cursor)).astype(np.int64)
+        return ep * acap + cu
+
+    def poll(self) -> list:
+        from sitewhere_tpu.ops.readback import read_range
+        from sitewhere_tpu.outbound.feed import OutboundEvent
+
+        with self.engine.lock:
+            if self.engine._pending_outs:
+                self.engine.drain()
+            store = self.engine.state.store
+        acap = self.engine.config.store_capacity_per_shard // self.arenas
+        heads = self._heads(store)
+        out: list[OutboundEvent] = []
+        eng = self.engine
+        lane_names: dict[int, str] = {}
+        for name, nid in eng.channel_map.names.items():
+            lane_names.setdefault(nid % eng.config.channels, name)
+        for s in range(self.n_shards):
+            shard_store = jax.tree_util.tree_map(lambda x: x[s], store)
+            for a in range(self.arenas):
+                head = int(heads[s, a])
+                if head <= self.offsets[s, a]:
+                    continue
+                oldest = max(0, head - acap)
+                if self.offsets[s, a] < oldest:
+                    self.lag_lost += oldest - int(self.offsets[s, a])
+                    self.offsets[s, a] = oldest
+                count = min(head - int(self.offsets[s, a]), self.max_batch)
+                sl = jax.device_get(read_range(
+                    shard_store, jnp.int32(self.offsets[s, a] % acap),
+                    count, arena=a))
+                base = int(self.offsets[s, a])
+                for i in range(count):
+                    if not bool(sl.valid[i]):
+                        continue
+                    gdid = eng._gdid(s, int(sl.device[i]))
+                    info = eng.devices.get(gdid)
+                    et = EventType(int(sl.etype[i]))
+                    meas = {}
+                    lat = lon = None
+                    if et is EventType.MEASUREMENT:
+                        for ch in np.nonzero(np.asarray(sl.vmask[i]))[0]:
+                            meas[lane_names.get(int(ch), f"ch{ch}")] = float(
+                                sl.values[i, ch])
+                    elif et is EventType.LOCATION and bool(sl.vmask[i, 0]):
+                        lat = float(sl.values[i, 0])
+                        lon = float(sl.values[i, 1])
+                    out.append(OutboundEvent(
+                        latitude=lat,
+                        longitude=lon,
+                        event_id=((base + i) * self._parts
+                                  + s * self.arenas + a),
+                        etype=et,
+                        device_token=info.token if info else f"#{gdid}",
+                        device_id=gdid,
+                        assignment_id=eng._gdid(s, int(sl.assignment[i])),
+                        tenant=(eng.tenants.token(int(sl.tenant[i]))
+                                if int(sl.tenant[i]) != NULL_ID else "default"),
+                        area_id=int(sl.area[i]),
+                        customer_id=int(sl.customer[i]),
+                        asset_id=int(sl.asset[i]),
+                        ts_ms=int(sl.ts_ms[i]),
+                        received_ms=int(sl.received_ms[i]),
+                        measurements=meas,
+                        values=[float(v) for v in sl.values[i]],
+                        aux0=int(sl.aux[i, 0]),
+                        aux1=int(sl.aux[i, 1]),
+                    ))
+        return out
+
+    def commit(self, events: list) -> None:
+        for ev in events:
+            part = ev.event_id % self._parts
+            pos = ev.event_id // self._parts
+            s, a = part // self.arenas, part % self.arenas
+            self.offsets[s, a] = max(self.offsets[s, a], pos + 1)
 
 
 def restore_distributed(directory) -> DistributedEngine:

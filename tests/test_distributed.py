@@ -212,3 +212,109 @@ def test_multi_batch_steady_state(engine):
     m = engine.metrics()
     assert m["persisted"] == total
     assert m["processed"] == total
+
+
+def test_instance_and_rest_over_distributed_engine():
+    """The full product surface — REST gateway, management, outbound feed,
+    command delivery — serves from the SHARDED mesh state when the instance
+    is built over a DistributedEngine (VERDICT item 1's 'REST served from
+    the sharded state')."""
+    import asyncio
+    import base64
+
+    import aiohttp
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from sitewhere_tpu.instance.instance import (
+        InstanceConfig,
+        SiteWhereTpuInstance,
+    )
+    from sitewhere_tpu.web.rest import make_app
+
+    deng = DistributedEngine(small_config())
+    inst = SiteWhereTpuInstance(InstanceConfig(), engine=deng)
+    assert inst.engine is deng
+
+    async def go():
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        try:
+            basic = base64.b64encode(b"admin:password").decode()
+            r = await client.get("/api/authapi/jwt",
+                                 headers={"Authorization": f"Basic {basic}"})
+            token = (await r.json())["token"]
+            h = {"Authorization": f"Bearer {token}"}
+
+            # device CRUD through management -> sharded registry
+            r = await client.post("/api/devices",
+                                  json={"token": "dr-1"}, headers=h)
+            assert r.status == 201
+            # telemetry through REST -> sharded step -> state readback
+            r = await client.post("/api/devices/dr-1/events", json={
+                "deviceToken": "dr-1", "type": "DeviceMeasurement",
+                "request": {"name": "temp", "value": 21.0}}, headers=h)
+            assert r.status == 201
+            inst.engine.flush()
+            r = await client.get("/api/devices/dr-1/state", headers=h)
+            body = await r.json()
+            assert body["measurements"]["temp"]["value"] == 21.0
+            r = await client.get("/api/events", headers=h)
+            assert (await r.json())["total"] >= 1
+            # device update (PUT) against the stacked admin path
+            r = await client.put("/api/devices/dr-1",
+                                 json={"deviceType": "default",
+                                       "metadata": {"k": "v"}}, headers=h)
+            assert r.status == 200
+        finally:
+            await client.close()
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_distributed_feed_and_command_delivery():
+    """Outbound feed over per-shard rings + command delivery end to end on
+    the mesh engine."""
+    import asyncio
+    import json as _json
+
+    from sitewhere_tpu.commands.destinations import (
+        CommandDestination,
+        LocalDeliveryProvider,
+        mqtt_topic_extractor,
+    )
+    from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
+    from sitewhere_tpu.commands.model import CommandParameter, DeviceCommand, ParameterType
+    from sitewhere_tpu.commands.routing import SingleChoiceCommandRouter
+    from sitewhere_tpu.commands.service import CommandDeliveryService
+    from sitewhere_tpu.parallel.distributed import DistributedFeedConsumer
+
+    eng = DistributedEngine(small_config())
+    eng.ingest_json_batch([meas_payload(f"fd-{i}", float(i))
+                           for i in range(12)])
+    eng.flush()
+    feed = DistributedFeedConsumer(eng, "grp")
+    evs = feed.poll()
+    assert len(evs) == 12
+    assert len({e.event_id for e in evs}) == 12
+    assert {e.device_token for e in evs} == {f"fd-{i}" for i in range(12)}
+    feed.commit(evs)
+    assert feed.poll() == []
+
+    # command delivery consumes the same per-shard rings
+    svc = CommandDeliveryService(eng, SingleChoiceCommandRouter("local"))
+    svc.registry.create(DeviceCommand(token="ping", device_type="default",
+                                      name="ping"))
+    provider = LocalDeliveryProvider()
+    svc.add_destination(CommandDestination(
+        "local", mqtt_topic_extractor(), JsonCommandExecutionEncoder(),
+        provider))
+    inv = svc.invoke("fd-3", "ping")
+    eng.flush()
+
+    async def pump():
+        return await svc.pump()
+
+    n = asyncio.new_event_loop().run_until_complete(pump())
+    assert n == 1 and len(provider.delivered) == 1
+    target, payload, system = provider.delivered[0]
+    assert target == "fd-3" and not system
