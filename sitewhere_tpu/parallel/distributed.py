@@ -257,6 +257,22 @@ def _admin_add_assignment_stacked(state: PipelineState, shard, did, aid, slot,
 
 
 @jax.jit
+def _admin_update_assignment_stacked(state: PipelineState, shard, aid,
+                                     asset_id, area_id, customer_id):
+    """Stacked-axis analog of engine._admin_update_assignment (REST PUT
+    path; reference: Assignments.java:144 -> updateDeviceAssignment)."""
+    reg = state.registry
+    return dataclasses.replace(
+        state, registry=dataclasses.replace(
+            reg,
+            assignment_asset=reg.assignment_asset.at[shard, aid].set(asset_id),
+            assignment_area=reg.assignment_area.at[shard, aid].set(area_id),
+            assignment_customer=reg.assignment_customer.at[shard, aid].set(
+                customer_id),
+        ))
+
+
+@jax.jit
 def _admin_set_assignment_status_stacked(state: PipelineState, shard, aid,
                                          status, active):
     reg = state.registry
@@ -854,25 +870,90 @@ class DistributedEngine(IngestHostMixin):
             ]
             return sorted(out, key=lambda a: a.id)
 
-    def release_assignment(self, token: str) -> AssignmentInfo:
+    def _set_assignment_status(self, token: str,
+                               status: DeviceAssignmentStatus) -> AssignmentInfo:
         with self.lock:
             self._sync_mirrors()
             gaid = self.assignment_tokens.get(token)
             if gaid is None:
                 raise KeyError(f"assignment {token!r} not found")
             shard, aid = self._split_gdid(gaid)
+            active = status is not DeviceAssignmentStatus.RELEASED
             self.sharded.state = _admin_set_assignment_status_stacked(
                 self.sharded.state, jnp.int32(shard), jnp.int32(aid),
-                jnp.int32(DeviceAssignmentStatus.RELEASED), False)
+                jnp.int32(status), active)
             info = self.assignments[gaid]
-            info.status = "RELEASED"
-            info.released_ms = self.epoch.now_ms()
-            gdid = self.token_device.get(self.tokens.lookup(info.device_token))
-            if gdid is not None and gdid in self.device_slots:
-                self.device_slots[gdid] = [
-                    NULL_ID if a == gaid else a
-                    for a in self.device_slots[gdid]]
+            info.status = status.name
+            if not active:
+                info.released_ms = self.epoch.now_ms()
+                gdid = self.token_device.get(
+                    self.tokens.lookup(info.device_token))
+                if gdid is not None and gdid in self.device_slots:
+                    self.device_slots[gdid] = [
+                        NULL_ID if a == gaid else a
+                        for a in self.device_slots[gdid]]
             return info
+
+    def release_assignment(self, token: str) -> AssignmentInfo:
+        return self._set_assignment_status(
+            token, DeviceAssignmentStatus.RELEASED)
+
+    def mark_assignment_missing(self, token: str) -> AssignmentInfo:
+        """Flag an assignment MISSING (reference: Assignments.java
+        /assignments/{token}/missing); it stays active so events still
+        expand to it — Engine parity for the REST surface."""
+        return self._set_assignment_status(
+            token, DeviceAssignmentStatus.MISSING)
+
+    def update_assignment(self, token: str, asset: str | None = None,
+                          area: str | None = None,
+                          customer: str | None = None,
+                          metadata: dict | None = None) -> AssignmentInfo:
+        """Update an assignment's association columns on its owning shard +
+        host metadata (Engine.update_assignment parity; reference:
+        Assignments.java:144 PUT)."""
+        with self.lock:
+            self._sync_mirrors()
+            gaid = self.assignment_tokens.get(token)
+            if gaid is None:
+                raise KeyError(f"assignment {token!r} not found")
+            info = self.assignments[gaid]
+            shard, aid = self._split_gdid(gaid)
+            new_asset = asset if asset is not None else info.asset
+            new_area = area if area is not None else info.area
+            new_customer = customer if customer is not None else info.customer
+            # intern before mutating so a capacity error never half-applies
+            asset_id = jnp.int32(
+                self.assets.intern(new_asset) if new_asset else NULL_ID)
+            area_id = jnp.int32(
+                self.areas.intern(new_area) if new_area else NULL_ID)
+            customer_id = jnp.int32(
+                self.customers.intern(new_customer)
+                if new_customer else NULL_ID)
+            self.sharded.state = _admin_update_assignment_stacked(
+                self.sharded.state, jnp.int32(shard), jnp.int32(aid),
+                asset_id, area_id, customer_id)
+            info.asset, info.area, info.customer = (
+                new_asset, new_area, new_customer)
+            if metadata is not None:
+                info.metadata = metadata
+            return info
+
+    def delete_assignment(self, token: str) -> bool:
+        """Delete an assignment (reference: Assignments.java DELETE):
+        detach on-device (release semantics) and drop the host record;
+        persisted events keep the id — deletes don't rewrite history."""
+        with self.lock:
+            self._sync_mirrors()
+            gaid = self.assignment_tokens.get(token)
+            if gaid is None:
+                return False
+            if self.assignments[gaid].status != "RELEASED":
+                self._set_assignment_status(
+                    token, DeviceAssignmentStatus.RELEASED)
+            del self.assignments[gaid]
+            del self.assignment_tokens[token]
+            return True
 
     # ------------------------------------------------------------------ queries
     def get_device(self, token: str) -> DeviceInfo | None:
@@ -995,6 +1076,7 @@ class DistributedEngine(IngestHostMixin):
                 aux1 = self.event_ids.lookup(alternate_id)
                 if aux1 == NULL_ID:
                     return {"total": 0, "events": []}
+            a_local = None
             if assignment_id is not None:
                 # global assignment id -> its owning shard's local row;
                 # restrict the scan to that shard like the device filter
@@ -1012,6 +1094,10 @@ class DistributedEngine(IngestHostMixin):
                 device=jnp.int32(dev_filter),
                 device_shard=(jnp.int32(shard_filter)
                               if shard_filter is not None else None),
+                assignment=(jnp.int32(a_local)
+                            if a_local is not None else None),
+                assignment_shard=(jnp.int32(shard_filter)
+                                  if a_local is not None else None),
                 aux0=jnp.int32(aux0) if aux0 is not None else None,
                 aux1=jnp.int32(aux1) if aux1 is not None else None,
                 area=jnp.int32(area_id) if area_id is not None else None,
@@ -1110,6 +1196,55 @@ class DistributedEngine(IngestHostMixin):
                     out.append(info.token)
             return out
 
+    def get_event(self, event_id: int) -> dict | None:
+        """Fetch one persisted event by its mesh-global id — the id layout
+        DistributedFeedConsumer hands out (``pos * n_parts + shard * arenas
+        + arena`` with ``n_parts = n_shards * arenas``), so the REST
+        /api/events/id/{eventId} lookup works identically against the
+        distributed engine (reference: DeviceEvents.java
+        getDeviceEventById). Returns None when the id was never written or
+        its ring slot has been overwritten."""
+        from sitewhere_tpu.ops.readback import read_range
+
+        with self.lock:
+            self._sync_mirrors()
+            store = self.state.store
+            if event_id < 0:
+                return None
+            arenas = store.cursor.shape[-1]
+            pos, s, a = split_event_id(event_id, self.n_shards, arenas)
+            acap = self.config.store_capacity_per_shard // arenas
+            head = (int(jax.device_get(store.epoch[s, a])) * acap
+                    + int(jax.device_get(store.cursor[s, a])))
+            if not (max(0, head - acap) <= pos < head):
+                return None
+            shard_store = jax.tree_util.tree_map(lambda x: x[s], store)
+            sl = jax.device_get(read_range(
+                shard_store, jnp.int32(pos % acap), 1, arena=a))
+            if not bool(sl.valid[0]):
+                return None
+            et = EventType(int(sl.etype[0]))
+            gdid = self._gdid(s, int(sl.device[0]))
+            info = self.devices.get(gdid)
+            ev = {
+                "eventId": event_id,
+                "type": et.name,
+                "deviceToken": info.token if info else None,
+                "shard": s,
+                "assignmentId": self._gdid(s, int(sl.assignment[0])),
+                "eventDateMs": int(sl.ts_ms[0]),
+                "receivedDateMs": int(sl.received_ms[0]),
+            }
+            if et is EventType.MEASUREMENT:
+                lane_names: dict[int, str] = {}
+                for name, nid in self.channel_map.names.items():
+                    lane_names.setdefault(nid % self.config.channels, name)
+                ev["measurements"] = {
+                    lane_names.get(int(c), f"ch{c}"): float(sl.values[0, c])
+                    for c in np.nonzero(np.asarray(sl.vmask[0]))[0]
+                }
+            return ev
+
     def make_feed_consumer(self, group_id: str, max_batch: int = 1024,
                            start_from_latest: bool = False):
         """Outbound consumer over the per-shard rings (Engine parity)."""
@@ -1198,11 +1333,28 @@ class DistributedEngine(IngestHostMixin):
             return manifest
 
 
+def encode_event_id(pos: int, shard: int, arena: int, n_shards: int,
+                    arenas: int) -> int:
+    """Mesh-global event id: ``pos * (n_shards*arenas) + shard*arenas +
+    arena``. The single place the id layout lives — get_event and
+    DistributedFeedConsumer.commit decode with :func:`split_event_id`."""
+    return pos * (n_shards * arenas) + shard * arenas + arena
+
+
+def split_event_id(event_id: int, n_shards: int,
+                   arenas: int) -> tuple[int, int, int]:
+    """Inverse of :func:`encode_event_id` -> (pos, shard, arena)."""
+    parts = n_shards * arenas
+    part = event_id % parts
+    return event_id // parts, part // arenas, part % arenas
+
+
 class DistributedFeedConsumer:
     """Outbound consumer group over the mesh engine's per-shard rings —
     the per-partition consumer-group analog (one committed offset per
     (shard, arena) sub-ring). Event ids encode (position, shard, arena)
-    so commits are exact and ids stay unique across the mesh."""
+    via :func:`encode_event_id` so commits are exact and ids stay unique
+    across the mesh."""
 
     def __init__(self, engine: DistributedEngine, group_id: str,
                  max_batch: int = 1024, start_from_latest: bool = False):
@@ -1212,7 +1364,6 @@ class DistributedFeedConsumer:
         store = engine.state.store
         self.n_shards = engine.n_shards
         self.arenas = store.cursor.shape[-1]
-        self._parts = self.n_shards * self.arenas
         self.offsets = np.zeros((self.n_shards, self.arenas), np.int64)
         if start_from_latest:
             self.offsets[:] = self._heads(store)
@@ -1272,8 +1423,8 @@ class DistributedFeedConsumer:
                     out.append(OutboundEvent(
                         latitude=lat,
                         longitude=lon,
-                        event_id=((base + i) * self._parts
-                                  + s * self.arenas + a),
+                        event_id=encode_event_id(
+                            base + i, s, a, self.n_shards, self.arenas),
                         etype=et,
                         device_token=info.token if info else f"#{gdid}",
                         device_id=gdid,
@@ -1294,9 +1445,8 @@ class DistributedFeedConsumer:
 
     def commit(self, events: list) -> None:
         for ev in events:
-            part = ev.event_id % self._parts
-            pos = ev.event_id // self._parts
-            s, a = part // self.arenas, part % self.arenas
+            pos, s, a = split_event_id(ev.event_id, self.n_shards,
+                                       self.arenas)
             self.offsets[s, a] = max(self.offsets[s, a], pos + 1)
 
 

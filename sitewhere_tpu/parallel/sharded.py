@@ -311,14 +311,14 @@ class ShardedEngine:
 
 @functools.partial(jax.jit, static_argnames=("limit",))
 def _stacked_query(store, etype, tenant, t0, t1, *, limit, device=None,
-                   device_shard=None, aux0=None, aux1=None, area=None,
-                   customer=None):
+                   device_shard=None, assignment=None, assignment_shard=None,
+                   aux0=None, aux1=None, area=None, customer=None):
     """Per-shard ring query vmapped over the stacked shard axis; XLA keeps
     each shard's scan on its own device (no cross-shard traffic until the
-    host merges the top pages). ``device``/``device_shard`` restrict the
-    scan to one device row on its owning shard (other shards match
-    nothing); the remaining optional filters pass straight through to
-    query_store on every shard."""
+    host merges the top pages). ``device``/``device_shard`` (and the
+    analogous ``assignment``/``assignment_shard``) restrict the scan to one
+    row on its owning shard (other shards match nothing); the remaining
+    optional filters pass straight through to query_store on every shard."""
     from sitewhere_tpu.ops.query import query_store
 
     n_shards = jax.tree_util.tree_leaves(store)[0].shape[0]
@@ -329,8 +329,13 @@ def _stacked_query(store, etype, tenant, t0, t1, *, limit, device=None,
             # -2 is matched by no store row (valid rows have device >= 0,
             # and padding rows are masked by store.valid)
             dev = jnp.where(sidx == device_shard, dev, jnp.int32(-2))
+        asn = None
+        if assignment is not None:
+            asn = assignment
+            if assignment_shard is not None:
+                asn = jnp.where(sidx == assignment_shard, asn, jnp.int32(-2))
         return query_store(st, dev, etype, tenant, t0, t1, limit=limit,
-                           aux0=aux0, aux1=aux1, area=area,
+                           assignment=asn, aux0=aux0, aux1=aux1, area=area,
                            customer=customer)
 
     return jax.vmap(one)(store, jnp.arange(n_shards, dtype=jnp.int32))

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import pathlib
 
 import jax
@@ -203,6 +204,13 @@ def replay_wal_into(engine, after_cursor: int,
     if foreign:
         wal.close()
     engine.wal = live_wal
+    if live_wal is None:
+        # recovered from a wal_dir copy but config.wal_dir is unset: the
+        # engine would silently continue with durability OFF — make the
+        # operator aware new ingest is no longer logged
+        logging.getLogger(__name__).warning(
+            "WAL replay finished but engine has no live WAL "
+            "(config.wal_dir is None): new ingest will NOT be durable")
 
 
 def recover_engine(snapshot_dir: str | pathlib.Path,

@@ -1257,11 +1257,12 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         inv = inst.commands.history.get(inv_id)
         if inv is None:
             raise EntityNotFound("unknown invocation")
-        responses = inst.engine.query_events(
-            etype=EventType.COMMAND_RESPONSE, aux0=inv_id, limit=100)
+        # responses store aux0 = interner id of the originatingEventId
+        # string, NOT the raw invocation counter — responses_for owns that
+        # mapping (same path as /api/invocations/{id}/responses)
         return json_response({
             "invocation": dataclasses.asdict(inv),
-            "responses": responses["events"],
+            "responses": inst.commands.responses_for(inv_id),
         })
 
     r.add_get("/api/invocations/{id}/summary", get_invocation_summary)

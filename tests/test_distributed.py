@@ -265,6 +265,26 @@ def test_instance_and_rest_over_distributed_engine():
                                  json={"deviceType": "default",
                                        "metadata": {"k": "v"}}, headers=h)
             assert r.status == 200
+            # assignment PUT/missing/DELETE + event-by-id: the Engine
+            # admin endpoints must serve (not 500) from the mesh (ADVICE r2)
+            r = await client.post("/api/assignments", json={
+                "deviceToken": "dr-1", "token": "dr-1:x"}, headers=h)
+            assert r.status == 201
+            r = await client.put("/api/assignments/dr-1:x",
+                                 json={"assetToken": "pump"}, headers=h)
+            assert r.status == 200 and (await r.json())["assetToken"] == "pump"
+            r = await client.post("/api/assignments/dr-1:x/missing",
+                                  headers=h)
+            assert r.status == 200
+            r = await client.delete("/api/assignments/dr-1:x", headers=h)
+            assert r.status == 200
+            feed = deng.make_feed_consumer("rest-ev")
+            evs = feed.poll()
+            assert evs
+            r = await client.get(f"/api/events/id/{evs[0].event_id}",
+                                 headers=h)
+            assert r.status == 200
+            assert (await r.json())["deviceToken"] == "dr-1"
         finally:
             await client.close()
 
@@ -318,3 +338,82 @@ def test_distributed_feed_and_command_delivery():
     assert n == 1 and len(provider.delivered) == 1
     target, payload, system = provider.delivered[0]
     assert target == "fd-3" and not system
+
+
+def test_query_events_by_assignment_scopes_to_one_assignment(engine):
+    """ADVICE r2 (high): assignment-scoped queries must filter on the
+    shard-local assignment row, not just the owning shard — two devices
+    whose events land on the SAME shard must not leak into each other's
+    assignment listing."""
+    for i in range(2 * engine.n_shards):
+        engine.register_device(f"aq-{i}", tenant="t1")
+    engine.flush()
+    asgs = [engine.list_assignments(device_token=f"aq-{i}")[0]
+            for i in range(2 * engine.n_shards)]
+    by_shard: dict[int, list] = {}
+    for a in asgs:
+        by_shard.setdefault(engine._split_gdid(a.id)[0], []).append(a)
+    shard, pair = next((s, v) for s, v in by_shard.items() if len(v) >= 2)
+    a0, a1 = pair[0], pair[1]
+    engine.ingest_json_batch(
+        [meas_payload(a0.device_token, 1.0 + i, ts_ms=1000 + i)
+         for i in range(3)]
+        + [meas_payload(a1.device_token, 2.0 + i, ts_ms=2000 + i)
+           for i in range(2)],
+        tenant="t1")
+    engine.flush()
+    r0 = engine.query_events(assignment_id=a0.id)
+    r1 = engine.query_events(assignment_id=a1.id)
+    assert r0["total"] == 3 and r1["total"] == 2
+    assert all(e["assignmentId"] == a0.id for e in r0["events"])
+    assert all(e["deviceToken"] == a0.device_token for e in r0["events"])
+    # device+assignment combined filter still works
+    both = engine.query_events(device_token=a0.device_token,
+                               assignment_id=a0.id)
+    assert both["total"] == 3
+    # mismatched device/assignment shards -> empty
+    other = next(a for a in asgs
+                 if engine._split_gdid(a.id)[0] != shard)
+    assert engine.query_events(device_token=a0.device_token,
+                               assignment_id=other.id)["total"] == 0
+
+
+def test_distributed_assignment_admin_parity(engine):
+    """ADVICE r2 (medium): DistributedEngine must implement the Engine
+    admin surface REST calls (update/delete/missing + get_event) so a
+    distributed instance never 500s on those endpoints."""
+    engine.register_device("adm-1", tenant="t1")
+    a = engine.create_assignment("adm-1", token="adm-1:x", asset="pump")
+    upd = engine.update_assignment("adm-1:x", asset="valve",
+                                   metadata={"k": "v"})
+    assert upd.asset == "valve" and upd.metadata == {"k": "v"}
+    assert engine.get_assignment("adm-1:x").asset == "valve"
+
+    miss = engine.mark_assignment_missing("adm-1:x")
+    assert miss.status == "MISSING"
+    # missing assignments stay active: events still expand to both
+    engine.ingest_json_batch([meas_payload("adm-1", 7.0)], tenant="t1")
+    out = engine.flush()
+    assert out["persisted"] == 2
+
+    assert engine.delete_assignment("adm-1:x") is True
+    assert engine.get_assignment("adm-1:x") is None
+    assert engine.delete_assignment("adm-1:x") is False
+
+
+def test_distributed_get_event_roundtrip(engine):
+    from sitewhere_tpu.parallel.distributed import DistributedFeedConsumer
+
+    engine.ingest_json_batch([meas_payload(f"ge-{i}", 10.0 + i)
+                              for i in range(6)])
+    engine.flush()
+    evs = DistributedFeedConsumer(engine, "ge-grp").poll()
+    assert len(evs) == 6
+    for src in evs:
+        ev = engine.get_event(src.event_id)
+        assert ev is not None
+        assert ev["deviceToken"] == src.device_token
+        assert ev["eventDateMs"] == src.ts_ms
+        assert ev["measurements"] == src.measurements
+    assert engine.get_event(-1) is None
+    assert engine.get_event(10**9) is None

@@ -700,4 +700,11 @@ def test_invocation_summary(api):
     inv_id = inv["invocationId"] if "invocationId" in inv else inv.get("id")
     s, body = call("GET", f"/api/invocations/{inv_id}/summary")
     assert s == 200 and body["invocation"]["command_token"] == "ping"
-    assert isinstance(body["responses"], list)
+    assert body["responses"] == []
+    # a device response must surface in the summary (ADVICE r2: responses
+    # store aux0 = interner id of originatingEventId, not the raw counter)
+    call("POST", "/api/devices/is-1/events", json_body={
+        "type": "DeviceCommandResponse",
+        "request": {"originatingEventId": str(inv_id), "response": "pong"}})
+    s, body = call("GET", f"/api/invocations/{inv_id}/summary")
+    assert s == 200 and len(body["responses"]) == 1
