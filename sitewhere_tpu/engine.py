@@ -23,6 +23,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import functools
+import logging
 import threading
 import time
 from typing import Any
@@ -421,6 +422,12 @@ class EngineConfig:
                                        # (DeviceManagementTriggers analog)
     wal_dir: str | None = None         # write-ahead log directory; None
                                        # disables the durability log
+    archive_dir: str | None = None     # long-term retention tier: spill
+                                       # ring segments to disk before
+                                       # overwrite; query_events merges
+                                       # ring + archive (utils/archive.py)
+    archive_segment_rows: int = 4096   # rows per spilled segment (clamped
+                                       # to arena_capacity // 4)
     scan_chunk: int = 1                # >1: dispatch K emitted batches as
                                        # ONE lax.scan program (amortizes
                                        # dispatch/transfer per chunk; adds
@@ -711,6 +718,34 @@ class Engine(IngestHostMixin):
             from sitewhere_tpu.utils.ingestlog import IngestLog
 
             self.wal = IngestLog(c.wal_dir)
+        # long-term retention tier: rows spill to disk before the ring can
+        # overwrite them (the external-DB history of the reference)
+        self.archive = None
+        self._rows_since_spool = 0
+        if c.archive_dir:
+            from sitewhere_tpu.utils.archive import EventArchive
+
+            acap = c.store_capacity // c.tenant_arenas
+            self.archive = EventArchive(
+                c.archive_dir,
+                segment_rows=max(1, min(c.archive_segment_rows, acap // 4)))
+            # spool whenever any arena could be halfway to overwrite; with
+            # the worst case of every staged row landing in one arena this
+            # keeps backlog + one batch < arena capacity
+            self._spool_trigger = max(self.archive.segment_rows,
+                                      acap // 2 - c.batch_capacity)
+            # one scan-chunk dispatch advances the head by up to
+            # K*batch*MAX_ACTIVE rows before the next spool check runs; if
+            # that exceeds the arena's headroom no trigger can guarantee
+            # loss-free spill (losses are still COUNTED via note_lost)
+            worst = (max(1, c.scan_chunk) * c.batch_capacity
+                     * MAX_ACTIVE_ASSIGNMENTS)
+            if worst > acap - self.archive.segment_rows:
+                logging.getLogger(__name__).warning(
+                    "archive: one dispatch can write %d rows but arena "
+                    "capacity is %d — ring may wrap before spooling; "
+                    "raise store_capacity or lower scan_chunk/batch_capacity",
+                    worst, acap)
 
     @property
     def staged_count(self) -> int:
@@ -944,6 +979,7 @@ class Engine(IngestHostMixin):
                 self._form_fair_batch()
             if not len(self._buf):
                 return
+            n_staged = len(self._buf)
             batch = self._buf.emit()
             if self.config.scan_chunk > 1:
                 self._staged_batches.append(batch)
@@ -951,6 +987,10 @@ class Engine(IngestHostMixin):
             else:
                 self.state, out = self._step(self.state, batch)
                 self._enqueue_out(out)
+                # ring head has advanced: each staged row persists up to
+                # one event per active assignment — count the upper bound
+                # so rows always spill before the ring wraps over them
+                self._archive_account(n_staged * MAX_ACTIVE_ASSIGNMENTS)
             self._last_flush = time.monotonic()
 
     def _dispatch_staged(self, all_batches: bool) -> None:
@@ -976,6 +1016,12 @@ class Engine(IngestHostMixin):
             self.state, outs = self._scan_step(self.state,
                                                pack_batches(chunk))
             self._enqueue_out(outs)
+            # spool accounting happens HERE, where the ring head actually
+            # advances — NOT at staging time (a staged-but-undispatched
+            # batch would reset the counter while contributing no rows,
+            # letting the chunk dispatch wrap the ring untracked)
+            self._archive_account(
+                k * self.config.batch_capacity * MAX_ACTIVE_ASSIGNMENTS)
 
     def _enqueue_out(self, out: StepOutput) -> None:
         """Queue a step output for drain, bounding outstanding device
@@ -1003,6 +1049,40 @@ class Engine(IngestHostMixin):
             self._dispatch_staged(all_batches=True)
             if self._pending_outs:
                 jax.block_until_ready(self._pending_outs[-1].n_persisted)
+
+    def _archive_account(self, max_new_rows: int) -> None:
+        """Track the upper bound of ring rows written by a dispatch; spool
+        when any arena could be approaching overwrite. Caller holds the
+        lock. No-op without an archive."""
+        if self.archive is None:
+            return
+        self._rows_since_spool += max_new_rows
+        if self._rows_since_spool >= self._spool_trigger:
+            self._spool()
+
+    def _spool(self) -> None:
+        """Spill full segments of not-yet-archived ring rows to disk.
+        Caller holds the lock. Reads use ONE compiled ``read_range``
+        program (fixed ``segment_rows`` count) per segment; partial tails
+        stay in the ring (still queryable there), so the archive only ever
+        holds whole segments."""
+        from sitewhere_tpu.ops.readback import arena_cursor, read_range
+
+        store = self.state.store
+        acap = store.arena_capacity
+        rows = self.archive.segment_rows
+        for a in range(store.arenas):
+            head = arena_cursor(store, a)
+            start = self.archive.spilled(a)
+            if head - start > acap:   # wrapped before we got here
+                self.archive.note_lost(head - acap - start)
+                start = head - acap
+            while head - start >= rows:
+                sl = jax.device_get(read_range(
+                    store, jnp.int32(start % acap), rows, arena=a))
+                self.archive.append_segment(a, start, sl)
+                start += rows
+        self._rows_since_spool = 0
 
     def drain(self) -> list[dict]:
         """Absorb every queued step output into the host mirrors. ONLY the
@@ -1581,55 +1661,111 @@ class Engine(IngestHostMixin):
                           if customer_id is not None else None),
             )
             n = int(res.n)
-            lane_names: dict[int, str] = {}
-            for name, nid in self.channel_map.names.items():
-                lane_names.setdefault(nid % self.config.channels, name)
+            lane_names = self._lane_names()
             events = []
             vmask = np.asarray(res.vmask[:n])
             values = np.asarray(res.values[:n])
+            aux = np.asarray(res.aux[:n])
             for i in range(n):
-                et = EventType(int(res.etype[i]))
-                info = self.devices.get(int(res.device[i]))
-                ev = {
-                    "type": et.name,
-                    "deviceToken": info.token if info else None,
-                    "assignmentId": int(res.assignment[i]),
-                    "eventDateMs": int(res.ts_ms[i]),
-                    "receivedDateMs": int(res.received_ms[i]),
-                }
-                if et is EventType.MEASUREMENT:
-                    ev["measurements"] = {
-                        lane_names.get(int(c), f"ch{c}"): float(values[i, c])
-                        for c in np.nonzero(vmask[i])[0]
-                    }
-                elif et is EventType.LOCATION:
-                    if vmask[i, 0]:
-                        ev["latitude"], ev["longitude"], ev["elevation"] = (
-                            float(values[i, 0]), float(values[i, 1]),
-                            float(values[i, 2])
-                        )
-                    else:  # decoded without coordinates — never null island
-                        ev["latitude"] = ev["longitude"] = ev["elevation"] = None
-                elif et is EventType.ALERT:
-                    ev["level"] = int(values[i, 0])
-                    atype = int(res.aux[i, 0])
-                    ev["alertType"] = (
-                        self.alert_types.token(atype) if 0 <= atype < len(self.alert_types) else None
-                    )
-                elif et is EventType.COMMAND_INVOCATION:
-                    ev["invocationId"] = int(res.aux[i, 0])
-                elif et is EventType.COMMAND_RESPONSE:
-                    oid = int(res.aux[i, 0])
-                    ev["originatingEventId"] = (
-                        self.event_ids.token(oid) if 0 <= oid < len(self.event_ids) else None
-                    )
-                elif et is EventType.STATE_CHANGE:
-                    sid = int(res.aux[i, 0])
-                    if 0 <= sid < len(self.event_ids):
-                        attr, _, change = self.event_ids.token(sid).partition(":")
-                        ev["attribute"], ev["stateChange"] = attr, change
-                events.append(ev)
-            return {"total": int(res.total), "events": events}
+                events.append(self._format_event(
+                    int(res.etype[i]), int(res.device[i]),
+                    int(res.assignment[i]), int(res.ts_ms[i]),
+                    int(res.received_ms[i]), values[i], vmask[i], aux[i],
+                    lane_names))
+            total = int(res.total)
+            if self.archive is not None and self.archive.segments:
+                total, events = self._merge_archive(
+                    total, events, limit,
+                    device=dev if device_token is not None else None,
+                    etype=int(etype) if etype is not None else None,
+                    tenant=ten if tenant is not None else None,
+                    since_ms=since_ms, until_ms=until_ms,
+                    assignment=assignment_id, aux0=aux0, aux1=aux1,
+                    area=area_id, customer=customer_id)
+            return {"total": total, "events": events}
+
+    def _lane_names(self) -> dict[int, str]:
+        lane_names: dict[int, str] = {}
+        for name, nid in self.channel_map.names.items():
+            lane_names.setdefault(nid % self.config.channels, name)
+        return lane_names
+
+    def _format_event(self, et_i: int, device_id: int, assignment: int,
+                      ts: int, received: int, values, vmask, aux,
+                      lane_names: dict[int, str]) -> dict:
+        """One persisted store row -> the REST event dict (shared by the
+        ring query and the archive merge so both tiers serve identical
+        shapes)."""
+        et = EventType(et_i)
+        info = self.devices.get(device_id)
+        ev = {
+            "type": et.name,
+            "deviceToken": info.token if info else None,
+            "assignmentId": assignment,
+            "eventDateMs": ts,
+            "receivedDateMs": received,
+        }
+        if et is EventType.MEASUREMENT:
+            ev["measurements"] = {
+                lane_names.get(int(c), f"ch{c}"): float(values[c])
+                for c in np.nonzero(vmask)[0]
+            }
+        elif et is EventType.LOCATION:
+            if vmask[0]:
+                ev["latitude"], ev["longitude"], ev["elevation"] = (
+                    float(values[0]), float(values[1]), float(values[2]))
+            else:  # decoded without coordinates — never null island
+                ev["latitude"] = ev["longitude"] = ev["elevation"] = None
+        elif et is EventType.ALERT:
+            ev["level"] = int(values[0])
+            atype = int(aux[0])
+            ev["alertType"] = (
+                self.alert_types.token(atype)
+                if 0 <= atype < len(self.alert_types) else None)
+        elif et is EventType.COMMAND_INVOCATION:
+            ev["invocationId"] = int(aux[0])
+        elif et is EventType.COMMAND_RESPONSE:
+            oid = int(aux[0])
+            ev["originatingEventId"] = (
+                self.event_ids.token(oid)
+                if 0 <= oid < len(self.event_ids) else None)
+        elif et is EventType.STATE_CHANGE:
+            sid = int(aux[0])
+            if 0 <= sid < len(self.event_ids):
+                attr, _, change = self.event_ids.token(sid).partition(":")
+                ev["attribute"], ev["stateChange"] = attr, change
+        return ev
+
+    def _merge_archive(self, total: int, events: list[dict], limit: int,
+                       **filters) -> tuple[int, list[dict]]:
+        """Fold archived history into a ring query result. The archive scan
+        is capped at rows already EVICTED from each arena (absolute pos <
+        head - capacity) so the two tiers never overlap; the reference's
+        unbounded date-range search (InfluxDbDeviceEventManagement.java:
+        63-161) falls out of ring + archive union. Caller holds the lock."""
+        from sitewhere_tpu.ops.readback import arena_cursor
+
+        store = self.state.store
+        acap = store.arena_capacity
+        max_pos = {a: arena_cursor(store, a) - acap
+                   for a in range(store.arenas)}
+        if all(v <= 0 for v in max_pos.values()):
+            return total, events
+        a_total, rows = self.archive.query(max_pos=max_pos, limit=limit,
+                                           **filters)
+        if not a_total:
+            return total, events
+        lane_names = self._lane_names()
+        a_events = [
+            self._format_event(
+                int(r["etype"]), int(r["device"]), int(r["assignment"]),
+                int(r["ts_ms"]), int(r["received_ms"]), r["values"],
+                r["vmask"], r["aux"], lane_names)
+            for r in rows
+        ]
+        merged = sorted(events + a_events,
+                        key=lambda e: -e["eventDateMs"])[:limit]
+        return total + a_total, merged
 
     def get_event(self, event_id: int) -> dict | None:
         """Fetch one persisted event by its absolute store position — the
@@ -1647,31 +1783,33 @@ class Engine(IngestHostMixin):
             arena = event_id % store.arenas
             pos = event_id // store.arenas
             head = arena_cursor(store, arena)
-            if not (max(0, head - store.arena_capacity) <= pos < head):
+            if pos >= head:
                 return None
+            if pos < head - store.arena_capacity:
+                # evicted from the ring: the id must resolve from the
+                # archive so the by-id surface agrees with query_events
+                if self.archive is None:
+                    return None
+                r = self.archive.get_row(arena, pos)
+                if r is None:
+                    return None
+                ev = self._format_event(
+                    int(r["etype"]), int(r["device"]), int(r["assignment"]),
+                    int(r["ts_ms"]), int(r["received_ms"]), r["values"],
+                    r["vmask"], r["aux"], self._lane_names())
+                ev["eventId"] = event_id
+                return ev
             sl = jax.device_get(read_range(
                 store, jnp.int32(pos % store.arena_capacity), 1,
                 arena=arena))
             if not bool(sl.valid[0]):
                 return None
-            et = EventType(int(sl.etype[0]))
-            info = self.devices.get(int(sl.device[0]))
-            ev = {
-                "eventId": event_id,
-                "type": et.name,
-                "deviceToken": info.token if info else None,
-                "assignmentId": int(sl.assignment[0]),
-                "eventDateMs": int(sl.ts_ms[0]),
-                "receivedDateMs": int(sl.received_ms[0]),
-            }
-            if et is EventType.MEASUREMENT:
-                lane_names: dict[int, str] = {}
-                for name, nid in self.channel_map.names.items():
-                    lane_names.setdefault(nid % self.config.channels, name)
-                ev["measurements"] = {
-                    lane_names.get(int(c), f"ch{c}"): float(sl.values[0, c])
-                    for c in np.nonzero(np.asarray(sl.vmask[0]))[0]
-                }
+            ev = self._format_event(
+                int(sl.etype[0]), int(sl.device[0]), int(sl.assignment[0]),
+                int(sl.ts_ms[0]), int(sl.received_ms[0]), sl.values[0],
+                np.asarray(sl.vmask[0]), np.asarray(sl.aux[0]),
+                self._lane_names())
+            ev["eventId"] = event_id
             return ev
 
     def make_feed_consumer(self, group_id: str, max_batch: int = 1024,
@@ -1727,4 +1865,7 @@ class Engine(IngestHostMixin):
             "reg_overflow": int(m.reg_overflow),
             "channel_collisions": self.channel_map.collisions,
             "staged": len(self._buf),
+            **({"archived_rows": self.archive.total_rows(),
+                "archive_lost_rows": self.archive.lost_rows}
+               if self.archive is not None else {}),
         }

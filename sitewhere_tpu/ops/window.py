@@ -19,7 +19,7 @@ One call merges one batch/window of events into the HBM-resident
 
 Correctness does not depend on batch boundaries aligning with wall-clock
 windows: merging two half-windows yields the same state as one full window
-(tested against a numpy oracle in tests/test_window.py).
+(tested against a numpy oracle in tests/test_pipeline.py).
 """
 
 from __future__ import annotations

@@ -80,6 +80,10 @@ class DistributedConfig:
     strict_channels: bool = False
     fair_tenancy: bool = False
     wal_dir: str | None = None
+    archive_dir: str | None = None     # long-term retention: spill each
+                                       # (shard, arena) sub-ring to disk
+                                       # before overwrite (utils/archive.py)
+    archive_segment_rows: int = 4096
 
 
 class _StackedBuffer:
@@ -363,6 +367,20 @@ class DistributedEngine(IngestHostMixin):
             from sitewhere_tpu.utils.ingestlog import IngestLog
 
             self.wal = IngestLog(c.wal_dir)
+        # long-term retention: every (shard, arena) sub-ring spills to one
+        # archive partition before its rows can be overwritten
+        self.archive = None
+        self._rows_since_spool = 0
+        if c.archive_dir:
+            from sitewhere_tpu.utils.archive import EventArchive
+
+            arenas = self.state.store.cursor.shape[-1]
+            acap = c.store_capacity_per_shard // arenas
+            self.archive = EventArchive(
+                c.archive_dir,
+                segment_rows=max(1, min(c.archive_segment_rows, acap // 4)))
+            self._spool_trigger = max(self.archive.segment_rows,
+                                      acap // 2 - c.batch_capacity_per_shard)
 
     # ---------------------------------------------------------------- routing
     def _route(self, gid: int) -> tuple[int, int]:
@@ -589,10 +607,50 @@ class DistributedEngine(IngestHostMixin):
                         self._form_fair_batch(s)
             if not self._buf.total():
                 return
+            n_staged = int(max(self._buf.counts))  # worst shard's rows
             batch = self._buf.emit()
             out = self.sharded.step(batch)
             self._pending_outs.append(out)
             self._last_flush = time.monotonic()
+            if self.archive is not None:
+                # per-shard bound: each staged row persists at most one
+                # event per active assignment
+                self._rows_since_spool += n_staged * MAX_ACTIVE_ASSIGNMENTS
+                if self._rows_since_spool >= self._spool_trigger:
+                    self._spool()
+
+    def _spool(self) -> None:
+        """Spill full archive segments from every (shard, arena) sub-ring.
+        Caller holds the lock. One fixed-count ``read_range`` program per
+        segment (reused across shards via the per-shard tree slice)."""
+        from sitewhere_tpu.ops.readback import read_range
+
+        store = self.state.store
+        arenas = store.cursor.shape[-1]
+        acap = self.config.store_capacity_per_shard // arenas
+        rows = self.archive.segment_rows
+        ep = np.asarray(jax.device_get(store.epoch)).astype(np.int64)
+        cu = np.asarray(jax.device_get(store.cursor)).astype(np.int64)
+        heads = ep * acap + cu
+        for s in range(self.n_shards):
+            shard_store = None
+            for a in range(arenas):
+                part = s * arenas + a
+                head = int(heads[s, a])
+                start = self.archive.spilled(part)
+                if head - start > acap:   # wrapped before we got here
+                    self.archive.note_lost(head - acap - start)
+                    start = head - acap
+                while head - start >= rows:
+                    if shard_store is None:
+                        shard_store = jax.tree_util.tree_map(
+                            lambda x: x[s], store)
+                    sl = jax.device_get(read_range(
+                        shard_store, jnp.int32(start % acap), rows,
+                        arena=a))
+                    self.archive.append_segment(part, start, sl)
+                    start += rows
+        self._rows_since_spool = 0
 
     def drain(self) -> list[dict]:
         """Absorb queued stacked outputs. Only the [S] scalar counter lanes
@@ -1111,44 +1169,123 @@ class DistributedEngine(IngestHostMixin):
             s_idx, i_idx = np.nonzero(valid)
             order = np.argsort(-ts[s_idx, i_idx], kind="stable")[:limit]
             sel_s, sel_i = s_idx[order], i_idx[order]
-            lane_names: dict[int, str] = {}
-            for name, nid in self.channel_map.names.items():
-                lane_names.setdefault(nid % self.config.channels, name)
-            events = []
-            for s, i in zip(sel_s, sel_i):
-                et = EventType(int(res.etype[s, i]))
-                gdid = self._gdid(int(s), int(res.device[s, i]))
-                info = self.devices.get(gdid)
-                ev = {
-                    "type": et.name,
-                    "deviceToken": info.token if info else None,
-                    "shard": int(s),
-                    "assignmentId": self._gdid(int(s), int(res.assignment[s, i])),
-                    "eventDateMs": int(res.ts_ms[s, i]),
-                    "receivedDateMs": int(res.received_ms[s, i]),
-                }
-                if et is EventType.MEASUREMENT:
-                    ev["measurements"] = {
-                        lane_names.get(int(c), f"ch{c}"):
-                            float(res.values[s, i, c])
-                        for c in np.nonzero(res.vmask[s, i])[0]
-                    }
-                elif et is EventType.LOCATION:
-                    if res.vmask[s, i, 0]:
-                        ev["latitude"] = float(res.values[s, i, 0])
-                        ev["longitude"] = float(res.values[s, i, 1])
-                        ev["elevation"] = float(res.values[s, i, 2])
-                    else:
-                        ev["latitude"] = ev["longitude"] = ev["elevation"] = None
-                elif et is EventType.ALERT:
-                    ev["level"] = int(res.values[s, i, 0])
-                    atype = int(res.aux[s, i, 0])
-                    ev["alertType"] = (
-                        self.alert_types.token(atype)
-                        if 0 <= atype < len(self.alert_types) else None)
-                events.append(ev)
-            return {"total": int(np.sum(np.asarray(res.total))),
-                    "events": events}
+            lane_names = self._lane_names()
+            events = [
+                self._format_event(
+                    int(res.etype[s, i]), int(s), int(res.device[s, i]),
+                    int(res.assignment[s, i]), int(res.ts_ms[s, i]),
+                    int(res.received_ms[s, i]), res.values[s, i],
+                    res.vmask[s, i], res.aux[s, i], lane_names)
+                for s, i in zip(sel_s, sel_i)
+            ]
+            total = int(np.sum(np.asarray(res.total)))
+            if self.archive is not None and self.archive.segments:
+                arenas = self.state.store.cursor.shape[-1]
+                parts_of = (
+                    frozenset(shard_filter * arenas + a
+                              for a in range(arenas))
+                    if shard_filter is not None else None)
+                total, events = self._merge_archive(
+                    total, events, limit, lane_names,
+                    device=int(dev_filter) if dev_filter != NULL_ID else None,
+                    device_parts=parts_of,
+                    etype=int(etype) if etype is not None else None,
+                    tenant=ten if ten != NULL_ID else None,
+                    since_ms=since_ms, until_ms=until_ms,
+                    assignment=a_local,
+                    assignment_parts=(parts_of if a_local is not None
+                                      else None),
+                    aux0=aux0, aux1=aux1, area=area_id,
+                    customer=customer_id)
+            return {"total": total, "events": events}
+
+    def _merge_archive(self, total: int, events: list[dict], limit: int,
+                       lane_names: dict[int, str],
+                       **filters) -> tuple[int, list[dict]]:
+        """Fold archived (evicted-from-ring) history into a mesh query
+        result — same no-overlap cap as Engine._merge_archive, per
+        (shard, arena) partition. Caller holds the lock."""
+        store = self.state.store
+        arenas = store.cursor.shape[-1]
+        acap = self.config.store_capacity_per_shard // arenas
+        ep = np.asarray(jax.device_get(store.epoch)).astype(np.int64)
+        cu = np.asarray(jax.device_get(store.cursor)).astype(np.int64)
+        heads = ep * acap + cu
+        max_pos = {s * arenas + a: int(heads[s, a]) - acap
+                   for s in range(self.n_shards) for a in range(arenas)}
+        if all(v <= 0 for v in max_pos.values()):
+            return total, events
+        a_total, rows = self.archive.query(max_pos=max_pos, limit=limit,
+                                           **filters)
+        if not a_total:
+            return total, events
+        a_events = [
+            self._format_event(
+                int(r["etype"]), int(r["part"]) // arenas, int(r["device"]),
+                int(r["assignment"]), int(r["ts_ms"]),
+                int(r["received_ms"]), r["values"], r["vmask"], r["aux"],
+                lane_names)
+            for r in rows
+        ]
+        merged = sorted(events + a_events,
+                        key=lambda e: -e["eventDateMs"])[:limit]
+        return total + a_total, merged
+
+    def _lane_names(self) -> dict[int, str]:
+        lane_names: dict[int, str] = {}
+        for name, nid in self.channel_map.names.items():
+            lane_names.setdefault(nid % self.config.channels, name)
+        return lane_names
+
+    def _format_event(self, et_i: int, shard: int, device: int,
+                      assignment: int, ts: int, received: int, values,
+                      vmask, aux, lane_names: dict[int, str]) -> dict:
+        """One persisted store row (shard-local ids) -> the REST event dict
+        — the single formatter behind the ring query, the archive merge,
+        and the by-id lookup, full six-type coverage matching
+        Engine._format_event."""
+        et = EventType(et_i)
+        gdid = self._gdid(shard, device)
+        info = self.devices.get(gdid)
+        ev = {
+            "type": et.name,
+            "deviceToken": info.token if info else None,
+            "shard": shard,
+            "assignmentId": self._gdid(shard, assignment),
+            "eventDateMs": ts,
+            "receivedDateMs": received,
+        }
+        if et is EventType.MEASUREMENT:
+            ev["measurements"] = {
+                lane_names.get(int(c), f"ch{c}"): float(values[c])
+                for c in np.nonzero(vmask)[0]
+            }
+        elif et is EventType.LOCATION:
+            if vmask[0]:
+                ev["latitude"] = float(values[0])
+                ev["longitude"] = float(values[1])
+                ev["elevation"] = float(values[2])
+            else:
+                ev["latitude"] = ev["longitude"] = ev["elevation"] = None
+        elif et is EventType.ALERT:
+            ev["level"] = int(values[0])
+            atype = int(aux[0])
+            ev["alertType"] = (
+                self.alert_types.token(atype)
+                if 0 <= atype < len(self.alert_types) else None)
+        elif et is EventType.COMMAND_INVOCATION:
+            ev["invocationId"] = int(aux[0])
+        elif et is EventType.COMMAND_RESPONSE:
+            oid = int(aux[0])
+            ev["originatingEventId"] = (
+                self.event_ids.token(oid)
+                if 0 <= oid < len(self.event_ids) else None)
+        elif et is EventType.STATE_CHANGE:
+            sid = int(aux[0])
+            if 0 <= sid < len(self.event_ids):
+                attr, _, change = self.event_ids.token(sid).partition(":")
+                ev["attribute"], ev["stateChange"] = attr, change
+        return ev
 
     def search_device_states(self, last_interaction_before_ms: int | None = None,
                              presence: str | None = None,
@@ -1216,33 +1353,35 @@ class DistributedEngine(IngestHostMixin):
             acap = self.config.store_capacity_per_shard // arenas
             head = (int(jax.device_get(store.epoch[s, a])) * acap
                     + int(jax.device_get(store.cursor[s, a])))
-            if not (max(0, head - acap) <= pos < head):
+            if pos >= head:
                 return None
+            if pos < head - acap:
+                # evicted from the ring — resolve from the archive so the
+                # by-id surface agrees with query_events
+                if self.archive is None:
+                    return None
+                r = self.archive.get_row(s * arenas + a, pos)
+                if r is None:
+                    return None
+                ev = self._format_event(
+                    int(r["etype"]), s, int(r["device"]),
+                    int(r["assignment"]), int(r["ts_ms"]),
+                    int(r["received_ms"]), r["values"], r["vmask"],
+                    r["aux"], self._lane_names())
+                ev["eventId"] = event_id
+                return ev
             shard_store = jax.tree_util.tree_map(lambda x: x[s], store)
             sl = jax.device_get(read_range(
                 shard_store, jnp.int32(pos % acap), 1, arena=a))
             if not bool(sl.valid[0]):
                 return None
-            et = EventType(int(sl.etype[0]))
-            gdid = self._gdid(s, int(sl.device[0]))
-            info = self.devices.get(gdid)
-            ev = {
-                "eventId": event_id,
-                "type": et.name,
-                "deviceToken": info.token if info else None,
-                "shard": s,
-                "assignmentId": self._gdid(s, int(sl.assignment[0])),
-                "eventDateMs": int(sl.ts_ms[0]),
-                "receivedDateMs": int(sl.received_ms[0]),
-            }
-            if et is EventType.MEASUREMENT:
-                lane_names: dict[int, str] = {}
-                for name, nid in self.channel_map.names.items():
-                    lane_names.setdefault(nid % self.config.channels, name)
-                ev["measurements"] = {
-                    lane_names.get(int(c), f"ch{c}"): float(sl.values[0, c])
-                    for c in np.nonzero(np.asarray(sl.vmask[0]))[0]
-                }
+            ev = self._format_event(
+                int(sl.etype[0]), s, int(sl.device[0]),
+                int(sl.assignment[0]), int(sl.ts_ms[0]),
+                int(sl.received_ms[0]), sl.values[0],
+                np.asarray(sl.vmask[0]), np.asarray(sl.aux[0]),
+                self._lane_names())
+            ev["eventId"] = event_id
             return ev
 
     def make_feed_consumer(self, group_id: str, max_batch: int = 1024,
@@ -1257,6 +1396,9 @@ class DistributedEngine(IngestHostMixin):
         m["staged"] = self.staged_count
         m["n_shards"] = self.n_shards
         m["devices"] = int(self._next_device.sum())
+        if self.archive is not None:
+            m["archived_rows"] = self.archive.total_rows()
+            m["archive_lost_rows"] = self.archive.lost_rows
         return m
 
     def shard_metrics(self) -> list[dict]:

@@ -280,7 +280,10 @@ def make_packed_scan_step(config: PipelineConfig, capacity: int,
 
         return jax.lax.scan(body, state, packed)
 
-    return jax.jit(multi, donate_argnums=(0, 1))
+    # donate ONLY the state: the packed wire buffer has no same-shaped
+    # output to alias, so donating it is a no-op that makes XLA warn
+    # "Some donated buffers were not usable" on every dispatch
+    return jax.jit(multi, donate_argnums=(0,))
 
 
 @functools.cache

@@ -1,0 +1,230 @@
+"""Long-term event retention: host-side spill of HBM ring segments to disk.
+
+The reference retains FULL event history in an external time-series store
+(InfluxDB/Cassandra/Warp10) and serves arbitrary date-range queries
+(service-event-management/.../influxdb/InfluxDbDeviceEventManagement.java:63-161);
+the HBM ring (core/store.py) is a fixed-capacity recency window. This module
+is the retention tier between them: before a ring row can be overwritten,
+its segment is spilled to an on-disk columnar file, and the engines'
+``query_events`` transparently merges ring + archive so date ranges older
+than the ring come back exactly like the reference's unbounded history.
+
+Design (TPU-first):
+- Spooling reads the ring with the SAME ``read_range`` program every time
+  (fixed ``segment_rows`` chunk -> one compiled executable, no recompiles)
+  and only at flush boundaries, never per event.
+- A partition is one (shard, arena) sub-ring: spill order within a
+  partition is the ring's write order, so a partition's segments tile
+  absolute positions [0, spilled) contiguously.
+- Segment files are columnar ``.npz`` (structure-of-arrays, like the ring
+  itself); queries prune whole segments by their [ts_min, ts_max] interval
+  before touching rows — the archive analog of time-series index pruning.
+- Crash safety: segments are written to a temp name and renamed; the
+  manifest is rebuilt from the segment files when missing or stale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+import numpy as np
+
+_COLUMNS = ("etype", "device", "assignment", "tenant", "area", "customer",
+            "asset", "ts_ms", "received_ms", "values", "vmask", "aux",
+            "valid")
+
+
+@dataclasses.dataclass
+class _Segment:
+    part: int        # partition = shard * arenas + arena (0 for 1-ring)
+    start: int       # absolute position of first row within the partition
+    count: int
+    ts_min: int
+    ts_max: int
+    path: str
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class EventArchive:
+    """Directory of spilled ring segments + a queryable index.
+
+    ``parts`` is the number of independent sub-rings feeding this archive
+    (arenas for a single-chip engine, n_shards*arenas for the mesh); each
+    keeps its own spill watermark."""
+
+    def __init__(self, directory: str | pathlib.Path, segment_rows: int = 4096):
+        self.dir = pathlib.Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.segment_rows = int(segment_rows)
+        self.segments: list[_Segment] = []
+        self.lost_rows = 0   # rows overwritten before they could spill
+        self._load_index()
+
+    # ------------------------------------------------------------- index
+    def _manifest_path(self) -> pathlib.Path:
+        return self.dir / "index.json"
+
+    def _load_index(self) -> None:
+        # a crash mid-write leaves a *.npz.tmp — never adopted (the glob
+        # below requires the final .npz name), just swept away here
+        for stray in self.dir.glob("*.npz.tmp"):
+            stray.unlink()
+        manifest = self._manifest_path()
+        known: dict[str, _Segment] = {}
+        if manifest.exists():
+            for e in json.loads(manifest.read_text()).get("segments", []):
+                known[e["path"]] = _Segment(**e)
+        # adopt any segment file the manifest missed (crash between the
+        # segment rename and the manifest rewrite)
+        for f in sorted(self.dir.glob("seg-*.npz")):
+            if f.name in known:
+                self.segments.append(known[f.name])
+                continue
+            with np.load(f) as z:
+                ts = z["ts_ms"]
+                self.segments.append(_Segment(
+                    part=int(z["part"]), start=int(z["start"]),
+                    count=int(ts.shape[0]),
+                    ts_min=int(ts.min()) if ts.size else 0,
+                    ts_max=int(ts.max()) if ts.size else 0,
+                    path=f.name))
+        self.segments.sort(key=lambda s: (s.part, s.start))
+
+    def _save_index(self) -> None:
+        tmp = self._manifest_path().with_suffix(".tmp")
+        tmp.write_text(json.dumps(
+            {"segments": [s.to_json() for s in self.segments]}))
+        tmp.replace(self._manifest_path())
+
+    def spilled(self, part: int) -> int:
+        """Next absolute position of ``part`` not yet on disk."""
+        return max((s.start + s.count for s in self.segments
+                    if s.part == part), default=0)
+
+    def total_rows(self) -> int:
+        return sum(s.count for s in self.segments)
+
+    # ------------------------------------------------------------- write
+    def append_segment(self, part: int, start: int, sl) -> None:
+        """Persist one contiguous ring slice (a ``StoreSlice`` already on
+        host). Idempotent: re-spooling an existing (part, start) range —
+        e.g. after WAL replay — is a no-op."""
+        name = f"seg-p{part:04d}-o{start:014d}-n{sl.ts_ms.shape[0]}.npz"
+        path = self.dir / name
+        if path.exists():
+            return
+        ts = np.asarray(sl.ts_ms)
+        # temp name must NOT match the seg-*.npz recovery glob (write via a
+        # file handle — np.savez would append .npz to a bare path)
+        tmp = path.with_name(path.name + ".tmp")
+        with open(tmp, "wb") as f:
+            np.savez(f, part=np.int64(part), start=np.int64(start),
+                     **{c: np.asarray(getattr(sl, c)) for c in _COLUMNS})
+        tmp.replace(path)
+        self.segments.append(_Segment(
+            part=part, start=start, count=int(ts.shape[0]),
+            ts_min=int(ts.min()) if ts.size else 0,
+            ts_max=int(ts.max()) if ts.size else 0, path=name))
+        self.segments.sort(key=lambda s: (s.part, s.start))
+        self._save_index()
+
+    def note_lost(self, count: int) -> None:
+        """Record rows that wrapped before spooling (mis-sized trigger —
+        surfaced in metrics the way the feed reports ``lag_lost``)."""
+        self.lost_rows += int(count)
+
+    # ------------------------------------------------------------- query
+    def get_row(self, part: int, pos: int) -> dict | None:
+        """Fetch one archived row by (partition, absolute position) — the
+        by-id lookup for events evicted from the ring. Returns the ring
+        column layout as a dict, or None if the position was never
+        spilled."""
+        for seg in self.segments:
+            if seg.part == part and seg.start <= pos < seg.start + seg.count:
+                i = pos - seg.start
+                with np.load(self.dir / seg.path) as z:
+                    if not bool(z["valid"][i]):
+                        return None
+                    return {c: np.asarray(z[c])[i] for c in _COLUMNS}
+        return None
+
+    def query(self, *, max_pos: dict[int, int] | None = None,
+              device: int | None = None, etype: int | None = None,
+              tenant: int | None = None, since_ms: int | None = None,
+              until_ms: int | None = None, assignment: int | None = None,
+              aux0: int | None = None, aux1: int | None = None,
+              area: int | None = None, customer: int | None = None,
+              limit: int = 100,
+              device_parts: frozenset[int] | None = None,
+              assignment_parts: frozenset[int] | None = None,
+              ) -> tuple[int, list[dict]]:
+        """Newest-first filtered scan over archived rows.
+
+        ``max_pos[part]`` caps the scan at rows already EVICTED from that
+        partition's ring (absolute position < max_pos) so ring + archive
+        results never overlap. ``device_parts``/``assignment_parts`` scope
+        a shard-LOCAL id filter to the partitions of its owning shard (mesh
+        engines — the id namespaces repeat per shard). Returns
+        (total_matching, top rows) where each row is a plain dict of
+        scalars/arrays in ring column layout plus ``part``/``pos``."""
+        total = 0
+        top: list[tuple[int, dict]] = []
+        for seg in self.segments:
+            if max_pos is not None and seg.start >= max_pos.get(seg.part, 0):
+                continue
+            if since_ms is not None and seg.ts_max < since_ms:
+                continue
+            if until_ms is not None and seg.ts_min > until_ms:
+                continue
+            if device is not None and device_parts is not None \
+                    and seg.part not in device_parts:
+                continue
+            with np.load(self.dir / seg.path) as z:
+                m = np.asarray(z["valid"], bool).copy()
+                cap = seg.count
+                if max_pos is not None:
+                    cap = min(cap, max_pos.get(seg.part, 0) - seg.start)
+                    m[cap:] = False
+                if device is not None:
+                    m &= np.asarray(z["device"]) == device
+                if etype is not None:
+                    m &= np.asarray(z["etype"]) == etype
+                if tenant is not None:
+                    m &= np.asarray(z["tenant"]) == tenant
+                if assignment is not None:
+                    if assignment_parts is not None \
+                            and seg.part not in assignment_parts:
+                        m[:] = False
+                    else:
+                        m &= np.asarray(z["assignment"]) == assignment
+                if aux0 is not None:
+                    m &= np.asarray(z["aux"])[:, 0] == aux0
+                if aux1 is not None:
+                    m &= np.asarray(z["aux"])[:, 1] == aux1
+                if area is not None:
+                    m &= np.asarray(z["area"]) == area
+                if customer is not None:
+                    m &= np.asarray(z["customer"]) == customer
+                ts = np.asarray(z["ts_ms"])
+                if since_ms is not None:
+                    m &= ts >= since_ms
+                if until_ms is not None:
+                    m &= ts <= until_ms
+                idx = np.nonzero(m)[0]
+                total += int(idx.size)
+                if not idx.size:
+                    continue
+                # keep only this segment's newest ``limit`` matches
+                order = idx[np.argsort(-ts[idx], kind="stable")][:limit]
+                cols = {c: np.asarray(z[c])[order] for c in _COLUMNS}
+                for j, i in enumerate(order):
+                    row = {c: cols[c][j] for c in _COLUMNS}
+                    row["part"] = seg.part
+                    row["pos"] = seg.start + int(i)
+                    top.append((int(ts[i]), row))
+        top.sort(key=lambda t: -t[0])
+        return total, [r for _, r in top[:limit]]

@@ -1,0 +1,263 @@
+"""Long-term retention tier: ring-segment spill + transparent query merge.
+
+The VERDICT r2 acceptance test: ingest 4x ring capacity, then query events
+from the first quarter by date range and get them back — single-node and
+distributed. Matches the reference's unbounded external-DB history
+(InfluxDbDeviceEventManagement.java:63-161 date-range search).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.types import EventType
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.utils.archive import EventArchive
+
+
+def meas(eng: Engine, token: str, value: float, ts_rel: int) -> bytes:
+    """Payload with eventDate at engine-relative millisecond ``ts_rel``
+    (wire carries absolute unix ms; queries use the relative domain)."""
+    base = int(eng.epoch.base_unix_s * 1000)
+    return json.dumps({
+        "deviceToken": token,
+        "type": "DeviceMeasurements",
+        "request": {"measurements": {"temp": value}, "eventDate": base + ts_rel},
+    }).encode()
+
+
+def small_engine(tmp_path, **kw) -> Engine:
+    cfg = dict(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=64, channels=4, batch_capacity=16,
+        archive_dir=str(tmp_path / "arch"), archive_segment_rows=16,
+    )
+    cfg.update(kw)
+    return Engine(EngineConfig(**cfg))
+
+
+def test_ingest_4x_capacity_then_query_first_quarter(tmp_path):
+    eng = small_engine(tmp_path)
+    n = 4 * 64
+    for i in range(n):
+        eng.ingest_json_batch([meas(eng, f"d-{i % 8}", float(i), 1000 + i)])
+    eng.flush()
+
+    # ring holds only the newest <=64 rows; the rest must be on disk
+    assert eng.archive.total_rows() >= n - 64 - eng.archive.segment_rows
+    assert eng.archive.lost_rows == 0
+
+    # date-range query over the FIRST quarter — long gone from the ring
+    res = eng.query_events(since_ms=1000, until_ms=1000 + 63, limit=64)
+    assert res["total"] == 64
+    assert len(res["events"]) == 64
+    assert res["events"][0]["eventDateMs"] == 1063   # newest first
+    assert res["events"][-1]["eventDateMs"] == 1000
+    # values decoded from the archived columns
+    by_ts = {e["eventDateMs"]: e for e in res["events"]}
+    assert by_ts[1005]["measurements"]["temp"] == pytest.approx(5.0)
+    assert by_ts[1005]["deviceToken"] == "d-5"
+
+    # unfiltered total covers the full history (ring + archive, no overlap)
+    res = eng.query_events(limit=10)
+    assert res["total"] == n
+
+    # device filter reaches archived rows
+    res = eng.query_events(device_token="d-3", since_ms=1000,
+                           until_ms=1000 + 63, limit=64)
+    assert res["total"] == 8
+    assert all(e["deviceToken"] == "d-3" for e in res["events"])
+
+
+def test_archive_tenant_and_type_filters(tmp_path):
+    eng = small_engine(tmp_path)
+    for i in range(128):
+        eng.ingest_json_batch([meas(eng, "t-1", float(i), 2000 + i)],
+                              tenant="acme")
+    eng.flush()
+    res = eng.query_events(tenant="acme", since_ms=2000, until_ms=2031,
+                           limit=64)
+    assert res["total"] == 32
+    res = eng.query_events(tenant="ghost", since_ms=2000, until_ms=2031)
+    assert res["total"] == 0
+    res = eng.query_events(etype=EventType.LOCATION, since_ms=2000,
+                           until_ms=2031)
+    assert res["total"] == 0
+
+
+def test_archive_index_rebuild_after_manifest_loss(tmp_path):
+    eng = small_engine(tmp_path)
+    for i in range(128):
+        eng.ingest_json_batch([meas(eng, "r-1", float(i), 3000 + i)])
+    eng.flush()
+    n_rows = eng.archive.total_rows()
+    assert n_rows > 0
+    # crash between segment rename and manifest rewrite: manifest gone
+    (tmp_path / "arch" / "index.json").unlink()
+    arch = EventArchive(tmp_path / "arch", segment_rows=16)
+    assert arch.total_rows() == n_rows
+    assert arch.spilled(0) == eng.archive.spilled(0)
+
+
+def test_archive_append_idempotent(tmp_path):
+    eng = small_engine(tmp_path)
+    for i in range(128):
+        eng.ingest_json_batch([meas(eng, "i-1", float(i), 4000 + i)])
+    eng.flush()
+    before = eng.archive.total_rows()
+    spilled = eng.archive.spilled(0)
+    # WAL-replay style re-spool of an already-archived range is a no-op
+    eng._rows_since_spool = 10**9
+    eng._spool()
+    assert eng.archive.total_rows() == before
+    assert eng.archive.spilled(0) == spilled
+
+
+def test_archive_respects_limit_and_merge_order(tmp_path):
+    eng = small_engine(tmp_path)
+    for i in range(4 * 64):
+        eng.ingest_json_batch([meas(eng, "m-1", float(i), 5000 + i)])
+    eng.flush()
+    res = eng.query_events(limit=300)
+    # limit caps the page; total still counts everything
+    assert res["total"] == 256
+    assert len(res["events"]) == 256 if 256 <= 300 else 300
+    ts = [e["eventDateMs"] for e in res["events"]]
+    assert ts == sorted(ts, reverse=True)
+    assert ts[0] == 5000 + 255
+
+
+# ---------------------------------------------------------------- distributed
+def test_distributed_ingest_4x_capacity_then_query_first_quarter(tmp_path):
+    from sitewhere_tpu.parallel.distributed import (
+        DistributedConfig,
+        DistributedEngine,
+    )
+
+    eng = DistributedEngine(DistributedConfig(
+        n_shards=4, device_capacity_per_shard=64, token_capacity_per_shard=128,
+        assignment_capacity_per_shard=128, store_capacity_per_shard=64,
+        channels=4, batch_capacity_per_shard=16,
+        archive_dir=str(tmp_path / "darch"), archive_segment_rows=16))
+    base = int(eng.epoch.base_unix_s * 1000)
+
+    def pay(token, value, ts_rel):
+        return json.dumps({
+            "deviceToken": token, "type": "DeviceMeasurements",
+            "request": {"measurements": {"temp": value},
+                        "eventDate": base + ts_rel}}).encode()
+
+    # 4x the AGGREGATE ring capacity, over enough devices that every shard
+    # wraps several times
+    n = 4 * 4 * 64
+    for i in range(0, n, 32):
+        eng.ingest_json_batch([
+            pay(f"da-{j % 16}", float(j), 1000 + j)
+            for j in range(i, i + 32)])
+    eng.flush()
+    assert eng.archive.lost_rows == 0
+    assert eng.archive.total_rows() > 0
+
+    # first-quarter date range, long evicted from every shard's ring
+    res = eng.query_events(since_ms=1000, until_ms=1000 + 255, limit=256)
+    assert res["total"] == 256
+    ts = [e["eventDateMs"] for e in res["events"]]
+    assert ts == sorted(ts, reverse=True)
+    assert ts[0] == 1255 and ts[-1] == 1000
+    by_ts = {e["eventDateMs"]: e for e in res["events"]}
+    assert by_ts[1005]["deviceToken"] == "da-5"
+    assert by_ts[1005]["measurements"]["temp"] == pytest.approx(5.0)
+
+    # full-history totals agree (ring + archive, no overlap)
+    assert eng.query_events(limit=10)["total"] == n
+
+    # device filter scoped to the owning shard's partitions
+    res = eng.query_events(device_token="da-3", since_ms=1000,
+                           until_ms=1000 + 255, limit=256)
+    assert res["total"] == 16
+    assert all(e["deviceToken"] == "da-3" for e in res["events"])
+
+    m = eng.metrics()
+    assert m["archived_rows"] == eng.archive.total_rows()
+
+
+def test_archive_with_scan_chunks_loses_nothing(tmp_path):
+    """Review r3: spool accounting must happen at DISPATCH time — with
+    scan_chunk>1 a staged batch advances the ring only when its chunk
+    dispatches, and rows must still spill before overwrite."""
+    eng = small_engine(tmp_path, batch_capacity=4, scan_chunk=2)
+    for i in range(4 * 64):
+        eng.ingest_json_batch([meas(eng, f"sc-{i % 4}", float(i), 6000 + i)])
+    eng.flush()
+    assert eng.archive.lost_rows == 0
+    res = eng.query_events(since_ms=6000, until_ms=6063, limit=64)
+    assert res["total"] == 64
+
+
+def test_get_event_falls_back_to_archive(tmp_path):
+    """Review r3: /api/events/id/{id} must agree with query_events about
+    archived history — by-id lookups follow evicted rows to disk."""
+    eng = small_engine(tmp_path)
+    feed = eng.make_feed_consumer("arch-feed")
+    eng.ingest_json_batch([meas(eng, "ge-1", 1.5, 7000)])
+    eng.flush()
+    first = feed.poll()[0]
+    assert eng.get_event(first.event_id)["eventDateMs"] == 7000
+    # wrap the ring several times; the first event now lives only on disk
+    for i in range(4 * 64):
+        eng.ingest_json_batch([meas(eng, "ge-1", float(i), 7100 + i)])
+    eng.flush()
+    ev = eng.get_event(first.event_id)
+    assert ev is not None
+    assert ev["eventDateMs"] == 7000
+    assert ev["measurements"]["temp"] == pytest.approx(1.5)
+    # never-written ids still miss
+    assert eng.get_event(10**9) is None
+
+
+def test_archive_ignores_partial_tmp_file(tmp_path):
+    eng = small_engine(tmp_path)
+    for i in range(128):
+        eng.ingest_json_batch([meas(eng, "tf-1", float(i), 8000 + i)])
+    eng.flush()
+    n_rows = eng.archive.total_rows()
+    # crash mid-write: a truncated temp file must not poison recovery
+    (tmp_path / "arch" / "seg-p0000-o99999999999999-n16.npz.tmp").write_bytes(
+        b"\x50\x4b\x03\x04 truncated")
+    arch = EventArchive(tmp_path / "arch", segment_rows=16)
+    assert arch.total_rows() == n_rows
+    assert not list((tmp_path / "arch").glob("*.npz.tmp"))
+
+
+def test_distributed_get_event_falls_back_to_archive(tmp_path):
+    from sitewhere_tpu.parallel.distributed import (
+        DistributedConfig,
+        DistributedEngine,
+        DistributedFeedConsumer,
+    )
+
+    eng = DistributedEngine(DistributedConfig(
+        n_shards=4, device_capacity_per_shard=64, token_capacity_per_shard=128,
+        assignment_capacity_per_shard=128, store_capacity_per_shard=64,
+        channels=4, batch_capacity_per_shard=16,
+        archive_dir=str(tmp_path / "dga"), archive_segment_rows=16))
+    base = int(eng.epoch.base_unix_s * 1000)
+
+    def pay(token, value, ts_rel):
+        return json.dumps({
+            "deviceToken": token, "type": "DeviceMeasurements",
+            "request": {"measurements": {"temp": value},
+                        "eventDate": base + ts_rel}}).encode()
+
+    feed = DistributedFeedConsumer(eng, "dga-feed")
+    eng.ingest_json_batch([pay("dg-1", 2.5, 9000)])
+    eng.flush()
+    first = feed.poll()[0]
+    for i in range(4 * 4 * 64):
+        eng.ingest_json_batch([pay(f"dg-{i % 8}", float(i), 9100 + i)])
+    eng.flush()
+    ev = eng.get_event(first.event_id)
+    assert ev is not None and ev["eventDateMs"] == 9000
+    assert ev["deviceToken"] == "dg-1"
+    assert ev["measurements"]["temp"] == pytest.approx(2.5)
