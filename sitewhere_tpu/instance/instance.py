@@ -44,6 +44,8 @@ class InstanceConfig:
     admin_username: str = "admin"
     admin_password: str = "password"
     index_events: bool = True
+    script_root: str | None = None   # versioned tenant-script store dir;
+                                     # None -> per-instance temp dir
 
 
 class SiteWhereTpuInstance(LifecycleComponent):
@@ -119,6 +121,24 @@ class SiteWhereTpuInstance(LifecycleComponent):
 
             self.analytics = AnalyticsService(self.engine)
 
+        # versioned tenant scripts (Instance.java scripting REST family);
+        # activation rewrites active.py, which scripted components bind
+        # through the hot-reloading ScriptManager
+        import tempfile
+
+        from sitewhere_tpu.utils.scripting import (
+            DEFAULT_MANAGER,
+            ScriptManagement,
+        )
+
+        self._scripts_tmpdir = None
+        if self.config.script_root is None:
+            # ephemeral store for embedded instances — removed on stop()
+            self._scripts_tmpdir = tempfile.mkdtemp(prefix="swtpu-scripts-")
+        self.scripts = ScriptManagement(
+            self.config.script_root or self._scripts_tmpdir,
+            manager=DEFAULT_MANAGER)
+
         # auth + tenants
         self.users = UserManagement()
         self.users.create_user(self.config.admin_username,
@@ -127,6 +147,17 @@ class SiteWhereTpuInstance(LifecycleComponent):
                               issuer=self.config.instance_id)
         self.tenants = TenantManagement(self.engine, self.device_management)
         self.tenants.create_tenant("default", "Default Tenant")
+
+        # per-tenant applied component graphs (config.py hot-reload state):
+        # tenant -> {"config": dict, "summary": dict}
+        self.tenant_configs: dict[str, dict] = {}
+
+    async def on_stop(self) -> None:
+        if self._scripts_tmpdir is not None:
+            import shutil
+
+            shutil.rmtree(self._scripts_tmpdir, ignore_errors=True)
+            self._scripts_tmpdir = None
 
     # --- wiring helpers ---------------------------------------------------
     def add_source(self, source: InboundEventSource) -> InboundEventSource:

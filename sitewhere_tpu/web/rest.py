@@ -179,6 +179,177 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_get("/api/instance/metrics/prometheus", prometheus_metrics)
 
+    # --- script management (reference: Instance.java scripting @Path
+    # family — script CRUD, versions, content, clone, activate) -----------
+    # ADMIN-ONLY: scripts execute as in-process Python and config pushes
+    # rebuild live component graphs — instance-management powers, gated
+    # like the user/tenant admin endpoints below
+    def _admin(handler):
+        async def wrapped(request: web.Request):
+            if AUTH_ADMIN not in request.get("authorities", []):
+                return json_response({"error": "admin required"}, status=403)
+            return await handler(request)
+
+        return wrapped
+
+    def _sm_args(req: web.Request) -> tuple[str, str]:
+        return req.match_info["identifier"], req.match_info["tenant"]
+
+    _scr_base = "/api/microservices/{identifier}/tenants/{tenant}/scripting"
+
+    async def list_tenant_scripts(request: web.Request):
+        return json_response(inst.scripts.list_scripts(*_sm_args(request)))
+
+    async def list_scripts_by_category(request: web.Request):
+        by_cat = inst.scripts.list_by_category(*_sm_args(request))
+        return json_response([
+            {"id": cat, "scripts": scripts}
+            for cat, scripts in sorted(by_cat.items())
+        ])
+
+    async def list_scripts_for_category(request: web.Request):
+        by_cat = inst.scripts.list_by_category(*_sm_args(request))
+        return json_response(by_cat.get(request.match_info["category"], []))
+
+    async def get_tenant_script(request: web.Request):
+        try:
+            return json_response(inst.scripts.get_script(
+                *_sm_args(request), request.match_info["scriptId"]))
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+
+    async def create_tenant_script(request: web.Request):
+        body = await request.json()
+        try:
+            meta = inst.scripts.create_script(
+                *_sm_args(request),
+                script_id=body["id"], name=body.get("name"),
+                description=body.get("description", ""),
+                category=body.get("category", "uncategorized"),
+                content=body.get("content", ""),
+                activate=body.get("activate", True))
+        except ValueError as e:
+            return json_response({"error": str(e)}, status=409)
+        return json_response(meta, status=201)
+
+    async def get_script_content(request: web.Request):
+        try:
+            text = inst.scripts.get_content(
+                *_sm_args(request), request.match_info["scriptId"],
+                request.match_info["versionId"])
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return web.Response(text=text, content_type="text/plain")
+
+    async def update_tenant_script(request: web.Request):
+        body = await request.json()
+        try:
+            meta = inst.scripts.update_script(
+                *_sm_args(request), request.match_info["scriptId"],
+                request.match_info["versionId"],
+                content=body.get("content"), name=body.get("name"),
+                description=body.get("description"),
+                category=body.get("category"))
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return json_response(meta)
+
+    async def clone_tenant_script(request: web.Request):
+        body = await request.json() if request.can_read_body else {}
+        try:
+            meta = inst.scripts.clone_version(
+                *_sm_args(request), request.match_info["scriptId"],
+                request.match_info["versionId"],
+                comment=body.get("comment", ""))
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return json_response(meta, status=201)
+
+    async def activate_tenant_script(request: web.Request):
+        try:
+            meta = inst.scripts.activate(
+                *_sm_args(request), request.match_info["scriptId"],
+                request.match_info["versionId"])
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return json_response(meta)
+
+    async def delete_tenant_script(request: web.Request):
+        if not inst.scripts.delete_script(
+                *_sm_args(request), request.match_info["scriptId"]):
+            raise EntityNotFound(request.match_info["scriptId"])
+        return json_response({"deleted": True})
+
+    r.add_get(f"{_scr_base}/scripts", _admin(list_tenant_scripts))
+    r.add_get(f"{_scr_base}/categories", _admin(list_scripts_by_category))
+    r.add_get(f"{_scr_base}/categories/{{category}}",
+              _admin(list_scripts_for_category))
+    r.add_get(f"{_scr_base}/scripts/{{scriptId}}", _admin(get_tenant_script))
+    r.add_post(f"{_scr_base}/scripts", _admin(create_tenant_script))
+    r.add_get(f"{_scr_base}/scripts/{{scriptId}}/versions/{{versionId}}"
+              "/content", _admin(get_script_content))
+    r.add_post(f"{_scr_base}/scripts/{{scriptId}}/versions/{{versionId}}",
+               _admin(update_tenant_script))
+    r.add_post(f"{_scr_base}/scripts/{{scriptId}}/versions/{{versionId}}"
+               "/clone", _admin(clone_tenant_script))
+    r.add_post(f"{_scr_base}/scripts/{{scriptId}}/versions/{{versionId}}"
+               "/activate", _admin(activate_tenant_script))
+    r.add_delete(f"{_scr_base}/scripts/{{scriptId}}", _admin(delete_tenant_script))
+
+    # microservice-level script templates (Instance.java
+    # /microservices/{id}/scripting/templates; served from the shipped
+    # script-templates/ directory, the dockerimage/script-templates analog)
+    import pathlib as _pathlib
+
+    _tpl_root = _pathlib.Path(__file__).resolve().parents[2] / "script-templates"
+
+    async def list_script_template_categories(request: web.Request):
+        tpls = (sorted(p.stem for p in _tpl_root.glob("*.py"))
+                if _tpl_root.exists() else [])
+        return json_response([{
+            "id": "templates", "name": "Script templates",
+            "templates": tpls,
+        }])
+
+    async def get_script_template(request: web.Request):
+        p = _tpl_root / (request.match_info["templateId"] + ".py")
+        if not _tpl_root.exists() or not p.resolve().is_file() \
+                or p.resolve().parent != _tpl_root:
+            raise EntityNotFound(request.match_info["templateId"])
+        return web.Response(text=p.read_text(), content_type="text/plain")
+
+    r.add_get("/api/microservices/{identifier}/scripting/categories",
+              _admin(list_script_template_categories))
+    r.add_get("/api/microservices/{identifier}/scripting/templates"
+              "/{templateId}", _admin(get_script_template))
+
+    # --- tenant configuration get + LIVE hot-reload (reference: ZooKeeper
+    # config watch rebuilds tenant component graphs without restart,
+    # README "Centralized Configuration Management") -----------------------
+    async def get_tenant_configuration(request: web.Request):
+        entry = inst.tenant_configs.get(request.match_info["tenant"])
+        if entry is None:
+            raise EntityNotFound(request.match_info["tenant"])
+        return json_response({"configuration": entry["config"],
+                              "summary": entry["summary"]})
+
+    async def update_tenant_configuration(request: web.Request):
+        from sitewhere_tpu.config import ConfigError, reload_tenant_config
+
+        body = await request.json()
+        cfg = body.get("configuration", body)
+        try:
+            summary = await reload_tenant_config(
+                inst, cfg, tenant=request.match_info["tenant"])
+        except ConfigError as e:
+            return json_response({"error": str(e)}, status=400)
+        return json_response({"summary": summary})
+
+    r.add_get("/api/microservices/{identifier}/tenants/{tenant}"
+              "/configuration", _admin(get_tenant_configuration))
+    r.add_post("/api/microservices/{identifier}/tenants/{tenant}"
+               "/configuration", _admin(update_tenant_configuration))
+
     # --- devices ----------------------------------------------------------
     async def create_device(request: web.Request):
         body = await request.json()

@@ -1,0 +1,174 @@
+"""Versioned script management over REST + activation hot-swap.
+
+VERDICT r2 item 5: expose ScriptManager over REST with script CRUD,
+versions, content, clone, activate; persist versions to disk; prove
+activate-then-decode-with-new-script. Matches the reference's Instance.java
+scripting @Path family (/microservices/{id}/tenants/{token}/scripting/...).
+"""
+
+import asyncio
+import base64
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from sitewhere_tpu.engine import EngineConfig
+from sitewhere_tpu.instance.instance import InstanceConfig, SiteWhereTpuInstance
+from sitewhere_tpu.web.rest import make_app
+
+V1 = """
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+
+def decode(payload, metadata):
+    return [DecodedRequest(type=RequestType.DEVICE_MEASUREMENT,
+                           device_token=payload.decode(),
+                           measurements={"script": 1.0})]
+"""
+
+V2 = V1.replace('"script": 1.0', '"script": 2.0')
+
+
+@pytest.fixture
+def inst(tmp_path):
+    return SiteWhereTpuInstance(InstanceConfig(
+        engine=EngineConfig(device_capacity=64, token_capacity=128,
+                            assignment_capacity=128, store_capacity=1024,
+                            channels=4, batch_capacity=16),
+        script_root=str(tmp_path / "scripts")))
+
+
+def run(inst, coro_factory):
+    async def go():
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        try:
+            basic = base64.b64encode(b"admin:password").decode()
+            r = await client.get("/api/authapi/jwt",
+                                 headers={"Authorization": f"Basic {basic}"})
+            h = {"Authorization": f"Bearer {(await r.json())['token']}"}
+            return await coro_factory(client, h)
+        finally:
+            await client.close()
+
+    return asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_script_lifecycle_over_rest(inst):
+    base = "/api/microservices/event-sources/tenants/default/scripting"
+
+    async def flow(client, h):
+        # create (v1 auto-activates)
+        r = await client.post(f"{base}/scripts", json={
+            "id": "my-decoder", "name": "My decoder",
+            "category": "decoders", "content": V1}, headers=h)
+        assert r.status == 201
+        meta = await r.json()
+        assert meta["activeVersion"] == "v1"
+        # duplicate id -> 409
+        r = await client.post(f"{base}/scripts",
+                              json={"id": "my-decoder"}, headers=h)
+        assert r.status == 409
+        # listing + categories
+        r = await client.get(f"{base}/scripts", headers=h)
+        assert [s["id"] for s in await r.json()] == ["my-decoder"]
+        r = await client.get(f"{base}/categories", headers=h)
+        cats = await r.json()
+        assert cats[0]["id"] == "decoders" and len(cats[0]["scripts"]) == 1
+        r = await client.get(f"{base}/categories/decoders", headers=h)
+        assert len(await r.json()) == 1
+        r = await client.get(f"{base}/categories/ghost", headers=h)
+        assert await r.json() == []
+        # content
+        r = await client.get(f"{base}/scripts/my-decoder/versions/v1/content",
+                             headers=h)
+        assert "script\": 1.0" in await r.text()
+        # clone v1 -> v2, update v2's content
+        r = await client.post(f"{base}/scripts/my-decoder/versions/v1/clone",
+                              json={"comment": "tweak"}, headers=h)
+        assert r.status == 201
+        assert [v["versionId"] for v in (await r.json())["versions"]] == \
+            ["v1", "v2"]
+        r = await client.post(f"{base}/scripts/my-decoder/versions/v2",
+                              json={"content": V2}, headers=h)
+        assert r.status == 200
+        # v2 exists but v1 is still active
+        r = await client.get(f"{base}/scripts/my-decoder", headers=h)
+        assert (await r.json())["activeVersion"] == "v1"
+        # activate v2
+        r = await client.post(
+            f"{base}/scripts/my-decoder/versions/v2/activate",
+            json={}, headers=h)
+        assert (await r.json())["activeVersion"] == "v2"
+        # unknown version -> 404
+        r = await client.post(
+            f"{base}/scripts/my-decoder/versions/v9/activate",
+            json={}, headers=h)
+        assert r.status == 404
+        # delete
+        r = await client.delete(f"{base}/scripts/my-decoder", headers=h)
+        assert r.status == 200
+        r = await client.get(f"{base}/scripts/my-decoder", headers=h)
+        assert r.status == 404
+        return True
+
+    assert run(inst, flow)
+
+
+def test_activate_then_decode_with_new_script(inst):
+    """The acceptance flow: a scripted decoder bound to the store's
+    active.py decodes with v1; activating v2 changes the very next decode
+    (hot reload through ScriptManager, no restart)."""
+    from sitewhere_tpu.ingest.decoders import ScriptedDecoder
+
+    base = "/api/microservices/event-sources/tenants/default/scripting"
+
+    async def flow(client, h):
+        await client.post(f"{base}/scripts", json={
+            "id": "hot-decoder", "content": V1}, headers=h)
+
+        # bind a scripted decoder to the ACTIVE script path
+        handle = inst.scripts.manager.handle(
+            inst.scripts.active_path("event-sources", "default",
+                                     "hot-decoder"), "decode")
+        decoder = ScriptedDecoder(handle)
+        reqs = decoder.decode(b"dev-hot", {})
+        assert reqs[0].measurements == {"script": 1.0}
+
+        # publish + activate v2; next decode must use it
+        await client.post(f"{base}/scripts/hot-decoder/versions/v1/clone",
+                          json={}, headers=h)
+        await client.post(f"{base}/scripts/hot-decoder/versions/v2",
+                          json={"content": V2}, headers=h)
+        await client.post(f"{base}/scripts/hot-decoder/versions/v2/activate",
+                          json={}, headers=h)
+        reqs = decoder.decode(b"dev-hot", {})
+        assert reqs[0].measurements == {"script": 2.0}
+
+        # and the decoded request flows into the engine
+        inst.engine.process(reqs[0])
+        out = inst.engine.flush()
+        assert out["persisted"] == 1
+        return True
+
+    assert run(inst, flow)
+
+
+def test_script_templates_endpoints(inst):
+    async def flow(client, h):
+        r = await client.get(
+            "/api/microservices/event-sources/scripting/categories",
+            headers=h)
+        cats = await r.json()
+        assert r.status == 200 and cats[0]["id"] == "templates"
+        assert "event-decoder" in cats[0]["templates"]
+        r = await client.get(
+            "/api/microservices/event-sources/scripting/templates"
+            "/event-decoder", headers=h)
+        assert r.status == 200 and "decode" in await r.text()
+        r = await client.get(
+            "/api/microservices/event-sources/scripting/templates/../etc",
+            headers=h)
+        assert r.status == 404
+        return True
+
+    assert run(inst, flow)
