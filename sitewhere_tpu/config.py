@@ -326,8 +326,14 @@ def apply_tenant_config(instance, config: dict | str | pathlib.Path,
         if "router" in routing:
             instance.commands.router = build_router(routing["router"])
     if hasattr(instance, "tenant_configs"):
-        instance.tenant_configs[tenant] = {"config": config,
-                                           "summary": summary}
+        instance.tenant_configs[tenant] = {
+            "config": config, "summary": summary,
+            # identity of the router THIS config installed (if any), so a
+            # later reload can tell whether the live router is ours to
+            # retire — never serialized to REST (only config/summary are)
+            "router_obj": (instance.commands.router
+                           if routing and "router" in routing else None),
+        }
     return summary
 
 
@@ -343,8 +349,25 @@ def apply_tenant_config(instance, config: dict | str | pathlib.Path,
 # --------------------------------------------------------------------------
 
 
-async def teardown_tenant_components(instance, summary: dict) -> None:
-    """Stop + detach the components a previous apply built."""
+async def _stop_quietly(component) -> None:
+    """Stop a component being retired; a failing stop (e.g. unreachable
+    broker) must never abort the swap — the component is going away
+    regardless."""
+    import logging
+
+    try:
+        await component.stop()
+    except Exception:
+        logging.getLogger(__name__).exception(
+            "stop of retired component %s failed (continuing teardown)",
+            getattr(component, "name", component))
+
+
+async def teardown_tenant_components(instance, entry: dict) -> None:
+    """Stop + detach the components a previous apply built. ``entry`` is a
+    tenant_configs record ({summary, router_obj, ...}); a bare summary dict
+    also works (no router handling)."""
+    summary = entry.get("summary", entry)
     mgr = instance.event_sources
     for sid in summary.get("eventSources", []):
         src = mgr.sources.pop(sid, None)
@@ -352,7 +375,7 @@ async def teardown_tenant_components(instance, summary: dict) -> None:
             continue
         if src in mgr.children:
             mgr.children.remove(src)
-        await src.stop()
+        await _stop_quietly(src)
     for cid in summary.get("connectors", []):
         host = next((h for h in instance.connector_hosts
                      if h.connector.connector_id == cid), None)
@@ -361,14 +384,20 @@ async def teardown_tenant_components(instance, summary: dict) -> None:
         instance.connector_hosts.remove(host)
         if host in instance.children:
             instance.children.remove(host)
-        await host.stop()
+        await _stop_quietly(host)
     for did in summary.get("destinations", []):
         dest = instance.commands.destinations.pop(did, None)
         if dest is None:
             continue
         if dest in instance.commands.children:
             instance.commands.children.remove(dest)
-        await dest.stop()
+        await _stop_quietly(dest)
+    # if the live router is the one THIS config installed and the
+    # replacement config doesn't bring its own, retire it too — a stale
+    # router would route every invocation at the just-removed destinations
+    router_obj = entry.get("router_obj")
+    if router_obj is not None and instance.commands.router is router_obj:
+        instance.commands.router = NoOpCommandRouter()
 
 
 async def reload_tenant_config(instance, config: dict | str | pathlib.Path,
@@ -424,7 +453,7 @@ async def reload_tenant_config(instance, config: dict | str | pathlib.Path,
                set(instance.commands.destinations))
 
     if prev is not None:
-        await teardown_tenant_components(instance, prev["summary"])
+        await teardown_tenant_components(instance, prev)
     summary = apply_tenant_config(instance, config, tenant=tenant)
 
     if instance.status is LifecycleStatus.STARTED:

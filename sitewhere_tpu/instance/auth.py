@@ -198,6 +198,30 @@ class UserManagement:
                 setattr(user, k, v)
             return user
 
+    def add_roles(self, username: str, roles: list[str]) -> User:
+        """Append roles (reference: Users.java @PUT /{username}/roles ->
+        SyncopeUserManagement.addRoles)."""
+        with self._lock:
+            user = self.users.get(username)
+            if user is None:
+                raise KeyError(f"user {username!r} not found")
+            unknown = [r for r in roles if r not in self.roles]
+            if unknown:
+                raise ValueError(f"unknown roles: {unknown}")
+            for r in roles:
+                if r not in user.roles:
+                    user.roles.append(r)
+            return user
+
+    def remove_roles(self, username: str, roles: list[str]) -> User:
+        """Remove roles (reference: Users.java @DELETE /{username}/roles)."""
+        with self._lock:
+            user = self.users.get(username)
+            if user is None:
+                raise KeyError(f"user {username!r} not found")
+            user.roles = [r for r in user.roles if r not in set(roles)]
+            return user
+
     def delete_user(self, username: str) -> bool:
         with self._lock:
             return self.users.pop(username, None) is not None

@@ -133,8 +133,16 @@ class SiteWhereTpuInstance(LifecycleComponent):
 
         self._scripts_tmpdir = None
         if self.config.script_root is None:
-            # ephemeral store for embedded instances — removed on stop()
+            # ephemeral store for embedded instances — removed on stop(),
+            # and by GC/interpreter-exit for instances that never run the
+            # lifecycle (tests, short-lived embedding)
+            import shutil
+            import weakref
+
             self._scripts_tmpdir = tempfile.mkdtemp(prefix="swtpu-scripts-")
+            self._scripts_finalizer = weakref.finalize(
+                self, shutil.rmtree, self._scripts_tmpdir,
+                ignore_errors=True)
         self.scripts = ScriptManagement(
             self.config.script_root or self._scripts_tmpdir,
             manager=DEFAULT_MANAGER)

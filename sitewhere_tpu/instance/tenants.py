@@ -67,6 +67,57 @@ BUILTIN_DATASETS: dict[str, DatasetTemplate] = {
     "construction": construction_dataset,
 }
 
+# tenant configuration templates (reference: Tenants.java
+# /templates/configuration backed by TenantConfigurationTemplate CRDs) —
+# canned component-graph configs a new tenant can start from, in the
+# config.py apply_tenant_config schema
+CONFIG_TEMPLATES: list[dict] = [
+    {
+        "id": "default",
+        "name": "Default configuration",
+        "description": "In-memory event source with JSON decoder and "
+                       "local command delivery.",
+        "configuration": {
+            "eventSources": [
+                {"id": "default-in", "type": "inmemory",
+                 "decoder": {"type": "json"},
+                 "deduplicator": {"type": "alternate-id"}},
+            ],
+            "commandRouting": {
+                "router": {"type": "single-choice",
+                           "destination": "default-local"},
+                "destinations": [
+                    {"id": "default-local", "type": "local",
+                     "encoder": {"type": "json"}},
+                ],
+            },
+        },
+    },
+    {
+        "id": "mqtt",
+        "name": "MQTT configuration",
+        "description": "MQTT event source (JSON decoder) with MQTT "
+                       "command delivery.",
+        "configuration": {
+            "eventSources": [
+                {"id": "mqtt-in", "type": "mqtt",
+                 "decoder": {"type": "json"},
+                 "configuration": {"host": "127.0.0.1", "port": 1883,
+                                   "topic": "sitewhere/input/#"}},
+            ],
+            "commandRouting": {
+                "router": {"type": "single-choice",
+                           "destination": "mqtt-out"},
+                "destinations": [
+                    {"id": "mqtt-out", "type": "mqtt",
+                     "encoder": {"type": "json"},
+                     "configuration": {"host": "127.0.0.1", "port": 1883}},
+                ],
+            },
+        },
+    },
+]
+
 
 class TenantManagement:
     """Tenant CRUD + bootstrap orchestration."""

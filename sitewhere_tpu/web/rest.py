@@ -968,6 +968,27 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     r.add_post("/api/tenants", create_tenant)
     r.add_get("/api/tenants", _sync(lambda req: json_response(
         _paged(inst.tenants.tenants.list()))))
+
+    # templates for creating tenants (reference: Tenants.java
+    # /templates/configuration + /templates/dataset, backed there by k8s
+    # TenantConfiguration/DatasetTemplate CRDs). Registered BEFORE the
+    # /{token} route so "templates" never resolves as a tenant token.
+    async def list_tenant_configuration_templates(request: web.Request):
+        from sitewhere_tpu.instance.tenants import CONFIG_TEMPLATES
+
+        return json_response(CONFIG_TEMPLATES)
+
+    async def list_tenant_dataset_templates(request: web.Request):
+        return json_response([
+            {"id": key, "name": key.title(),
+             "description": (fn.__doc__ or "").strip().split("\n")[0]}
+            for key, fn in inst.tenants.datasets.items()
+        ])
+
+    r.add_get("/api/tenants/templates/configuration",
+              list_tenant_configuration_templates)
+    r.add_get("/api/tenants/templates/dataset",
+              list_tenant_dataset_templates)
     r.add_get("/api/tenants/{token}", _sync(lambda req: json_response(
         _entity(inst.tenants.tenants.get(req.match_info["token"])))))
 
@@ -1022,6 +1043,40 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     r.add_get("/api/users/{username}", get_user)
     r.add_put("/api/users/{username}", update_user)
     r.add_delete("/api/users/{username}", delete_user)
+
+    # role mutation (reference: Users.java @GET/@PUT/@DELETE
+    # /{username}/roles -> add/removeRoles; empty role list is an error)
+    async def get_user_roles(request: web.Request):
+        u = inst.users.users.get(request.match_info["username"])
+        if u is None:
+            raise EntityNotFound("user")
+        return json_response({"numResults": len(u.roles), "results": u.roles})
+
+    async def add_user_roles(request: web.Request):
+        roles = await request.json()
+        if not isinstance(roles, list) or not roles:
+            return json_response({"error": "non-empty role list required"},
+                                 status=400)
+        try:
+            u = inst.users.add_roles(request.match_info["username"], roles)
+        except KeyError:
+            raise EntityNotFound("user") from None
+        return json_response(_user_json(u))
+
+    async def remove_user_roles(request: web.Request):
+        roles = await request.json()
+        if not isinstance(roles, list) or not roles:
+            return json_response({"error": "non-empty role list required"},
+                                 status=400)
+        try:
+            u = inst.users.remove_roles(request.match_info["username"], roles)
+        except KeyError:
+            raise EntityNotFound("user") from None
+        return json_response(_user_json(u))
+
+    r.add_get("/api/users/{username}/roles", get_user_roles)
+    r.add_put("/api/users/{username}/roles", _admin(add_user_roles))
+    r.add_delete("/api/users/{username}/roles", _admin(remove_user_roles))
 
     # --- roles / authorities (reference: Roles.java + Authorities.java) ---
     async def create_role(request: web.Request):

@@ -247,3 +247,28 @@ def test_scripting_and_config_endpoints_require_admin(tmp_path):
             await client.close()
 
     asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_reload_retires_stale_router():
+    """Review r3: dropping commandRouting from a tenant's config must not
+    leave the old router aimed at torn-down destinations."""
+    from sitewhere_tpu.commands.routing import NoOpCommandRouter
+
+    inst = mini_instance()
+    cfg = dict(V1_CFG)
+    cfg["commandRouting"] = {
+        "router": {"type": "single-choice", "destination": "d1"},
+        "destinations": [{"id": "d1", "type": "local",
+                          "encoder": {"type": "json"}}]}
+    apply_tenant_config(inst, cfg)
+    installed = inst.commands.router
+    loop = asyncio.new_event_loop()
+    # new config without commandRouting: destinations AND router retire
+    loop.run_until_complete(reload_tenant_config(inst, V1_CFG))
+    assert inst.commands.destinations == {}
+    assert isinstance(inst.commands.router, NoOpCommandRouter)
+    assert inst.commands.router is not installed
+    # a config WITH routing installs its own router again
+    loop.run_until_complete(reload_tenant_config(inst, cfg))
+    assert not isinstance(inst.commands.router, NoOpCommandRouter)
+    assert list(inst.commands.destinations) == ["d1"]

@@ -708,3 +708,49 @@ def test_invocation_summary(api):
         "request": {"originatingEventId": str(inv_id), "response": "pong"}})
     s, body = call("GET", f"/api/invocations/{inv_id}/summary")
     assert s == 200 and len(body["responses"]) == 1
+
+
+def test_tenant_templates_endpoints(api):
+    """VERDICT r2 missing #5: Tenants.java /templates/configuration and
+    /templates/dataset."""
+    call, inst, loop = api
+    s, body = call("GET", "/api/tenants/templates/configuration")
+    assert s == 200 and {t["id"] for t in body} >= {"default", "mqtt"}
+    assert all("configuration" in t and "description" in t for t in body)
+    s, body = call("GET", "/api/tenants/templates/dataset")
+    assert s == 200
+    ids = {t["id"] for t in body}
+    assert ids >= {"empty", "construction"}
+    # a listed configuration template actually applies
+    from sitewhere_tpu.config import apply_tenant_config
+    s, cfg_tpls = call("GET", "/api/tenants/templates/configuration")
+    tpl = next(t for t in cfg_tpls if t["id"] == "default")
+    summary = apply_tenant_config(inst, tpl["configuration"])
+    assert summary["eventSources"] == ["default-in"]
+    # /api/tenants/{token} still resolves normal tokens
+    s, body = call("GET", "/api/tenants/default")
+    assert s == 200 and body["token"] == "default"
+
+
+def test_user_role_mutation(api):
+    """VERDICT r2 missing #5: Users.java @PUT/@DELETE /{username}/roles."""
+    call, inst, loop = api
+    call("POST", "/api/users", {"username": "roley", "password": "pw",
+                                "roles": ["user"]})
+    s, body = call("GET", "/api/users/roley/roles")
+    assert s == 200 and body["results"] == ["user"]
+    s, body = call("PUT", "/api/users/roley/roles", ["admin"])
+    assert s == 200 and set(body["roles"]) == {"user", "admin"}
+    # adding an existing role is idempotent
+    s, body = call("PUT", "/api/users/roley/roles", ["admin"])
+    assert s == 200 and body["roles"].count("admin") == 1
+    # unknown role rejected
+    s, body = call("PUT", "/api/users/roley/roles", ["ghost-role"])
+    assert s == 400
+    s, body = call("DELETE", "/api/users/roley/roles", ["user"])
+    assert s == 200 and body["roles"] == ["admin"]
+    # empty list is an error (reference: InvalidUserInformation)
+    s, body = call("PUT", "/api/users/roley/roles", [])
+    assert s == 400
+    s, body = call("GET", "/api/users/ghost/roles")
+    assert s == 404
