@@ -1,0 +1,192 @@
+"""Multi-worker host ingest: N decode processes feeding one engine.
+
+VERDICT r2 item 4 / SURVEY §2.9 "multiple host ingest workers feeding a
+fixed chip mesh". The decode runs in worker processes against worker-local
+interners; the engine translates dictionary ids with numpy gathers and the
+results must be indistinguishable from single-process ingest.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ingest.fast_decode import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native library unavailable")
+
+
+def mini_engine(**kw) -> Engine:
+    cfg = dict(device_capacity=256, token_capacity=512,
+               assignment_capacity=512, store_capacity=4096,
+               batch_capacity=64, channels=4)
+    cfg.update(kw)
+    return Engine(EngineConfig(**cfg))
+
+
+def meas(eng, token, name, value, ts_rel):
+    base = int(eng.epoch.base_unix_s * 1000)
+    return json.dumps({
+        "deviceToken": token, "type": "DeviceMeasurements",
+        "request": {"measurements": {name: value},
+                    "eventDate": base + ts_rel}}).encode()
+
+
+def alert(token, atype, level):
+    return json.dumps({
+        "deviceToken": token, "type": "DeviceAlert",
+        "request": {"type": atype, "level": level, "message": "x"}}).encode()
+
+
+def test_pool_matches_single_process_ingest():
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng_pool = mini_engine()
+    eng_ref = mini_engine()
+    eng_ref.epoch = eng_pool.epoch
+
+    batches = [
+        [meas(eng_pool, f"wk-{i % 8}", "temp", float(i), 1000 + i)
+         for i in range(b * 16, b * 16 + 16)]
+        for b in range(6)
+    ]
+    with DecodeWorkerPool(eng_pool, n_workers=2, max_msgs=64) as pool:
+        for b in batches:
+            pool.submit(b)
+        pool.flush()
+        assert pool.stats()["n_workers"] == 2
+        assert pool.stats()["fallback_batches"] == 0
+    eng_pool.flush()
+
+    for b in batches:
+        eng_ref.ingest_json_batch(b)
+    eng_ref.flush()
+
+    mp_, mr = eng_pool.metrics(), eng_ref.metrics()
+    for k in ("found", "missed", "registered", "persisted"):
+        assert mp_[k] == mr[k], (k, mp_, mr)
+    for tok in {f"wk-{i}" for i in range(8)}:
+        sp = eng_pool.get_device_state(tok)
+        sr = eng_ref.get_device_state(tok)
+        assert sp["measurements"]["temp"]["value"] == \
+            sr["measurements"]["temp"]["value"]
+        assert sp["event_counts"] == sr["event_counts"]
+
+
+def test_pool_translates_names_and_alert_types():
+    """Workers intern names/alert-types in a DIFFERENT order than the
+    engine; lane permutation + alert-id translation must reconcile."""
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng = mini_engine()
+    # engine already knows some names in its own order
+    eng.ingest_json_batch([meas(eng, "seed", "pressure", 1.0, 10),
+                           meas(eng, "seed", "temp", 2.0, 11)])
+    eng.flush()
+    with DecodeWorkerPool(eng, n_workers=1, max_msgs=64) as pool:
+        # worker sees temp FIRST (different local name order)
+        pool.submit([meas(eng, "wn-1", "temp", 21.5, 100),
+                     meas(eng, "wn-1", "pressure", 3.5, 101),
+                     alert("wn-1", "overheat", 2)])
+        pool.flush()
+    eng.flush()
+    st = eng.get_device_state("wn-1")
+    assert st["measurements"]["temp"]["value"] == 21.5
+    assert st["measurements"]["pressure"]["value"] == 3.5
+    res = eng.query_events(device_token="wn-1",
+                           etype=__import__("sitewhere_tpu.core.types",
+                                            fromlist=["EventType"]).EventType.ALERT)
+    assert res["total"] == 1
+    assert res["events"][0]["alertType"] == "overheat"
+
+
+def test_pool_registration_envelopes_flow_through():
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng = mini_engine()
+    reg = json.dumps({
+        "deviceToken": "wr-1", "type": "RegisterDevice",
+        "request": {"deviceTypeToken": "sensor",
+                    "metadata": {"k": "v"}}}).encode()
+    with DecodeWorkerPool(eng, n_workers=1, max_msgs=64) as pool:
+        pool.submit([reg, meas(eng, "wr-1", "temp", 5.0, 50)])
+        pool.flush()
+    eng.flush()
+    info = eng.get_device("wr-1")
+    assert info is not None and info.device_type == "sensor"
+    assert eng.get_device_state("wr-1")["measurements"]["temp"]["value"] == 5.0
+
+
+def test_pool_wal_durability(tmp_path):
+    """Batches ingested through the pool must be WAL-logged like the
+    single-process path (crash recovery replays them)."""
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+    from sitewhere_tpu.utils.checkpoint import recover_engine
+
+    eng = mini_engine(wal_dir=str(tmp_path / "wal"))
+    eng.save = None  # unused
+    with DecodeWorkerPool(eng, n_workers=1, max_msgs=64) as pool:
+        pool.submit([meas(eng, "wd-1", "temp", 9.0, 500)])
+        pool.flush()
+    eng.flush()
+    from sitewhere_tpu.utils.checkpoint import save_engine
+
+    save_dir = tmp_path / "snap"
+    # snapshot BEFORE more traffic; then one more pooled batch hits only WAL
+    save_engine(eng, save_dir)
+    with DecodeWorkerPool(eng, n_workers=1, max_msgs=64) as pool:
+        pool.submit([meas(eng, "wd-1", "temp", 11.0, 600)])
+        pool.flush()
+    eng.flush()
+    eng.wal.close()
+    rec = recover_engine(save_dir, tmp_path / "wal")
+    assert rec.get_device_state("wd-1")["measurements"]["temp"]["value"] == 11.0
+
+
+def test_pool_lane_scatter_is_exact_with_shifted_lanes():
+    """Review r3 repro: engine pre-interns names so a worker's first name
+    maps to a DIFFERENT engine lane; the scatter must not let unmapped
+    worker lanes clobber mapped engine lanes."""
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng = mini_engine()
+    # engine occupies lanes 0..2 through its own ingest path
+    eng.ingest_json_batch([
+        meas(eng, "seed", "n0", 1.0, 1), meas(eng, "seed", "n1", 1.0, 2),
+        meas(eng, "seed", "n2", 1.0, 3)])
+    eng.flush()
+    with DecodeWorkerPool(eng, n_workers=1, max_msgs=64) as pool:
+        # worker's first-ever name -> worker lane 0, engine lane 3
+        pool.submit([meas(eng, "ls-1", "fresh", 7.5, 100)])
+        pool.flush()
+        assert pool.stats()["fallback_batches"] == 0
+        assert pool.stats()["lane_conflicts"] == 0
+    eng.flush()
+    st = eng.get_device_state("ls-1")
+    assert st["measurements"]["fresh"]["value"] == 7.5
+
+
+def test_pool_falls_back_on_lane_conflict():
+    """With more names than channels the worker's lane permutation can
+    become ambiguous; the pool must detect it and fall back to exact
+    engine-side decode rather than silently mis-lane values."""
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng = mini_engine(channels=3)
+    with DecodeWorkerPool(eng, n_workers=1, max_msgs=64) as pool:
+        # engine interns "b" first, so worker name "c" (worker lane 2)
+        # maps to engine lane 0 which belongs to worker lane 1 ("b") —
+        # a non-injective lane map the pool must refuse to scatter through
+        eng.ingest_json_batch([meas(eng, "seed", "b", 1.0, 1)])
+        eng.flush()
+        for i, name in enumerate(["a", "b", "c", "d"]):
+            pool.submit([meas(eng, "lc-1", name, float(i), 10 + i)])
+        pool.flush()
+        stats = pool.stats()
+    eng.flush()
+    assert stats["lane_conflicts"] == 1
+    assert stats["fallback_batches"] >= 1
+    # the fallback path (engine-side decode) kept every event
+    assert eng.metrics()["persisted"] >= 5
