@@ -195,10 +195,20 @@ class DecodeWorkerPool:
 
         if not native_available():
             raise RuntimeError("native library unavailable")
+        if engine.config.strict_channels:
+            # the strict contract (reject + roll back a batch that would
+            # exceed channel capacity, engine._check_strict_native) cannot
+            # be enforced from worker-local interners — a colliding batch
+            # would be WAL-logged and staged before the engine could see
+            # the collision. Refuse loudly instead of silently degrading.
+            raise ValueError(
+                "DecodeWorkerPool does not support strict_channels engines;"
+                " use the in-process ingest path")
         self.engine = engine
         self.channels = engine.config.channels
         self.n_workers = n_workers or max(1, (os.cpu_count() or 1) - 1)
         self.max_msgs = max_msgs or max(16384, engine.config.batch_capacity)
+        self.max_bytes = max_bytes
         ctx = mp.get_context("spawn")   # workers must not inherit jax state
         self.workers = [
             _Worker(i, self.max_msgs, max_bytes, self.channels,
@@ -309,6 +319,12 @@ class DecodeWorkerPool:
         if n > self.max_msgs:
             raise ValueError(f"batch of {n} exceeds max_msgs {self.max_msgs}")
         lens = np.fromiter((len(p) for p in payloads), np.int64, n)
+        total = int(lens.sum())
+        if total > self.max_bytes:
+            raise ValueError(
+                f"batch of {total} payload bytes exceeds the pool's "
+                f"max_bytes {self.max_bytes}; raise max_bytes or split "
+                "the batch")
         self.offsets_fill(w, lens)
         buf = b"".join(payloads)
         w.shm_in.buf[w.data_off:w.data_off + len(buf)] = buf

@@ -105,20 +105,46 @@ class FeedConsumer:
                 self.engine.drain()
             store = self.engine.state.store
         acap = store.arena_capacity
+        archive = getattr(self.engine, "archive", None)
         out: list[OutboundEvent] = []
         for a in range(self.arenas):
             head = arena_cursor(store, a)
             if head <= self.offsets[a]:
                 continue
-            # ring overwrite: oldest retained position is head - arena cap
+            # ring overwrite: oldest retained position is head - arena cap.
+            # A lagging consumer REPLAYS evicted rows from the archive tier
+            # (Kafka-consumer at-least-once: falling behind means reading
+            # older log segments, not losing events). Like the ring read,
+            # replay does NOT advance the committed offset — redelivery
+            # until commit(); only unrecoverable gaps (rows absent from the
+            # archive too) advance the offset and count as lag_lost.
             oldest = max(0, head - acap)
-            if self.offsets[a] < oldest:
+            budget = self.max_batch
+            if archive is None and self.offsets[a] < oldest:
                 self.lag_lost += oldest - self.offsets[a]
                 self.offsets[a] = oldest
-            count = min(head - self.offsets[a], self.max_batch)
-            sl = read_range(store, np.int32(self.offsets[a] % acap), count,
-                            arena=a)
-            out.extend(self._enrich(sl, self.offsets[a], count, a))
+            pos = self.offsets[a]
+            while archive is not None and pos < oldest and budget > 0:
+                sl, n = archive.read_rows(a, pos, min(oldest - pos, budget))
+                if n == 0:
+                    # recorded-loss gap: skip ONLY to the next archived
+                    # segment (or the ring) — rows beyond the gap replay
+                    nxt = archive.next_start(a, pos)
+                    nxt = oldest if nxt is None else min(nxt, oldest)
+                    self.lag_lost += nxt - pos
+                    self.offsets[a] = max(self.offsets[a], nxt)
+                    pos = nxt
+                    continue
+                out.extend(self._enrich(sl, pos, n, a))
+                pos += n
+                budget -= n
+            if pos < oldest:
+                continue   # batch full mid-replay; resumes next poll
+            count = min(head - pos, budget)
+            if count <= 0:
+                continue
+            sl = read_range(store, np.int32(pos % acap), count, arena=a)
+            out.extend(self._enrich(sl, pos, count, a))
         return out
 
     def commit(self, events: list[OutboundEvent]) -> None:

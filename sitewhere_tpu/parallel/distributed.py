@@ -1517,6 +1517,52 @@ class DistributedFeedConsumer:
         cu = np.asarray(jax.device_get(store.cursor)).astype(np.int64)
         return ep * acap + cu
 
+    def _events_from_slice(self, sl, base: int, count: int, s: int, a: int,
+                           lane_names: dict[int, str]) -> list:
+        """Host-enrich one contiguous column slice (ring readback or
+        archived segment — both carry the ring column layout)."""
+        from sitewhere_tpu.outbound.feed import OutboundEvent
+
+        eng = self.engine
+        out = []
+        for i in range(count):
+            if not bool(sl.valid[i]):
+                continue
+            gdid = eng._gdid(s, int(sl.device[i]))
+            info = eng.devices.get(gdid)
+            et = EventType(int(sl.etype[i]))
+            meas = {}
+            lat = lon = None
+            if et is EventType.MEASUREMENT:
+                for ch in np.nonzero(np.asarray(sl.vmask[i]))[0]:
+                    meas[lane_names.get(int(ch), f"ch{ch}")] = float(
+                        sl.values[i, ch])
+            elif et is EventType.LOCATION and bool(sl.vmask[i, 0]):
+                lat = float(sl.values[i, 0])
+                lon = float(sl.values[i, 1])
+            out.append(OutboundEvent(
+                latitude=lat,
+                longitude=lon,
+                event_id=encode_event_id(
+                    base + i, s, a, self.n_shards, self.arenas),
+                etype=et,
+                device_token=info.token if info else f"#{gdid}",
+                device_id=gdid,
+                assignment_id=eng._gdid(s, int(sl.assignment[i])),
+                tenant=(eng.tenants.token(int(sl.tenant[i]))
+                        if int(sl.tenant[i]) != NULL_ID else "default"),
+                area_id=int(sl.area[i]),
+                customer_id=int(sl.customer[i]),
+                asset_id=int(sl.asset[i]),
+                ts_ms=int(sl.ts_ms[i]),
+                received_ms=int(sl.received_ms[i]),
+                measurements=meas,
+                values=[float(v) for v in sl.values[i]],
+                aux0=int(sl.aux[i, 0]),
+                aux1=int(sl.aux[i, 1]),
+            ))
+        return out
+
     def poll(self) -> list:
         from sitewhere_tpu.ops.readback import read_range
         from sitewhere_tpu.outbound.feed import OutboundEvent
@@ -1529,60 +1575,56 @@ class DistributedFeedConsumer:
         heads = self._heads(store)
         out: list[OutboundEvent] = []
         eng = self.engine
+        archive = getattr(eng, "archive", None)
         lane_names: dict[int, str] = {}
         for name, nid in eng.channel_map.names.items():
             lane_names.setdefault(nid % eng.config.channels, name)
         for s in range(self.n_shards):
-            shard_store = jax.tree_util.tree_map(lambda x: x[s], store)
+            shard_store = None
             for a in range(self.arenas):
                 head = int(heads[s, a])
                 if head <= self.offsets[s, a]:
                     continue
+                # a lagging consumer REPLAYS evicted rows from its archive
+                # partition (Kafka-consumer at-least-once: falling behind
+                # means reading older log segments, not losing events).
+                # Replay does NOT advance committed offsets — redelivery
+                # until commit(); only unrecoverable gaps advance + count
+                # as lag_lost, and replay resumes at the next segment
                 oldest = max(0, head - acap)
-                if self.offsets[s, a] < oldest:
+                budget = self.max_batch
+                part = s * self.arenas + a
+                if archive is None and self.offsets[s, a] < oldest:
                     self.lag_lost += oldest - int(self.offsets[s, a])
                     self.offsets[s, a] = oldest
-                count = min(head - int(self.offsets[s, a]), self.max_batch)
-                sl = jax.device_get(read_range(
-                    shard_store, jnp.int32(self.offsets[s, a] % acap),
-                    count, arena=a))
-                base = int(self.offsets[s, a])
-                for i in range(count):
-                    if not bool(sl.valid[i]):
+                pos = int(self.offsets[s, a])
+                while archive is not None and pos < oldest and budget > 0:
+                    sl, n = archive.read_rows(
+                        part, pos, min(oldest - pos, budget))
+                    if n == 0:
+                        nxt = archive.next_start(part, pos)
+                        nxt = oldest if nxt is None else min(nxt, oldest)
+                        self.lag_lost += nxt - pos
+                        self.offsets[s, a] = max(int(self.offsets[s, a]),
+                                                 nxt)
+                        pos = nxt
                         continue
-                    gdid = eng._gdid(s, int(sl.device[i]))
-                    info = eng.devices.get(gdid)
-                    et = EventType(int(sl.etype[i]))
-                    meas = {}
-                    lat = lon = None
-                    if et is EventType.MEASUREMENT:
-                        for ch in np.nonzero(np.asarray(sl.vmask[i]))[0]:
-                            meas[lane_names.get(int(ch), f"ch{ch}")] = float(
-                                sl.values[i, ch])
-                    elif et is EventType.LOCATION and bool(sl.vmask[i, 0]):
-                        lat = float(sl.values[i, 0])
-                        lon = float(sl.values[i, 1])
-                    out.append(OutboundEvent(
-                        latitude=lat,
-                        longitude=lon,
-                        event_id=encode_event_id(
-                            base + i, s, a, self.n_shards, self.arenas),
-                        etype=et,
-                        device_token=info.token if info else f"#{gdid}",
-                        device_id=gdid,
-                        assignment_id=eng._gdid(s, int(sl.assignment[i])),
-                        tenant=(eng.tenants.token(int(sl.tenant[i]))
-                                if int(sl.tenant[i]) != NULL_ID else "default"),
-                        area_id=int(sl.area[i]),
-                        customer_id=int(sl.customer[i]),
-                        asset_id=int(sl.asset[i]),
-                        ts_ms=int(sl.ts_ms[i]),
-                        received_ms=int(sl.received_ms[i]),
-                        measurements=meas,
-                        values=[float(v) for v in sl.values[i]],
-                        aux0=int(sl.aux[i, 0]),
-                        aux1=int(sl.aux[i, 1]),
-                    ))
+                    out.extend(self._events_from_slice(
+                        sl, pos, n, s, a, lane_names))
+                    pos += n
+                    budget -= n
+                if pos < oldest:
+                    continue   # batch full mid-replay; resumes next poll
+                count = min(head - pos, budget)
+                if count <= 0:
+                    continue
+                if shard_store is None:
+                    shard_store = jax.tree_util.tree_map(
+                        lambda x, _s=s: x[_s], store)
+                sl = jax.device_get(read_range(
+                    shard_store, jnp.int32(pos % acap), count, arena=a))
+                out.extend(self._events_from_slice(
+                    sl, pos, count, s, a, lane_names))
         return out
 
     def commit(self, events: list) -> None:

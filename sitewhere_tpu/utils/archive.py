@@ -62,6 +62,11 @@ class EventArchive:
         self.segment_rows = int(segment_rows)
         self.segments: list[_Segment] = []
         self.lost_rows = 0   # rows overwritten before they could spill
+        # per-partition segments sorted by start (bisect lookups) + a
+        # one-segment row cache: replay reads a segment in max_batch
+        # chunks and must not re-extract the npz per chunk
+        self._by_part: dict[int, list[_Segment]] = {}
+        self._row_cache: tuple[str, dict] | None = None
         self._load_index()
 
     # ------------------------------------------------------------- index
@@ -93,6 +98,14 @@ class EventArchive:
                     ts_max=int(ts.max()) if ts.size else 0,
                     path=f.name))
         self.segments.sort(key=lambda s: (s.part, s.start))
+        self._reindex()
+
+    def _reindex(self) -> None:
+        self._by_part = {}
+        for s in self.segments:
+            self._by_part.setdefault(s.part, []).append(s)
+        for segs in self._by_part.values():
+            segs.sort(key=lambda s: s.start)
 
     def _save_index(self) -> None:
         tmp = self._manifest_path().with_suffix(".tmp")
@@ -130,6 +143,7 @@ class EventArchive:
             ts_min=int(ts.min()) if ts.size else 0,
             ts_max=int(ts.max()) if ts.size else 0, path=name))
         self.segments.sort(key=lambda s: (s.part, s.start))
+        self._reindex()
         self._save_index()
 
     def note_lost(self, count: int) -> None:
@@ -143,14 +157,62 @@ class EventArchive:
         by-id lookup for events evicted from the ring. Returns the ring
         column layout as a dict, or None if the position was never
         spilled."""
-        for seg in self.segments:
-            if seg.part == part and seg.start <= pos < seg.start + seg.count:
-                i = pos - seg.start
-                with np.load(self.dir / seg.path) as z:
-                    if not bool(z["valid"][i]):
-                        return None
-                    return {c: np.asarray(z[c])[i] for c in _COLUMNS}
+        seg = self._segment_for(part, pos)
+        if seg is None:
+            return None
+        cols = self._segment_cols(seg)
+        i = pos - seg.start
+        if not bool(cols["valid"][i]):
+            return None
+        return {c: cols[c][i] for c in _COLUMNS}
+
+    def _segment_for(self, part: int, pos: int) -> "_Segment | None":
+        import bisect
+
+        segs = self._by_part.get(part)
+        if not segs:
+            return None
+        i = bisect.bisect_right(segs, pos, key=lambda s: s.start) - 1
+        if i >= 0 and segs[i].start <= pos < segs[i].start + segs[i].count:
+            return segs[i]
         return None
+
+    def next_start(self, part: int, pos: int) -> int | None:
+        """First archived position strictly after ``pos`` that is on disk
+        — where replay resumes after a recorded-loss gap."""
+        import bisect
+
+        segs = self._by_part.get(part)
+        if not segs:
+            return None
+        i = bisect.bisect_right(segs, pos, key=lambda s: s.start)
+        return segs[i].start if i < len(segs) else None
+
+    def _segment_cols(self, seg: "_Segment") -> dict:
+        if self._row_cache is not None and self._row_cache[0] == seg.path:
+            return self._row_cache[1]
+        with np.load(self.dir / seg.path) as z:
+            cols = {c: np.asarray(z[c]) for c in _COLUMNS}
+        self._row_cache = (seg.path, cols)
+        return cols
+
+    def read_rows(self, part: int, start: int, count: int):
+        """Contiguous archived rows [start, start+n) of a partition as a
+        StoreSlice-compatible column namespace (n <= count; one segment per
+        call — callers loop). Returns (cols, n); n == 0 means the range is
+        not on disk (never spilled, or a recorded-loss gap — see
+        :meth:`next_start`). Bisect lookup + one-segment cache, so chunked
+        replay never rescans the index or re-extracts a segment file."""
+        import types
+
+        seg = self._segment_for(part, start)
+        if seg is None:
+            return None, 0
+        i = start - seg.start
+        n = min(count, seg.count - i)
+        cols = self._segment_cols(seg)
+        return types.SimpleNamespace(
+            **{c: cols[c][i:i + n] for c in _COLUMNS}), n
 
     def query(self, *, max_pos: dict[int, int] | None = None,
               device: int | None = None, etype: int | None = None,

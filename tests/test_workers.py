@@ -190,3 +190,21 @@ def test_pool_falls_back_on_lane_conflict():
     assert stats["fallback_batches"] >= 1
     # the fallback path (engine-side decode) kept every event
     assert eng.metrics()["persisted"] >= 5
+
+
+def test_pool_rejects_strict_channel_engines():
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng = mini_engine(strict_channels=True)
+    with pytest.raises(ValueError, match="strict_channels"):
+        DecodeWorkerPool(eng, n_workers=1)
+
+
+def test_pool_rejects_oversized_batches():
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng = mini_engine()
+    with DecodeWorkerPool(eng, n_workers=1, max_msgs=64,
+                          max_bytes=256) as pool:
+        with pytest.raises(ValueError, match="max_bytes"):
+            pool.submit([meas(eng, "big-1", "temp", 1.0, 1)] * 8)
