@@ -428,6 +428,10 @@ class EngineConfig:
                                        # ring + archive (utils/archive.py)
     archive_segment_rows: int = 4096   # rows per spilled segment (clamped
                                        # to arena_capacity // 4)
+    archive_max_rows: int | None = None  # retention policy per arena: None
+                                         # = unbounded history; else oldest
+                                         # whole segments expire past this
+                                         # (INFLUX_RETENTION_POLICY analog)
     scan_chunk: int = 1                # >1: dispatch K emitted batches as
                                        # ONE lax.scan program (amortizes
                                        # dispatch/transfer per chunk; adds
@@ -728,7 +732,8 @@ class Engine(IngestHostMixin):
             acap = c.store_capacity // c.tenant_arenas
             self.archive = EventArchive(
                 c.archive_dir,
-                segment_rows=max(1, min(c.archive_segment_rows, acap // 4)))
+                segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
+                max_rows_per_part=c.archive_max_rows)
             # spool whenever any arena could be halfway to overwrite; with
             # the worst case of every staged row landing in one arena this
             # keeps backlog + one batch < arena capacity

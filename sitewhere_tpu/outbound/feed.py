@@ -125,16 +125,25 @@ class FeedConsumer:
                 self.offsets[a] = oldest
             pos = self.offsets[a]
             while archive is not None and pos < oldest and budget > 0:
-                sl, n = archive.read_rows(a, pos, min(oldest - pos, budget))
-                if n == 0:
-                    # recorded-loss gap: skip ONLY to the next archived
-                    # segment (or the ring) — rows beyond the gap replay
-                    nxt = archive.next_start(a, pos)
-                    nxt = oldest if nxt is None else min(nxt, oldest)
-                    self.lag_lost += nxt - pos
-                    self.offsets[a] = max(self.offsets[a], nxt)
-                    pos = nxt
-                    continue
+                # archive reads under the engine lock: _spool/_expire
+                # mutate the segment index and unlink files under it
+                with self.engine.lock:
+                    sl, n = archive.read_rows(a, pos,
+                                              min(oldest - pos, budget))
+                    if n == 0:
+                        # recorded-loss/expired gap: skip ONLY to the next
+                        # archived segment (or the ring) — and only when
+                        # nothing replayed-but-uncommitted precedes the
+                        # gap, else the offset advance would drop those
+                        # events on a pre-commit crash
+                        if pos != self.offsets[a]:
+                            break   # deliver pre-gap events first
+                        nxt = archive.next_start(a, pos)
+                        nxt = oldest if nxt is None else min(nxt, oldest)
+                        self.lag_lost += nxt - pos
+                        self.offsets[a] = nxt
+                        pos = nxt
+                        continue
                 out.extend(self._enrich(sl, pos, n, a))
                 pos += n
                 budget -= n

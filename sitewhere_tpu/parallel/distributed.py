@@ -84,6 +84,7 @@ class DistributedConfig:
                                        # (shard, arena) sub-ring to disk
                                        # before overwrite (utils/archive.py)
     archive_segment_rows: int = 4096
+    archive_max_rows: int | None = None  # per-(shard,arena) retention cap
 
 
 class _StackedBuffer:
@@ -378,7 +379,8 @@ class DistributedEngine(IngestHostMixin):
             acap = c.store_capacity_per_shard // arenas
             self.archive = EventArchive(
                 c.archive_dir,
-                segment_rows=max(1, min(c.archive_segment_rows, acap // 4)))
+                segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
+                max_rows_per_part=c.archive_max_rows)
             self._spool_trigger = max(self.archive.segment_rows,
                                       acap // 2 - c.batch_capacity_per_shard)
 
@@ -1599,16 +1601,24 @@ class DistributedFeedConsumer:
                     self.offsets[s, a] = oldest
                 pos = int(self.offsets[s, a])
                 while archive is not None and pos < oldest and budget > 0:
-                    sl, n = archive.read_rows(
-                        part, pos, min(oldest - pos, budget))
-                    if n == 0:
-                        nxt = archive.next_start(part, pos)
-                        nxt = oldest if nxt is None else min(nxt, oldest)
-                        self.lag_lost += nxt - pos
-                        self.offsets[s, a] = max(int(self.offsets[s, a]),
-                                                 nxt)
-                        pos = nxt
-                        continue
+                    # archive reads under the engine lock: _spool/_expire
+                    # mutate the segment index and unlink files under it
+                    with eng.lock:
+                        sl, n = archive.read_rows(
+                            part, pos, min(oldest - pos, budget))
+                        if n == 0:
+                            # gap skip only when nothing replayed-but-
+                            # uncommitted precedes it (else a pre-commit
+                            # crash would drop those events)
+                            if pos != int(self.offsets[s, a]):
+                                break   # deliver pre-gap events first
+                            nxt = archive.next_start(part, pos)
+                            nxt = (oldest if nxt is None
+                                   else min(nxt, oldest))
+                            self.lag_lost += nxt - pos
+                            self.offsets[s, a] = nxt
+                            pos = nxt
+                            continue
                     out.extend(self._events_from_slice(
                         sl, pos, n, s, a, lane_names))
                     pos += n

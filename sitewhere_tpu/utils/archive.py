@@ -56,10 +56,20 @@ class EventArchive:
     (arenas for a single-chip engine, n_shards*arenas for the mesh); each
     keeps its own spill watermark."""
 
-    def __init__(self, directory: str | pathlib.Path, segment_rows: int = 4096):
+    def __init__(self, directory: str | pathlib.Path, segment_rows: int = 4096,
+                 max_rows_per_part: int | None = None):
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.segment_rows = int(segment_rows)
+        # retention policy (reference: per-assignment
+        # INFLUX_RETENTION_POLICY override, InfluxDbDeviceEventManagement):
+        # None = unbounded history; otherwise each partition keeps at most
+        # this many archived rows and the OLDEST whole segments expire.
+        # The newest archived rows duplicate the ring window (spill is
+        # eager), so the queryable history beyond the ring is roughly
+        # max_rows_per_part - arena_capacity: size the cap ABOVE the ring
+        self.max_rows_per_part = max_rows_per_part
+        self.expired_rows = 0
         self.segments: list[_Segment] = []
         self.lost_rows = 0   # rows overwritten before they could spill
         # per-partition segments sorted by start (bisect lookups) + a
@@ -144,7 +154,30 @@ class EventArchive:
             ts_max=int(ts.max()) if ts.size else 0, path=name))
         self.segments.sort(key=lambda s: (s.part, s.start))
         self._reindex()
+        self._expire(part)
         self._save_index()
+
+    def _expire(self, part: int) -> None:
+        """Apply the retention policy: drop this partition's OLDEST whole
+        segments while it exceeds ``max_rows_per_part``. Expired rows are
+        deliberate policy (counted separately from ``lost_rows``)."""
+        if self.max_rows_per_part is None:
+            return
+        segs = self._by_part.get(part, [])
+        total = sum(s.count for s in segs)
+        changed = False
+        while segs and total > self.max_rows_per_part:
+            victim = segs.pop(0)
+            total -= victim.count
+            self.expired_rows += victim.count
+            self.segments.remove(victim)
+            (self.dir / victim.path).unlink(missing_ok=True)
+            if self._row_cache is not None \
+                    and self._row_cache[0] == victim.path:
+                self._row_cache = None
+            changed = True
+        if changed:
+            self._reindex()
 
     def note_lost(self, count: int) -> None:
         """Record rows that wrapped before spooling (mis-sized trigger —

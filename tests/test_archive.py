@@ -394,3 +394,60 @@ def test_feed_replay_resumes_after_recorded_gap(tmp_path):
     ts = [e.ts_ms for e in seen]
     assert ts == sorted(ts)
     assert 1000 + 40 not in ts and 1000 + 100 in ts
+
+
+def test_archive_retention_policy_expires_oldest(tmp_path):
+    """Bounded retention (reference: INFLUX_RETENTION_POLICY override) —
+    the oldest whole segments expire; recent history stays queryable."""
+    # cap = ring (64) + 64 rows of history beyond it
+    eng = small_engine(tmp_path, archive_max_rows=128)
+    for i in range(4 * 64):
+        eng.ingest_json_batch([meas(eng, "rp-1", float(i), 1000 + i)])
+    eng.flush()
+    arch = eng.archive
+    # per-partition archived rows bounded; expiries counted as policy
+    assert sum(s.count for s in arch.segments) <= 128 + arch.segment_rows
+    assert arch.expired_rows > 0
+    assert arch.lost_rows == 0
+    # evicted-but-retained rows still resolve; expired ones are gone
+    res = eng.query_events(since_ms=1000 + 128, until_ms=1000 + 191,
+                           limit=64)
+    assert res["total"] == 64
+    res = eng.query_events(since_ms=1000, until_ms=1063, limit=64)
+    assert res["total"] == 0
+    # only the policy-retained segment files remain on disk
+    n_files = len(list((tmp_path / "arch").glob("seg-*.npz")))
+    assert n_files == len(arch.segments)
+
+
+def test_gap_skip_never_commits_past_uncommitted_replay(tmp_path):
+    """Review r3: hitting a gap mid-poll must NOT advance the offset past
+    events replayed earlier in the same poll but not yet committed."""
+    eng = small_engine(tmp_path)
+    for i in range(256):
+        eng.ingest_json_batch([meas(eng, "gc-1", float(i), 1000 + i)])
+    eng.flush()
+    for seg in list(eng.archive.segments):
+        if 32 <= seg.start < 64:
+            (tmp_path / "arch" / seg.path).unlink()
+            eng.archive.segments.remove(seg)
+    eng.archive._reindex()
+    eng.archive._row_cache = None
+    feed = eng.make_feed_consumer("crashy", max_batch=512)
+    first = feed.poll()               # replays [0,32) then stops at gap
+    assert len(first) == 32
+    # handler crash: no commit -> exact redelivery, offset untouched
+    again = feed.poll()
+    assert [e.event_id for e in again] == [e.event_id for e in first]
+    assert feed.offsets[0] == 0
+    feed.commit(again)
+    # now the gap is at the committed offset: it may be skipped
+    rest = []
+    while True:
+        evs = feed.poll()
+        if not evs:
+            break
+        rest.extend(evs)
+        feed.commit(evs)
+    assert feed.lag_lost == 32
+    assert len(first) + len(rest) == 256 - 32
