@@ -94,16 +94,25 @@ class FeedConsumer:
     def poll(self) -> list[OutboundEvent]:
         """Fetch newly persisted events past the committed offsets (does not
         commit — call ``commit(events)`` after successful processing)."""
-        from sitewhere_tpu.ops.readback import arena_cursor
-
-        # async flushes may have advanced the store past the host mirrors;
-        # drain under the engine lock so no flush_async can slip between the
-        # mirror sync and the store-head read (else _enrich would see events
-        # from devices the mirror doesn't know yet)
+        # the WHOLE poll holds the engine lock: pipeline state is DONATED
+        # through every step, so a store reference captured outside the
+        # lock dies ("Array has been deleted") the moment a concurrent
+        # flush dispatches — and a ring that wrapped between the head read
+        # and the range read would serve new rows under old positions.
+        # Polls are control-plane (connector pumping); ingest holds the
+        # lock only per dispatch, so the serialization is acceptable.
         with self.engine.lock:
             if self.engine._pending_outs:
                 self.engine.drain()
-            store = self.engine.state.store
+            return self._poll_locked()
+
+    def _poll_locked(self) -> list[OutboundEvent]:
+        """Poll body; caller MUST hold the engine lock (protects the
+        donated store AND the archive index, which _spool/_expire mutate
+        and whose segment files they unlink)."""
+        from sitewhere_tpu.ops.readback import arena_cursor
+
+        store = self.engine.state.store
         acap = store.arena_capacity
         archive = getattr(self.engine, "archive", None)
         out: list[OutboundEvent] = []
@@ -125,25 +134,22 @@ class FeedConsumer:
                 self.offsets[a] = oldest
             pos = self.offsets[a]
             while archive is not None and pos < oldest and budget > 0:
-                # archive reads under the engine lock: _spool/_expire
-                # mutate the segment index and unlink files under it
-                with self.engine.lock:
-                    sl, n = archive.read_rows(a, pos,
-                                              min(oldest - pos, budget))
-                    if n == 0:
-                        # recorded-loss/expired gap: skip ONLY to the next
-                        # archived segment (or the ring) — and only when
-                        # nothing replayed-but-uncommitted precedes the
-                        # gap, else the offset advance would drop those
-                        # events on a pre-commit crash
-                        if pos != self.offsets[a]:
-                            break   # deliver pre-gap events first
-                        nxt = archive.next_start(a, pos)
-                        nxt = oldest if nxt is None else min(nxt, oldest)
-                        self.lag_lost += nxt - pos
-                        self.offsets[a] = nxt
-                        pos = nxt
-                        continue
+                sl, n = archive.read_rows(a, pos,
+                                          min(oldest - pos, budget))
+                if n == 0:
+                    # recorded-loss/expired gap: skip ONLY to the next
+                    # archived segment (or the ring) — and only when
+                    # nothing replayed-but-uncommitted precedes the gap,
+                    # else the offset advance would drop those events on
+                    # a pre-commit crash
+                    if pos != self.offsets[a]:
+                        break   # deliver pre-gap events first
+                    nxt = archive.next_start(a, pos)
+                    nxt = oldest if nxt is None else min(nxt, oldest)
+                    self.lag_lost += nxt - pos
+                    self.offsets[a] = nxt
+                    pos = nxt
+                    continue
                 out.extend(self._enrich(sl, pos, n, a))
                 pos += n
                 budget -= n

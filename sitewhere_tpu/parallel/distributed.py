@@ -1566,13 +1566,22 @@ class DistributedFeedConsumer:
         return out
 
     def poll(self) -> list:
-        from sitewhere_tpu.ops.readback import read_range
-        from sitewhere_tpu.outbound.feed import OutboundEvent
-
+        # whole-poll engine lock: stacked state is donated through every
+        # step, so store references captured outside the lock die under a
+        # concurrent flush, and a wrapped ring would serve new rows under
+        # old positions (see outbound/feed.py:poll)
         with self.engine.lock:
             if self.engine._pending_outs:
                 self.engine.drain()
-            store = self.engine.state.store
+            return self._poll_locked()
+
+    def _poll_locked(self) -> list:
+        """Poll body; caller MUST hold the engine lock (protects the
+        donated stacked store AND the archive index)."""
+        from sitewhere_tpu.ops.readback import read_range
+        from sitewhere_tpu.outbound.feed import OutboundEvent
+
+        store = self.engine.state.store
         acap = self.engine.config.store_capacity_per_shard // self.arenas
         heads = self._heads(store)
         out: list[OutboundEvent] = []
@@ -1601,24 +1610,20 @@ class DistributedFeedConsumer:
                     self.offsets[s, a] = oldest
                 pos = int(self.offsets[s, a])
                 while archive is not None and pos < oldest and budget > 0:
-                    # archive reads under the engine lock: _spool/_expire
-                    # mutate the segment index and unlink files under it
-                    with eng.lock:
-                        sl, n = archive.read_rows(
-                            part, pos, min(oldest - pos, budget))
-                        if n == 0:
-                            # gap skip only when nothing replayed-but-
-                            # uncommitted precedes it (else a pre-commit
-                            # crash would drop those events)
-                            if pos != int(self.offsets[s, a]):
-                                break   # deliver pre-gap events first
-                            nxt = archive.next_start(part, pos)
-                            nxt = (oldest if nxt is None
-                                   else min(nxt, oldest))
-                            self.lag_lost += nxt - pos
-                            self.offsets[s, a] = nxt
-                            pos = nxt
-                            continue
+                    sl, n = archive.read_rows(
+                        part, pos, min(oldest - pos, budget))
+                    if n == 0:
+                        # gap skip only when nothing replayed-but-
+                        # uncommitted precedes it (else a pre-commit
+                        # crash would drop those events)
+                        if pos != int(self.offsets[s, a]):
+                            break   # deliver pre-gap events first
+                        nxt = archive.next_start(part, pos)
+                        nxt = oldest if nxt is None else min(nxt, oldest)
+                        self.lag_lost += nxt - pos
+                        self.offsets[s, a] = nxt
+                        pos = nxt
+                        continue
                     out.extend(self._events_from_slice(
                         sl, pos, n, s, a, lane_names))
                     pos += n
