@@ -733,7 +733,8 @@ class Engine(IngestHostMixin):
             self.archive = EventArchive(
                 c.archive_dir,
                 segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
-                max_rows_per_part=c.archive_max_rows)
+                max_rows_per_part=c.archive_max_rows,
+                topology=f"single/{c.tenant_arenas}")
             # spool whenever any arena could be halfway to overwrite; with
             # the worst case of every staged row landing in one arena this
             # keeps backlog + one batch < arena capacity

@@ -115,6 +115,7 @@ class FeedConsumer:
         store = self.engine.state.store
         acap = store.arena_capacity
         archive = getattr(self.engine, "archive", None)
+        lane_names = self._lane_names()   # once per poll, not per chunk
         out: list[OutboundEvent] = []
         for a in range(self.arenas):
             head = arena_cursor(store, a)
@@ -150,7 +151,7 @@ class FeedConsumer:
                     self.offsets[a] = nxt
                     pos = nxt
                     continue
-                out.extend(self._enrich(sl, pos, n, a))
+                out.extend(self._enrich(sl, pos, n, a, lane_names))
                 pos += n
                 budget -= n
             if pos < oldest:
@@ -159,7 +160,7 @@ class FeedConsumer:
             if count <= 0:
                 continue
             sl = read_range(store, np.int32(pos % acap), count, arena=a)
-            out.extend(self._enrich(sl, pos, count, a))
+            out.extend(self._enrich(sl, pos, count, a, lane_names))
         return out
 
     def commit(self, events: list[OutboundEvent]) -> None:
@@ -168,9 +169,20 @@ class FeedConsumer:
             pos = ev.event_id // self.arenas
             self.offsets[a] = max(self.offsets[a], pos + 1)
 
-    def _enrich(self, sl, base: int, count: int,
-                arena: int = 0) -> list[OutboundEvent]:
+    def _lane_names(self) -> dict[int, str]:
+        """channel -> representative name (first interned name per lane)."""
         eng = self.engine
+        lane_names: dict[int, str] = {}
+        for name, nid in eng.channel_map.names.items():
+            lane_names.setdefault(nid % eng.config.channels, name)
+        return lane_names
+
+    def _enrich(self, sl, base: int, count: int, arena: int = 0,
+                lane_names: dict[int, str] | None = None
+                ) -> list[OutboundEvent]:
+        eng = self.engine
+        if lane_names is None:
+            lane_names = self._lane_names()
         etype = np.asarray(sl.etype[:count])
         device = np.asarray(sl.device[:count])
         assignment = np.asarray(sl.assignment[:count])
@@ -184,11 +196,6 @@ class FeedConsumer:
         vmask = np.asarray(sl.vmask[:count])
         aux = np.asarray(sl.aux[:count])
         valid = np.asarray(sl.valid[:count])
-
-        # channel -> representative name map (first interned name per lane)
-        lane_names: dict[int, str] = {}
-        for name, nid in eng.channel_map.names.items():
-            lane_names.setdefault(nid % eng.config.channels, name)
 
         out = []
         for i in range(count):

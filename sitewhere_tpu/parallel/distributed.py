@@ -380,7 +380,8 @@ class DistributedEngine(IngestHostMixin):
             self.archive = EventArchive(
                 c.archive_dir,
                 segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
-                max_rows_per_part=c.archive_max_rows)
+                max_rows_per_part=c.archive_max_rows,
+                topology=f"mesh/{self.n_shards}x{arenas}")
             self._spool_trigger = max(self.archive.segment_rows,
                                       acap // 2 - c.batch_capacity_per_shard)
 

@@ -52,15 +52,25 @@ class _Segment:
 class EventArchive:
     """Directory of spilled ring segments + a queryable index.
 
-    ``parts`` is the number of independent sub-rings feeding this archive
-    (arenas for a single-chip engine, n_shards*arenas for the mesh); each
-    keeps its own spill watermark."""
+    A partition is one independent sub-ring feeding this archive (an
+    arena for a single-chip engine; (shard, arena) flattened for the
+    mesh); each keeps its own spill watermark. ``topology`` labels the
+    exact engine shape writing the archive (see __init__)."""
 
     def __init__(self, directory: str | pathlib.Path, segment_rows: int = 4096,
-                 max_rows_per_part: int | None = None):
+                 max_rows_per_part: int | None = None,
+                 topology: str | None = None):
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.segment_rows = int(segment_rows)
+        # partition-topology stamp: segment `part` indices are only
+        # meaningful for the exact engine layout that wrote them — after an
+        # elastic reshard (or a single<->mesh migration with equal
+        # partition COUNTS) the same integers would resolve to the WRONG
+        # shard/arena and shard-local device ids shift, so the stamp is a
+        # full shape label (e.g. "mesh/8x1"), and any mismatch retires the
+        # old data instead of misreading it
+        self.topology = topology
         # retention policy (reference: per-assignment
         # INFLUX_RETENTION_POLICY override, InfluxDbDeviceEventManagement):
         # None = unbounded history; otherwise each partition keeps at most
@@ -91,22 +101,39 @@ class EventArchive:
         manifest = self._manifest_path()
         known: dict[str, _Segment] = {}
         if manifest.exists():
-            for e in json.loads(manifest.read_text()).get("segments", []):
-                known[e["path"]] = _Segment(**e)
+            m = json.loads(manifest.read_text())
+            stamped = m.get("topology", m.get("parts"))
+            if (self.topology is not None and stamped is not None
+                    and str(stamped) != self.topology):
+                self._retire(str(stamped))
+            else:
+                for e in m.get("segments", []):
+                    known[e["path"]] = _Segment(**e)
         # adopt any segment file the manifest missed (crash between the
-        # segment rename and the manifest rewrite)
+        # segment rename and the manifest rewrite) — but NEVER a file whose
+        # own topology stamp disagrees (a manifest-less dir must not smuggle
+        # old-topology partition indices past the retire check)
         for f in sorted(self.dir.glob("seg-*.npz")):
             if f.name in known:
                 self.segments.append(known[f.name])
                 continue
             with np.load(f) as z:
-                ts = z["ts_ms"]
-                self.segments.append(_Segment(
-                    part=int(z["part"]), start=int(z["start"]),
-                    count=int(ts.shape[0]),
-                    ts_min=int(ts.min()) if ts.size else 0,
-                    ts_max=int(ts.max()) if ts.size else 0,
-                    path=f.name))
+                seg_topo = (str(z["topology"]) if "topology" in z.files
+                            else None)
+                if (self.topology is not None and seg_topo is not None
+                        and seg_topo != self.topology):
+                    pass  # retired below, outside the np.load handle
+                else:
+                    seg_topo = None
+                    ts = z["ts_ms"]
+                    self.segments.append(_Segment(
+                        part=int(z["part"]), start=int(z["start"]),
+                        count=int(ts.shape[0]),
+                        ts_min=int(ts.min()) if ts.size else 0,
+                        ts_max=int(ts.max()) if ts.size else 0,
+                        path=f.name))
+            if seg_topo is not None:
+                self._retire(seg_topo, files=[f])
         self.segments.sort(key=lambda s: (s.part, s.start))
         self._reindex()
 
@@ -117,10 +144,35 @@ class EventArchive:
         for segs in self._by_part.values():
             segs.sort(key=lambda s: s.start)
 
+    def _retire(self, old_topology: str,
+                files: "list[pathlib.Path] | None" = None) -> None:
+        """Move different-topology archive files aside (never delete
+        history: the operator may migrate it offline). Runs before any
+        index adoption, so the live archive never carries them."""
+        import logging
+
+        tag = old_topology.replace("/", "-")
+        retired = self.dir / f"retired-{tag}"
+        n = 0
+        while retired.exists():
+            n += 1
+            retired = self.dir / f"retired-{tag}-{n}"
+        retired.mkdir()
+        if files is None:
+            files = list(self.dir.glob("seg-*.npz")) + [self._manifest_path()]
+        for f in files:
+            if f.exists():
+                f.rename(retired / f.name)
+        logging.getLogger(__name__).warning(
+            "archive topology changed (%s -> %s): previous history moved "
+            "to %s; spill starts fresh",
+            old_topology, self.topology, retired)
+
     def _save_index(self) -> None:
         tmp = self._manifest_path().with_suffix(".tmp")
         tmp.write_text(json.dumps(
-            {"segments": [s.to_json() for s in self.segments]}))
+            {"topology": self.topology,
+             "segments": [s.to_json() for s in self.segments]}))
         tmp.replace(self._manifest_path())
 
     def spilled(self, part: int) -> int:
@@ -146,6 +198,7 @@ class EventArchive:
         tmp = path.with_name(path.name + ".tmp")
         with open(tmp, "wb") as f:
             np.savez(f, part=np.int64(part), start=np.int64(start),
+                     topology=np.str_(self.topology or ""),
                      **{c: np.asarray(getattr(sl, c)) for c in _COLUMNS})
         tmp.replace(path)
         self.segments.append(_Segment(

@@ -451,3 +451,118 @@ def test_gap_skip_never_commits_past_uncommitted_replay(tmp_path):
         feed.commit(evs)
     assert feed.lag_lost == 32
     assert len(first) + len(rest) == 256 - 32
+
+
+def test_archive_survives_snapshot_recovery(tmp_path):
+    """Archived history must still serve after distributed snapshot + WAL
+    crash recovery (same topology re-attaches the archive)."""
+    from sitewhere_tpu.parallel.distributed import (
+        DistributedConfig,
+        DistributedEngine,
+        recover_distributed,
+    )
+
+    cfg = DistributedConfig(
+        n_shards=4, device_capacity_per_shard=64, token_capacity_per_shard=128,
+        assignment_capacity_per_shard=128, store_capacity_per_shard=64,
+        channels=4, batch_capacity_per_shard=16,
+        archive_dir=str(tmp_path / "ra"), archive_segment_rows=16,
+        wal_dir=str(tmp_path / "wal"))
+    eng = DistributedEngine(cfg)
+    base = int(eng.epoch.base_unix_s * 1000)
+
+    def pay(token, value, ts_rel):
+        return json.dumps({
+            "deviceToken": token, "type": "DeviceMeasurements",
+            "request": {"measurements": {"temp": value},
+                        "eventDate": base + ts_rel}}).encode()
+
+    n = 2 * 4 * 64
+    for lo in range(0, n, 32):
+        eng.ingest_json_batch([pay(f"rs-{j % 8}", float(j), 1000 + j)
+                               for j in range(lo, lo + 32)])
+    eng.flush()
+    eng.save(tmp_path / "snap")
+    eng.wal.close()
+    rec = recover_distributed(tmp_path / "snap")
+    # first-half history (evicted from every ring) still resolves
+    res = rec.query_events(since_ms=1000, until_ms=1000 + n // 2 - 1,
+                           limit=16)
+    assert res["total"] == n // 2
+    assert rec.archive.total_rows() > 0
+
+
+def test_archive_retired_on_topology_change(tmp_path):
+    """After an elastic reshard, the old archive's partition indices no
+    longer mean the same (shard, arena) — it must be RETIRED, never
+    misread under the new mesh."""
+    from sitewhere_tpu.utils.archive import EventArchive
+
+    arch4 = EventArchive(tmp_path / "topo", segment_rows=4,
+                     topology="mesh/4x1")
+    import types
+
+    cols = types.SimpleNamespace(**{
+        c: np.zeros((4, 4) if c in ("values", "vmask") else (4, 2)
+                    if c == "aux" else 4,
+                    np.float32 if c == "values" else
+                    bool if c in ("vmask", "valid") else np.int32)
+        for c in ("etype", "device", "assignment", "tenant", "area",
+                  "customer", "asset", "ts_ms", "received_ms", "values",
+                  "vmask", "aux", "valid")})
+    arch4.append_segment(3, 0, cols)
+    assert arch4.total_rows() == 4
+
+    # same topology re-opens and keeps the data
+    again = EventArchive(tmp_path / "topo", segment_rows=4,
+                     topology="mesh/4x1")
+    assert again.total_rows() == 4
+
+    # different topology retires it
+    arch2 = EventArchive(tmp_path / "topo", segment_rows=4,
+                     topology="mesh/2x1")
+    assert arch2.total_rows() == 0
+    assert arch2.spilled(3) == 0
+    retired = list((tmp_path / "topo").glob("retired-mesh-4x1*"))
+    assert len(retired) == 1
+    assert list(retired[0].glob("seg-*.npz"))
+
+
+def test_topology_check_covers_manifestless_and_equal_count(tmp_path):
+    """Review r3: (a) segments carry their OWN topology stamp, so a
+    manifest-less dir can't smuggle old-topology partitions past the
+    check; (b) the stamp is the full shape, so single/2 vs mesh/2x1
+    (equal partition COUNTS) still retires."""
+    import types
+
+    from sitewhere_tpu.utils.archive import EventArchive
+
+    def cols(n=4):
+        return types.SimpleNamespace(**{
+            c: np.zeros((n, 4) if c in ("values", "vmask") else (n, 2)
+                        if c == "aux" else n,
+                        np.float32 if c == "values" else
+                        bool if c in ("vmask", "valid") else np.int32)
+            for c in ("etype", "device", "assignment", "tenant", "area",
+                      "customer", "asset", "ts_ms", "received_ms",
+                      "values", "vmask", "aux", "valid")})
+
+    a1 = EventArchive(tmp_path / "t", segment_rows=4, topology="single/2")
+    a1.append_segment(1, 0, cols())
+    # (b) equal partition count, different shape -> retired
+    a2 = EventArchive(tmp_path / "t", segment_rows=4, topology="mesh/2x1")
+    assert a2.total_rows() == 0
+    assert list((tmp_path / "t").glob("retired-single-2*"))
+
+    # (a) manifest-less: write a segment, delete index.json, reopen under
+    # a different topology — the per-segment stamp still blocks adoption
+    a2.append_segment(0, 0, cols())
+    (tmp_path / "t" / "index.json").unlink()
+    a3 = EventArchive(tmp_path / "t", segment_rows=4, topology="mesh/8x1")
+    assert a3.total_rows() == 0
+    # and the same topology WITHOUT a manifest still rebuilds fine
+    a4 = EventArchive(tmp_path / "t", segment_rows=4, topology="mesh/8x1")
+    a4.append_segment(0, 0, cols())
+    (tmp_path / "t" / "index.json").unlink()
+    a5 = EventArchive(tmp_path / "t", segment_rows=4, topology="mesh/8x1")
+    assert a5.total_rows() == 4
