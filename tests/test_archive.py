@@ -27,12 +27,15 @@ def meas(eng: Engine, token: str, value: float, ts_rel: int) -> bytes:
     }).encode()
 
 
+SMALL_CFG = dict(
+    device_capacity=64, token_capacity=128, assignment_capacity=128,
+    store_capacity=64, channels=4, batch_capacity=16,
+    archive_segment_rows=16,
+)
+
+
 def small_engine(tmp_path, **kw) -> Engine:
-    cfg = dict(
-        device_capacity=64, token_capacity=128, assignment_capacity=128,
-        store_capacity=64, channels=4, batch_capacity=16,
-        archive_dir=str(tmp_path / "arch"), archive_segment_rows=16,
-    )
+    cfg = dict(SMALL_CFG, archive_dir=str(tmp_path / "arch"))
     cfg.update(kw)
     return Engine(EngineConfig(**cfg))
 
@@ -566,3 +569,60 @@ def test_topology_check_covers_manifestless_and_equal_count(tmp_path):
     (tmp_path / "t" / "index.json").unlink()
     a5 = EventArchive(tmp_path / "t", segment_rows=4, topology="mesh/8x1")
     assert a5.total_rows() == 4
+
+
+def test_archived_history_serves_over_rest(tmp_path):
+    """The REST event listings transparently include archived history —
+    the user-visible version of the unbounded date-range search."""
+    import asyncio
+    import base64
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from sitewhere_tpu.instance.instance import (
+        InstanceConfig,
+        SiteWhereTpuInstance,
+    )
+    from sitewhere_tpu.web.rest import make_app
+
+    inst = SiteWhereTpuInstance(InstanceConfig(engine=EngineConfig(
+        **SMALL_CFG, archive_dir=str(tmp_path / "ra"))))
+    eng = inst.engine
+    for i in range(256):
+        eng.ingest_json_batch([meas(eng, f"rr-{i % 4}", float(i), 1000 + i)])
+    eng.flush()
+
+    async def go():
+        client = TestClient(TestServer(make_app(inst)))
+        await client.start_server()
+        try:
+            basic = base64.b64encode(b"admin:password").decode()
+            r = await client.get("/api/authapi/jwt",
+                                 headers={"Authorization": f"Basic {basic}"})
+            h = {"Authorization": f"Bearer {(await r.json())['token']}"}
+            # full-history total through the generic listing
+            r = await client.get("/api/events", headers=h)
+            assert (await r.json())["total"] == 256
+            # device listing reaches the archived first quarter
+            r = await client.get(
+                "/api/devices/rr-1/events",
+                params={"sinceMs": "1000", "untilMs": "1063",
+                        "pageSize": "64"}, headers=h)
+            body = await r.json()
+            assert body["total"] == 16
+            assert all(e["deviceToken"] == "rr-1" for e in body["events"])
+            # by-id lookup follows an evicted event to disk
+            feed = eng.make_feed_consumer("rest-arch")
+            first = feed.poll()[0]
+            r = await client.get(f"/api/events/id/{first.event_id}",
+                                 headers=h)
+            assert r.status == 200
+            assert (await r.json())["eventDateMs"] == 1000
+        finally:
+            await client.close()
+
+    asyncio.run(go())
+    # engine-level date-range agreement for the same instance
+    res = eng.query_events(device_token="rr-1", since_ms=1000,
+                           until_ms=1063, limit=64)
+    assert res["total"] == 16
