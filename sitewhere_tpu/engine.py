@@ -432,6 +432,10 @@ class EngineConfig:
                                          # = unbounded history; else oldest
                                          # whole segments expire past this
                                          # (INFLUX_RETENTION_POLICY analog)
+    archive_max_age_ms: int | None = None  # event-time retention horizon:
+                                           # segments older than this (vs
+                                           # the partition's newest event)
+                                           # expire
     scan_chunk: int = 1                # >1: dispatch K emitted batches as
                                        # ONE lax.scan program (amortizes
                                        # dispatch/transfer per chunk; adds
@@ -734,7 +738,8 @@ class Engine(IngestHostMixin):
                 c.archive_dir,
                 segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
                 max_rows_per_part=c.archive_max_rows,
-                topology=f"single/{c.tenant_arenas}")
+                topology=f"single/{c.tenant_arenas}",
+                max_age_ms=c.archive_max_age_ms)
             # spool whenever any arena could be halfway to overwrite; with
             # the worst case of every staged row landing in one arena this
             # keeps backlog + one batch < arena capacity

@@ -85,6 +85,7 @@ class DistributedConfig:
                                        # before overwrite (utils/archive.py)
     archive_segment_rows: int = 4096
     archive_max_rows: int | None = None  # per-(shard,arena) retention cap
+    archive_max_age_ms: int | None = None  # event-time retention horizon
 
 
 class _StackedBuffer:
@@ -381,7 +382,8 @@ class DistributedEngine(IngestHostMixin):
                 c.archive_dir,
                 segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
                 max_rows_per_part=c.archive_max_rows,
-                topology=f"mesh/{self.n_shards}x{arenas}")
+                topology=f"mesh/{self.n_shards}x{arenas}",
+                max_age_ms=c.archive_max_age_ms)
             self._spool_trigger = max(self.archive.segment_rows,
                                       acap // 2 - c.batch_capacity_per_shard)
 

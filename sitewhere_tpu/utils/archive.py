@@ -59,7 +59,8 @@ class EventArchive:
 
     def __init__(self, directory: str | pathlib.Path, segment_rows: int = 4096,
                  max_rows_per_part: int | None = None,
-                 topology: str | None = None):
+                 topology: str | None = None,
+                 max_age_ms: int | None = None):
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.segment_rows = int(segment_rows)
@@ -79,6 +80,11 @@ class EventArchive:
         # eager), so the queryable history beyond the ring is roughly
         # max_rows_per_part - arena_capacity: size the cap ABOVE the ring
         self.max_rows_per_part = max_rows_per_part
+        # time-based retention (the closer Influx analog): a segment whose
+        # NEWEST event is older than the partition's newest event minus
+        # max_age_ms expires wholesale. Event-time based (ts_ms domain),
+        # so replayed/backfilled history ages consistently
+        self.max_age_ms = max_age_ms
         self.expired_rows = 0
         self.segments: list[_Segment] = []
         self.lost_rows = 0   # rows overwritten before they could spill
@@ -211,25 +217,37 @@ class EventArchive:
         self._save_index()
 
     def _expire(self, part: int) -> None:
-        """Apply the retention policy: drop this partition's OLDEST whole
-        segments while it exceeds ``max_rows_per_part``. Expired rows are
-        deliberate policy (counted separately from ``lost_rows``)."""
-        if self.max_rows_per_part is None:
+        """Apply the retention policies: drop this partition's OLDEST whole
+        segments while it exceeds ``max_rows_per_part``, and any segment
+        whose newest event fell behind ``max_age_ms`` of the partition's
+        newest. Expired rows are deliberate policy (counted separately
+        from ``lost_rows``)."""
+        if self.max_rows_per_part is None and self.max_age_ms is None:
             return
         segs = self._by_part.get(part, [])
-        total = sum(s.count for s in segs)
-        changed = False
-        while segs and total > self.max_rows_per_part:
-            victim = segs.pop(0)
-            total -= victim.count
+        victims: list[_Segment] = []
+        # phase 1 — row cap pops in WRITE order (oldest position first)
+        if self.max_rows_per_part is not None:
+            total = sum(s.count for s in segs)
+            while segs and total > self.max_rows_per_part:
+                victims.append(segs.pop(0))
+                total -= victims[-1].count
+        # phase 2 — age horizon from the SURVIVORS' newest event (a
+        # just-popped segment must not inflate it), sweeping EVERY
+        # segment: event time is client-supplied, so a backfilled segment
+        # can sit behind a fresher one in write order
+        if self.max_age_ms is not None and segs:
+            horizon = max(s.ts_max for s in segs) - self.max_age_ms
+            victims += [s for s in segs if s.ts_max < horizon]
+            segs[:] = [s for s in segs if s.ts_max >= horizon]
+        for victim in victims:
             self.expired_rows += victim.count
             self.segments.remove(victim)
             (self.dir / victim.path).unlink(missing_ok=True)
             if self._row_cache is not None \
                     and self._row_cache[0] == victim.path:
                 self._row_cache = None
-            changed = True
-        if changed:
+        if victims:
             self._reindex()
 
     def note_lost(self, count: int) -> None:

@@ -626,3 +626,56 @@ def test_archived_history_serves_over_rest(tmp_path):
     res = eng.query_events(device_token="rr-1", since_ms=1000,
                            until_ms=1063, limit=64)
     assert res["total"] == 16
+
+
+def test_archive_age_based_retention(tmp_path):
+    """Event-time retention horizon: segments whose newest event trails
+    the partition's newest by more than max_age_ms expire."""
+    eng = small_engine(tmp_path, archive_max_age_ms=100)
+    for i in range(4 * 64):
+        eng.ingest_json_batch([meas(eng, "ag-1", float(i), 1000 + i)])
+    eng.flush()
+    arch = eng.archive
+    assert arch.expired_rows > 0
+    # everything inside the horizon (newest ts 1255, horizon 1155) that
+    # is already evicted from the ring still resolves...
+    res = eng.query_events(since_ms=1160, until_ms=1191, limit=64)
+    assert res["total"] == 32
+    # ...while history beyond the horizon is gone
+    assert eng.query_events(since_ms=1000, until_ms=1063)["total"] == 0
+    # retained archive segments all end within the horizon
+    newest = max(s.ts_max for s in arch.segments)
+    assert all(s.ts_max >= newest - 100 for s in arch.segments)
+
+
+def test_age_retention_sweeps_backfilled_segments(tmp_path):
+    """Review r3: the age horizon must come from surviving segments and
+    sweep ALL of them — a backfilled (out-of-order event time) segment
+    behind a fresher head still expires."""
+    import types
+
+    from sitewhere_tpu.utils.archive import EventArchive
+
+    def cols(ts_vals):
+        n = len(ts_vals)
+        d = {c: np.zeros((n, 4) if c in ("values", "vmask") else (n, 2)
+                         if c == "aux" else n,
+                         np.float32 if c == "values" else
+                         bool if c in ("vmask", "valid") else np.int32)
+             for c in ("etype", "device", "assignment", "tenant", "area",
+                       "customer", "asset", "ts_ms", "received_ms",
+                       "values", "vmask", "aux", "valid")}
+        d["ts_ms"][:] = ts_vals
+        d["valid"][:] = True
+        return types.SimpleNamespace(**d)
+
+    arch = EventArchive(tmp_path / "bk", segment_rows=2, max_age_ms=50,
+                        topology="single/1")
+    arch.append_segment(0, 0, cols([300, 300]))   # live
+    arch.append_segment(0, 2, cols([100, 100]))   # backfill, past horizon
+    arch.append_segment(0, 4, cols([310, 310]))   # live again
+    # horizon = 310 - 50 = 260: the backfilled middle segment expires even
+    # though a fresher segment precedes it in write order
+    starts = sorted(s.start for s in arch.segments)
+    assert starts == [0, 4]
+    assert arch.expired_rows == 2
