@@ -58,34 +58,55 @@ def worker_main(rank: int, nproc: int, port: int,
         (eng.mesh.devices.flat[s].process_index == rank) for s in local)
 
     # each process ingests events ONLY for its own shards (the partitioned
-    # consumer-group analog): 8 events per shard, shard-local device ids
+    # consumer-group analog): 8 events per shard, shard-local device ids.
+    # THREE steps: registration (miss path), lookup hits on the same
+    # devices, then a later-timestamped round — exercising the steady
+    # state, not just cold start, as one SPMD program per step.
     per_shard = 8
-    batches = {}
-    for s in local:
-        buf = HostEventBuffer(16, channels=4)
-        for k in range(per_shard):
-            buf.append(EventType.MEASUREMENT, token_id=k, tenant_id=0,
-                       ts_ms=1000 + k, received_ms=1000 + k,
-                       values=[float(s * 100 + k)])
-        batches[s] = buf.emit()
-    stacked = multihost.assemble_stacked_batch(eng.mesh, batches)
-    eng.step(stacked)
 
-    # global metrics: SPMD reduction over the whole mesh — every process
-    # computes the same replicated totals (the DCN-side agreement check)
-    m = eng.global_metrics()
-    expect = per_shard * n_global
-    assert m["registered"] == expect, m
-    assert m["persisted"] == expect, m
-    # global store scan (query agreement): all persisted rows visible with
-    # the ingested timestamp range from EVERY process
+    def make_stacked(ts0: int) -> object:
+        batches = {}
+        for s in local:
+            buf = HostEventBuffer(16, channels=4)
+            for k in range(per_shard):
+                buf.append(EventType.MEASUREMENT, token_id=k, tenant_id=0,
+                           ts_ms=ts0 + k, received_ms=ts0 + k,
+                           values=[float(s * 100 + k)])
+            batches[s] = buf.emit()
+        return multihost.assemble_stacked_batch(eng.mesh, batches)
+
+    for step_i, ts0 in enumerate((1000, 2000, 3000)):
+        eng.step(make_stacked(ts0))
+        # global metrics after EVERY step: SPMD reduction over the whole
+        # mesh — all processes must compute identical replicated totals
+        m = eng.global_metrics()
+        expect = per_shard * n_global * (step_i + 1)
+        assert m["persisted"] == expect, (step_i, m)
+    assert m["registered"] == per_shard * n_global, m   # first step only
+    # "found" counts every resolved event, including just-registered ones
+    # re-looked-up within their own step — so all three steps contribute
+    assert m["found"] == 3 * per_shard * n_global, m
+
+    # global store scan (query agreement) from EVERY process
     store = eng.state.store
     n_valid = int(jnp.sum(store.valid))
-    n_in_range = int(jnp.sum(store.valid & (store.ts_ms >= 1000)
-                             & (store.ts_ms < 1000 + per_shard)))
-    assert n_valid == expect == n_in_range, (n_valid, n_in_range)
+    n_late = int(jnp.sum(store.valid & (store.ts_ms >= 3000)))
+    assert n_valid == 3 * per_shard * n_global, n_valid
+    assert n_late == per_shard * n_global, n_late
+
+    # presence sweep as a mesh-wide collective pass: with a 0ms horizon
+    # every registered device on every shard goes MISSING consistently
+    # (the private _stacked_sweep is deliberate: the public presence_sweep
+    # does a host readback that is not multi-host-safe)
+    from sitewhere_tpu.parallel.sharded import _stacked_sweep
+
+    eng.state, newly = _stacked_sweep(eng.state, jnp.int32(10_000),
+                                      jnp.int32(0))
+    n_missing = int(jnp.sum(newly))
+    assert n_missing == per_shard * n_global, n_missing
     print(f"MULTIHOST_OK rank={rank}/{nproc} shards={local} "
-          f"persisted={m['persisted']} store_valid={n_valid}", flush=True)
+          f"persisted={m['persisted']} store_valid={n_valid} "
+          f"found={m['found']} missing={n_missing}", flush=True)
 
 
 def _spawn_once(devices_per_proc: int, timeout_s: float) -> list[str]:

@@ -93,6 +93,12 @@ class EventArchive:
         # chunks and must not re-extract the npz per chunk
         self._by_part: dict[int, list[_Segment]] = {}
         self._row_cache: tuple[str, dict] | None = None
+        # monotone spill watermark per partition, independent of segment
+        # PRESENCE: retention may expire the tail segment (backfilled event
+        # times), and a watermark derived from surviving segments would
+        # regress below the ring head — making the spooler re-spill and
+        # re-expire the same rows forever
+        self._spilled: dict[int, int] = {}
         self._load_index()
 
     # ------------------------------------------------------------- index
@@ -115,6 +121,8 @@ class EventArchive:
             else:
                 for e in m.get("segments", []):
                     known[e["path"]] = _Segment(**e)
+                self._spilled = {int(k): int(v)
+                                 for k, v in m.get("spilled", {}).items()}
         # adopt any segment file the manifest missed (crash between the
         # segment rename and the manifest rewrite) — but NEVER a file whose
         # own topology stamp disagrees (a manifest-less dir must not smuggle
@@ -178,13 +186,17 @@ class EventArchive:
         tmp = self._manifest_path().with_suffix(".tmp")
         tmp.write_text(json.dumps(
             {"topology": self.topology,
+             "spilled": self._spilled,
              "segments": [s.to_json() for s in self.segments]}))
         tmp.replace(self._manifest_path())
 
     def spilled(self, part: int) -> int:
-        """Next absolute position of ``part`` not yet on disk."""
-        return max((s.start + s.count for s in self.segments
-                    if s.part == part), default=0)
+        """Next absolute position of ``part`` the spooler should write —
+        monotone even after retention expires the newest-position
+        segment."""
+        ends = max((s.start + s.count for s in self._by_part.get(part, ())),
+                   default=0)
+        return max(self._spilled.get(part, 0), ends)
 
     def total_rows(self) -> int:
         return sum(s.count for s in self.segments)
@@ -196,6 +208,8 @@ class EventArchive:
         e.g. after WAL replay — is a no-op."""
         name = f"seg-p{part:04d}-o{start:014d}-n{sl.ts_ms.shape[0]}.npz"
         path = self.dir / name
+        end = start + int(sl.ts_ms.shape[0])
+        self._spilled[part] = max(self._spilled.get(part, 0), end)
         if path.exists():
             return
         ts = np.asarray(sl.ts_ms)

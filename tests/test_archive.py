@@ -679,3 +679,42 @@ def test_age_retention_sweeps_backfilled_segments(tmp_path):
     starts = sorted(s.start for s in arch.segments)
     assert starts == [0, 4]
     assert arch.expired_rows == 2
+
+
+def test_spill_watermark_survives_tail_expiry(tmp_path):
+    """Review r3: age-expiring the newest-POSITION segment must not
+    regress spilled() — the spooler would otherwise re-spill and
+    re-expire the same rows forever (and miscount them as lost)."""
+    import types
+
+    from sitewhere_tpu.utils.archive import EventArchive
+
+    def cols(ts_vals):
+        n = len(ts_vals)
+        d = {c: np.zeros((n, 4) if c in ("values", "vmask") else (n, 2)
+                         if c == "aux" else n,
+                         np.float32 if c == "values" else
+                         bool if c in ("vmask", "valid") else np.int32)
+             for c in ("etype", "device", "assignment", "tenant", "area",
+                       "customer", "asset", "ts_ms", "received_ms",
+                       "values", "vmask", "aux", "valid")}
+        d["ts_ms"][:] = ts_vals
+        d["valid"][:] = True
+        return types.SimpleNamespace(**d)
+
+    arch = EventArchive(tmp_path / "wm", segment_rows=2, max_age_ms=50,
+                        topology="single/1")
+    arch.append_segment(0, 0, cols([300, 300]))
+    # backfilled TAIL segment: newest position, oldest event time -> it
+    # expires immediately, but the watermark must stay at 4
+    arch.append_segment(0, 2, cols([100, 100]))
+    assert arch.expired_rows == 2
+    assert arch.spilled(0) == 4
+    before = arch.expired_rows
+    # an idempotent re-append of the same range must not churn
+    arch.append_segment(0, 2, cols([100, 100]))
+    assert arch.spilled(0) == 4
+    # the watermark survives a reopen (persisted in the manifest)
+    again = EventArchive(tmp_path / "wm", segment_rows=2, max_age_ms=50,
+                         topology="single/1")
+    assert again.spilled(0) == 4

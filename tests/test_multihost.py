@@ -23,7 +23,10 @@ def test_two_process_job_agrees_on_global_state():
     tail0 = by_rank[0].split("persisted=")[1]
     tail1 = by_rank[1].split("persisted=")[1]
     assert tail0 == tail1
-    assert "persisted=64" in by_rank[0] and "store_valid=64" in by_rank[0]
+    # 3 steps x 8 events x 8 shards, all visible and all marked missing by
+    # the mesh-wide presence sweep
+    assert "persisted=192" in by_rank[0] and "store_valid=192" in by_rank[0]
+    assert "missing=64" in by_rank[0]
     # disjoint shard ownership: rank 0 owns 0-3, rank 1 owns 4-7
     assert "shards=[0, 1, 2, 3]" in by_rank[0]
     assert "shards=[4, 5, 6, 7]" in by_rank[1]
