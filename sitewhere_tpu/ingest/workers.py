@@ -230,36 +230,41 @@ class DecodeWorkerPool:
         assert kind == "done"
         eng = self.engine
         # ---- extend translation tables from first-seen strings ----------
-        if new_tokens:
-            w.tok_map = np.concatenate([
-                w.tok_map,
-                np.fromiter((eng.tokens.intern(t) for t in new_tokens),
-                            np.int32, len(new_tokens))])
-        if new_alerts:
-            w.alert_map = np.concatenate([
-                w.alert_map,
-                np.fromiter((eng.alert_types.intern(t) for t in new_alerts),
-                            np.int32, len(new_alerts))])
-        if new_names:
-            names_interner = (eng._native_decoder.names
-                              if eng._native_decoder else None)
-            for name in new_names:
-                wid = w.n_names_seen   # dense worker-local name id order
-                w.n_names_seen += 1
-                eid = (names_interner.intern(name) if names_interner
-                       else eng.channel_map.names.intern(name))
-                wlane, elane = wid % self.channels, eid % self.channels
-                prev = w.lane_owner.get(wlane)
-                if prev is None:
-                    # the engine lane must not already belong to a DIFFERENT
-                    # worker lane — a non-injective map would let one lane's
-                    # scatter clobber the other's (silent data loss)
-                    if w.elane_owner.get(elane, wlane) != wlane:
+        # Under eng.lock: these interners are shared with REST registration
+        # and in-process ingest, which all intern under the same lock.
+        with eng.lock:
+            if new_tokens:
+                w.tok_map = np.concatenate([
+                    w.tok_map,
+                    np.fromiter((eng.tokens.intern(t) for t in new_tokens),
+                                np.int32, len(new_tokens))])
+            if new_alerts:
+                w.alert_map = np.concatenate([
+                    w.alert_map,
+                    np.fromiter(
+                        (eng.alert_types.intern(t) for t in new_alerts),
+                        np.int32, len(new_alerts))])
+            if new_names:
+                names_interner = (eng._native_decoder.names
+                                  if eng._native_decoder else None)
+                for name in new_names:
+                    wid = w.n_names_seen   # dense worker-local name id order
+                    w.n_names_seen += 1
+                    eid = (names_interner.intern(name) if names_interner
+                           else eng.channel_map.names.intern(name))
+                    wlane, elane = wid % self.channels, eid % self.channels
+                    prev = w.lane_owner.get(wlane)
+                    if prev is None:
+                        # the engine lane must not already belong to a
+                        # DIFFERENT worker lane — a non-injective map would
+                        # let one lane's scatter clobber the other's
+                        # (silent data loss)
+                        if w.elane_owner.get(elane, wlane) != wlane:
+                            w.lane_conflict = True
+                        w.lane_owner[wlane] = elane
+                        w.elane_owner[elane] = wlane
+                    elif prev != elane:
                         w.lane_conflict = True
-                    w.lane_owner[wlane] = elane
-                    w.elane_owner[elane] = wlane
-                elif prev != elane:
-                    w.lane_conflict = True
         n = len(payloads)
         if w.lane_conflict:
             # ambiguous lane permutation: exactness over speed — decode
@@ -269,7 +274,9 @@ class DecodeWorkerPool:
         # ---- translate + stage (numpy gathers only) ---------------------
         from sitewhere_tpu.engine import WAL_JSON
         from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
-        from sitewhere_tpu.ingest.fast_decode import RT_ALERT, DecodedArrays
+        from sitewhere_tpu.ingest.fast_decode import (RT_ALERT,
+                                                      RT_MEASUREMENT,
+                                                      DecodedArrays)
 
         o = w.out
         rtype = o["rtype"][:n].copy()
@@ -288,10 +295,20 @@ class DecodeWorkerPool:
                              len(w.lane_owner))
             el = np.fromiter(w.lane_owner.values(), np.int64,
                              len(w.lane_owner))
+            raw_v = o["values"][:n]
+            raw_m = o["chmask"][:n].astype(bool)
             values = np.zeros((n, self.channels), np.float32)
             chmask = np.zeros((n, self.channels), bool)
-            values[:, el] = o["values"][:n][:, wl]
-            chmask[:, el] = o["chmask"][:n].astype(bool)[:, wl]
+            values[:, el] = raw_v[:, wl]
+            chmask[:, el] = raw_m[:, wl]
+            # the lane permutation is derived from measurement names only;
+            # LOCATION rows carry lat/lon/elev in FIXED lanes 0-2 (see
+            # swtpu.cpp scan_location) and other non-measurement rows use
+            # raw lanes — keep their lanes untouched
+            nonmeas = rtype != RT_MEASUREMENT
+            if np.any(nonmeas):
+                values[nonmeas] = raw_v[nonmeas]
+                chmask[nonmeas] = raw_m[nonmeas]
         aux0 = o["aux0"][:n].copy()
         alert_rows = rtype == RT_ALERT
         if np.any(alert_rows) and len(w.alert_map):

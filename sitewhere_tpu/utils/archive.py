@@ -132,8 +132,12 @@ class EventArchive:
                 self.segments.append(known[f.name])
                 continue
             with np.load(f) as z:
+                # an archive opened with topology=None stamps np.str_("");
+                # treat that like a missing stamp (same semantics as a
+                # null manifest stamp) so such segments are adopted, not
+                # retired, by a later topology-aware open
                 seg_topo = (str(z["topology"]) if "topology" in z.files
-                            else None)
+                            else "") or None
                 if (self.topology is not None and seg_topo is not None
                         and seg_topo != self.topology):
                     pass  # retired below, outside the np.load handle

@@ -1005,12 +1005,28 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(
             {"username": u.username, "roles": u.roles}, status=201)
 
+    def _self_or_admin(request: web.Request) -> bool:
+        """User reads are self-or-admin: every read path that exposes a
+        user's roles/authorities shares one gate (listing is admin-only)."""
+        return (request.match_info.get("username") == request.get("user")
+                or AUTH_ADMIN in request.get("authorities", []))
+
+    async def list_users(request: web.Request):
+        return json_response(
+            [{"username": u.username, "roles": u.roles, "enabled": u.enabled}
+             for u in inst.users.users.values()])
+
+    async def get_user_authorities(request: web.Request):
+        if not _self_or_admin(request):
+            return json_response({"error": "admin required"}, status=403)
+        u = inst.users.users.get(request.match_info["username"])
+        if u is None:
+            raise EntityNotFound("user")
+        return json_response(inst.users.authorities_for(u))
+
     r.add_post("/api/users", create_user)
-    r.add_get("/api/users", _sync(lambda req: json_response(
-        [{"username": u.username, "roles": u.roles, "enabled": u.enabled}
-         for u in inst.users.users.values()])))
-    r.add_get("/api/users/{username}/authorities", _sync(lambda req: json_response(
-        inst.users.authorities_for(inst.users.users[req.match_info["username"]]))))
+    r.add_get("/api/users", _admin(list_users))
+    r.add_get("/api/users/{username}/authorities", get_user_authorities)
 
     def _user_json(u) -> dict:
         return {"username": u.username, "roles": u.roles, "enabled": u.enabled,
@@ -1018,6 +1034,8 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
                 "email": u.email}
 
     async def get_user(request: web.Request):
+        if not _self_or_admin(request):
+            return json_response({"error": "admin required"}, status=403)
         u = inst.users.users.get(request.match_info["username"])
         if u is None:
             raise EntityNotFound("user")
@@ -1047,6 +1065,8 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     # role mutation (reference: Users.java @GET/@PUT/@DELETE
     # /{username}/roles -> add/removeRoles; empty role list is an error)
     async def get_user_roles(request: web.Request):
+        if not _self_or_admin(request):
+            return json_response({"error": "admin required"}, status=403)
         u = inst.users.users.get(request.match_info["username"])
         if u is None:
             raise EntityNotFound("user")

@@ -571,6 +571,34 @@ def test_topology_check_covers_manifestless_and_equal_count(tmp_path):
     assert a5.total_rows() == 4
 
 
+def test_unstamped_segments_adopted_by_topology_aware_open(tmp_path):
+    """Advisor r3 (low): an archive opened with topology=None stamps
+    segments with an empty string; a later topology-aware open must treat
+    that like a missing/None stamp (adopt), matching the manifest-level
+    null-stamp semantics — not retire them as a foreign topology."""
+    import types
+
+    from sitewhere_tpu.utils.archive import EventArchive
+
+    def cols(n=4):
+        return types.SimpleNamespace(**{
+            c: np.zeros((n, 4) if c in ("values", "vmask") else (n, 2)
+                        if c == "aux" else n,
+                        np.float32 if c == "values" else
+                        bool if c in ("vmask", "valid") else np.int32)
+            for c in ("etype", "device", "assignment", "tenant", "area",
+                      "customer", "asset", "ts_ms", "received_ms",
+                      "values", "vmask", "aux", "valid")})
+
+    a0 = EventArchive(tmp_path / "u", segment_rows=4, topology=None)
+    a0.append_segment(0, 0, cols())
+    # manifest-less reopen forces the file-level stamp path
+    (tmp_path / "u" / "index.json").unlink()
+    a1 = EventArchive(tmp_path / "u", segment_rows=4, topology="mesh/4x1")
+    assert a1.total_rows() == 4
+    assert not list((tmp_path / "u").glob("retired-*"))
+
+
 def test_archived_history_serves_over_rest(tmp_path):
     """The REST event listings transparently include archived history —
     the user-visible version of the unbounded date-range search."""

@@ -754,3 +754,25 @@ def test_user_role_mutation(api):
     assert s == 400
     s, body = call("GET", "/api/users/ghost/roles")
     assert s == 404
+    # advisor r3 (low): a non-admin may read their OWN roles but cannot
+    # enumerate another user's (the mutations are admin-only already)
+    call("POST", "/api/users", {"username": "peeker", "password": "pw",
+                                "roles": ["user"]})
+    peeker_jwt = inst.jwt.generate("peeker", inst.users.authorities_for(
+        inst.users.users["peeker"]))
+    hdr = {"Authorization": f"Bearer {peeker_jwt}"}
+    s, body = call("GET", "/api/users/peeker/roles", headers=hdr)
+    assert s == 200 and body["results"] == ["user"]
+    s, body = call("GET", "/api/users/roley/roles", headers=hdr)
+    assert s == 403
+    # ...and the sibling read paths that expose the same data share the gate
+    s, _ = call("GET", "/api/users/roley", headers=hdr)
+    assert s == 403
+    s, _ = call("GET", "/api/users/roley/authorities", headers=hdr)
+    assert s == 403
+    s, _ = call("GET", "/api/users", headers=hdr)
+    assert s == 403
+    s, _ = call("GET", "/api/users/peeker", headers=hdr)
+    assert s == 200
+    s, _ = call("GET", "/api/users/peeker/authorities", headers=hdr)
+    assert s == 200

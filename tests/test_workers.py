@@ -168,6 +168,38 @@ def test_pool_lane_scatter_is_exact_with_shifted_lanes():
     assert st["measurements"]["fresh"]["value"] == 7.5
 
 
+def test_pool_location_rows_survive_shifted_lanes():
+    """Advisor r3 (high): the lane permutation comes from measurement names
+    only, but LOCATION rows carry lat/lon/elev in fixed lanes 0-2 — a
+    shifted lane map must not scramble or drop coordinates."""
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng = mini_engine()
+    # engine pre-interns 3 names so the worker's first name lands on a
+    # different engine lane (non-identity permutation)
+    eng.ingest_json_batch([
+        meas(eng, "seed", "n0", 1.0, 1), meas(eng, "seed", "n1", 1.0, 2),
+        meas(eng, "seed", "n2", 1.0, 3)])
+    eng.flush()
+    base = int(eng.epoch.base_unix_s * 1000)
+    loc = json.dumps({
+        "deviceToken": "lg-1", "type": "DeviceLocation",
+        "request": {"latitude": 42.25, "longitude": -71.5,
+                    "elevation": 12.5, "eventDate": base + 100}}).encode()
+    with DecodeWorkerPool(eng, n_workers=1, max_msgs=64) as pool:
+        # force a non-identity lane map, then a location through it
+        pool.submit([meas(eng, "lg-1", "fresh", 7.5, 99), loc])
+        pool.flush()
+        assert pool.stats()["fallback_batches"] == 0
+    eng.flush()
+    st = eng.get_device_state("lg-1")
+    assert st["measurements"]["fresh"]["value"] == 7.5
+    (rec,) = st["recent_locations"]
+    assert rec["latitude"] == 42.25
+    assert rec["longitude"] == -71.5
+    assert rec["elevation"] == 12.5
+
+
 def test_pool_falls_back_on_lane_conflict():
     """With more names than channels the worker's lane permutation can
     become ambiguous; the pool must detect it and fall back to exact
