@@ -53,6 +53,23 @@ class DeviceCommand:
             raise ValueError(f"unknown parameters {sorted(unknown)}")
 
 
+def command_from_json(token: str, device_type: str, name: str,
+                      namespace: str = "http://sitewhere/tpu",
+                      description: str = "",
+                      parameters: list[dict] | None = None) -> DeviceCommand:
+    """Build a DeviceCommand from the wire/JSON shape shared by the REST
+    and RPC create-command surfaces (reference: DeviceCommandCreateRequest
+    marshaling)."""
+    return DeviceCommand(
+        token=token, device_type=device_type, name=name,
+        namespace=namespace, description=description,
+        parameters=tuple(
+            CommandParameter(p["name"],
+                             ParameterType(p.get("type", "String")),
+                             p.get("required", False))
+            for p in (parameters or [])))
+
+
 class SystemCommandType(enum.Enum):
     """Built-in system commands (reference: RegistrationAck et al. sent by
     DeviceRegistrationManager.java:150-163)."""

@@ -1778,16 +1778,25 @@ class Engine(IngestHostMixin):
                         key=lambda e: -e["eventDateMs"])[:limit]
         return total + a_total, merged
 
-    def get_event(self, event_id: int) -> dict | None:
+    def get_event(self, event_id: int,
+                  tenant: str | None = None) -> dict | None:
         """Fetch one persisted event by its absolute store position — the
         stable event id handed out by the outbound feed and the
         /api/events/id/{eventId} lookup (reference: DeviceEvents.java
         getDeviceEventById). Returns None when the id was never written or
-        its ring slot has been overwritten."""
+        its ring slot has been overwritten. ``tenant`` scopes the lookup:
+        a row belonging to another tenant reads as absent (ids are
+        enumerable ring positions, so tenant-bound callers must not be
+        able to walk other tenants' history)."""
         from sitewhere_tpu.ops.readback import arena_cursor, read_range
 
         with self.lock:
             self._sync_mirrors()
+            ten = None
+            if tenant is not None:
+                ten = self.tenants.lookup(tenant)
+                if ten == NULL_ID:
+                    return None
             store = self.state.store
             if event_id < 0:
                 return None
@@ -1804,6 +1813,8 @@ class Engine(IngestHostMixin):
                 r = self.archive.get_row(arena, pos)
                 if r is None:
                     return None
+                if ten is not None and int(r["tenant"]) != ten:
+                    return None
                 ev = self._format_event(
                     int(r["etype"]), int(r["device"]), int(r["assignment"]),
                     int(r["ts_ms"]), int(r["received_ms"]), r["values"],
@@ -1814,6 +1825,8 @@ class Engine(IngestHostMixin):
                 store, jnp.int32(pos % store.arena_capacity), 1,
                 arena=arena))
             if not bool(sl.valid[0]):
+                return None
+            if ten is not None and int(sl.tenant[0]) != ten:
                 return None
             ev = self._format_event(
                 int(sl.etype[0]), int(sl.device[0]), int(sl.assignment[0]),

@@ -135,6 +135,31 @@ class EntityStore(Generic[T]):
         return token in self._by_token
 
 
+def entity_json(obj, **extra) -> dict:
+    """Wire/JSON form of an entity dataclass: the ``meta`` audit columns
+    flatten to token/createdDateMs/updatedDateMs, mirroring how the
+    reference marshals Rdb* entities over REST and gRPC."""
+    out = dataclasses.asdict(obj)
+    meta = out.pop("meta", None)
+    if meta:
+        out.update({"token": meta["token"],
+                    "createdDateMs": meta["created_ms"],
+                    "updatedDateMs": meta["updated_ms"]})
+    out.update(extra)
+    return out
+
+
+def paged_json(res: SearchResults) -> dict:
+    """Wire form of SearchResults (reference: ISearchResults envelopes)."""
+    return {
+        "numResults": res.total,
+        "page": res.page,
+        "pageSize": res.page_size,
+        "results": [(entity_json(e) if hasattr(e, "meta")
+                     else dataclasses.asdict(e)) for e in res.results],
+    }
+
+
 @dataclasses.dataclass
 class TreeNode(Generic[T]):
     entity: T

@@ -1338,18 +1338,25 @@ class DistributedEngine(IngestHostMixin):
                     out.append(info.token)
             return out
 
-    def get_event(self, event_id: int) -> dict | None:
+    def get_event(self, event_id: int,
+                  tenant: str | None = None) -> dict | None:
         """Fetch one persisted event by its mesh-global id — the id layout
         DistributedFeedConsumer hands out (``pos * n_parts + shard * arenas
         + arena`` with ``n_parts = n_shards * arenas``), so the REST
         /api/events/id/{eventId} lookup works identically against the
         distributed engine (reference: DeviceEvents.java
         getDeviceEventById). Returns None when the id was never written or
-        its ring slot has been overwritten."""
+        its ring slot has been overwritten. ``tenant`` scopes the lookup
+        (rows of other tenants read as absent — ids are enumerable)."""
         from sitewhere_tpu.ops.readback import read_range
 
         with self.lock:
             self._sync_mirrors()
+            ten = None
+            if tenant is not None:
+                ten = self.tenants.lookup(tenant)
+                if ten == NULL_ID:
+                    return None
             store = self.state.store
             if event_id < 0:
                 return None
@@ -1368,6 +1375,8 @@ class DistributedEngine(IngestHostMixin):
                 r = self.archive.get_row(s * arenas + a, pos)
                 if r is None:
                     return None
+                if ten is not None and int(r["tenant"]) != ten:
+                    return None
                 ev = self._format_event(
                     int(r["etype"]), s, int(r["device"]),
                     int(r["assignment"]), int(r["ts_ms"]),
@@ -1379,6 +1388,8 @@ class DistributedEngine(IngestHostMixin):
             sl = jax.device_get(read_range(
                 shard_store, jnp.int32(pos % acap), 1, arena=a))
             if not bool(sl.valid[0]):
+                return None
+            if ten is not None and int(sl.tenant[0]) != ten:
                 return None
             ev = self._format_event(
                 int(sl.etype[0]), s, int(sl.device[0]),

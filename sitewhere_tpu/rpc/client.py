@@ -23,10 +23,11 @@ class RpcClient:
     """Async client over one connection; calls multiplex by request id."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 tenant: str | None = None):
+                 tenant: str | None = None, auth_token: str | None = None):
         self.host = host
         self.port = port
         self.tenant = tenant
+        self.auth_token = auth_token
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._reader = None
@@ -40,6 +41,14 @@ class RpcClient:
             self.host, self.port)
         self._send_lock = asyncio.Lock()
         self._recv_task = asyncio.ensure_future(self._recv_loop())
+        if self.auth_token is not None:
+            # system-user security context: authenticate the connection
+            # before any call rides it (SystemUserRunnable analog)
+            try:
+                await self.call("Auth.handshake", token=self.auth_token)
+            except BaseException:
+                await self.close()
+                raise
         return self
 
     async def close(self) -> None:

@@ -30,8 +30,21 @@ class RpcError(Exception):
         self.code = code
 
 
+def _default(o):
+    """Wire coercion for entity payloads: enums marshal as their value
+    (the REST layer does the same). Anything else still raises — a
+    handler returning an unconverted dataclass/bytes must fail loudly,
+    not ship its repr."""
+    import enum
+
+    if isinstance(o, enum.Enum):
+        return o.value if isinstance(o.value, (str, int)) else o.name
+    raise TypeError(
+        f"Object of type {o.__class__.__name__} is not RPC-serializable")
+
+
 def encode_frame(obj: dict[str, Any]) -> bytes:
-    body = json.dumps(obj, separators=(",", ":")).encode()
+    body = json.dumps(obj, separators=(",", ":"), default=_default).encode()
     if len(body) > MAX_FRAME:
         raise RpcError(f"frame too large: {len(body)}", 413)
     return struct.pack(">I", len(body)) + body

@@ -21,13 +21,15 @@ from typing import Any
 import numpy as np
 from aiohttp import web
 
-from sitewhere_tpu.commands.model import CommandParameter, DeviceCommand, ParameterType
+from sitewhere_tpu.commands.model import (CommandParameter, ParameterType,
+                                          command_from_json)
 from sitewhere_tpu.core.types import EventType
 from sitewhere_tpu.ingest.decoders import request_from_envelope
 from sitewhere_tpu.ingest.requests import EventDecodeException
 from sitewhere_tpu.instance.auth import AUTH_ADMIN, AuthenticationError, JwtError
 from sitewhere_tpu.instance.instance import SiteWhereTpuInstance
-from sitewhere_tpu.management.entities import DuplicateToken, EntityNotFound
+from sitewhere_tpu.management.entities import (DuplicateToken, EntityNotFound,
+                                               entity_json, paged_json)
 
 JSON = "application/json"
 
@@ -64,24 +66,8 @@ def _meta_dict(meta) -> dict:
             "updatedDateMs": meta.updated_ms, "metadata": meta.metadata}
 
 
-def _entity(obj, **extra) -> dict:
-    out = dataclasses.asdict(obj)
-    meta = out.pop("meta", None)
-    if meta:
-        out.update({"token": meta["token"], "createdDateMs": meta["created_ms"],
-                    "updatedDateMs": meta["updated_ms"]})
-    out.update(extra)
-    return out
-
-
-def _paged(res) -> dict:
-    return {
-        "numResults": res.total,
-        "page": res.page,
-        "pageSize": res.page_size,
-        "results": [(_entity(e) if hasattr(e, "meta") else dataclasses.asdict(e))
-                    for e in res.results],
-    }
+_entity = entity_json
+_paged = paged_json
 
 
 @web.middleware
@@ -478,15 +464,11 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     async def create_command(request: web.Request):
         body = await request.json()
-        params = tuple(
-            CommandParameter(p["name"], ParameterType(p.get("type", "String")),
-                             p.get("required", False))
-            for p in body.get("parameters", [])
-        )
-        cmd = DeviceCommand(
-            token=body["token"], device_type=request.match_info["token"],
-            name=body["name"], namespace=body.get("namespace", "http://sitewhere/tpu"),
-            description=body.get("description", ""), parameters=params,
+        cmd = command_from_json(
+            body["token"], request.match_info["token"], body["name"],
+            namespace=body.get("namespace", "http://sitewhere/tpu"),
+            description=body.get("description", ""),
+            parameters=body.get("parameters"),
         )
         inst.command_registry.create(cmd)
         return json_response(dataclasses.asdict(cmd), status=201)
@@ -1419,15 +1401,29 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     r.add_delete("/api/devicegroups/{token}/elements", delete_group_elements)
 
     # ---- event lookups by id / alternate id (reference: DeviceEvents.java)
+    def _event_lookup_tenant(request: web.Request) -> str | None:
+        """Ids are enumerable ring positions: a non-admin caller must be
+        tenant-bound (X-SiteWhere-Tenant-Id) so other tenants' rows read
+        as absent; admins get the instance-wide view."""
+        tenant = request.get("tenant")
+        if tenant is None and AUTH_ADMIN not in request.get(
+                "authorities", []):
+            raise web.HTTPForbidden(
+                text='{"error": "tenant header required"}',
+                content_type=JSON)
+        return tenant
+
     async def get_event_by_id(request: web.Request):
-        ev = inst.engine.get_event(int(request.match_info["eventId"]))
+        ev = inst.engine.get_event(int(request.match_info["eventId"]),
+                                   tenant=_event_lookup_tenant(request))
         if ev is None:
             raise EntityNotFound("unknown or expired event id")
         return json_response(ev)
 
     async def get_event_by_alternate(request: web.Request):
         res = inst.engine.query_events(
-            alternate_id=request.match_info["alternateId"], limit=1)
+            alternate_id=request.match_info["alternateId"], limit=1,
+            tenant=_event_lookup_tenant(request))
         if not res["events"]:
             raise EntityNotFound("no event with that alternate id")
         return json_response(res["events"][0])
