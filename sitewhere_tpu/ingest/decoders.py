@@ -273,6 +273,57 @@ def encode_binary_request(req: DecodedRequest) -> bytes:
     return out
 
 
+def binary_token_of(payload: bytes) -> str | None:
+    """Device token of one binary wire payload WITHOUT a full decode —
+    the cluster router's partition key (it needs only the token, like the
+    Kafka producer keying on deviceToken)."""
+    if len(payload) < 4 or payload[0] != _BIN_MAGIC_VERSION:
+        return None
+    (n,) = struct.unpack_from("<H", payload, 2)
+    tok = payload[4:4 + n]
+    if len(tok) != n:
+        return None
+    try:
+        return tok.decode()
+    except UnicodeDecodeError:
+        return None
+
+
+def envelope_from_request(req: DecodedRequest) -> dict:
+    """Inverse of request_from_envelope: re-serialize a DecodedRequest as
+    the DeviceRequest JSON envelope, so single events route across cluster
+    ranks on the same wire shape devices send (round-trip tested)."""
+    body: dict = {}
+    if req.event_ts_ms is not None:
+        body["eventDate"] = req.event_ts_ms
+    if req.alternate_id is not None:
+        body["alternateId"] = req.alternate_id
+    if req.metadata:
+        body["metadata"] = dict(req.metadata)
+    if req.type is RequestType.DEVICE_MEASUREMENT:
+        body["measurements"] = dict(req.measurements or {})
+    elif req.type is RequestType.DEVICE_LOCATION:
+        body["latitude"] = req.latitude
+        body["longitude"] = req.longitude
+        body["elevation"] = req.elevation
+    elif req.type is RequestType.DEVICE_ALERT:
+        body["type"] = req.alert_type
+        body["level"] = req.alert_level.name.capitalize()
+        body["message"] = req.alert_message
+    elif req.type is RequestType.ACKNOWLEDGE:
+        body["originatingEventId"] = req.originating_event_id
+        body["response"] = req.response
+    elif req.type is RequestType.DEVICE_STATE_CHANGE:
+        body["attribute"] = req.attribute
+        body["type"] = req.state_type
+        body["previousState"] = req.previous_state
+        body["newState"] = req.new_state
+    else:
+        body.update(req.extras or {})
+    return {"deviceToken": req.device_token, "type": req.type.value,
+            "tenant": req.tenant, "request": body}
+
+
 class BinaryEventDecoder:
     """Decode the compact flat binary format (the reference's
     sources/decoder/protobuf/ProtobufDeviceEventDecoder slot)."""

@@ -1,0 +1,586 @@
+"""Multi-process product runtime: DistributedEngine replicas + router.
+
+The reference deploys every service as horizontally scaled replicas over
+partitioned Kafka consumer groups — each replica runs the FULL product
+behavior for its partitions
+(service-outbound-connectors/.../kafka/KafkaOutboundConnectorHost.java:43-257),
+and gRPC routers dispatch calls into the right engine from any node
+(service-device-state/.../grpc/DeviceStateRouter.java:62-72). This module
+is that deployment model for the TPU build:
+
+  * every rank runs a complete ``DistributedEngine`` — string tokens, WAL,
+    archive, feeds — over ITS chips, for the devices it OWNS;
+  * ownership is a stable hash of the device-token STRING
+    (``owner_rank``), not interner order — so every rank routes the same
+    token to the same owner without any shared state. This is the
+    token-keyed Kafka partitioner (EventSourcesManager.java:183) applied
+    at the process level;
+  * ingest accepted at any rank forwards the raw payload bytes of
+    remote-owned events to their owner over the authenticated control
+    RPC (rpc/protocol.py) — decode, WAL, dedup, and registration all
+    happen exactly once, AT the owner, in its own dictionary space.
+    Interner federation therefore needs no cross-rank translation
+    tables: the owning rank's interners are authoritative by
+    construction (route-then-decode, like a Kafka producer sending raw
+    bytes to the partition's broker);
+  * reads from any rank route (device/state lookups → owner) or fan out
+    and merge (event queries, state search, metrics) — the
+    ``DeviceStateRouter`` pattern — so REST served from ANY rank returns
+    identical results;
+  * event ids are cluster-global: ``local_id * n_ranks + rank`` —
+    bijective, so by-id lookups route without coordination.
+
+Within a rank, scaling stays TPU-native (ShardedEngine's shard_map step +
+ICI collectives); ACROSS ranks the data plane is this replica model over
+DCN, mirroring Kafka's role at the pod boundary (SURVEY.md §2.9).
+
+Deployment rule: serve the cluster RPC on its OWN event loop (thread),
+separate from any loop whose handlers call the ClusterEngine facade
+(e.g. the REST gateway). Facade calls block synchronously on peer RPC;
+if the blocked loop is also the only one answering incoming cluster RPC,
+two ranks fanning out at each other deadlock. ``register_cluster_rpc``
+handlers bind to the local engine only, so a dedicated RPC loop can
+always answer (cluster_demo.py wires it this way).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import dataclasses
+import json
+import logging
+import threading
+import time
+from typing import Any
+
+from sitewhere_tpu.core.events import EpochBase
+from sitewhere_tpu.engine import AssignmentInfo, DeviceInfo
+from sitewhere_tpu.parallel.distributed import (DistributedConfig,
+                                                DistributedEngine)
+
+logger = logging.getLogger(__name__)
+
+_FNV_OFFSET = 0xcbf29ce484222325
+_FNV_PRIME = 0x100000001b3
+_MASK64 = (1 << 64) - 1
+
+
+def owner_rank(token: str, n_ranks: int) -> int:
+    """Owning rank of a device token: FNV-1a over the token STRING —
+    stable across processes, restarts, and interner orders (the process-
+    level Kafka partitioner)."""
+    h = _FNV_OFFSET
+    for b in token.encode():
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h % n_ranks
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    """One rank's view of the cluster."""
+
+    rank: int
+    n_ranks: int
+    peers: list[str]                  # RPC "host:port" per rank
+    secret: str                       # shared JWT secret (cross-rank auth)
+    epoch_base_unix_s: float          # ONE epoch base for the whole
+                                      # cluster so merged timestamps agree
+    engine: DistributedConfig = dataclasses.field(
+        default_factory=DistributedConfig)
+    connect_timeout_s: float = 30.0
+
+
+class _SyncPeer:
+    """Synchronous facade over one RpcClient: a background event loop owns
+    the connection; ``call()`` blocks the calling thread only (the engine
+    surface is synchronous, like the reference's blocking gRPC stubs)."""
+
+    def __init__(self, addr: str, auth_token: str, timeout_s: float = 30.0):
+        host, _, port = addr.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.auth_token = auth_token
+        self.timeout_s = timeout_s
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._loop.run_forever,
+                                        daemon=True)
+        self._thread.start()
+        self._client = None
+        self._lock = threading.Lock()
+
+    def _run(self, coro):
+        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
+            self.timeout_s + 30.0)
+
+    def _connect(self):
+        from sitewhere_tpu.rpc.client import RpcClient
+
+        deadline = time.monotonic() + self.timeout_s
+        last: Exception | None = None
+        while time.monotonic() < deadline:
+            try:
+                return self._run(RpcClient(
+                    host=self.host, port=self.port,
+                    auth_token=self.auth_token).connect())
+            except (ConnectionError, OSError) as e:
+                last = e
+                time.sleep(0.1)
+        raise ConnectionError(
+            f"peer {self.host}:{self.port} unreachable: {last}")
+
+    def call(self, method: str, **params: Any) -> Any:
+        with self._lock:
+            if self._client is None:
+                self._client = self._connect()
+            client = self._client
+        try:
+            return self._run(client.call(method, **params))
+        except ConnectionError:
+            # one reconnect attempt: the peer may have restarted (crash
+            # recovery) — the reference's gRPC channels reconnect the same
+            # way
+            with self._lock:
+                if self._client is client:
+                    self._run(client.close())
+                    self._client = self._connect()
+                client = self._client
+            return self._run(client.call(method, **params))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._client is not None:
+                try:
+                    self._run(self._client.close())
+                except Exception:
+                    pass
+                self._client = None
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread.join(timeout=5)
+
+
+def _b64(payloads: list[bytes]) -> list[str]:
+    return [base64.b64encode(p).decode() for p in payloads]
+
+
+def _unb64(payloads: list[str]) -> list[bytes]:
+    return [base64.b64decode(p) for p in payloads]
+
+
+def _merge_counts(dicts: list[dict]) -> dict:
+    out: dict = {}
+    for d in dicts:
+        for k, v in d.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = out.get(k, 0) + v
+            elif isinstance(v, list):
+                out.setdefault(k, []).extend(v)
+            else:
+                out.setdefault(k, v)
+    return out
+
+
+class _MergedDevices:
+    """Read-only merged view of every rank's device mirror, shaped like
+    the dict the management layer iterates (``.values()`` /
+    ``.get(local_id)`` / ``len``). Local ids are rank-scoped, so ``get``
+    answers from the local rank only (feed/connector records carry local
+    ids of the rank that produced them)."""
+
+    def __init__(self, cluster: "ClusterEngine"):
+        self._c = cluster
+
+    def values(self):
+        out = list(self._c.local.devices.values())
+        for r in range(self._c.n_ranks):
+            if r == self._c.rank:
+                continue
+            out.extend(DeviceInfo(**d) for d in
+                       self._c._peer(r).call("Cluster.listDeviceInfos"))
+        return out
+
+    def get(self, key, default=None):
+        return self._c.local.devices.get(key, default)
+
+    def __len__(self) -> int:
+        n = len(self._c.local.devices)
+        for r in range(self._c.n_ranks):
+            if r != self._c.rank:
+                n += self._c._peer(r).call("Cluster.deviceCount")
+        return n
+
+
+class _ClusterFeed:
+    """Wraps a rank-local feed consumer, translating event ids to the
+    cluster-global id space so records can be re-fetched via
+    ``ClusterEngine.get_event`` from ANY rank."""
+
+    def __init__(self, feed, rank: int, n_ranks: int):
+        self._feed = feed
+        self._rank = rank
+        self._n = n_ranks
+
+    def poll(self, *a, **kw):
+        return [dataclasses.replace(
+            rec, event_id=rec.event_id * self._n + self._rank)
+            for rec in self._feed.poll(*a, **kw)]
+
+    def __getattr__(self, name):
+        return getattr(self._feed, name)
+
+
+class ClusterEngine:
+    """The any-rank product surface: a local DistributedEngine for owned
+    devices plus RPC routing/fan-out to peers. Everything not overridden
+    here (config, interners, staging, WAL, archive, feeds) delegates to
+    the local engine — handlers registered by ``register_cluster_rpc``
+    always bind to ``.local``, so routed calls never recurse."""
+
+    def __init__(self, config: ClusterConfig,
+                 local: DistributedEngine | None = None):
+        self.cluster_config = config
+        self.rank = config.rank
+        self.n_ranks = config.n_ranks
+        self.local = local if local is not None else DistributedEngine(
+            config.engine)
+        self.local.epoch = EpochBase(config.epoch_base_unix_s)
+        self.epoch = self.local.epoch
+        self._peers: dict[int, _SyncPeer] = {}
+        self._peers_lock = threading.Lock()
+        self._auth_token = cluster_system_jwt(config.secret)
+
+    # ------------------------------------------------------------- plumbing
+    def __getattr__(self, name):
+        return getattr(self.local, name)
+
+    def _peer(self, rank: int) -> _SyncPeer:
+        # locked: concurrent REST/executor threads racing the lazy create
+        # would each spawn (and one would leak) a client loop thread
+        with self._peers_lock:
+            peer = self._peers.get(rank)
+            if peer is None:
+                peer = self._peers[rank] = _SyncPeer(
+                    self.cluster_config.peers[rank], self._auth_token,
+                    self.cluster_config.connect_timeout_s)
+            return peer
+
+    def owner(self, token: str) -> int:
+        return owner_rank(token, self.n_ranks)
+
+    def _route(self, _token: str, _local_fn, _method: str, **params):
+        r = self.owner(_token)
+        if r == self.rank:
+            return _local_fn()
+        return self._peer(r).call(_method, **params)
+
+    def close(self) -> None:
+        for peer in self._peers.values():
+            peer.close()
+        self._peers.clear()
+
+    # --------------------------------------------------------------- ingest
+    def _partition_payloads(self, payloads: list[bytes],
+                            token_of) -> dict[int, list[bytes]]:
+        by_rank: dict[int, list[bytes]] = {}
+        for p in payloads:
+            tok = token_of(p)
+            # undecodable/tokenless payloads stay local: the local engine's
+            # dead-letter path owns them
+            r = self.rank if tok is None else owner_rank(tok, self.n_ranks)
+            by_rank.setdefault(r, []).append(p)
+        return by_rank
+
+    @staticmethod
+    def _json_token(p: bytes) -> str | None:
+        try:
+            env = json.loads(p)
+            tok = env.get("deviceToken") or env.get("hardwareId")
+            return str(tok) if tok else None
+        except (ValueError, AttributeError):
+            return None
+
+    def ingest_json_batch(self, payloads: list[bytes],
+                          tenant: str = "default") -> dict:
+        """Partition the batch by owning rank (token-hash, like the Kafka
+        producer partitioner) and forward raw remote payloads — WAL,
+        decode, and registration happen once, at each owner."""
+        by_rank = self._partition_payloads(payloads, self._json_token)
+        summaries = []
+        for r, plist in by_rank.items():
+            if r == self.rank:
+                summaries.append(self.local.ingest_json_batch(plist, tenant))
+            else:
+                summaries.append(self._peer(r).call(
+                    "Cluster.ingestJson", payloads=_b64(plist),
+                    tenant=tenant))
+        return _merge_counts(summaries)
+
+    def ingest_binary_batch(self, payloads: list[bytes],
+                            tenant: str = "default") -> dict:
+        from sitewhere_tpu.ingest.decoders import binary_token_of
+
+        by_rank = self._partition_payloads(payloads, binary_token_of)
+        summaries = []
+        for r, plist in by_rank.items():
+            if r == self.rank:
+                summaries.append(
+                    self.local.ingest_binary_batch(plist, tenant))
+            else:
+                summaries.append(self._peer(r).call(
+                    "Cluster.ingestBinary", payloads=_b64(plist),
+                    tenant=tenant))
+        return _merge_counts(summaries)
+
+    def process(self, req) -> None:
+        r = self.owner(req.device_token)
+        if r == self.rank:
+            return self.local.process(req)
+        from sitewhere_tpu.ingest.decoders import envelope_from_request
+
+        self._peer(r).call("Cluster.processEnvelope",
+                           envelope=envelope_from_request(req),
+                           tenant=req.tenant)
+
+    def flush(self) -> dict:
+        """Flush every rank — after this, queries anywhere see everything
+        accepted anywhere (the test/REST consistency point)."""
+        out = [self.local.flush()]
+        for r in range(self.n_ranks):
+            if r != self.rank:
+                out.append(self._peer(r).call("Cluster.flush"))
+        return _merge_counts([s for s in out if s])
+
+    # ---------------------------------------------------------------- admin
+    def register_device(self, token: str, device_type: str | None = None,
+                        tenant: str = "default", area: str | None = None,
+                        customer: str | None = None,
+                        metadata: dict | None = None):
+        r = self.owner(token)
+        if r == self.rank:
+            return self.local.register_device(token, device_type, tenant,
+                                              area, customer, metadata)
+        self._peer(r).call("Cluster.registerDevice", token=token,
+                           deviceType=device_type, tenant=tenant, area=area,
+                           customer=customer, metadata=metadata)
+
+    def update_device(self, token: str, device_type: str | None = None,
+                      area: str | None = None, customer: str | None = None,
+                      metadata: dict | None = None):
+        r = self.owner(token)
+        if r == self.rank:
+            return self.local.update_device(token, device_type, area,
+                                            customer, metadata)
+        res = self._peer(r).call(
+            "Cluster.updateDevice", token=token, deviceType=device_type,
+            area=area, customer=customer, metadata=metadata)
+        if res is None:
+            raise KeyError(token)
+
+    def delete_device(self, token: str) -> bool:
+        return self._route(
+            token, lambda: self.local.delete_device(token),
+            "Cluster.deleteDevice", token=token)
+
+    # ---------------------------------------------------------------- reads
+    def get_device(self, token: str) -> DeviceInfo | None:
+        d = self._route(token, lambda: self.local.get_device(token),
+                        "Cluster.getDevice", token=token)
+        if d is None or isinstance(d, DeviceInfo):
+            return d
+        return DeviceInfo(**d)
+
+    def list_assignments(self, device_token: str | None = None,
+                         **kw) -> list[AssignmentInfo]:
+        if device_token is not None:
+            res = self._route(
+                device_token,
+                lambda: self.local.list_assignments(device_token, **kw),
+                "Cluster.listAssignments", token=device_token, **kw)
+            return [a if isinstance(a, AssignmentInfo) else
+                    AssignmentInfo(**a) for a in res]
+        out = list(self.local.list_assignments(None, **kw))
+        for r in range(self.n_ranks):
+            if r != self.rank:
+                out.extend(AssignmentInfo(**a) for a in self._peer(r).call(
+                    "Cluster.listAssignments", token=None, **kw))
+        return out
+
+    def get_device_state(self, token: str) -> dict | None:
+        return self._route(
+            token, lambda: self.local.get_device_state(token),
+            "Cluster.getDeviceState", token=token)
+
+    def search_device_states(self, **kw) -> list[dict]:
+        out = list(self.local.search_device_states(**kw))
+        for r in range(self.n_ranks):
+            if r != self.rank:
+                out.extend(self._peer(r).call(
+                    "Cluster.searchDeviceStates", **kw))
+        limit = kw.get("limit")
+        if limit is not None:
+            out = out[:limit]
+        return out
+
+    def query_events(self, **kw) -> dict:
+        """Fan out to every rank, merge newest-first — the cross-partition
+        query the reference's REST tier performs over per-service gRPC."""
+        results = [self.local.query_events(**kw)]
+        for r in range(self.n_ranks):
+            if r != self.rank:
+                results.append(self._peer(r).call(
+                    "Cluster.queryEvents", **kw))
+        events = [e for res in results for e in res["events"]]
+        events.sort(key=lambda e: (-e.get("eventDateMs", 0),
+                                   -e.get("receivedDateMs", 0),
+                                   e.get("deviceToken") or ""))
+        limit = kw.get("limit", 100)
+        return {"total": sum(res["total"] for res in results),
+                "events": events[:limit]}
+
+    def get_event(self, event_id: int,
+                  tenant: str | None = None) -> dict | None:
+        """Cluster-global by-id lookup: ids are ``local * n_ranks + rank``
+        so the owning rank is recoverable from the id alone."""
+        if event_id < 0:
+            return None
+        r = event_id % self.n_ranks
+        local_id = event_id // self.n_ranks
+        if r == self.rank:
+            ev = self.local.get_event(local_id, tenant=tenant)
+        else:
+            ev = self._peer(r).call("Cluster.getEvent", eventId=local_id,
+                                    tenant=tenant)
+        if ev is not None:
+            ev["eventId"] = event_id
+        return ev
+
+    def make_feed_consumer(self, group_id: str, **kw):
+        """Rank-local feed (outbound connectors run per-rank over the
+        rank's partition, exactly as the reference's connector hosts
+        consume per-partition Kafka groups), with event ids translated to
+        the cluster-global space."""
+        return _ClusterFeed(self.local.make_feed_consumer(group_id, **kw),
+                            self.rank, self.n_ranks)
+
+    def metrics(self) -> dict:
+        out = [self.local.metrics()]
+        for r in range(self.n_ranks):
+            if r != self.rank:
+                out.append(self._peer(r).call("Cluster.metrics"))
+        return _merge_counts(out)
+
+    @property
+    def devices(self) -> _MergedDevices:
+        return _MergedDevices(self)
+
+
+def cluster_system_jwt(secret: str) -> str:
+    """System token for cross-rank calls, minted from the shared cluster
+    secret (the reference's system-user JWT context)."""
+    from sitewhere_tpu.instance.auth import DEFAULT_ROLES, JwtService
+
+    return JwtService(secret=secret.encode(), expiration_s=24 * 3600)\
+        .generate("cluster-system", DEFAULT_ROLES["admin"])
+
+
+def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
+    """Register the cross-rank data/admin plane over the LOCAL engine —
+    the per-service gRPC surface peers dispatch into
+    (DeviceStateRouter.java:62-72). Handlers bind to the concrete engine,
+    never the ClusterEngine facade, so routed calls cannot recurse."""
+
+    def ingest_json(payloads: list, tenant: str = "default"):
+        return engine.ingest_json_batch(_unb64(payloads), tenant)
+
+    def ingest_binary(payloads: list, tenant: str = "default"):
+        return engine.ingest_binary_batch(_unb64(payloads), tenant)
+
+    def process_envelope(envelope: dict, tenant: str = "default"):
+        from sitewhere_tpu.ingest.decoders import request_from_envelope
+
+        req = request_from_envelope(envelope)
+        req.tenant = tenant
+        engine.process(req)
+        return {"accepted": True}
+
+    def register_device(token: str, deviceType: str = None,
+                        tenant: str = "default", area: str = None,
+                        customer: str = None, metadata: dict = None):
+        engine.register_device(token, deviceType, tenant, area, customer,
+                               metadata)
+        return {"registered": True}
+
+    def update_device(token: str, deviceType: str = None, area: str = None,
+                      customer: str = None, metadata: dict = None):
+        try:
+            engine.update_device(token, deviceType, area, customer, metadata)
+        except KeyError:
+            return None
+        return {"updated": True}
+
+    def delete_device(token: str):
+        return engine.delete_device(token)
+
+    def get_device(token: str):
+        info = engine.get_device(token)
+        return dataclasses.asdict(info) if info is not None else None
+
+    def list_assignments(token: str = None, **kw):
+        return [dataclasses.asdict(a)
+                for a in engine.list_assignments(token, **kw)]
+
+    def get_device_state(token: str):
+        return engine.get_device_state(token)
+
+    def search_device_states(**kw):
+        return engine.search_device_states(**kw)
+
+    def query_events(**kw):
+        return engine.query_events(**kw)
+
+    def get_event(eventId: int, tenant: str = None):
+        return engine.get_event(eventId, tenant=tenant)
+
+    def list_device_infos():
+        return [dataclasses.asdict(i) for i in engine.devices.values()]
+
+    def device_count():
+        return len(engine.devices)
+
+    def metrics():
+        return engine.metrics()
+
+    def flush():
+        return engine.flush()
+
+    for name, fn in {
+        "Cluster.ingestJson": ingest_json,
+        "Cluster.ingestBinary": ingest_binary,
+        "Cluster.processEnvelope": process_envelope,
+        "Cluster.registerDevice": register_device,
+        "Cluster.updateDevice": update_device,
+        "Cluster.deleteDevice": delete_device,
+        "Cluster.getDevice": get_device,
+        "Cluster.listAssignments": list_assignments,
+        "Cluster.getDeviceState": get_device_state,
+        "Cluster.searchDeviceStates": search_device_states,
+        "Cluster.queryEvents": query_events,
+        "Cluster.getEvent": get_event,
+        "Cluster.listDeviceInfos": list_device_infos,
+        "Cluster.deviceCount": device_count,
+        "Cluster.metrics": metrics,
+        "Cluster.flush": flush,
+    }.items():
+        srv.register(name, fn)
+
+
+def build_cluster_rpc(engine: DistributedEngine, secret: str):
+    """The rank's RPC server: cluster data plane, authenticated with the
+    shared cluster secret (unauthenticated peers are rejected exactly like
+    the instance RPC)."""
+    from sitewhere_tpu.instance.auth import JwtService
+    from sitewhere_tpu.rpc.server import RpcServer
+
+    jwt = JwtService(secret=secret.encode(), expiration_s=24 * 3600)
+    srv = RpcServer(authenticator=jwt.validate)
+    register_cluster_rpc(srv, engine)
+    return srv

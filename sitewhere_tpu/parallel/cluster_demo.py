@@ -1,0 +1,327 @@
+"""Two-process PRODUCT runtime job: DistributedEngine per rank + crash.
+
+This is the deployment proof for the cluster layer (parallel/cluster.py):
+two OS processes, each running a complete DistributedEngine — string
+tokens, WAL, feeds — plus its authenticated cluster RPC server and a full
+REST gateway. Both ranks ingest batches naming devices of BOTH ranks (raw
+payloads forward to owners, the Kafka-producer analog), then each rank
+logs in to BOTH REST gateways over HTTP basic auth and asserts the
+listings/state agree byte-for-byte regardless of which rank serves them
+(KafkaOutboundConnectorHost.java:43-257 replicas +
+DeviceStateRouter.java:62-72 routing). Then rank 1 is crashed (os._exit
+with events that live only in its WAL tail), restarted in recovery mode,
+and the cluster must serve the FULL pre-crash history from either rank
+and stay writable — the durability story the reference delegates to
+Kafka offsets + k8s restarts (SURVEY.md §5.4/5.5).
+
+Phases hand off through marker files in the shared scratch dir; the
+parent (``spawn_cluster_demo``) orchestrates the crash/restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+import time
+
+N_PER_RANK = 3          # devices owned per rank in the demo traffic
+PHASE_TIMEOUT_S = 120.0
+
+
+def _wait_for(path: pathlib.Path, timeout_s: float = PHASE_TIMEOUT_S) -> None:
+    deadline = time.monotonic() + timeout_s
+    while not path.exists():
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"phase marker {path.name} never appeared")
+        time.sleep(0.05)
+
+
+def _tokens_for(rank: int, n_ranks: int, n: int) -> list[str]:
+    from sitewhere_tpu.parallel.cluster import owner_rank
+
+    out, i = [], 0
+    while len(out) < n:
+        tok = f"cd-{i}"
+        if owner_rank(tok, n_ranks) == rank:
+            out.append(tok)
+        i += 1
+    return out
+
+
+def _meas(token: str, name: str, value: float, ts_ms: int) -> bytes:
+    return json.dumps({
+        "deviceToken": token, "type": "DeviceMeasurements",
+        "request": {"measurements": {name: value},
+                    "eventDate": ts_ms}}).encode()
+
+
+def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
+                rest1: int, base_s: float, devices_per_proc: int = 2,
+                recover: bool = False) -> None:
+    """One rank of the 2-process product job. Prints CLUSTER_OK /
+    CLUSTER_RECOVERED lines; any assertion failure exits nonzero."""
+    os.environ.pop("XLA_FLAGS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)  # surface handler errors
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", devices_per_proc)
+
+    import asyncio
+
+    import aiohttp
+    from aiohttp import web
+
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+    from sitewhere_tpu.parallel.cluster import (ClusterConfig, ClusterEngine,
+                                                build_cluster_rpc)
+    from sitewhere_tpu.parallel.distributed import (DistributedConfig,
+                                                    recover_distributed)
+    from sitewhere_tpu.web.rest import make_app
+
+    scratch_p = pathlib.Path(scratch)
+    peers = [f"127.0.0.1:{rpc0}", f"127.0.0.1:{rpc1}"]
+    rests = [rest0, rest1]
+    secret = "cluster-demo-secret"
+    base_ms = int(base_s * 1000)
+    ecfg = DistributedConfig(
+        n_shards=devices_per_proc, device_capacity_per_shard=64,
+        token_capacity_per_shard=128, assignment_capacity_per_shard=128,
+        store_capacity_per_shard=512, channels=4,
+        batch_capacity_per_shard=16,
+        wal_dir=str(scratch_p / f"wal-r{rank}"))
+    ccfg = ClusterConfig(rank=rank, n_ranks=2, peers=peers, secret=secret,
+                         epoch_base_unix_s=base_s, engine=ecfg,
+                         connect_timeout_s=60.0)
+    if recover:
+        local = recover_distributed(scratch_p / "snap-r1",
+                                    scratch_p / "wal-r1")
+        cluster = ClusterEngine(ccfg, local=local)
+    else:
+        cluster = ClusterEngine(ccfg)
+    inst = SiteWhereTpuInstance(InstanceConfig(engine=EngineConfig()),
+                                engine=cluster)
+    toks0 = _tokens_for(0, 2, N_PER_RANK)
+    toks1 = _tokens_for(1, 2, N_PER_RANK)
+    both = toks0 + toks1
+
+    async def rest_snapshot(session: aiohttp.ClientSession,
+                            port: int) -> dict:
+        """Login (basic auth, the reference's BasicAuthForJwt flow) and
+        read the event listing + per-device state from one gateway."""
+        import base64
+
+        basic = base64.b64encode(b"admin:password").decode()
+        async with session.get(
+                f"http://127.0.0.1:{port}/api/authapi/jwt",
+                headers={"Authorization": f"Basic {basic}"}) as r:
+            assert r.status == 200, (port, r.status, await r.text())
+            jwt = (await r.json())["token"]
+        h = {"Authorization": f"Bearer {jwt}"}
+        out: dict = {}
+        async with session.get(
+                f"http://127.0.0.1:{port}/api/events?pageSize=100",
+                headers=h) as r:
+            assert r.status == 200, (port, r.status, await r.text())
+            listing = await r.json()
+            out["events"] = [(e["deviceToken"], e["eventDateMs"],
+                              e.get("measurements"))
+                             for e in listing["events"]]
+            out["total"] = listing["total"]
+        out["state"] = {}
+        for t in both:
+            async with session.get(
+                    f"http://127.0.0.1:{port}/api/devices/{t}/state",
+                    headers=h) as r:
+                assert r.status == 200, (port, t, r.status, await r.text())
+                st = await r.json()
+                out["state"][t] = (st["measurements"], st["presence"])
+        return out
+
+    import threading
+
+    async def main() -> None:
+        # The cluster RPC server gets its OWN event loop: its handlers
+        # touch only the local engine, so they can always answer even
+        # while the REST loop blocks inside a fan-out call to the peer.
+        # One shared loop would deadlock: both ranks' REST handlers wait
+        # on each other's RPC while holding the only loop that serves it.
+        srv = build_cluster_rpc(cluster.local, secret)
+        rpc_loop = asyncio.new_event_loop()
+        threading.Thread(target=rpc_loop.run_forever, daemon=True).start()
+        asyncio.run_coroutine_threadsafe(
+            srv.start(port=int(peers[rank].rsplit(":", 1)[1])),
+            rpc_loop).result(15)
+        runner = web.AppRunner(make_app(inst))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", rests[rank])
+        await site.start()
+        loop = asyncio.get_event_loop()
+
+        def blocking(fn, *a, **kw):
+            # engine/cluster calls (and phase-marker waits) block on the
+            # peer: keep them OFF the loop so our RPC/REST servers can
+            # answer the peer's calls meanwhile — waiting on the loop
+            # while the peer's forwarded ingest needs our server is a
+            # distributed deadlock
+            return loop.run_in_executor(None, lambda: fn(*a, **kw))
+
+        if not recover:
+            # ---- phase 1: mixed ingest from BOTH ranks ----------------
+            await blocking(
+                cluster.ingest_json_batch,
+                [_meas(t, "temp", rank * 100.0 + i, base_ms + 1000 * rank + i)
+                 for i, t in enumerate(both)])
+            (scratch_p / f"ingested-r{rank}").touch()
+            await blocking(_wait_for, scratch_p / f"ingested-r{1 - rank}")
+            await blocking(cluster.flush)
+            async with aiohttp.ClientSession() as session:
+                mine = await rest_snapshot(session, rests[rank])
+                theirs = await rest_snapshot(session, rests[1 - rank])
+            assert mine == theirs, (rank, mine, theirs)
+            assert mine["total"] == 2 * len(both), mine["total"]
+            m = await blocking(cluster.metrics)
+            assert m["persisted"] == 2 * len(both), m
+            print(f"CLUSTER_OK rank={rank} phase=1 "
+                  f"total={mine['total']} persisted={m['persisted']} "
+                  f"rest_agree=1", flush=True)
+
+            if rank == 1:
+                # snapshot, then wait for rank 0's extra (WAL-tail-only)
+                # traffic and crash WITHOUT closing anything
+                await blocking(cluster.local.save, scratch_p / "snap-r1")
+                (scratch_p / "r1-snapshotted").touch()
+                await blocking(_wait_for, scratch_p / "extra-sent")
+                # the forwarded events are in OUR WAL (logged at ingest
+                # accept time) but NOT in the snapshot — the recovery has
+                # real work to do
+                print("CLUSTER_CRASHING rank=1", flush=True)
+                sys.stdout.flush()
+                os._exit(17)    # simulated crash: no clean shutdown
+            else:
+                await blocking(_wait_for, scratch_p / "r1-snapshotted")
+                await blocking(
+                    cluster.ingest_json_batch,
+                    [_meas(toks1[0], "temp", 777.0, base_ms + 7777)])
+                await blocking(cluster.flush)
+                (scratch_p / "extra-sent").touch()
+                # ---- phase 2: peer crashed; wait for its recovery -----
+                await blocking(_wait_for, scratch_p / "r1-recovered",
+                               timeout_s=PHASE_TIMEOUT_S * 2)
+                q = await blocking(
+                    cluster.query_events, device_token=toks1[0])
+                assert q["total"] == 3, q   # 2 original + WAL-tail event
+                assert q["events"][0]["measurements"]["temp"] == 777.0
+                # the cluster stays writable through the recovered rank
+                await blocking(
+                    cluster.ingest_json_batch,
+                    [_meas(toks1[0], "temp", 888.0, base_ms + 8888)])
+                await blocking(cluster.flush)
+                async with aiohttp.ClientSession() as session:
+                    mine = await rest_snapshot(session, rests[0])
+                    theirs = await rest_snapshot(session, rests[1])
+                assert mine == theirs, (mine, theirs)
+                assert mine["total"] == 2 * len(both) + 2
+                print(f"CLUSTER_OK rank=0 phase=2 "
+                      f"total={mine['total']} "
+                      f"recovered_peer_serves_history=1", flush=True)
+                (scratch_p / "r0-done").touch()
+        else:
+            # ---- restarted rank 1: WAL replayed over the snapshot -----
+            q = await blocking(cluster.local.query_events,
+                               device_token=toks1[0])
+            assert q["total"] == 3, q   # snapshot(2) + WAL tail(1)
+            assert q["events"][0]["measurements"]["temp"] == 777.0
+            print(f"CLUSTER_RECOVERED rank=1 "
+                  f"replayed_total={q['total']}", flush=True)
+            (scratch_p / "r1-recovered").touch()
+            await blocking(_wait_for, scratch_p / "r0-done",
+                           timeout_s=PHASE_TIMEOUT_S * 2)
+        asyncio.run_coroutine_threadsafe(srv.stop(), rpc_loop).result(15)
+        rpc_loop.call_soon_threadsafe(rpc_loop.stop)
+        await runner.cleanup()
+        cluster.close()
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def _ports(n: int) -> list[int]:
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    out = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return out
+
+
+def _spawn(rank: int, scratch: str, ports: list[int], base_s: float,
+           devices_per_proc: int, recover: bool) -> subprocess.Popen:
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "from sitewhere_tpu.parallel.cluster_demo import worker_main;"
+        f"worker_main({rank}, {scratch!r}, {ports[0]}, {ports[1]}, "
+        f"{ports[2]}, {ports[3]}, {base_s}, {devices_per_proc}, "
+        f"recover={recover})")
+    return subprocess.Popen([sys.executable, "-c", code],
+                            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                            text=True, env=env)
+
+
+def spawn_cluster_demo(devices_per_proc: int = 2,
+                       timeout_s: float = 300.0) -> list[str]:
+    """Run the 2-process product job incl. the crash/recover phase.
+    Returns the marker lines (CLUSTER_OK x3, CLUSTER_RECOVERED)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as scratch:
+        ports = _ports(4)
+        base_s = float(int(time.time()))
+        p0 = _spawn(0, scratch, ports, base_s, devices_per_proc, False)
+        p1 = _spawn(1, scratch, ports, base_s, devices_per_proc, False)
+        deadline = time.monotonic() + timeout_s
+
+        def finish(p: subprocess.Popen, name: str) -> tuple[str, str]:
+            try:
+                return p.communicate(timeout=max(5.0, deadline -
+                                                 time.monotonic()))
+            except subprocess.TimeoutExpired:
+                for q in (p0, p1):
+                    q.kill()
+                    q.wait()
+                raise RuntimeError(f"{name} timed out")
+
+        # rank 1 crashes itself with code 17 after phase 1
+        out1, err1 = finish(p1, "rank1")
+        if p1.returncode != 17 or "CLUSTER_CRASHING" not in out1:
+            p0.kill()
+            p0.wait()
+            raise RuntimeError(
+                f"rank1 phase1 failed rc={p1.returncode}\n{out1}\n"
+                f"{err1[-2000:]}")
+        p1b = _spawn(1, scratch, ports, base_s, devices_per_proc, True)
+        out1b, err1b = finish(p1b, "rank1-recovered")
+        out0, err0 = finish(p0, "rank0")
+        errs = []
+        if p0.returncode != 0 or "CLUSTER_OK rank=0 phase=2" not in out0:
+            errs.append(f"rank0 rc={p0.returncode}\n{out0}\n{err0[-2000:]}")
+        if p1b.returncode != 0 or "CLUSTER_RECOVERED" not in out1b:
+            errs.append(
+                f"rank1b rc={p1b.returncode}\n{out1b}\n{err1b[-2000:]}")
+        if errs:
+            raise RuntimeError("cluster demo failed:\n" + "\n".join(errs))
+        lines = [ln for out in (out0, out1, out1b)
+                 for ln in out.splitlines()
+                 if ln.startswith(("CLUSTER_OK", "CLUSTER_RECOVERED"))]
+        return lines

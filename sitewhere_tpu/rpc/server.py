@@ -48,14 +48,19 @@ class RpcServer:
     def __init__(self, tenant_validator: Callable[[str], bool] | None = None,
                  authenticator: Callable[[str], dict] | None = None,
                  tenant_authorizer: Callable[[str, str, list], bool]
-                 | None = None):
+                 | None = None,
+                 unbound_authority: str | None = None):
         self.methods: dict[str, Handler] = {}
         self._tenant_scoped: dict[str, bool] = {}
         self._authority: dict[str, str | None] = {}
         self._tenant_validator = tenant_validator
         self._authenticator = authenticator
         self._tenant_authorizer = tenant_authorizer
+        # authority required to call WITHOUT a tenant binding: tenant-less
+        # calls see instance-wide data, so they are admin-plane
+        self._unbound_authority = unbound_authority
         self._server: asyncio.AbstractServer | None = None
+        self._conns: set = set()
         self.port: int | None = None
 
     def register(self, name: str, fn: Handler,
@@ -75,6 +80,11 @@ class RpcServer:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # sever live connections: wait_closed() (3.12+) waits for
+            # every handler, and an idle client would hold its handler in
+            # read_frame forever
+            for w in list(self._conns):
+                w.close()
             await self._server.wait_closed()
             self._server = None
 
@@ -84,6 +94,7 @@ class RpcServer:
         # per-connection security context (the reference's UserContext)
         conn = {"authed": self._authenticator is None,
                 "user": None, "authorities": [], "jwt_tenant": None}
+        self._conns.add(writer)
         try:
             while True:
                 frame = await read_frame(reader)
@@ -99,6 +110,7 @@ class RpcServer:
             if tasks:                           # let in-flight calls respond
                 await asyncio.gather(*tasks, return_exceptions=True)
             writer.close()
+            self._conns.discard(writer)
 
     def _handshake(self, conn: dict, params: dict) -> dict:
         try:
@@ -158,6 +170,16 @@ class RpcServer:
                 raise RpcError(f"unknown tenant {tenant!r}", 404)
             if tenant is not None:
                 authorize(tenant)
+            elif (params.get("tenant") is None
+                  and self._authenticator is not None
+                  and self._unbound_authority is not None
+                  and self._unbound_authority not in conn["authorities"]):
+                # no tenant named anywhere: the call reads/writes
+                # instance-wide (event ids are enumerable ring positions)
+                # — admin-plane only, mirroring the REST tier's gate
+                raise RpcError(
+                    "tenant binding required (or authority "
+                    f"{self._unbound_authority!r})", 403)
             if tenant is not None and self._tenant_scoped.get(method):
                 # executeInTenantEngine semantics: a tenant-bound connection
                 # operates in ITS tenant — callers cannot address another
@@ -229,7 +251,8 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         tenant_validator=lambda t: inst.tenants.tenants.try_get(t) is not None,
         authenticator=inst.jwt.validate if require_auth else None,
         tenant_authorizer=lambda t, user, auths: inst.tenants.user_can_access(
-            t, user, AUTH_ADMIN in auths))
+            t, user, AUTH_ADMIN in auths),
+        unbound_authority=AUTH_ADMIN)
 
     # --- device-management (DeviceManagementImpl.java:75-90 analog) -------
     def get_device_by_token(token: str):

@@ -169,9 +169,25 @@ def test_rpc_authority_gating():
         port = await srv.start()
         op_jwt = inst.jwt.generate(
             "op", inst.users.authorities_for(inst.users.users["op"]))
-        cli = await RpcClient(port=port, auth_token=op_jwt).connect()
+        # a non-admin WITHOUT any tenant binding is refused outright:
+        # tenant-less calls see instance-wide data (review r4)
+        unbound = await RpcClient(port=port, auth_token=op_jwt).connect()
         try:
-            # data-plane families are open to any authenticated caller
+            for method, params in (
+                    ("DeviceManagement.listDevices", {}),
+                    ("DeviceEventManagement.getDeviceEventById",
+                     {"eventId": 0}),
+                    ("DeviceEventManagement.listDeviceEvents", {})):
+                with pytest.raises(RpcError) as ei:
+                    await unbound.call(method, **params)
+                assert ei.value.code == 403, method
+        finally:
+            await unbound.close()
+        cli = await RpcClient(port=port, tenant="default",
+                              auth_token=op_jwt).connect()
+        try:
+            # tenant-bound data-plane families are open to any authorized
+            # authenticated caller
             await cli.call("DeviceManagement.createDevice", token="ag-1")
             # admin families are not
             for method, params in (
