@@ -731,14 +731,15 @@ class Engine(IngestHostMixin):
         self.archive = None
         self._rows_since_spool = 0
         if c.archive_dir:
-            from sitewhere_tpu.utils.archive import EventArchive
+            from sitewhere_tpu.utils.archive import (EventArchive,
+                                                     single_topology)
 
             acap = c.store_capacity // c.tenant_arenas
             self.archive = EventArchive(
                 c.archive_dir,
                 segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
                 max_rows_per_part=c.archive_max_rows,
-                topology=f"single/{c.tenant_arenas}",
+                topology=single_topology(c.tenant_arenas),
                 max_age_ms=c.archive_max_age_ms)
             # spool whenever any arena could be halfway to overwrite; with
             # the worst case of every staged row landing in one arena this

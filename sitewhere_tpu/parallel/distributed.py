@@ -374,7 +374,7 @@ class DistributedEngine(IngestHostMixin):
         self.archive = None
         self._rows_since_spool = 0
         if c.archive_dir:
-            from sitewhere_tpu.utils.archive import EventArchive
+            from sitewhere_tpu.utils.archive import EventArchive, mesh_topology
 
             arenas = self.state.store.cursor.shape[-1]
             acap = c.store_capacity_per_shard // arenas
@@ -382,7 +382,7 @@ class DistributedEngine(IngestHostMixin):
                 c.archive_dir,
                 segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
                 max_rows_per_part=c.archive_max_rows,
-                topology=f"mesh/{self.n_shards}x{arenas}",
+                topology=mesh_topology(self.n_shards, arenas),
                 max_age_ms=c.archive_max_age_ms)
             self._spool_trigger = max(self.archive.segment_rows,
                                       acap // 2 - c.batch_capacity_per_shard)
@@ -1634,7 +1634,10 @@ class DistributedFeedConsumer:
                             break   # deliver pre-gap events first
                         nxt = archive.next_start(part, pos)
                         nxt = oldest if nxt is None else min(nxt, oldest)
-                        self.lag_lost += nxt - pos
+                        # registered gaps (migration padding) never held
+                        # data — skipping them is not loss
+                        self.lag_lost += max(
+                            0, nxt - pos - archive.gap_rows(part, pos, nxt))
                         self.offsets[s, a] = nxt
                         pos = nxt
                         continue

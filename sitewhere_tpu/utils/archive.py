@@ -36,6 +36,18 @@ _COLUMNS = ("etype", "device", "assignment", "tenant", "area", "customer",
             "valid")
 
 
+def mesh_topology(n_shards: int, arenas: int) -> str:
+    """Canonical topology stamp of a mesh engine's archive — ONE producer
+    for the stamp the engine writes, recovery matches, and migration
+    rewrites."""
+    return f"mesh/{n_shards}x{arenas}"
+
+
+def single_topology(arenas: int) -> str:
+    """Canonical topology stamp of a single-chip engine's archive."""
+    return f"single/{arenas}"
+
+
 @dataclasses.dataclass
 class _Segment:
     part: int        # partition = shard * arenas + arena (0 for 1-ring)
@@ -99,6 +111,10 @@ class EventArchive:
         # regress below the ring head — making the spooler re-spill and
         # re-expire the same rows forever
         self._spilled: dict[int, int] = {}
+        # registered gaps: position ranges that NEVER held data (topology
+        # migration pads history up to an arena boundary) — replay must
+        # not count them as lost rows
+        self._gaps: dict[int, list[list[int]]] = {}
         self._load_index()
 
     # ------------------------------------------------------------- index
@@ -123,6 +139,8 @@ class EventArchive:
                     known[e["path"]] = _Segment(**e)
                 self._spilled = {int(k): int(v)
                                  for k, v in m.get("spilled", {}).items()}
+                self._gaps = {int(k): [[int(lo), int(hi)] for lo, hi in v]
+                              for k, v in m.get("gaps", {}).items()}
         # adopt any segment file the manifest missed (crash between the
         # segment rename and the manifest rewrite) — but NEVER a file whose
         # own topology stamp disagrees (a manifest-less dir must not smuggle
@@ -153,7 +171,25 @@ class EventArchive:
             if seg_topo is not None:
                 self._retire(seg_topo, files=[f])
         self.segments.sort(key=lambda s: (s.part, s.start))
+        self._drop_covered()
         self._reindex()
+
+    def _drop_covered(self) -> None:
+        """Delete segment files whose row range is fully covered by a
+        larger segment of the same partition — the leftovers of a
+        compaction that crashed between the merged-segment rename and the
+        source deletes (merged files exactly cover their sources, so
+        covered == superseded)."""
+        keep: list[_Segment] = []
+        end: dict[int, int] = {}
+        for s in sorted(self.segments,
+                        key=lambda s: (s.part, s.start, -s.count)):
+            if s.start + s.count <= end.get(s.part, 0):
+                (self.dir / s.path).unlink(missing_ok=True)
+                continue
+            end[s.part] = max(end.get(s.part, 0), s.start + s.count)
+            keep.append(s)
+        self.segments = keep
 
     def _reindex(self) -> None:
         self._by_part = {}
@@ -191,6 +227,7 @@ class EventArchive:
         tmp.write_text(json.dumps(
             {"topology": self.topology,
              "spilled": self._spilled,
+             "gaps": self._gaps,
              "segments": [s.to_json() for s in self.segments]}))
         tmp.replace(self._manifest_path())
 
@@ -204,6 +241,17 @@ class EventArchive:
 
     def total_rows(self) -> int:
         return sum(s.count for s in self.segments)
+
+    def register_gap(self, part: int, lo: int, hi: int) -> None:
+        """Record [lo, hi) of ``part`` as positions that never held data
+        (migration padding) — replay skips them without loss accounting."""
+        if hi > lo:
+            self._gaps.setdefault(part, []).append([int(lo), int(hi)])
+
+    def gap_rows(self, part: int, lo: int, hi: int) -> int:
+        """Rows of [lo, hi) covered by registered never-written gaps."""
+        return sum(max(0, min(hi, g_hi) - max(lo, g_lo))
+                   for g_lo, g_hi in self._gaps.get(part, ()))
 
     # ------------------------------------------------------------- write
     def append_segment(self, part: int, start: int, sl) -> None:
@@ -267,6 +315,108 @@ class EventArchive:
                 self._row_cache = None
         if victims:
             self._reindex()
+
+    # -------------------------------------------------------- maintenance
+    def compact(self, target_rows: int | None = None) -> dict:
+        """Merge runs of contiguous small segments per partition into
+        files of up to ``target_rows`` (default 8x the spool segment) —
+        the maintenance the reference delegates to its time-series
+        store's own compaction (Influx shard compaction). Row positions
+        are preserved, so by-id lookups, replay cursors, and the query
+        cap are unaffected. Crash-safe: the merged file is renamed into
+        place before the sources are deleted; a crash in between leaves
+        covered sources that ``_load_index`` sweeps."""
+        target = int(target_rows or 8 * self.segment_rows)
+        merged_segments = files_removed = 0
+        for part, segs in list(self._by_part.items()):
+            i = 0
+            while i < len(segs):
+                run = [segs[i]]
+                total = segs[i].count
+                j = i + 1
+                while (j < len(segs)
+                       and segs[j].start == run[-1].start + run[-1].count
+                       and total + segs[j].count <= target):
+                    total += segs[j].count
+                    run.append(segs[j])
+                    j += 1
+                if len(run) < 2:
+                    i = j
+                    continue
+                cols: dict[str, list] = {c: [] for c in _COLUMNS}
+                for s in run:
+                    sc = self._segment_cols(s)
+                    for c in _COLUMNS:
+                        cols[c].append(sc[c])
+                merged = {c: np.concatenate(cols[c]) for c in _COLUMNS}
+                start = run[0].start
+                name = f"seg-p{part:04d}-o{start:014d}-n{total}.npz"
+                tmp = self.dir / (name + ".tmp")
+                with open(tmp, "wb") as f:
+                    np.savez(f, part=np.int64(part), start=np.int64(start),
+                             topology=np.str_(self.topology or ""), **merged)
+                tmp.replace(self.dir / name)
+                ts = merged["ts_ms"]
+                new_seg = _Segment(
+                    part=part, start=start, count=total,
+                    ts_min=int(ts.min()) if ts.size else 0,
+                    ts_max=int(ts.max()) if ts.size else 0, path=name)
+                for s in run:
+                    (self.dir / s.path).unlink(missing_ok=True)
+                    self.segments.remove(s)
+                    files_removed += 1
+                self.segments.append(new_seg)
+                self._row_cache = None
+                merged_segments += 1
+                segs[i:j] = [new_seg]
+                i += 1
+        if merged_segments:
+            self.segments.sort(key=lambda s: (s.part, s.start))
+            self._reindex()
+            self._save_index()
+        return {"merged_segments": merged_segments,
+                "files_removed": files_removed,
+                "files_now": len(self.segments)}
+
+    def disk_usage(self) -> dict:
+        """Bytes on disk: live segments + everything under retired-*/
+        (the disk-bounding observability knob). Tolerates concurrent
+        expiry/compaction unlinking files mid-walk."""
+        live = 0
+        segments = list(self.segments)
+        for s in segments:
+            try:
+                live += (self.dir / s.path).stat().st_size
+            except FileNotFoundError:
+                pass
+            except OSError:
+                pass
+        retired = retired_files = 0
+        for d in self.dir.glob("retired-*"):
+            for f in d.rglob("*"):
+                try:
+                    if f.is_file():
+                        retired += f.stat().st_size
+                        retired_files += 1
+                except OSError:
+                    pass
+        return {"live_bytes": live, "live_segments": len(segments),
+                "retired_bytes": retired, "retired_files": retired_files}
+
+    def purge_retired(self) -> int:
+        """Delete every retired-*/ directory (call AFTER their history has
+        been migrated to the new topology — reshard_snapshot's archive
+        migration — or is otherwise expendable). Returns bytes
+        reclaimed."""
+        import shutil
+
+        freed = 0
+        for d in self.dir.glob("retired-*"):
+            for f in d.rglob("*"):
+                if f.is_file():
+                    freed += f.stat().st_size
+            shutil.rmtree(d)
+        return freed
 
     def note_lost(self, count: int) -> None:
         """Record rows that wrapped before spooling (mis-sized trigger —
