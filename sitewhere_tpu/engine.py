@@ -656,6 +656,10 @@ class Engine(IngestHostMixin):
         c = self.config
         self.epoch = EpochBase()
         self.lock = threading.RLock()
+        # host-side auxiliary counters merged into metrics() — e.g. the
+        # DecodeWorkerPool's ambiguous-lane fallback count (VERDICT r3:
+        # the exactness fallback must be visible, not just a log line)
+        self.host_counters: dict[str, int] = {}
         # the native host data-plane (C++ decode + interning) is the default;
         # pure-Python fallback when no compiler is available
         self._native_decoder = None
@@ -1882,6 +1886,8 @@ class Engine(IngestHostMixin):
     def metrics(self) -> dict:
         m = self.state.metrics
         return {
+            # host_counters first: a counter can never shadow a core key
+            **self.host_counters,
             "processed": int(m.processed),
             "found": int(m.found),
             "missed": int(m.missed),

@@ -77,6 +77,35 @@ class NativeBatchDecoder:
         format of ingest/decoders.py encode_binary_request)."""
         return self._decode(payloads, self.lib.swtpu_decode_binary_batch)
 
+    def decode_packed(self, buf, offsets: np.ndarray, n: int,
+                      rtype: np.ndarray, token: np.ndarray, ts: np.ndarray,
+                      values: np.ndarray, chmask: np.ndarray,
+                      aux0: np.ndarray, level: np.ndarray,
+                      *, binary: bool = False) -> tuple[int, int]:
+        """One scanner call over an already-concatenated wire batch
+        (``offsets`` int64[>=n+1]; output arrays sized >= n rows). THE
+        single marshalling site for swtpu_decode_*_batch — the worker
+        pool's shared-memory views and the bench's preallocated arrays
+        go through here too, so a signature change has one home.
+        Returns (n_ok, channel_collisions)."""
+        collisions = ctypes.c_int32(0)
+
+        def ptr(a, t):
+            return a.ctypes.data_as(ctypes.POINTER(t))
+
+        fn = (self.lib.swtpu_decode_binary_batch if binary
+              else self.lib.swtpu_decode_batch)
+        n_ok = int(fn(
+            self.handle, buf, ptr(offsets, ctypes.c_int64),
+            np.int32(n), np.int32(self.channels),
+            ptr(rtype, ctypes.c_int32), ptr(token, ctypes.c_int32),
+            ptr(ts, ctypes.c_int64),
+            ptr(values, ctypes.c_float), ptr(chmask, ctypes.c_uint8),
+            ptr(aux0, ctypes.c_int32), ptr(level, ctypes.c_int32),
+            ctypes.byref(collisions),
+        ))
+        return n_ok, int(collisions.value)
+
     def _decode(self, payloads: list[bytes], fn) -> DecodedArrays:
         n = len(payloads)
         c = self.channels
@@ -90,24 +119,13 @@ class NativeBatchDecoder:
         chmask = np.empty((n, c), np.uint8)
         aux0 = np.empty(n, np.int32)
         level = np.empty(n, np.int32)
-        collisions = ctypes.c_int32(0)
-
-        def ptr(a, t):
-            return a.ctypes.data_as(ctypes.POINTER(t))
-
-        n_ok = int(fn(
-            self.handle, buf, ptr(offsets, ctypes.c_int64),
-            np.int32(n), np.int32(c),
-            ptr(rtype, ctypes.c_int32), ptr(token, ctypes.c_int32),
-            ptr(ts, ctypes.c_int64),
-            ptr(values, ctypes.c_float), ptr(chmask, ctypes.c_uint8),
-            ptr(aux0, ctypes.c_int32), ptr(level, ctypes.c_int32),
-            ctypes.byref(collisions),
-        ))
+        n_ok, collisions = self.decode_packed(
+            buf, offsets, n, rtype, token, ts, values, chmask, aux0, level,
+            binary=fn is self.lib.swtpu_decode_binary_batch)
         return DecodedArrays(
             n_ok=n_ok, rtype=rtype, token_id=token, ts_ms64=ts,
             values=values, chmask=chmask.astype(bool), aux0=aux0, level=level,
-            collisions=int(collisions.value),
+            collisions=collisions,
         )
 
 

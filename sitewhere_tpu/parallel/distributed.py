@@ -316,6 +316,7 @@ class DistributedEngine(IngestHostMixin):
         self.n_shards = self.sharded.n_shards
         self.epoch = EpochBase()
         self.lock = threading.RLock()
+        self.host_counters: dict[str, int] = {}
         token_capacity = c.token_capacity_per_shard * self.n_shards
         self._native_decoder = None
         if c.use_native:
@@ -1415,6 +1416,9 @@ class DistributedEngine(IngestHostMixin):
         if self.archive is not None:
             m["archived_rows"] = self.archive.total_rows()
             m["archive_lost_rows"] = self.archive.lost_rows
+        # counters first would shadow nothing, but m is built from the
+        # device metrics; guard the same way — core keys win
+        m = dict(self.host_counters) | m
         return m
 
     def shard_metrics(self) -> list[dict]:

@@ -221,7 +221,11 @@ def test_pool_falls_back_on_lane_conflict():
     assert stats["lane_conflicts"] == 1
     assert stats["fallback_batches"] >= 1
     # the fallback path (engine-side decode) kept every event
-    assert eng.metrics()["persisted"] >= 5
+    m = eng.metrics()
+    assert m["persisted"] >= 5
+    # ...and the degradation is VISIBLE in the engine metrics (VERDICT r3
+    # weak #1), which is what /api/instance/metrics serves
+    assert m["worker_fallback_batches"] == stats["fallback_batches"]
 
 
 def test_pool_rejects_strict_channel_engines():
