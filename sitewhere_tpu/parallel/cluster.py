@@ -34,13 +34,30 @@ Within a rank, scaling stays TPU-native (ShardedEngine's shard_map step +
 ICI collectives); ACROSS ranks the data plane is this replica model over
 DCN, mirroring Kafka's role at the pod boundary (SURVEY.md §2.9).
 
-Deployment rule: serve the cluster RPC on its OWN event loop (thread),
-separate from any loop whose handlers call the ClusterEngine facade
-(e.g. the REST gateway). Facade calls block synchronously on peer RPC;
-if the blocked loop is also the only one answering incoming cluster RPC,
-two ranks fanning out at each other deadlock. ``register_cluster_rpc``
-handlers bind to the local engine only, so a dedicated RPC loop can
-always answer (cluster_demo.py wires it this way).
+Deployment rules:
+
+1. Serve the cluster RPC on its OWN event loop (thread), separate from
+   any loop whose handlers call the ClusterEngine facade (e.g. the REST
+   gateway). Facade calls block synchronously on peer RPC; if the
+   blocked loop is also the only one answering incoming cluster RPC,
+   two ranks fanning out at each other deadlock. ``register_cluster_rpc``
+   handlers bind to the local engine only, so a dedicated RPC loop can
+   always answer (cluster_demo.py wires it this way).
+2. Scope: this layer clusters the ENGINE surface — devices, events,
+   state, feeds, metrics. Instance-level management entities (device
+   types, areas/customers, assets, schedules, users/tenants) live in
+   each rank's EntityStores, mirroring how the reference keeps them in
+   per-service databases shared by replicas: in a multi-rank deployment,
+   apply management mutations through the instance control-plane RPC
+   (rpc/server.py build_instance_rpc — every family is exposed) against
+   each rank, the way the reference's per-service gRPC is reachable
+   from every node. Tenant LANES need no broadcast: forwarded ingest
+   interns the tenant at the owner, and fan-out queries resolve tenant
+   names rank-locally.
+3. Rank count is part of the topology (ownership = token-hash %
+   n_ranks, exactly Kafka's partition semantics): change it like a
+   topology change — drain, snapshot + reshard per rank, restart with
+   the new peer list — not by adding ranks to a live cluster.
 """
 
 from __future__ import annotations
