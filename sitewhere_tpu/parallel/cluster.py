@@ -357,13 +357,20 @@ class ClusterEngine:
                            envelope=envelope_from_request(req),
                            tenant=req.tenant)
 
+    def _fanout(self, local_result, method: str, **params) -> list:
+        """Local result + the same call on every peer (the one idiom
+        behind flush/metrics/sweeps; timeout/parallelism policy lives
+        here once)."""
+        out = [local_result]
+        for r in range(self.n_ranks):
+            if r != self.rank:
+                out.append(self._peer(r).call(method, **params))
+        return out
+
     def flush(self) -> dict:
         """Flush every rank — after this, queries anywhere see everything
         accepted anywhere (the test/REST consistency point)."""
-        out = [self.local.flush()]
-        for r in range(self.n_ranks):
-            if r != self.rank:
-                out.append(self._peer(r).call("Cluster.flush"))
+        out = self._fanout(self.local.flush(), "Cluster.flush")
         return _merge_counts([s for s in out if s])
 
     # ---------------------------------------------------------------- admin
@@ -478,12 +485,20 @@ class ClusterEngine:
         return _ClusterFeed(self.local.make_feed_consumer(group_id, **kw),
                             self.rank, self.n_ranks)
 
+    def presence_sweep(self) -> list[str]:
+        """Cluster-wide presence sweep: each rank marks ITS devices
+        missing (per-partition, like the reference's per-tenant-engine
+        DevicePresenceManager); one trigger reaches every rank so the
+        REST admin surface behaves identically from any node. The
+        per-rank BACKGROUND loop should sweep its local engine only —
+        N ranks each fanning out would sweep N^2 times per interval."""
+        return [t for part in self._fanout(
+            self.local.presence_sweep(), "Cluster.presenceSweep")
+            for t in part]
+
     def metrics(self) -> dict:
-        out = [self.local.metrics()]
-        for r in range(self.n_ranks):
-            if r != self.rank:
-                out.append(self._peer(r).call("Cluster.metrics"))
-        return _merge_counts(out)
+        return _merge_counts(self._fanout(
+            self.local.metrics(), "Cluster.metrics"))
 
     @property
     def devices(self) -> _MergedDevices:
@@ -566,6 +581,9 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def metrics():
         return engine.metrics()
 
+    def presence_sweep():
+        return engine.presence_sweep()
+
     def flush():
         return engine.flush()
 
@@ -585,6 +603,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.listDeviceInfos": list_device_infos,
         "Cluster.deviceCount": device_count,
         "Cluster.metrics": metrics,
+        "Cluster.presenceSweep": presence_sweep,
         "Cluster.flush": flush,
     }.items():
         srv.register(name, fn)

@@ -13,6 +13,7 @@ jobs, labels, search, streams, tenants, users, and instance info.
 
 from __future__ import annotations
 
+import asyncio
 import base64
 import dataclasses
 import json
@@ -470,7 +471,9 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(state)
 
     async def presence_sweep(request: web.Request):
-        missing = inst.engine.presence_sweep()
+        # off the loop: on a ClusterEngine this fans out over peer RPC
+        # and must not stall the gateway
+        missing = await asyncio.to_thread(inst.engine.presence_sweep)
         return json_response({"newlyMissing": missing})
 
     r.add_get("/api/devices/{token}/state", get_device_state)
@@ -1622,8 +1625,11 @@ async def start_server(instance: SiteWhereTpuInstance, host: str = "127.0.0.1",
         while True:
             await asyncio.sleep(presence_interval_s)
             try:
-                missing = await asyncio.to_thread(
-                    instance.engine.presence_sweep)
+                # rank-LOCAL sweep: every rank runs this loop for its own
+                # partition (the reference's per-engine presence manager);
+                # the cluster-wide fan-out is only for the admin endpoint
+                eng = getattr(instance.engine, "local", instance.engine)
+                missing = await asyncio.to_thread(eng.presence_sweep)
                 if missing:
                     import logging
 
