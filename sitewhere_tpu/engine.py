@@ -1883,6 +1883,11 @@ class Engine(IngestHostMixin):
             }
         return out
 
+    # uniform name for "sweep THIS engine only" — the cluster facade
+    # overrides presence_sweep with a fan-out but keeps this local form,
+    # so per-rank background loops never trigger N^2 sweeps
+    presence_sweep_local = presence_sweep
+
     def metrics(self) -> dict:
         m = self.state.metrics
         return {

@@ -421,12 +421,10 @@ class ClusterEngine:
                 "Cluster.listAssignments", token=device_token, **kw)
             return [a if isinstance(a, AssignmentInfo) else
                     AssignmentInfo(**a) for a in res]
-        out = list(self.local.list_assignments(None, **kw))
-        for r in range(self.n_ranks):
-            if r != self.rank:
-                out.extend(AssignmentInfo(**a) for a in self._peer(r).call(
-                    "Cluster.listAssignments", token=None, **kw))
-        return out
+        parts = self._fanout(self.local.list_assignments(None, **kw),
+                             "Cluster.listAssignments", token=None, **kw)
+        return [a if isinstance(a, AssignmentInfo) else AssignmentInfo(**a)
+                for part in parts for a in part]
 
     def get_device_state(self, token: str) -> dict | None:
         return self._route(
@@ -434,11 +432,9 @@ class ClusterEngine:
             "Cluster.getDeviceState", token=token)
 
     def search_device_states(self, **kw) -> list[dict]:
-        out = list(self.local.search_device_states(**kw))
-        for r in range(self.n_ranks):
-            if r != self.rank:
-                out.extend(self._peer(r).call(
-                    "Cluster.searchDeviceStates", **kw))
+        out = [s for part in self._fanout(
+            self.local.search_device_states(**kw),
+            "Cluster.searchDeviceStates", **kw) for s in part]
         limit = kw.get("limit")
         if limit is not None:
             out = out[:limit]
@@ -447,11 +443,8 @@ class ClusterEngine:
     def query_events(self, **kw) -> dict:
         """Fan out to every rank, merge newest-first — the cross-partition
         query the reference's REST tier performs over per-service gRPC."""
-        results = [self.local.query_events(**kw)]
-        for r in range(self.n_ranks):
-            if r != self.rank:
-                results.append(self._peer(r).call(
-                    "Cluster.queryEvents", **kw))
+        results = self._fanout(self.local.query_events(**kw),
+                               "Cluster.queryEvents", **kw)
         events = [e for res in results for e in res["events"]]
         events.sort(key=lambda e: (-e.get("eventDateMs", 0),
                                    -e.get("receivedDateMs", 0),
@@ -495,6 +488,12 @@ class ClusterEngine:
         return [t for part in self._fanout(
             self.local.presence_sweep(), "Cluster.presenceSweep")
             for t in part]
+
+    def presence_sweep_local(self) -> list[str]:
+        """This rank's sweep only — what the per-rank background loop
+        calls (the N^2-avoidance policy lives HERE, not in the web
+        tier)."""
+        return self.local.presence_sweep()
 
     def metrics(self) -> dict:
         return _merge_counts(self._fanout(

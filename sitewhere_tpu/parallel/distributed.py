@@ -1339,6 +1339,9 @@ class DistributedEngine(IngestHostMixin):
                     out.append(info.token)
             return out
 
+    # uniform "sweep THIS engine only" name (see Engine.presence_sweep_local)
+    presence_sweep_local = presence_sweep
+
     def get_event(self, event_id: int,
                   tenant: str | None = None) -> dict | None:
         """Fetch one persisted event by its mesh-global id — the id layout

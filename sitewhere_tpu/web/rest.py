@@ -1628,8 +1628,8 @@ async def start_server(instance: SiteWhereTpuInstance, host: str = "127.0.0.1",
                 # rank-LOCAL sweep: every rank runs this loop for its own
                 # partition (the reference's per-engine presence manager);
                 # the cluster-wide fan-out is only for the admin endpoint
-                eng = getattr(instance.engine, "local", instance.engine)
-                missing = await asyncio.to_thread(eng.presence_sweep)
+                missing = await asyncio.to_thread(
+                    instance.engine.presence_sweep_local)
                 if missing:
                     import logging
 
