@@ -924,15 +924,22 @@ class Engine(IngestHostMixin):
                         "staged": int(len(idxs))}
             staged = 0
             pos = 0
+            # all-rows-decoded batches (the steady state) stage with plain
+            # slices — contiguous memcpy instead of a fancy-index gather
+            # per column (~0.5ms/16k-batch on the 1-core host)
+            contiguous = len(idxs) == len(ok)
             while pos < len(idxs):
                 room = self.config.batch_capacity - len(self._buf)
                 if room == 0:
                     self.flush_async()
                     room = self.config.batch_capacity
-                chunk = idxs[pos: pos + room]
+                chunk = (slice(pos, min(pos + room, len(idxs)))
+                         if contiguous else idxs[pos: pos + room])
+                n_chunk = (chunk.stop - chunk.start if contiguous
+                           else len(chunk))
                 b = self._buf
                 lo = b._n
-                hi = lo + len(chunk)
+                hi = lo + n_chunk
                 b.etype[lo:hi] = etype[chunk]
                 b.token_id[lo:hi] = res.token_id[chunk]
                 b.tenant_id[lo:hi] = tenant_id
@@ -942,7 +949,7 @@ class Engine(IngestHostMixin):
                 b.vmask[lo:hi] = res.chmask[chunk]
                 b.aux[lo:hi, 0] = res.aux0[chunk]
                 b._n = hi
-                staged += len(chunk)
+                staged += n_chunk
                 pos += room
             if self._buf.full:
                 self.flush_async()
