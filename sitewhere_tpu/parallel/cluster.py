@@ -56,8 +56,10 @@ Deployment rules:
    names rank-locally.
 3. Rank count is part of the topology (ownership = token-hash %
    n_ranks, exactly Kafka's partition semantics): change it like a
-   topology change — drain, snapshot + reshard per rank, restart with
-   the new peer list — not by adding ranks to a live cluster.
+   topology change — drain, stand up the new rank set, and migrate with
+   ``reshard_cluster`` (replay every old rank's WAL through the new
+   partitioner: each event re-routes exactly once to its new owner and
+   re-logs in that owner's WAL) — not by adding ranks to a live cluster.
 """
 
 from __future__ import annotations
@@ -502,6 +504,54 @@ class ClusterEngine:
     @property
     def devices(self) -> _MergedDevices:
         return _MergedDevices(self)
+
+
+def replay_wal_through(cluster: ClusterEngine, wal_dir,
+                       after_cursor: int = -1) -> int:
+    """Replay one (foreign, read-only) rank WAL through the cluster
+    router: every record re-routes to its owner under the CURRENT
+    partitioner and re-logs in that owner's live WAL. This is the
+    rank-count elasticity tool — changing n_ranks re-partitions devices
+    (ownership is token-hash % n_ranks, Kafka partition semantics), and
+    replaying every old rank's WAL into a fresh cluster migrates the
+    whole history exactly once per event to its new owner (the consumer-
+    group re-partition-by-replay analog; SURVEY §5.4). Returns records
+    replayed.
+
+    PRECONDITION: the source WAL must be complete (never pruned) — replay
+    IS the history. A log whose oldest segment was pruned after a
+    snapshot no longer carries the full stream, and replaying only its
+    tail would silently drop the snapshot-covered events; that case is
+    refused."""
+    import pathlib
+
+    from sitewhere_tpu.utils.checkpoint import replay_records
+    from sitewhere_tpu.utils.ingestlog import IngestLog
+
+    segs = sorted(pathlib.Path(wal_dir).glob("segment-*.log"))
+    if segs and int(segs[0].stem.split("-")[1]) != 0:
+        raise ValueError(
+            f"WAL {wal_dir} was pruned (oldest segment is {segs[0].name}): "
+            "it no longer carries the full history — reshard_cluster "
+            "needs complete WALs (disable pruning on clusters that want "
+            "rank-count elasticity by replay)")
+    wal = IngestLog(wal_dir, readonly=True)
+    try:
+        count = replay_records(wal, cluster.ingest_json_batch,
+                               cluster.ingest_binary_batch,
+                               after_cursor=after_cursor)
+    finally:
+        wal.close()
+    cluster.flush()
+    return count
+
+
+def reshard_cluster(cluster: ClusterEngine, old_wal_dirs) -> int:
+    """Migrate an old cluster's full history into ``cluster`` (fresh
+    ranks, any new rank count) by replaying every old rank's WAL through
+    the new partitioner. Run from ONE rank; forwarding distributes the
+    records. Returns total records replayed."""
+    return sum(replay_wal_through(cluster, d) for d in old_wal_dirs)
 
 
 def cluster_system_jwt(secret: str) -> str:

@@ -149,6 +149,44 @@ def restore_engine(directory: str | pathlib.Path) -> Engine:
     return engine
 
 
+def replay_records(wal, ingest_json, ingest_binary,
+                   after_cursor: int = -1, run_cap: int = 4096) -> int:
+    """Group a WAL's records into per-(wire-format, tenant) runs and feed
+    them through the given batch-ingest callables — the ONE place that
+    parses the record framing written by IngestHostMixin._wal_append
+    (tag byte + tenant + NUL + payload). Shared by engine crash recovery
+    (replay_wal_into) and cluster rank-reshard (cluster.py
+    replay_wal_through). Returns records replayed."""
+    from sitewhere_tpu.engine import WAL_JSON
+
+    count = 0
+    run_key: tuple | None = None
+    run: list[bytes] = []
+
+    def flush_run():
+        nonlocal run
+        if not run:
+            return
+        tag, tenant = run_key
+        if tag == WAL_JSON:
+            ingest_json(run, tenant=tenant)
+        else:
+            ingest_binary(run, tenant=tenant)
+        run = []
+
+    for rec in wal.replay(after_cursor=after_cursor):
+        tag = rec[:1]
+        sep = rec.index(b"\x00", 1)
+        key = (tag, rec[1:sep].decode())
+        if key != run_key or len(run) >= run_cap:
+            flush_run()
+            run_key = key
+        run.append(rec[sep + 1:])
+        count += 1
+    flush_run()
+    return count
+
+
 def replay_wal_into(engine, after_cursor: int,
                     wal_dir: str | pathlib.Path | None) -> None:
     """Shared WAL-replay mechanism for both engines (single-node and
@@ -175,29 +213,8 @@ def replay_wal_into(engine, after_cursor: int,
     else:
         wal = live_wal
 
-    run_key: tuple | None = None
-    run: list[bytes] = []
-
-    def flush_run():
-        nonlocal run
-        if not run:
-            return
-        tag, tenant = run_key
-        if tag == WAL_JSON:
-            engine.ingest_json_batch(run, tenant=tenant)
-        else:
-            engine.ingest_binary_batch(run, tenant=tenant)
-        run = []
-
-    for rec in wal.replay(after_cursor=after_cursor):
-        tag = rec[:1]
-        sep = rec.index(b"\x00", 1)
-        key = (tag, rec[1:sep].decode())
-        if key != run_key or len(run) >= 4096:
-            flush_run()
-            run_key = key
-        run.append(rec[sep + 1:])
-    flush_run()
+    replay_records(wal, engine.ingest_json_batch, engine.ingest_binary_batch,
+                   after_cursor=after_cursor)
     engine.flush()
     # future traffic logs to the engine's configured WAL, never the
     # read-only replay copy

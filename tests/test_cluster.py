@@ -457,6 +457,42 @@ def test_instance_rpc_serves_cluster_from_any_rank(tmp_path):
         _close(clusters, host)
 
 
+def test_protocol_edge_routes_across_cluster(tmp_path):
+    """The ingest edge (event sources -> decoder -> engine.process) on one
+    rank forwards each decoded request to its owning rank — a device can
+    publish to ANY rank's broker, like producing to any Kafka broker."""
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
+    from sitewhere_tpu.ingest.sources import (InboundEventSource,
+                                              InMemoryEventReceiver)
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        inst0 = SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c0)
+        recv = InMemoryEventReceiver()
+        inst0.event_sources.add_source(
+            InboundEventSource("edge", JsonDeviceRequestDecoder(), [recv]))
+        toks = tokens_owned_by(0, 2, prefix="pe") + \
+            tokens_owned_by(1, 2, prefix="pe")
+        for i, t in enumerate(toks):
+            recv.submit(meas(t, "temp", 30.0 + i, 400 + i))
+        c0.flush()
+        # every event landed at its owner; both ranks agree
+        for c in clusters:
+            assert c.query_events(limit=50)["total"] == 4
+        for t in tokens_owned_by(1, 2, prefix="pe"):
+            assert c1.local.get_device(t) is not None
+            assert c0.local.get_device(t) is None
+            st = c0.get_device_state(t)
+            assert st["measurements"]["temp"]["value"] >= 30.0
+    finally:
+        _close(clusters, host)
+
+
 def test_two_process_product_job_with_crash_recovery():
     """The VERDICT r3 done-bar, process-level: two OS processes each run
     a DistributedEngine (string tokens, WAL, feeds) + REST; both ingest
@@ -470,6 +506,88 @@ def test_two_process_product_job_with_crash_recovery():
     assert any(ln.startswith("CLUSTER_RECOVERED") and "replayed_total=3"
                in ln for ln in lines)
     assert all("rest_agree=1" in ln for ln in lines if "phase=1" in ln)
+
+
+def test_cluster_rank_count_reshard_by_wal_replay(tmp_path):
+    """Rank-count elasticity: ownership is token-hash % n_ranks, so
+    changing the rank count re-partitions devices. Replaying every old
+    rank's WAL through a FRESH 3-rank cluster migrates the whole history,
+    each event exactly once to its new owner."""
+    from sitewhere_tpu.parallel.cluster import reshard_cluster
+
+    # --- old 2-rank cluster with per-rank WALs -------------------------
+    clusters, host, _ = _mk_cluster(tmp_path / "old")
+    c0, c1 = clusters
+    toks = tokens_owned_by(0, 3, n_ranks=2) + tokens_owned_by(1, 3,
+                                                              n_ranks=2)
+    try:
+        c0.ingest_json_batch(
+            [meas(t, "temp", float(i), 600 + i) for i, t in enumerate(toks)])
+        c1.ingest_json_batch(
+            [meas(t, "temp", 50.0 + i, 900 + i) for i, t in enumerate(toks)])
+        c0.flush()
+        want = c0.query_events(limit=50)
+        want_states = {t: c0.get_device_state(t)["measurements"]
+                       for t in toks}
+    finally:
+        for c in clusters:
+            c.local.wal.close()
+        _close(clusters, host)
+
+    # --- fresh 3-rank cluster, replay both old WALs --------------------
+    ports = _free_ports(3)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    host3 = _ServerHost()
+    new = []
+    for r in range(3):
+        cc = ClusterConfig(rank=r, n_ranks=3, peers=peers, secret="rs3",
+                           epoch_base_unix_s=BASE_S,
+                           engine=_engine_cfg(tmp_path / "new", r),
+                           connect_timeout_s=10.0)
+        c = ClusterEngine(cc)
+        host3.start(build_cluster_rpc(c.local, "rs3"), ports[r])
+        new.append(c)
+    try:
+        n_replayed = reshard_cluster(
+            new[0], [tmp_path / "old" / "wal-r0", tmp_path / "old" / "wal-r1"])
+        assert n_replayed == 12
+        got = new[0].query_events(limit=50)
+        assert got["total"] == want["total"] == 12
+        assert [(e["deviceToken"], e["eventDateMs"]) for e in got["events"]] \
+            == [(e["deviceToken"], e["eventDateMs"]) for e in want["events"]]
+        for t in toks:
+            assert new[1].get_device_state(t)["measurements"] == \
+                want_states[t]
+            # ownership re-partitioned under n_ranks=3: the device mirror
+            # lives ONLY at its new owner
+            owner = owner_rank(t, 3)
+            for r in range(3):
+                has = new[r].local.get_device(t) is not None
+                assert has == (r == owner), (t, r, owner)
+        # the new cluster's own WALs carry the migrated history — each
+        # record re-logged at its NEW owner (count rank 2's wal directly;
+        # the merged metric would pass even with empty WALs)
+        assert new[2].local.metrics()["persisted"] == \
+            2 * sum(owner_rank(t, 3) == 2 for t in toks)
+        for c in new:
+            c.local.wal.close()
+        from sitewhere_tpu.utils.ingestlog import IngestLog
+
+        wal2 = IngestLog(tmp_path / "new" / "wal-r2", readonly=True)
+        n_logged = sum(1 for _ in wal2.replay())
+        wal2.close()
+        assert n_logged == 2 * sum(owner_rank(t, 3) == 2 for t in toks)
+        assert n_logged > 0
+        # pruned source WALs are refused, never silently partial
+        from sitewhere_tpu.parallel.cluster import replay_wal_through
+
+        pruned = tmp_path / "pruned-wal"
+        pruned.mkdir()
+        (pruned / "segment-00000003.log").write_bytes(b"SWAL1\n")
+        with pytest.raises(ValueError, match="pruned"):
+            replay_wal_through(new[0], pruned)
+    finally:
+        _close(new, host3)
 
 
 def test_envelope_round_trip():
