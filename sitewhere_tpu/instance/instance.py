@@ -98,6 +98,18 @@ class SiteWhereTpuInstance(LifecycleComponent):
         self.search = SearchProviderManager()
         self.search_index = EventSearchIndex()
         self.search.add_provider("embedded", self.search_index)
+        # a cluster-backed engine fans search out over every rank's index
+        # (all replicas feeding one Solr, reference-style): the cluster
+        # provider REPLACES "embedded" so REST stays a pure provider
+        # lookup; plain engines keep the single-index provider
+        attach = getattr(self.engine, "attach_search_index", None)
+        if attach is not None:
+            from sitewhere_tpu.parallel.cluster import ClusterSearchProvider
+
+            attach(self.search_index)
+            self.search.add_provider(
+                "embedded", ClusterSearchProvider(self.engine,
+                                                  self.search_index))
         self.connector_hosts: list[ConnectorHost] = []
         if self.config.index_events:
             self.add_connector(SearchIndexConnector("search-index", self.search_index))

@@ -75,6 +75,7 @@ from typing import Any
 
 from sitewhere_tpu.core.events import EpochBase
 from sitewhere_tpu.engine import AssignmentInfo, DeviceInfo
+from sitewhere_tpu.search.index import event_order_key
 from sitewhere_tpu.parallel.distributed import (DistributedConfig,
                                                 DistributedEngine)
 
@@ -263,6 +264,7 @@ class ClusterEngine:
             config.engine)
         self.local.epoch = EpochBase(config.epoch_base_unix_s)
         self.epoch = self.local.epoch
+        self.search_index = None          # see attach_search_index
         self._peers: dict[int, _SyncPeer] = {}
         self._peers_lock = threading.Lock()
         self._auth_token = cluster_system_jwt(config.secret)
@@ -448,9 +450,7 @@ class ClusterEngine:
         results = self._fanout(self.local.query_events(**kw),
                                "Cluster.queryEvents", **kw)
         events = [e for res in results for e in res["events"]]
-        events.sort(key=lambda e: (-e.get("eventDateMs", 0),
-                                   -e.get("receivedDateMs", 0),
-                                   e.get("deviceToken") or ""))
+        events.sort(key=event_order_key)
         limit = kw.get("limit", 100)
         return {"total": sum(res["total"] for res in results),
                 "events": events[:limit]}
@@ -497,6 +497,38 @@ class ClusterEngine:
         tier)."""
         return self.local.presence_sweep()
 
+    def attach_search_index(self, index) -> None:
+        """Wire this rank's embedded event-search index into the cluster
+        surface (each rank's connector indexes ITS partition — all-rank
+        queries need the fan-out, like every replica feeding one Solr).
+        Also placed on the local engine so the rank's cluster RPC server
+        (bound to the engine) can serve Cluster.searchEvents."""
+        self.search_index = index
+        self.local.search_index = index
+
+    def search_events(self, query: str,
+                      max_results: int = 100) -> "list[dict] | None":
+        """All-rank event search: fan out to every rank's embedded index,
+        merge newest-first. Returns None when no index is attached here
+        (the caller falls back to its local provider); a PEER without an
+        index fails the call loudly — a silent partial merge would read
+        as complete."""
+        if self.search_index is None:
+            return None
+        parts = self._fanout(
+            self.search_index.search(query, max_results,
+                                     order="eventDate"),
+            "Cluster.searchEvents", query=query, maxResults=max_results)
+        for r, part in zip([self.rank] + [r for r in range(self.n_ranks)
+                                          if r != self.rank], parts):
+            if part is None:
+                raise RuntimeError(
+                    f"cluster search incomplete: rank {r} has no search "
+                    "index attached")
+        docs = [d for part in parts for d in part]
+        docs.sort(key=event_order_key)
+        return docs[:max_results]
+
     def metrics(self) -> dict:
         return _merge_counts(self._fanout(
             self.local.metrics(), "Cluster.metrics"))
@@ -504,6 +536,25 @@ class ClusterEngine:
     @property
     def devices(self) -> _MergedDevices:
         return _MergedDevices(self)
+
+
+class ClusterSearchProvider:
+    """The cluster-wide face of the embedded event index: same
+    ``.search``/``.info`` surface as EventSearchIndex, backed by the
+    all-rank fan-out — the instance registers THIS as its "embedded"
+    provider so the REST tier stays a pure provider lookup with no
+    engine-topology branches."""
+
+    def __init__(self, cluster: ClusterEngine, local_index):
+        self._cluster = cluster
+        self._local = local_index
+        self.info = local_index.info
+
+    def search(self, query: str, max_results: int = 100) -> list[dict]:
+        docs = self._cluster.search_events(query, max_results)
+        if docs is None:   # facade has no index attached: local behavior
+            return self._local.search(query, max_results)
+        return docs
 
 
 def replay_wal_through(cluster: ClusterEngine, wal_dir,
@@ -633,6 +684,14 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def presence_sweep():
         return engine.presence_sweep()
 
+    def search_events(query: str, maxResults: int = 100):
+        # the rank's embedded index attaches AFTER server construction
+        # (instance wiring) — resolve lazily; None (vs []) tells the
+        # caller this rank cannot serve search, never "no matches"
+        idx = getattr(engine, "search_index", None)
+        return (idx.search(query, maxResults, order="eventDate")
+                if idx is not None else None)
+
     def flush():
         return engine.flush()
 
@@ -653,6 +712,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.deviceCount": device_count,
         "Cluster.metrics": metrics,
         "Cluster.presenceSweep": presence_sweep,
+        "Cluster.searchEvents": search_events,
         "Cluster.flush": flush,
     }.items():
         srv.register(name, fn)

@@ -15,11 +15,22 @@ from __future__ import annotations
 
 import dataclasses
 import re
+import threading
 from collections import defaultdict
 
 from sitewhere_tpu.outbound.feed import OutboundEvent
 
 _CLAUSE = re.compile(r"(\w+):(\[([^\]]+) TO ([^\]]+)\]|\S+)")
+
+
+def event_order_key(doc: dict):
+    """THE newest-first ordering for event documents — shared by the
+    index's own ranking and every cluster merge (per-rank top-N
+    truncation and the cross-rank merge must sort identically or the
+    merge drops documents that belong in the top-N). Ties break on
+    deviceToken so every rank orders the same."""
+    return (-doc.get("eventDateMs", 0), -doc.get("receivedDateMs", 0),
+            doc.get("deviceToken") or "")
 
 
 @dataclasses.dataclass
@@ -36,21 +47,27 @@ class EventSearchIndex:
         self.docs: dict[int, dict] = {}
         self.postings: dict[tuple[str, str], set[int]] = defaultdict(set)
         self.info = SearchProviderInfo()
+        # indexing runs on the server event loop while searches may run
+        # on worker threads (REST off-loop search): short critical
+        # sections, one lock
+        self._lock = threading.Lock()
 
     def add(self, event: OutboundEvent) -> None:
         doc = event.to_json_dict()
         doc_id = event.event_id
-        if doc_id in self.docs:
-            # re-delivered id (at-least-once feed): drop the old version's
-            # postings first so no stale key survives its doc
-            self._remove(doc_id)
-        elif len(self.docs) >= self.capacity:
-            # drop the oldest — ring semantics like the store. Insertion
-            # order == arrival order, so the dict's first key is oldest.
-            self._remove(next(iter(self.docs)))
-        self.docs[doc_id] = doc
-        for key in self._keys_of(doc):
-            self.postings[key].add(doc_id)
+        with self._lock:
+            if doc_id in self.docs:
+                # re-delivered id (at-least-once feed): drop the old
+                # version's postings first so no stale key survives
+                self._remove(doc_id)
+            elif len(self.docs) >= self.capacity:
+                # drop the oldest — ring semantics like the store.
+                # Insertion order == arrival order, so the dict's first
+                # key is oldest.
+                self._remove(next(iter(self.docs)))
+            self.docs[doc_id] = doc
+            for key in self._keys_of(doc):
+                self.postings[key].add(doc_id)
 
     @staticmethod
     def _keys_of(doc: dict) -> list[tuple[str, str]]:
@@ -70,34 +87,57 @@ class EventSearchIndex:
                 if not ids:
                     del self.postings[key]
 
-    def search(self, query: str, max_results: int = 100) -> list[dict]:
+    def search(self, query: str, max_results: int = 100,
+               order: str = "eventDate") -> list[dict]:
         """Solr-flavored query: ``field:value`` clauses are ANDed;
-        ``eventDateMs:[a TO b]`` range clauses supported; ``*:*`` matches all.
-        """
-        if not query or query.strip() == "*:*":
-            ids = sorted(self.docs, reverse=True)[:max_results]
-            return [self.docs[i] for i in ids]
-        candidate: set[int] | None = None
-        ranges: list[tuple[str, float, float]] = []
-        for m in _CLAUSE.finditer(query):
-            field, value = m.group(1), m.group(2)
-            if m.group(3) is not None:  # range clause
-                lo = -float("inf") if m.group(3) == "*" else float(m.group(3))
-                hi = float("inf") if m.group(4) == "*" else float(m.group(4))
-                ranges.append((field, lo, hi))
-                continue
-            ids = self.postings.get((field, value), set())
-            candidate = ids.copy() if candidate is None else candidate & ids
-        if candidate is None:
-            candidate = set(self.docs)
-        out = []
-        for doc_id in sorted(candidate, reverse=True):
-            doc = self.docs[doc_id]
-            if all(lo <= float(doc.get(f, 0) or 0) <= hi for f, lo, hi in ranges):
-                out.append(doc)
-                if len(out) >= max_results:
-                    break
-        return out
+        ``eventDateMs:[a TO b]`` range clauses supported; ``*:*`` matches
+        all. ``order``: "eventDate" (default) ranks by event_order_key
+        BEFORE truncation — newest event time first, the same ordering
+        every deployment topology serves (and the one a multi-index merge
+        needs, or backdated events silently fall outside the top-N);
+        "id" ranks by arrival (insertion id)."""
+        with self._lock:
+            if not query or query.strip() == "*:*":
+                candidate: set[int] | None = set(self.docs)
+                ranges: list[tuple[str, float, float]] = []
+            else:
+                candidate = None
+                ranges = []
+                for m in _CLAUSE.finditer(query):
+                    field, value = m.group(1), m.group(2)
+                    if m.group(3) is not None:  # range clause
+                        lo = (-float("inf") if m.group(3) == "*"
+                              else float(m.group(3)))
+                        hi = (float("inf") if m.group(4) == "*"
+                              else float(m.group(4)))
+                        ranges.append((field, lo, hi))
+                        continue
+                    ids = self.postings.get((field, value), set())
+                    candidate = (ids.copy() if candidate is None
+                                 else candidate & ids)
+                if candidate is None:
+                    candidate = set(self.docs)
+            key = ((lambda i: event_order_key(self.docs[i]))
+                   if order == "eventDate" else (lambda i: -i))
+            if ranges:
+                # range filters drop candidates AFTER ranking, so top-k
+                # selection could under-fill — full sort only here
+                ranked = sorted(candidate, key=key)
+            else:
+                # top-k selection: O(n log k) and a far shorter critical
+                # section than sorting a near-full index under the lock
+                import heapq
+
+                ranked = heapq.nsmallest(max_results, candidate, key=key)
+            out = []
+            for doc_id in ranked:
+                doc = self.docs[doc_id]
+                if all(lo <= float(doc.get(f, 0) or 0) <= hi
+                       for f, lo, hi in ranges):
+                    out.append(doc)
+                    if len(out) >= max_results:
+                        break
+            return out
 
 
 class SearchProviderManager:

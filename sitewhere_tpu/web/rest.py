@@ -947,8 +947,11 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         provider = inst.search.get(request.query.get("provider", "embedded"))
         if provider is None:
             raise EntityNotFound("search provider")
-        docs = provider.search(request.query.get("q", "*:*"),
-                               int(request.query.get("pageSize", 100)))
+        # off-loop: a cluster-backed provider blocks on peer RPC (the
+        # index itself is lock-protected for cross-thread search)
+        docs = await asyncio.to_thread(
+            provider.search, request.query.get("q", "*:*"),
+            int(request.query.get("pageSize", 100)))
         return json_response({"numResults": len(docs), "results": docs})
 
     r.add_get("/api/search/events", search_events)

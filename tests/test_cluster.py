@@ -508,6 +508,76 @@ def test_two_process_product_job_with_crash_recovery():
     assert all("rest_agree=1" in ln for ln in lines if "phase=1" in ln)
 
 
+def test_cluster_event_search_spans_ranks(tmp_path):
+    """Each rank's connector indexes ITS partition; the embedded search
+    fans out so /api/search/events answers identically (and completely)
+    from any rank — all replicas feeding one Solr, reference-style."""
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        insts = [SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c)
+            for c in clusters]
+        toks = tokens_owned_by(0, 2, prefix="se") + \
+            tokens_owned_by(1, 2, prefix="se")
+        c0.ingest_json_batch(
+            [meas(t, "temp", float(i), 300 + i) for i, t in enumerate(toks)])
+        c0.flush()
+        # each rank's connector indexes its OWN feed partition
+        loop = asyncio.new_event_loop()
+        try:
+            for inst in insts:
+                loop.run_until_complete(inst.pump_outbound())
+        finally:
+            loop.close()
+        # rank-local indexes are partial...
+        assert 0 < len(insts[0].search_index.search("*:*")) < 4
+        # ...but the cluster surface is complete and identical from both
+        d0 = c0.search_events("*:*", 50)
+        d1 = c1.search_events("*:*", 50)
+        assert len(d0) == len(d1) == 4
+        assert [d["deviceToken"] for d in d0] == \
+               [d["deviceToken"] for d in d1]
+        only_r1 = tokens_owned_by(1, 1, prefix="se")[0]
+        hits = c0.search_events(f"deviceToken:{only_r1}", 10)
+        assert len(hits) == 1 and hits[0]["deviceToken"] == only_r1
+        # backdated events rank by EVENT time even when a rank's top-N
+        # by arrival would drop them (review r4): tiny max_results
+        top = c0.search_events("*:*", 1)
+        assert top[0]["eventDateMs"] == max(
+            d["eventDateMs"] for d in c0.search_events("*:*", 50))
+        # ...and the instance's "embedded" PROVIDER is the cluster-wide
+        # one, so the REST tier needs no engine-topology branch
+        p0 = insts[0].search.get("embedded")
+        p1 = insts[1].search.get("embedded")
+        assert [d["deviceToken"] for d in p0.search("*:*", 50)] == \
+               [d["deviceToken"] for d in p1.search("*:*", 50)]
+        assert len(p0.search("*:*", 50)) == 4
+    finally:
+        _close(clusters, host)
+
+
+def test_cluster_search_fails_loudly_without_peer_index(tmp_path):
+    """A peer serving Cluster.searchEvents without an attached index must
+    fail the merge, not silently shrink it to one rank's partition."""
+    from sitewhere_tpu.search.index import EventSearchIndex
+
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        c0.attach_search_index(EventSearchIndex())   # rank 1: none
+        with pytest.raises(RuntimeError, match="rank 1"):
+            c0.search_events("*:*", 10)
+        # and with no LOCAL index the facade signals fallback, not failure
+        assert c1.search_events("*:*", 10) is None
+    finally:
+        _close(clusters, host)
+
+
 def test_cluster_rank_count_reshard_by_wal_replay(tmp_path):
     """Rank-count elasticity: ownership is token-hash % n_ranks, so
     changing the rank count re-partitions devices. Replaying every old

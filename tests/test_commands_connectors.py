@@ -355,3 +355,36 @@ def test_search_index_eviction_keeps_postings_consistent():
     assert idx.search("measurement:m1") == []
     assert ("measurement", "m0") not in idx.postings
     assert [d["eventId"] for d in idx.search("deviceToken:d-0")] == [4, 2]
+
+
+def test_search_index_event_time_order_survives_truncation():
+    """order=\"eventDate\" ranks BEFORE truncation, so a backdated-forward
+    event (late arrival, newest event time) stays in the top-N — the
+    ordering the cluster fan-out merge depends on."""
+    from sitewhere_tpu.core.types import EventType
+    from sitewhere_tpu.outbound.feed import OutboundEvent
+
+    idx = EventSearchIndex()
+
+    def ev(i, ts, recv=None):
+        return OutboundEvent(
+            event_id=i, etype=EventType.MEASUREMENT, device_token=f"d-{i}",
+            device_id=i, assignment_id=i, tenant="default", area_id=-1,
+            asset_id=-1, ts_ms=ts, received_ms=recv if recv is not None
+            else i, measurements={"m": 1.0}, values=[], aux0=-1, aux1=-1)
+
+    # FIRST arrival carries the NEWEST event time (backdated-forward)
+    idx.add(ev(0, ts=9_000))
+    for i in range(1, 6):
+        idx.add(ev(i, ts=100 + i))
+    # arrival order would rank doc 0 last and truncate it out...
+    assert [d["eventId"] for d in idx.search("*:*", 3,
+                                             order="id")] == [5, 4, 3]
+    # ...the event-time default keeps it on top
+    assert [d["eventId"] for d in idx.search("*:*", 3)][0] == 0
+    # ties break on deviceToken so every rank sorts identically
+    idx2 = EventSearchIndex()
+    idx2.add(ev(7, ts=500, recv=1))
+    idx2.add(ev(3, ts=500, recv=1))
+    docs = idx2.search("*:*", 10, order="eventDate")
+    assert [d["deviceToken"] for d in docs] == ["d-3", "d-7"]
