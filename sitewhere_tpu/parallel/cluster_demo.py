@@ -137,6 +137,12 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
                               e.get("measurements"))
                              for e in listing["events"]]
             out["total"] = listing["total"]
+        async with session.get(
+                f"http://127.0.0.1:{port}/api/search/events?q=*:*"
+                "&pageSize=100", headers=h) as r:
+            assert r.status == 200, (port, r.status, await r.text())
+            out["search"] = [(d["deviceToken"], d["eventDateMs"])
+                             for d in (await r.json())["results"]]
         out["state"] = {}
         for t in both:
             async with session.get(
@@ -184,11 +190,18 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
             (scratch_p / f"ingested-r{rank}").touch()
             await blocking(_wait_for, scratch_p / f"ingested-r{1 - rank}")
             await blocking(cluster.flush)
+            # index this rank's partition (the per-rank search connector),
+            # then barrier so both indexes are populated before the
+            # cross-rank search-equality snapshot
+            await inst.pump_outbound()
+            (scratch_p / f"indexed-r{rank}").touch()
+            await blocking(_wait_for, scratch_p / f"indexed-r{1 - rank}")
             async with aiohttp.ClientSession() as session:
                 mine = await rest_snapshot(session, rests[rank])
                 theirs = await rest_snapshot(session, rests[1 - rank])
             assert mine == theirs, (rank, mine, theirs)
             assert mine["total"] == 2 * len(both), mine["total"]
+            assert len(mine["search"]) == 2 * len(both), mine["search"]
             m = await blocking(cluster.metrics)
             assert m["persisted"] == 2 * len(both), m
             print(f"CLUSTER_OK rank={rank} phase=1 "
@@ -226,11 +239,17 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
                     cluster.ingest_json_batch,
                     [_meas(toks1[0], "temp", 888.0, base_ms + 8888)])
                 await blocking(cluster.flush)
+                await inst.pump_outbound()
+                (scratch_p / "r0-pumped").touch()
+                await blocking(_wait_for, scratch_p / "r1-pumped")
                 async with aiohttp.ClientSession() as session:
                     mine = await rest_snapshot(session, rests[0])
                     theirs = await rest_snapshot(session, rests[1])
                 assert mine == theirs, (mine, theirs)
                 assert mine["total"] == 2 * len(both) + 2
+                # the recovered rank re-indexed its partition from its
+                # rebuilt feed: search is complete again cluster-wide
+                assert len(mine["search"]) == mine["total"], mine["search"]
                 print(f"CLUSTER_OK rank=0 phase=2 "
                       f"total={mine['total']} "
                       f"recovered_peer_serves_history=1", flush=True)
@@ -244,6 +263,14 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
             print(f"CLUSTER_RECOVERED rank=1 "
                   f"replayed_total={q['total']}", flush=True)
             (scratch_p / "r1-recovered").touch()
+            # re-index this rank's partition (fresh in-memory index after
+            # the crash; the rebuilt feed replays it) for rank 0's
+            # phase-2 search-equality snapshot, then wait for the final
+            # post-recovery write to index it too
+            await blocking(_wait_for, scratch_p / "r0-pumped",
+                           timeout_s=PHASE_TIMEOUT_S * 2)
+            await inst.pump_outbound()
+            (scratch_p / "r1-pumped").touch()
             await blocking(_wait_for, scratch_p / "r0-done",
                            timeout_s=PHASE_TIMEOUT_S * 2)
         asyncio.run_coroutine_threadsafe(srv.stop(), rpc_loop).result(15)
