@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 
 from sitewhere_tpu.commands.destinations import CommandDestination, DeliveryError
 from sitewhere_tpu.commands.model import (
@@ -42,6 +43,21 @@ class UndeliveredCommand:
     error: str
 
 
+def local_command_responses(engine, invocation_id: str,
+                            limit: int = 100) -> list[dict]:
+    """ONE engine's command responses for an invocation id string,
+    resolved against that engine's OWN interner (the string -> aux0
+    mapping must never cross cluster ranks). Shared by the single-engine
+    responses_for fallback and the cluster fan-out legs."""
+    from sitewhere_tpu.core.types import NULL_ID
+
+    oid = engine.event_ids.lookup(invocation_id)
+    if oid == NULL_ID:
+        return []
+    return engine.query_events(etype=EventType.COMMAND_RESPONSE,
+                               aux0=oid, limit=limit)["events"]
+
+
 class CommandDeliveryService(LifecycleComponent):
     """Owns registry, strategy, router, destinations, and the feed consumer."""
 
@@ -57,7 +73,11 @@ class CommandDeliveryService(LifecycleComponent):
         self.nested = NestedDeviceSupport(engine)
         self.destinations: dict[str, CommandDestination] = {}
         self.undelivered: list[UndeliveredCommand] = []
-        # pending invocations keyed by the engine event id lane (aux0)
+        # pending invocations keyed by the engine event id lane (aux0).
+        # _book guards _pending/history: the cluster RPC server thread
+        # calls accept_remote() concurrently with the REST loop's
+        # invoke()/pump()
+        self._book = threading.Lock()
         self._pending: dict[int, CommandInvocation] = {}
         # retained history for the CommandInvocations controller queries,
         # bounded FIFO so long-running instances don't grow without bound
@@ -79,7 +99,7 @@ class CommandDeliveryService(LifecycleComponent):
         Assignments controller -> addDeviceCommandInvocations analog).
         Delivery happens when the persisted event surfaces on the feed."""
         inv = CommandInvocation(
-            invocation_id=next_invocation_id(),
+            invocation_id=self._new_invocation_id(),
             command_token=command_token,
             device_token=device_token,
             tenant=tenant,
@@ -90,22 +110,64 @@ class CommandDeliveryService(LifecycleComponent):
         )
         # validate early so bad invocations fail at the API surface
         self.strategy.build_execution(inv)
-        self._pending[inv.invocation_id] = inv
+        # cluster deployments route the whole invocation to the device's
+        # owning rank (event persists there; THAT rank's delivery pump
+        # sees it on its feed) — the Kafka-topic hop of the reference's
+        # command chain. Plain engines have no hook and stage locally.
+        route = getattr(self.engine, "route_invocation", None)
+        if route is not None:
+            routed_id = route(inv)
+            if routed_id is not None:
+                inv.invocation_id = routed_id   # owner-assigned id space
+                with self._book:
+                    self._record_history(inv)
+                return inv
+        with self._book:
+            self._pending[inv.invocation_id] = inv
+            self._record_history(inv)
+        self._stage_invocation(inv)
+        return inv
+
+    def _new_invocation_id(self) -> int:
+        """Next invocation id in this deployment's id space: cluster
+        engines rank-tag it (local * n_ranks + rank) so ids from
+        different ranks can never collide in histories, pending sets, or
+        device acks; plain engines use the raw counter."""
+        iid = next_invocation_id()
+        tag = getattr(self.engine, "tag_invocation_id", None)
+        return tag(iid) if tag is not None else iid
+
+    def _record_history(self, inv: CommandInvocation) -> None:
         self.history[inv.invocation_id] = inv
         while len(self.history) > self.HISTORY_LIMIT:
             self.history.pop(next(iter(self.history)))
-        # persist through the pipeline; aux0 carries the invocation id
+
+    def _stage_invocation(self, inv: CommandInvocation) -> None:
+        """Persist through the pipeline; aux0 carries the invocation id."""
         from sitewhere_tpu.core.types import NULL_ID
 
         with self.engine.lock:
-            token_id = self.engine.tokens.intern(device_token)
-            tenant_id = self.engine.tenants.intern(tenant)
+            token_id = self.engine.tokens.intern(inv.device_token)
+            tenant_id = self.engine.tenants.intern(inv.tenant)
             now = self.engine.epoch.now_ms()
             self.engine._stage_row(
                 int(EventType.COMMAND_INVOCATION), token_id, tenant_id,
                 inv.ts_ms, now, None, None, inv.invocation_id, NULL_ID,
             )
-        return inv
+
+    def accept_remote(self, inv: CommandInvocation) -> int:
+        """Adopt an invocation routed here from another cluster rank (we
+        own the target device): re-key into THIS rank's id space
+        (process-global counters collide across ranks), register it
+        pending, and persist its event locally so the delivery pump picks
+        it off this rank's feed. Returns the adopted id."""
+        inv.invocation_id = self._new_invocation_id()
+        self.strategy.build_execution(inv)   # validate against OUR registry
+        with self._book:
+            self._pending[inv.invocation_id] = inv
+            self._record_history(inv)
+        self._stage_invocation(inv)
+        return inv.invocation_id
 
     # ---------------------------------------------------------------- pumping
     async def pump(self) -> int:
@@ -117,7 +179,8 @@ class CommandDeliveryService(LifecycleComponent):
         n = 0
         for ev in events:
             if ev.etype is EventType.COMMAND_INVOCATION:
-                inv = self._pending.pop(ev.aux0, None)
+                with self._book:
+                    inv = self._pending.pop(ev.aux0, None)
                 if inv is not None:
                     await self._route_and_deliver(inv)
                     n += 1
@@ -179,22 +242,27 @@ class CommandDeliveryService(LifecycleComponent):
 
     def get_invocation(self, invocation_id: int) -> CommandInvocation | None:
         """Lookup a retained invocation (CommandInvocations controller
-        GET /invocations/{id})."""
-        return self.history.get(invocation_id)
+        GET /invocations/{id}). On a cluster, an id this rank never saw
+        resolves at its OWNING rank (the id encodes it), so the endpoint
+        answers identically from every rank, not just originator/owner."""
+        inv = self.history.get(invocation_id)
+        if inv is not None:
+            return inv
+        fetch = getattr(self.engine, "fetch_invocation", None)
+        return fetch(invocation_id) if fetch is not None else None
 
     def responses_for(self, invocation_id: int, limit: int = 100) -> list[dict]:
         """Command responses whose originatingEventId names this invocation
         (CommandInvocations controller listCommandInvocationResponses).
         Devices post COMMAND_RESPONSE events with originatingEventId set to
         the string invocation id they received."""
-        from sitewhere_tpu.core.types import NULL_ID
-
-        oid = self.engine.event_ids.lookup(str(invocation_id))
-        if oid == NULL_ID:
-            return []
-        res = self.engine.query_events(
-            etype=EventType.COMMAND_RESPONSE, aux0=oid, limit=limit)
-        return res["events"]
+        # interner ids for the originating-id string diverge across
+        # cluster ranks: the fan-out resolves the STRING per rank
+        fan = getattr(self.engine, "command_responses", None)
+        if fan is not None:
+            return fan(str(invocation_id), limit)
+        return local_command_responses(self.engine, str(invocation_id),
+                                       limit)
 
     async def send_system_command(self, device_token: str, command: SystemCommand) -> None:
         """Deliver a system command (e.g. RegistrationAck) immediately."""

@@ -81,6 +81,12 @@ class SiteWhereTpuInstance(LifecycleComponent):
             self.command_registry,
         )
         self.add_child(self.commands)
+        # cluster-backed engines route invocations to the owning rank's
+        # service (see ClusterEngine.route_invocation); the hook gives
+        # the rank's RPC server a path to OUR pending set
+        attach_cmd = getattr(self.engine, "attach_command_service", None)
+        if attach_cmd is not None:
+            attach_cmd(self.commands)
 
         # batch + scheduling
         self.batch = BatchOperationManager()

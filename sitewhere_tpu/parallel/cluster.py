@@ -244,6 +244,14 @@ class _ClusterFeed:
             rec, event_id=rec.event_id * self._n + self._rank)
             for rec in self._feed.poll(*a, **kw)]
 
+    def commit(self, events) -> None:
+        # commit() decodes (arena, position) from the event id — it must
+        # see the LOCAL id, or every commit over-advances ~n_ranks x and
+        # silently skips events the consumer never delivered
+        self._feed.commit([
+            dataclasses.replace(ev, event_id=ev.event_id // self._n)
+            for ev in events])
+
     def __getattr__(self, name):
         return getattr(self._feed, name)
 
@@ -265,6 +273,7 @@ class ClusterEngine:
         self.local.epoch = EpochBase(config.epoch_base_unix_s)
         self.epoch = self.local.epoch
         self.search_index = None          # see attach_search_index
+        self.command_service = None       # see attach_command_service
         self._peers: dict[int, _SyncPeer] = {}
         self._peers_lock = threading.Lock()
         self._auth_token = cluster_system_jwt(config.secret)
@@ -446,7 +455,14 @@ class ClusterEngine:
 
     def query_events(self, **kw) -> dict:
         """Fan out to every rank, merge newest-first — the cross-partition
-        query the reference's REST tier performs over per-service gRPC."""
+        query the reference's REST tier performs over per-service gRPC.
+        String filters (device/tenant/area/customer/alternate_id) resolve
+        per rank; raw interner-id filters cannot cross ranks."""
+        if kw.get("aux0") is not None or kw.get("aux1") is not None:
+            raise ValueError(
+                "aux0/aux1 are rank-local interner ids and mean different "
+                "strings on other ranks — use command_responses() or "
+                "alternate_id instead")
         results = self._fanout(self.local.query_events(**kw),
                                "Cluster.queryEvents", **kw)
         events = [e for res in results for e in res["events"]]
@@ -496,6 +512,77 @@ class ClusterEngine:
         calls (the N^2-avoidance policy lives HERE, not in the web
         tier)."""
         return self.local.presence_sweep()
+
+    def attach_command_service(self, svc) -> None:
+        """Wire this rank's command-delivery service into the cluster
+        surface: remotely-routed invocations land in ITS pending set so
+        the rank's own delivery pump can deliver them (per-partition
+        consumers, reference-style). Placed on the local engine so the
+        rank's RPC server can reach it."""
+        self.command_service = svc
+        self.local.command_service = svc
+
+    def tag_invocation_id(self, local_id: int) -> int:
+        """Cluster-global invocation id: ``local * n_ranks + rank`` (the
+        event-id scheme) — histories/pending sets/device acks can never
+        collide across ranks."""
+        return local_id * self.n_ranks + self.rank
+
+    def command_responses(self, invocation_id: str,
+                          limit: int = 100) -> list[dict]:
+        """Command responses for one invocation, resolved PER RANK: the
+        originating-id string interns into each rank's own id space, so
+        the integer must never cross rank boundaries."""
+        from sitewhere_tpu.commands.service import local_command_responses
+
+        parts = self._fanout(
+            local_command_responses(self.local, invocation_id, limit),
+            "Cluster.commandResponses", invocationId=invocation_id,
+            limit=limit)
+        docs = [d for part in parts for d in part]
+        docs.sort(key=event_order_key)
+        return docs[:limit]
+
+    def fetch_invocation(self, invocation_id: int):
+        """Resolve an invocation this rank never saw at its OWNING rank
+        (the rank-tagged id encodes it) — GET /api/invocations/{id}
+        answers identically from every rank, not just originator/owner."""
+        from sitewhere_tpu.commands.model import CommandInvocation
+
+        r = invocation_id % self.n_ranks
+        if r == self.rank:
+            return _owned_invocation(self.local, invocation_id)
+        d = self._peer(r).call("Cluster.getInvocation",
+                               invocationId=invocation_id)
+        return CommandInvocation(**d) if d is not None else None
+
+    def route_invocation(self, inv) -> "int | None":
+        """Route a command invocation to its device's owning rank.
+        Returns the owner-assigned invocation id, or None when the device
+        is local (the caller stages it as usual)."""
+        r = self.owner(inv.device_token)
+        if r == self.rank:
+            return None
+        res = self._peer(r).call("Cluster.invokeCommand",
+                                 invocation=dataclasses.asdict(inv))
+        return int(res["invocationId"])
+
+    def _stage_row(self, et, token_id, tenant_id, ts, now, values, mask,
+                   aux0, aux1):
+        """Direct row staging must never silently persist a remote-owned
+        device's event on the wrong rank (the product paths — process(),
+        ingest, route_invocation — all route BEFORE staging; this guards
+        any other direct caller)."""
+        tid = int(token_id)
+        tok = (self.local.tokens.token(tid)
+               if 0 <= tid < len(self.local.tokens) else None)
+        if tok is not None and self.owner(tok) != self.rank:
+            raise NotImplementedError(
+                f"direct staging for {tok!r} (owned by rank "
+                f"{self.owner(tok)}) would persist on the wrong rank — "
+                "use the routed surfaces (process/ingest/invoke)")
+        return self.local._stage_row(et, token_id, tenant_id, ts, now,
+                                     values, mask, aux0, aux1)
 
     def attach_search_index(self, index) -> None:
         """Wire this rank's embedded event-search index into the cluster
@@ -555,6 +642,13 @@ class ClusterSearchProvider:
         if docs is None:   # facade has no index attached: local behavior
             return self._local.search(query, max_results)
         return docs
+
+
+def _owned_invocation(engine, invocation_id: int):
+    """The owner-side invocation lookup (one copy for the facade's local
+    branch and the Cluster.getInvocation RPC handler)."""
+    svc = getattr(engine, "command_service", None)
+    return svc.history.get(invocation_id) if svc is not None else None
 
 
 def replay_wal_through(cluster: ClusterEngine, wal_dir,
@@ -684,6 +778,25 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def presence_sweep():
         return engine.presence_sweep()
 
+    def command_responses(invocationId: str, limit: int = 100):
+        from sitewhere_tpu.commands.service import local_command_responses
+
+        return local_command_responses(engine, invocationId, limit)
+
+    def get_invocation(invocationId: int):
+        inv = _owned_invocation(engine, invocationId)
+        return dataclasses.asdict(inv) if inv is not None else None
+
+    def invoke_command(invocation: dict):
+        svc = getattr(engine, "command_service", None)
+        if svc is None:
+            raise ValueError(
+                "no command-delivery service attached on this rank")
+        from sitewhere_tpu.commands.model import CommandInvocation
+
+        return {"invocationId": svc.accept_remote(
+            CommandInvocation(**invocation))}
+
     def search_events(query: str, maxResults: int = 100):
         # the rank's embedded index attaches AFTER server construction
         # (instance wiring) — resolve lazily; None (vs []) tells the
@@ -712,6 +825,9 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.deviceCount": device_count,
         "Cluster.metrics": metrics,
         "Cluster.presenceSweep": presence_sweep,
+        "Cluster.invokeCommand": invoke_command,
+        "Cluster.getInvocation": get_invocation,
+        "Cluster.commandResponses": command_responses,
         "Cluster.searchEvents": search_events,
         "Cluster.flush": flush,
     }.items():

@@ -1543,7 +1543,9 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     # /id/{id}/summary — invocation + its responses in one view)
     async def get_invocation_summary(request: web.Request):
         inv_id = int(request.match_info["id"])
-        inv = inst.commands.history.get(inv_id)
+        # through get_invocation, not raw history: on a cluster it
+        # resolves ids this rank never saw at their owning rank
+        inv = inst.commands.get_invocation(inv_id)
         if inv is None:
             raise EntityNotFound("unknown invocation")
         # responses store aux0 = interner id of the originatingEventId

@@ -578,6 +578,197 @@ def test_cluster_search_fails_loudly_without_peer_index(tmp_path):
         _close(clusters, host)
 
 
+def test_cluster_command_invocation_delivers_at_owning_rank(tmp_path):
+    """The downlink over the cluster: an invocation accepted at ANY rank
+    routes to the device's owner, persists there, and THAT rank's
+    delivery pump encodes + delivers it (the reference's command chain:
+    REST anywhere -> event-management partition -> the partition
+    consumer's destinations). Command definitions follow the management
+    deployment recipe (created on every rank)."""
+    from sitewhere_tpu.commands.destinations import (CommandDestination,
+                                                     LocalDeliveryProvider,
+                                                     mqtt_topic_extractor)
+    from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
+    from sitewhere_tpu.commands.model import (CommandParameter,
+                                              DeviceCommand, ParameterType)
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        insts = [SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c)
+            for c in clusters]
+        providers = []
+        for inst in insts:   # the broadcast recipe: same command + a
+            inst.command_registry.create(DeviceCommand(  # local dest on
+                token="reboot", device_type="default",   # every rank
+                name="reboot",
+                parameters=(CommandParameter("delay", ParameterType.INT64,
+                                             required=True),)))
+            p = LocalDeliveryProvider()
+            providers.append(p)
+            inst.commands.add_destination(CommandDestination(
+                "default", mqtt_topic_extractor(),
+                JsonCommandExecutionEncoder(), p))
+        remote_tok = tokens_owned_by(1, 1, prefix="cmd")[0]
+        local_tok = tokens_owned_by(0, 1, prefix="cmd")[0]
+        c0.register_device(remote_tok, "default")
+        c0.register_device(local_tok, "default")
+        # a LOCAL invocation first: its id and the routed one must live
+        # in disjoint (rank-tagged) id spaces — no history collisions
+        inv_local = insts[0].commands.invoke(local_tok, "reboot",
+                                             {"delay": 1})
+        # invoke at the NON-owner rank
+        inv = insts[0].commands.invoke(remote_tok, "reboot",
+                                       {"delay": 5})
+        assert inv.invocation_id != inv_local.invocation_id
+        assert inv.invocation_id % 2 == 1       # owner rank 1's id space
+        assert inv_local.invocation_id % 2 == 0
+        c0.flush()
+        loop = asyncio.new_event_loop()
+        try:
+            # rank 0's pump delivers only ITS partition (the local inv)...
+            assert loop.run_until_complete(insts[0].commands.pump()) == 1
+            # ...rank 1's pump delivers the routed one from ITS feed
+            assert loop.run_until_complete(insts[1].commands.pump()) == 1
+        finally:
+            loop.close()
+        assert len(providers[1].delivered) == 1
+        assert len(providers[0].delivered) == 1
+        assert insts[0].commands.get_invocation(
+            inv_local.invocation_id).device_token == local_tok
+        _target, payload, _system = providers[1].delivered[0]
+        assert b"reboot" in payload
+        assert insts[1].commands.undelivered == []
+        # the invocation EVENT persisted at the owner and is visible
+        # cluster-wide; both ranks' history carries the same owner id
+        from sitewhere_tpu.core.types import EventType
+        q = c0.query_events(device_token=remote_tok,
+                            etype=EventType.COMMAND_INVOCATION)
+        assert q["total"] == 1
+        assert insts[0].commands.get_invocation(inv.invocation_id) \
+            is not None
+        assert insts[1].commands.get_invocation(inv.invocation_id) \
+            is not None
+        # a device ack (COMMAND_RESPONSE naming the invocation) lands at
+        # the owner; responses_for answers identically from BOTH ranks
+        c0.ingest_json_batch([json.dumps({
+            "deviceToken": remote_tok, "type": "Acknowledge",
+            "request": {"originatingEventId": str(inv.invocation_id),
+                        "response": "done",
+                        "eventDate": BASE_MS + 999}}).encode()])
+        c0.flush()
+        r0 = insts[0].commands.responses_for(inv.invocation_id)
+        r1 = insts[1].commands.responses_for(inv.invocation_id)
+        assert len(r0) == len(r1) == 1
+        assert r0[0]["originatingEventId"] == str(inv.invocation_id)
+        # ...and no cross-talk with the local invocation's responses
+        assert insts[0].commands.responses_for(
+            inv_local.invocation_id) == []
+        # raw interner-id filters are refused at the cluster surface
+        with pytest.raises(ValueError, match="rank-local"):
+            c0.query_events(aux0=3)
+        # direct wrong-rank staging stays LOUD, never silent
+        with pytest.raises(NotImplementedError, match="owned by rank"):
+            with c0.lock:
+                c0._stage_row(1, c0.local.tokens.intern(remote_tok), 0,
+                              0, 0, None, None, -1, -1)
+    finally:
+        _close(clusters, host)
+
+
+def test_cluster_feed_commit_does_not_skip_events(tmp_path):
+    """Review r4 repro: ClusterFeed translates ids on poll, so commit
+    must UNTRANSLATE them — otherwise each commit over-advances ~n_ranks
+    x and silently skips undelivered invocations. Four invocations with
+    interleaved telemetry, pumping after each, must all deliver."""
+    from sitewhere_tpu.commands.destinations import (CommandDestination,
+                                                     LocalDeliveryProvider,
+                                                     mqtt_topic_extractor)
+    from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
+    from sitewhere_tpu.commands.model import DeviceCommand
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        insts = [SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c)
+            for c in clusters]
+        for inst in insts:
+            inst.command_registry.create(DeviceCommand(
+                token="ping", device_type="default", name="ping"))
+            inst.commands.add_destination(CommandDestination(
+                "default", mqtt_topic_extractor(),
+                JsonCommandExecutionEncoder(), LocalDeliveryProvider()))
+        tok = tokens_owned_by(1, 1, prefix="fc")[0]
+        c0.register_device(tok, "default")
+        loop = asyncio.new_event_loop()
+        try:
+            delivered = 0
+            for i in range(4):
+                insts[0].commands.invoke(tok, "ping")
+                # interleaved telemetry widens the feed between commits
+                c0.ingest_json_batch([meas(tok, "t", float(i), 50 + i)])
+                c0.flush()
+                delivered += loop.run_until_complete(
+                    insts[1].commands.pump())
+            delivered += loop.run_until_complete(insts[1].commands.pump())
+        finally:
+            loop.close()
+        assert delivered == 4, delivered
+        assert insts[1].commands._pending == {}
+    finally:
+        _close(clusters, host)
+
+
+def test_invocation_readable_from_third_rank(tmp_path):
+    """GET /api/invocations/{id} must answer from a rank that is NEITHER
+    originator nor owner: the rank-tagged id routes the lookup to its
+    owning rank (review r4 — invisible at n_ranks=2)."""
+    from sitewhere_tpu.commands.model import (CommandParameter,
+                                              DeviceCommand, ParameterType)
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+
+    ports = _free_ports(3)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    host = _ServerHost()
+    clusters = []
+    for r in range(3):
+        cc = ClusterConfig(rank=r, n_ranks=3, peers=peers, secret="i3",
+                           epoch_base_unix_s=BASE_S,
+                           engine=_engine_cfg(None, r, n_shards=1),
+                           connect_timeout_s=10.0)
+        c = ClusterEngine(cc)
+        host.start(build_cluster_rpc(c.local, "i3"), ports[r])
+        clusters.append(c)
+    try:
+        insts = [SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c)
+            for c in clusters]
+        for inst in insts:
+            inst.command_registry.create(DeviceCommand(
+                token="ping", device_type="default", name="ping"))
+        tok = tokens_owned_by(1, 1, n_ranks=3, prefix="inv3")[0]
+        clusters[0].register_device(tok, "default")
+        inv = insts[0].commands.invoke(tok, "ping")
+        assert inv.invocation_id % 3 == 1     # owner rank 1's id space
+        # rank 2 saw nothing locally; the lookup routes to the owner
+        got = insts[2].commands.get_invocation(inv.invocation_id)
+        assert got is not None
+        assert got.device_token == tok and got.command_token == "ping"
+        assert insts[2].commands.get_invocation(999_999 * 3 + 1) is None
+    finally:
+        _close(clusters, host)
+
+
 def test_cluster_rank_count_reshard_by_wal_replay(tmp_path):
     """Rank-count elasticity: ownership is token-hash % n_ranks, so
     changing the rank count re-partitions devices. Replaying every old
