@@ -108,6 +108,35 @@ def _close(clusters, host):
     host.close()
 
 
+def _mk_instances_with_command(clusters, command_token="ping"):
+    """One SiteWhereTpuInstance per rank, the same command registered on
+    every rank (the management broadcast recipe) and a local delivery
+    destination each. Returns (instances, providers)."""
+    from sitewhere_tpu.commands.destinations import (CommandDestination,
+                                                     LocalDeliveryProvider,
+                                                     mqtt_topic_extractor)
+    from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
+    from sitewhere_tpu.commands.model import DeviceCommand
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+
+    insts, providers = [], []
+    for c in clusters:
+        inst = SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c)
+        inst.command_registry.create(DeviceCommand(
+            token=command_token, device_type="default",
+            name=command_token))
+        p = LocalDeliveryProvider()
+        inst.commands.add_destination(CommandDestination(
+            "default", mqtt_topic_extractor(),
+            JsonCommandExecutionEncoder(), p))
+        insts.append(inst)
+        providers.append(p)
+    return insts, providers
+
+
 def tokens_owned_by(rank, n=4, n_ranks=2, prefix="cd"):
     out, i = [], 0
     while len(out) < n:
@@ -685,27 +714,10 @@ def test_cluster_feed_commit_does_not_skip_events(tmp_path):
     must UNTRANSLATE them — otherwise each commit over-advances ~n_ranks
     x and silently skips undelivered invocations. Four invocations with
     interleaved telemetry, pumping after each, must all deliver."""
-    from sitewhere_tpu.commands.destinations import (CommandDestination,
-                                                     LocalDeliveryProvider,
-                                                     mqtt_topic_extractor)
-    from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
-    from sitewhere_tpu.commands.model import DeviceCommand
-    from sitewhere_tpu.engine import EngineConfig
-    from sitewhere_tpu.instance.instance import (InstanceConfig,
-                                                 SiteWhereTpuInstance)
-
     clusters, host, _ = _mk_cluster(tmp_path)
     c0, c1 = clusters
     try:
-        insts = [SiteWhereTpuInstance(
-            InstanceConfig(engine=EngineConfig()), engine=c)
-            for c in clusters]
-        for inst in insts:
-            inst.command_registry.create(DeviceCommand(
-                token="ping", device_type="default", name="ping"))
-            inst.commands.add_destination(CommandDestination(
-                "default", mqtt_topic_extractor(),
-                JsonCommandExecutionEncoder(), LocalDeliveryProvider()))
+        insts, _providers = _mk_instances_with_command(clusters)
         tok = tokens_owned_by(1, 1, prefix="fc")[0]
         c0.register_device(tok, "default")
         loop = asyncio.new_event_loop()
@@ -723,6 +735,49 @@ def test_cluster_feed_commit_does_not_skip_events(tmp_path):
             loop.close()
         assert delivered == 4, delivered
         assert insts[1].commands._pending == {}
+    finally:
+        _close(clusters, host)
+
+
+def test_batch_command_operation_spans_cluster(tmp_path):
+    """A batch command created at ONE rank fans its per-device
+    invocations across the cluster: local devices deliver locally,
+    remote ones route to their owner's pump (the reference's
+    batch-operations -> command chain over partitioned topics)."""
+    from sitewhere_tpu.commands.destinations import (CommandDestination,
+                                                     LocalDeliveryProvider,
+                                                     mqtt_topic_extractor)
+    from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
+    from sitewhere_tpu.commands.model import DeviceCommand
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        insts, providers = _mk_instances_with_command(clusters)
+        toks = tokens_owned_by(0, 2, prefix="bat") + \
+            tokens_owned_by(1, 2, prefix="bat")
+        for t in toks:
+            c0.register_device(t, "default")
+        insts[0].batch.create_operation("bat-1", "InvokeCommand", toks,
+                                        parameters={"commandToken": "ping"})
+        loop = asyncio.new_event_loop()
+        try:
+            op = loop.run_until_complete(
+                insts[0].batch.process_operation("bat-1"))
+            assert op.counts()["SUCCEEDED"] == 4
+            c0.flush()
+            n1 = loop.run_until_complete(insts[1].commands.pump())
+        finally:
+            loop.close()
+        # rank 0's pump ran inside the batch handler; rank 1 delivers its
+        # routed half from its own feed
+        assert len(providers[0].delivered) == 2
+        assert n1 == 2 and len(providers[1].delivered) == 2
+        assert insts[0].commands.undelivered == []
+        assert insts[1].commands.undelivered == []
     finally:
         _close(clusters, host)
 
