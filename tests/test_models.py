@@ -178,22 +178,28 @@ def test_analytics_checkpoint_roundtrip(tmp_path):
     eng = Engine(EngineConfig(
         device_capacity=32, token_capacity=64, assignment_capacity=64,
         store_capacity=1024, batch_capacity=16, channels=4,
-        analytics_devices=8, analytics_window=16))
+        analytics_devices=8, analytics_window=8))
     rng = np.random.default_rng(0)
-    for step in range(16):
+    for step in range(10):
         for d in range(4):
             eng.process(DecodedRequest(
                 type=RequestType.DEVICE_MEASUREMENT, device_token=f"an-{d}",
                 measurements={"v": float(rng.standard_normal())},
                 event_ts_ms=None))
         eng.flush()
-    svc = AnalyticsService(eng, min_fill=8, learning_rate=1e-3)
+    from sitewhere_tpu.models.anomaly import AnomalyConfig
+
+    # tiny model: the roundtrip property is size-independent and the
+    # default 256-hidden LSTM costs ~25s of CPU-mesh compile alone
+    tiny = AnomalyConfig(sensors=4, window=8, hidden=32, lstm_hidden=32,
+                         latent=8)
+    svc = AnalyticsService(eng, cfg=tiny, min_fill=8, learning_rate=1e-3)
     loss = svc.train_on_live(batch_size=4, steps=2)
     assert loss == loss  # trained (not NaN)
     before = svc.score_all()
 
     svc.save_model(tmp_path / "ckpt")
-    svc2 = AnalyticsService(eng, min_fill=8)
+    svc2 = AnalyticsService(eng, cfg=tiny, min_fill=8)
     svc2.restore_model(tmp_path / "ckpt")
     after = svc2.score_all()
     np.testing.assert_allclose(np.asarray(after["scores"]),
