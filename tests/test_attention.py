@@ -121,11 +121,14 @@ def test_transformer_train_step_reduces_loss(rng):
 
 def test_transformer_sp_grads_finite(rng, sp_mesh):
     """AD flows through the ring (fori_loop + ppermute) — grads are finite
-    and match the single-device gradient direction. A 1-layer model: the
-    differentiated ring is identical per layer, and the 2-layer config's
-    gradient compile alone costs ~70s on the virtual CPU mesh."""
-    cfg = TransformerConfig(sensors=8, d_model=32, heads=2, layers=1,
-                            mlp=64, dtype=jnp.float32)
+    and match the single-device gradient direction. Depth AND width are
+    trimmed purely for gradient-compile time on the virtual CPU mesh
+    (~70s at the _small_cfg size): the differentiated ring is identical
+    per layer and per head."""
+    import dataclasses
+
+    cfg = dataclasses.replace(_small_cfg(), layers=1, d_model=32, heads=2,
+                              mlp=64)
     params = init_params(jax.random.key(0), cfg)
     x = jnp.asarray(rng.standard_normal((1, 32, cfg.sensors)), jnp.float32)
 
