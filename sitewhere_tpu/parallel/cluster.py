@@ -116,11 +116,17 @@ class _SyncPeer:
     the connection; ``call()`` blocks the calling thread only (the engine
     surface is synchronous, like the reference's blocking gRPC stubs)."""
 
-    def __init__(self, addr: str, auth_token: str, timeout_s: float = 30.0):
+    def __init__(self, addr: str, token_factory, timeout_s: float = 30.0):
         host, _, port = addr.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
-        self.auth_token = auth_token
+        # a FACTORY, not a token: JwtService.validate enforces exp, so a
+        # token minted once at engine construction would turn every
+        # reconnect after its 24h expiry into a permanent 401 — mint
+        # fresh per connection attempt instead
+        self.token_factory = token_factory
         self.timeout_s = timeout_s
+        self.grace_s = 30.0     # server-side processing allowance on top
+                                # of the connect timeout
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(target=self._loop.run_forever,
                                         daemon=True)
@@ -129,8 +135,15 @@ class _SyncPeer:
         self._lock = threading.Lock()
 
     def _run(self, coro):
-        return asyncio.run_coroutine_threadsafe(coro, self._loop).result(
-            self.timeout_s + 30.0)
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        try:
+            return fut.result(self.timeout_s + self.grace_s)
+        except TimeoutError:
+            # the coroutine is still running on the background loop —
+            # cancel it so the shared client isn't left with a pending
+            # future silently consuming the next response off the wire
+            fut.cancel()
+            raise
 
     def _connect(self):
         from sitewhere_tpu.rpc.client import RpcClient
@@ -141,12 +154,30 @@ class _SyncPeer:
             try:
                 return self._run(RpcClient(
                     host=self.host, port=self.port,
-                    auth_token=self.auth_token).connect())
-            except (ConnectionError, OSError) as e:
+                    auth_token=self.token_factory()).connect())
+            except (ConnectionError, OSError, TimeoutError) as e:
+                # TimeoutError: half-open peer accepted TCP but never
+                # answered the handshake — retry like any connect failure
                 last = e
                 time.sleep(0.1)
         raise ConnectionError(
             f"peer {self.host}:{self.port} unreachable: {last}")
+
+    def _reconnect(self, stale) -> "Any":
+        """Drop ``stale`` (connection state indeterminate after an error
+        or timeout) and return a fresh client. If the fresh connect
+        fails, the slot is left empty so the next caller retries from
+        scratch instead of reusing a closed client."""
+        with self._lock:
+            if self._client is stale:
+                try:
+                    self._run(stale.close())
+                except Exception:
+                    pass
+                self._client = None
+            if self._client is None:
+                self._client = self._connect()
+            return self._client
 
     def call(self, method: str, **params: Any) -> Any:
         with self._lock:
@@ -156,15 +187,26 @@ class _SyncPeer:
         try:
             return self._run(client.call(method, **params))
         except ConnectionError:
-            # one reconnect attempt: the peer may have restarted (crash
-            # recovery) — the reference's gRPC channels reconnect the same
-            # way
-            with self._lock:
-                if self._client is client:
-                    self._run(client.close())
-                    self._client = self._connect()
-                client = self._client
+            # one retry over a fresh connection: the peer may have
+            # restarted (crash recovery) — the reference's gRPC channels
+            # reconnect the same way
+            client = self._reconnect(client)
             return self._run(client.call(method, **params))
+        except TimeoutError:
+            # a timed-out call is INDETERMINATE: the peer may still be
+            # executing it, so auto-retrying would double-execute
+            # non-idempotent RPCs (invokeCommand, registerDevice).
+            # Reconnect so the NEXT caller gets a clean connection (the
+            # cancelled future must not eat a later response), then
+            # surface the timeout — idempotent callers retry themselves.
+            try:
+                self._reconnect(client)
+            except ConnectionError:
+                pass   # slot left empty; the next call() reconnects
+            raise TimeoutError(
+                f"peer {self.host}:{self.port} timed out on {method} "
+                f"after {self.timeout_s + self.grace_s:.1f}s (result "
+                "indeterminate — not auto-retried)") from None
 
     def close(self) -> None:
         with self._lock:
@@ -268,15 +310,27 @@ class ClusterEngine:
         self.cluster_config = config
         self.rank = config.rank
         self.n_ranks = config.n_ranks
-        self.local = local if local is not None else DistributedEngine(
-            config.engine)
+        if local is not None:
+            # a pre-built engine (recover_distributed) carries the epoch
+            # base its snapshot/WAL were written under; silently replacing
+            # it with a drifted configured base would shift every stored
+            # relative timestamp — refuse instead
+            base = getattr(local.epoch, "base_unix_s", None)
+            if base is not None and abs(base - config.epoch_base_unix_s) > 1e-3:
+                raise ValueError(
+                    f"recovered engine epoch base {base} != configured "
+                    f"cluster base {config.epoch_base_unix_s}: the cluster "
+                    "must keep the base its history was written under")
+            self.local = local
+        else:
+            self.local = DistributedEngine(config.engine)
         self.local.epoch = EpochBase(config.epoch_base_unix_s)
         self.epoch = self.local.epoch
         self.search_index = None          # see attach_search_index
         self.command_service = None       # see attach_command_service
         self._peers: dict[int, _SyncPeer] = {}
         self._peers_lock = threading.Lock()
-        self._auth_token = cluster_system_jwt(config.secret)
+        self._token_factory = lambda: cluster_system_jwt(config.secret)
 
     # ------------------------------------------------------------- plumbing
     def __getattr__(self, name):
@@ -289,7 +343,7 @@ class ClusterEngine:
             peer = self._peers.get(rank)
             if peer is None:
                 peer = self._peers[rank] = _SyncPeer(
-                    self.cluster_config.peers[rank], self._auth_token,
+                    self.cluster_config.peers[rank], self._token_factory,
                     self.cluster_config.connect_timeout_s)
             return peer
 

@@ -203,18 +203,26 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         if not isinstance(body, dict):
             return json_response({"error": "JSON object body required"},
                                  status=400)
-        with inst.engine.lock:
-            stats = arch.compact(target_rows=body.get("targetRows"))
-        return json_response(stats)
+
+        def run():
+            # long file I/O under the engine lock — keep it OFF the
+            # gateway loop (matches the to_thread treatment of
+            # presence_sweep/search) so REST stays responsive meanwhile
+            with inst.engine.lock:
+                return arch.compact(target_rows=body.get("targetRows"))
+
+        return json_response(await asyncio.to_thread(run))
 
     async def purge_retired_archive(request: web.Request):
         arch = getattr(inst.engine, "archive", None)
         if arch is None:
             return json_response({"error": "no archive configured"},
                                  status=404)
-        with inst.engine.lock:
-            freed = arch.purge_retired()
-        return json_response({"freedBytes": freed})
+        def run():
+            with inst.engine.lock:
+                return arch.purge_retired()
+
+        return json_response({"freedBytes": await asyncio.to_thread(run)})
 
     r.add_post("/api/instance/archive/compact", _admin(compact_archive))
     r.add_post("/api/instance/archive/purge-retired",

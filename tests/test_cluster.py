@@ -954,3 +954,98 @@ def test_binary_token_of():
     assert binary_token_of(encode_binary_request(req)) == "bin-7"
     assert binary_token_of(b"") is None
     assert binary_token_of(b"\xff\x01\x02\x00xx") is None
+
+
+def test_cluster_engine_refuses_epoch_base_drift(tmp_path):
+    # ADVICE r4: a recovered engine carries the epoch base its snapshot/
+    # WAL were written under — a drifted configured base must raise, not
+    # silently shift every stored relative timestamp
+    from sitewhere_tpu.core.events import EpochBase
+    from sitewhere_tpu.parallel.distributed import DistributedEngine
+
+    eng = DistributedEngine(_engine_cfg(tmp_path))
+    eng.epoch = EpochBase(BASE_S - 3600.0)   # snapshot written an hour ago
+    cc = ClusterConfig(rank=0, n_ranks=1, peers=["127.0.0.1:1"],
+                       secret="s", epoch_base_unix_s=BASE_S)
+    with pytest.raises(ValueError, match="epoch base"):
+        ClusterEngine(cc, local=eng)
+    # matching base is accepted (the recover_distributed path)
+    cc_ok = ClusterConfig(rank=0, n_ranks=1, peers=["127.0.0.1:1"],
+                          secret="s", epoch_base_unix_s=BASE_S - 3600.0)
+    ClusterEngine(cc_ok, local=eng).close()
+
+
+def test_sync_peer_mints_fresh_token_per_connection(tmp_path):
+    # ADVICE r4 (medium): a token minted once at engine construction
+    # expires after 24h and every later reconnect 401s permanently —
+    # the peer must call the token FACTORY on each connection attempt
+    from sitewhere_tpu.parallel.cluster import (_SyncPeer,
+                                                cluster_system_jwt)
+    from sitewhere_tpu.parallel.distributed import DistributedEngine
+
+    secret = "mint-secret"
+    eng = DistributedEngine(_engine_cfg(tmp_path))
+    host = _ServerHost()
+    [port] = _free_ports(1)
+    mints = []
+
+    def factory():
+        mints.append(1)
+        return cluster_system_jwt(secret)
+
+    srv = build_cluster_rpc(eng, secret)
+    host.start(srv, port)
+    peer = _SyncPeer(f"127.0.0.1:{port}", factory, timeout_s=10.0)
+    try:
+        assert peer.call("Cluster.deviceCount") == 0
+        assert len(mints) == 1
+        # server restart = the crash-recovery reconnect path: a SECOND
+        # mint must happen (a cached token would be stale by then)
+        host.stop(srv)
+        srv2 = build_cluster_rpc(eng, secret)
+        host.start(srv2, port)
+        assert peer.call("Cluster.deviceCount") == 0
+        assert len(mints) == 2
+    finally:
+        peer.close()
+        host.close()
+
+
+def test_sync_peer_timeout_reconnects_cleanly(tmp_path):
+    # ADVICE r4: a slow peer used to leak a pending future on the shared
+    # client with no reconnect — the next caller reused a connection in
+    # an indeterminate state. A timeout must cancel + reconnect.
+    from sitewhere_tpu.parallel.cluster import (_SyncPeer,
+                                                cluster_system_jwt)
+    from sitewhere_tpu.parallel.distributed import DistributedEngine
+
+    secret = "slow-secret"
+    eng = DistributedEngine(_engine_cfg(tmp_path))
+    host = _ServerHost()
+    [port] = _free_ports(1)
+    srv = build_cluster_rpc(eng, secret)
+
+    async def slow():
+        await asyncio.sleep(3.0)
+        return {"ok": True}
+
+    srv.register("Test.slow", slow)
+    host.start(srv, port)
+    peer = _SyncPeer(f"127.0.0.1:{port}",
+                     lambda: cluster_system_jwt(secret), timeout_s=1.0)
+    peer.grace_s = 0.2   # result window 1.2s < the 3s handler
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="indeterminate"):
+            # times out and is NOT auto-retried (the peer may still be
+            # executing it — a retry would double-execute non-idempotent
+            # RPCs); the in-flight future is cancelled, not leaked
+            peer.call("Test.slow")
+        assert time.monotonic() - t0 < 30.0
+        peer.grace_s = 30.0
+        # the shared peer still works: fresh connection, no stale
+        # pending future consuming the next response off the wire
+        assert peer.call("Cluster.deviceCount") == 0
+    finally:
+        peer.close()
+        host.close()
