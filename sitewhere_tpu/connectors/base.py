@@ -50,7 +50,10 @@ class DeviceTypeFilter:
         self.include = operation == "include"
 
     def is_excluded(self, event: OutboundEvent) -> bool:
-        info = self.engine.devices.get(event.device_id)
+        from sitewhere_tpu.engine import local_device_info
+
+        # feed records carry THIS rank's local device ids
+        info = local_device_info(self.engine, event.device_id)
         member = info is not None and info.device_type in self.device_types
         return (not member) if self.include else member
 

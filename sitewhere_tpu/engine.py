@@ -469,6 +469,15 @@ class DeviceInfo:
     auto_registered: bool = False
 
 
+def local_device_info(engine, device_id: int, default=None):
+    """DeviceInfo for a rank-LOCAL device id — the lookup for records this
+    engine produced itself (feed records, analytics tables, dead letters).
+    Device ids are rank-scoped, so on a cluster facade this must read the
+    local rank's mirror, never fan out (the same integer names a different
+    device on every rank)."""
+    return getattr(engine, "local", engine).devices.get(device_id, default)
+
+
 class _FairChunk:
     """A run of staged rows for one tenant awaiting fair batch formation.
     ``pos`` advances as formation slices rows out; arrays are never copied

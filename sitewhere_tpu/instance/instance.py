@@ -178,6 +178,10 @@ class SiteWhereTpuInstance(LifecycleComponent):
         # tenant -> {"config": dict, "summary": dict}
         self.tenant_configs: dict[str, dict] = {}
 
+        # extra readiness fields served on the public health route
+        # (run_rank fills in rank/peers/ports once the rank can serve)
+        self.health_extra: dict = {}
+
     async def on_stop(self) -> None:
         if self._scripts_tmpdir is not None:
             import shutil

@@ -179,6 +179,22 @@ class _SyncPeer:
                 self._client = self._connect()
             return self._client
 
+    def _timed_out(self, client, method: str) -> "Any":
+        """A timed-out call is INDETERMINATE: the peer may still be
+        executing it, so auto-retrying would double-execute
+        non-idempotent RPCs (invokeCommand, registerDevice). Reconnect
+        so the NEXT caller gets a clean connection (the cancelled future
+        must not eat a later response), then surface the timeout —
+        idempotent callers retry themselves."""
+        try:
+            self._reconnect(client)
+        except ConnectionError:
+            pass   # slot left empty; the next call() reconnects
+        raise TimeoutError(
+            f"peer {self.host}:{self.port} timed out on {method} "
+            f"after {self.timeout_s + self.grace_s:.1f}s (result "
+            "indeterminate — not auto-retried)") from None
+
     def call(self, method: str, **params: Any) -> Any:
         with self._lock:
             if self._client is None:
@@ -191,22 +207,15 @@ class _SyncPeer:
             # restarted (crash recovery) — the reference's gRPC channels
             # reconnect the same way
             client = self._reconnect(client)
-            return self._run(client.call(method, **params))
-        except TimeoutError:
-            # a timed-out call is INDETERMINATE: the peer may still be
-            # executing it, so auto-retrying would double-execute
-            # non-idempotent RPCs (invokeCommand, registerDevice).
-            # Reconnect so the NEXT caller gets a clean connection (the
-            # cancelled future must not eat a later response), then
-            # surface the timeout — idempotent callers retry themselves.
             try:
-                self._reconnect(client)
-            except ConnectionError:
-                pass   # slot left empty; the next call() reconnects
-            raise TimeoutError(
-                f"peer {self.host}:{self.port} timed out on {method} "
-                f"after {self.timeout_s + self.grace_s:.1f}s (result "
-                "indeterminate — not auto-retried)") from None
+                return self._run(client.call(method, **params))
+            except TimeoutError:
+                # the RETRY timing out needs the same indeterminate
+                # handling (an except clause does not catch exceptions
+                # raised by its sibling)
+                self._timed_out(client, method)
+        except TimeoutError:
+            self._timed_out(client, method)
 
     def close(self) -> None:
         with self._lock:
@@ -242,11 +251,14 @@ def _merge_counts(dicts: list[dict]) -> dict:
 
 
 class _MergedDevices:
-    """Read-only merged view of every rank's device mirror, shaped like
-    the dict the management layer iterates (``.values()`` /
-    ``.get(local_id)`` / ``len``). Local ids are rank-scoped, so ``get``
-    answers from the local rank only (feed/connector records carry local
-    ids of the rank that produced them)."""
+    """Read-only merged view of every rank's device mirror
+    (``.values()`` / ``len`` fan out to every rank). There is NO by-id
+    ``get``: device ids are rank-scoped, so the same integer names a
+    DIFFERENT device on every rank — a dict-shaped ``get`` would answer
+    from whichever rank it ran on and silently alias. By-id lookups are
+    either local by construction (feed/connector/analytics records of
+    THIS rank — use ``get_local`` / ``local_device_info``) or routed by
+    token (``ClusterEngine.get_device``)."""
 
     def __init__(self, cluster: "ClusterEngine"):
         self._c = cluster
@@ -261,6 +273,16 @@ class _MergedDevices:
         return out
 
     def get(self, key, default=None):
+        raise TypeError(
+            "device ids are rank-local: the same integer names a "
+            "different device on every rank, so a cluster-wide by-id "
+            "get() cannot exist. Use devices.get_local(id) for records "
+            "produced by THIS rank (feeds/connectors/analytics), or "
+            "engine.get_device(token) for a routed lookup.")
+
+    def get_local(self, key, default=None):
+        """This rank's mirror only — correct for local ids (this rank's
+        feed records, analytics tables, dead letters)."""
         return self._c.local.devices.get(key, default)
 
     def __len__(self) -> int:
@@ -689,7 +711,37 @@ class ClusterSearchProvider:
     def __init__(self, cluster: ClusterEngine, local_index):
         self._cluster = cluster
         self._local = local_index
-        self.info = local_index.info
+
+    @property
+    def provider_id(self) -> str:
+        return self._local.provider_id
+
+    @provider_id.setter
+    def provider_id(self, value: str) -> None:
+        self._local.provider_id = value
+
+    @property
+    def info(self):
+        """Cluster-wide provider info: ``docs`` sums every rank's corpus
+        (the listing must describe what ``search()`` actually searches,
+        not the local slice). A peer whose index isn't attached yet
+        counts 0, and an UNREACHABLE peer is skipped — the listing is a
+        health surface, not a query, so it must not raise (search()
+        itself stays loud about incomplete merges)."""
+        from sitewhere_tpu.search.index import SearchProviderInfo
+
+        c = self._cluster
+        docs = len(self._local.docs)
+        for r in range(c.n_ranks):
+            if r == c.rank:
+                continue
+            try:
+                docs += c._peer(r).call("Cluster.searchInfo") or 0
+            except (ConnectionError, TimeoutError):
+                pass
+        return SearchProviderInfo(
+            provider_id=self._local.provider_id,
+            name="Embedded event index (cluster)", docs=docs)
 
     def search(self, query: str, max_results: int = 100) -> list[dict]:
         docs = self._cluster.search_events(query, max_results)
@@ -851,6 +903,10 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         return {"invocationId": svc.accept_remote(
             CommandInvocation(**invocation))}
 
+    def search_info():
+        idx = getattr(engine, "search_index", None)
+        return len(idx.docs) if idx is not None else None
+
     def search_events(query: str, maxResults: int = 100):
         # the rank's embedded index attaches AFTER server construction
         # (instance wiring) — resolve lazily; None (vs []) tells the
@@ -883,6 +939,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.getInvocation": get_invocation,
         "Cluster.commandResponses": command_responses,
         "Cluster.searchEvents": search_events,
+        "Cluster.searchInfo": search_info,
         "Cluster.flush": flush,
     }.items():
         srv.register(name, fn)

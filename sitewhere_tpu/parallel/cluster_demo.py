@@ -62,8 +62,10 @@ def _meas(token: str, name: str, value: float, ts_ms: int) -> bytes:
 def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
                 rest1: int, base_s: float, devices_per_proc: int = 2,
                 recover: bool = False) -> None:
-    """One rank of the 2-process product job. Prints CLUSTER_OK /
-    CLUSTER_RECOVERED lines; any assertion failure exits nonzero."""
+    """One rank of the 2-process product job, booted entirely through
+    ``run_rank`` (config in, serving rank out — VERDICT r4 item 5).
+    Prints CLUSTER_OK / CLUSTER_RECOVERED lines; any assertion failure
+    exits nonzero."""
     os.environ.pop("XLA_FLAGS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import logging
@@ -77,16 +79,12 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
     import asyncio
 
     import aiohttp
-    from aiohttp import web
 
     from sitewhere_tpu.engine import EngineConfig
-    from sitewhere_tpu.instance.instance import (InstanceConfig,
-                                                 SiteWhereTpuInstance)
-    from sitewhere_tpu.parallel.cluster import (ClusterConfig, ClusterEngine,
-                                                build_cluster_rpc)
-    from sitewhere_tpu.parallel.distributed import (DistributedConfig,
-                                                    recover_distributed)
-    from sitewhere_tpu.web.rest import make_app
+    from sitewhere_tpu.instance.instance import InstanceConfig
+    from sitewhere_tpu.parallel.cluster import ClusterConfig
+    from sitewhere_tpu.parallel.distributed import DistributedConfig
+    from sitewhere_tpu.parallel.rank_runtime import RankConfig, run_rank
 
     scratch_p = pathlib.Path(scratch)
     peers = [f"127.0.0.1:{rpc0}", f"127.0.0.1:{rpc1}"]
@@ -102,14 +100,15 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
     ccfg = ClusterConfig(rank=rank, n_ranks=2, peers=peers, secret=secret,
                          epoch_base_unix_s=base_s, engine=ecfg,
                          connect_timeout_s=60.0)
-    if recover:
-        local = recover_distributed(scratch_p / "snap-r1",
-                                    scratch_p / "wal-r1")
-        cluster = ClusterEngine(ccfg, local=local)
-    else:
-        cluster = ClusterEngine(ccfg)
-    inst = SiteWhereTpuInstance(InstanceConfig(engine=EngineConfig()),
-                                engine=cluster)
+    # the WHOLE rank — engine (or crash recovery), cluster RPC on its own
+    # loop, REST + pumps + presence + scheduler — from one config
+    rt = run_rank(RankConfig(
+        cluster=ccfg, instance=InstanceConfig(engine=EngineConfig()),
+        rest_port=rests[rank],
+        snapshot_dir=str(scratch_p / f"snap-r{rank}") if recover else None,
+        presence_interval_s=600.0))
+    cluster, inst = rt.cluster, rt.instance
+    assert rt.recovered == recover
     toks0 = _tokens_for(0, 2, N_PER_RANK)
     toks1 = _tokens_for(1, 2, N_PER_RANK)
     both = toks0 + toks1
@@ -153,132 +152,110 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
                 out["state"][t] = (st["measurements"], st["presence"])
         return out
 
-    import threading
+    async def both_snapshots() -> tuple:
+        async with aiohttp.ClientSession() as session:
+            return (await rest_snapshot(session, rests[rank]),
+                    await rest_snapshot(session, rests[1 - rank]))
 
-    async def main() -> None:
-        # The cluster RPC server gets its OWN event loop: its handlers
-        # touch only the local engine, so they can always answer even
-        # while the REST loop blocks inside a fan-out call to the peer.
-        # One shared loop would deadlock: both ranks' REST handlers wait
-        # on each other's RPC while holding the only loop that serves it.
-        srv = build_cluster_rpc(cluster.local, secret)
-        rpc_loop = asyncio.new_event_loop()
-        threading.Thread(target=rpc_loop.run_forever, daemon=True).start()
-        asyncio.run_coroutine_threadsafe(
-            srv.start(port=int(peers[rank].rsplit(":", 1)[1])),
-            rpc_loop).result(15)
-        runner = web.AppRunner(make_app(inst))
-        await runner.setup()
-        site = web.TCPSite(runner, "127.0.0.1", rests[rank])
-        await site.start()
-        loop = asyncio.get_event_loop()
+    async def health(port: int) -> dict:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(
+                    f"http://127.0.0.1:{port}/api/instance/health") as r:
+                assert r.status == 200, (port, r.status, await r.text())
+                return await r.json()
 
-        def blocking(fn, *a, **kw):
-            # engine/cluster calls (and phase-marker waits) block on the
-            # peer: keep them OFF the loop so our RPC/REST servers can
-            # answer the peer's calls meanwhile — waiting on the loop
-            # while the peer's forwarded ingest needs our server is a
-            # distributed deadlock
-            return loop.run_in_executor(None, lambda: fn(*a, **kw))
+    # phases run on the MAIN thread: facade calls block on peer RPC, and
+    # run_rank serves cluster RPC + REST on their own loops, so blocking
+    # here can never deadlock the peer's forwarded ingest (rule 1)
+    if not recover:
+        # the readiness probe carries the composed-rank facts
+        h = asyncio.run(health(rests[rank]))
+        assert h["status"] == "UP" and h["ready"], h
+        assert h["rank"] == rank and h["nRanks"] == 2, h
+        # ---- phase 1: mixed ingest from BOTH ranks --------------------
+        cluster.ingest_json_batch(
+            [_meas(t, "temp", rank * 100.0 + i, base_ms + 1000 * rank + i)
+             for i, t in enumerate(both)])
+        (scratch_p / f"ingested-r{rank}").touch()
+        _wait_for(scratch_p / f"ingested-r{1 - rank}")
+        cluster.flush()
+        # index this rank's partition (the per-rank search connector),
+        # then barrier so both indexes are populated before the
+        # cross-rank search-equality snapshot
+        rt.pump_outbound()
+        (scratch_p / f"indexed-r{rank}").touch()
+        _wait_for(scratch_p / f"indexed-r{1 - rank}")
+        mine, theirs = asyncio.run(both_snapshots())
+        assert mine == theirs, (rank, mine, theirs)
+        assert mine["total"] == 2 * len(both), mine["total"]
+        assert len(mine["search"]) == 2 * len(both), mine["search"]
+        m = cluster.metrics()
+        assert m["persisted"] == 2 * len(both), m
+        print(f"CLUSTER_OK rank={rank} phase=1 "
+              f"total={mine['total']} persisted={m['persisted']} "
+              f"rest_agree=1", flush=True)
 
-        if not recover:
-            # ---- phase 1: mixed ingest from BOTH ranks ----------------
-            await blocking(
-                cluster.ingest_json_batch,
-                [_meas(t, "temp", rank * 100.0 + i, base_ms + 1000 * rank + i)
-                 for i, t in enumerate(both)])
-            (scratch_p / f"ingested-r{rank}").touch()
-            await blocking(_wait_for, scratch_p / f"ingested-r{1 - rank}")
-            await blocking(cluster.flush)
-            # index this rank's partition (the per-rank search connector),
-            # then barrier so both indexes are populated before the
-            # cross-rank search-equality snapshot
-            await inst.pump_outbound()
-            (scratch_p / f"indexed-r{rank}").touch()
-            await blocking(_wait_for, scratch_p / f"indexed-r{1 - rank}")
-            async with aiohttp.ClientSession() as session:
-                mine = await rest_snapshot(session, rests[rank])
-                theirs = await rest_snapshot(session, rests[1 - rank])
-            assert mine == theirs, (rank, mine, theirs)
-            assert mine["total"] == 2 * len(both), mine["total"]
-            assert len(mine["search"]) == 2 * len(both), mine["search"]
-            m = await blocking(cluster.metrics)
-            assert m["persisted"] == 2 * len(both), m
-            print(f"CLUSTER_OK rank={rank} phase=1 "
-                  f"total={mine['total']} persisted={m['persisted']} "
-                  f"rest_agree=1", flush=True)
-
-            if rank == 1:
-                # snapshot, then wait for rank 0's extra (WAL-tail-only)
-                # traffic and crash WITHOUT closing anything
-                await blocking(cluster.local.save, scratch_p / "snap-r1")
-                (scratch_p / "r1-snapshotted").touch()
-                await blocking(_wait_for, scratch_p / "extra-sent")
-                # the forwarded events are in OUR WAL (logged at ingest
-                # accept time) but NOT in the snapshot — the recovery has
-                # real work to do
-                print("CLUSTER_CRASHING rank=1", flush=True)
-                sys.stdout.flush()
-                os._exit(17)    # simulated crash: no clean shutdown
-            else:
-                await blocking(_wait_for, scratch_p / "r1-snapshotted")
-                await blocking(
-                    cluster.ingest_json_batch,
-                    [_meas(toks1[0], "temp", 777.0, base_ms + 7777)])
-                await blocking(cluster.flush)
-                (scratch_p / "extra-sent").touch()
-                # ---- phase 2: peer crashed; wait for its recovery -----
-                await blocking(_wait_for, scratch_p / "r1-recovered",
-                               timeout_s=PHASE_TIMEOUT_S * 2)
-                q = await blocking(
-                    cluster.query_events, device_token=toks1[0])
-                assert q["total"] == 3, q   # 2 original + WAL-tail event
-                assert q["events"][0]["measurements"]["temp"] == 777.0
-                # the cluster stays writable through the recovered rank
-                await blocking(
-                    cluster.ingest_json_batch,
-                    [_meas(toks1[0], "temp", 888.0, base_ms + 8888)])
-                await blocking(cluster.flush)
-                await inst.pump_outbound()
-                (scratch_p / "r0-pumped").touch()
-                await blocking(_wait_for, scratch_p / "r1-pumped")
-                async with aiohttp.ClientSession() as session:
-                    mine = await rest_snapshot(session, rests[0])
-                    theirs = await rest_snapshot(session, rests[1])
-                assert mine == theirs, (mine, theirs)
-                assert mine["total"] == 2 * len(both) + 2
-                # the recovered rank re-indexed its partition from its
-                # rebuilt feed: search is complete again cluster-wide
-                assert len(mine["search"]) == mine["total"], mine["search"]
-                print(f"CLUSTER_OK rank=0 phase=2 "
-                      f"total={mine['total']} "
-                      f"recovered_peer_serves_history=1", flush=True)
-                (scratch_p / "r0-done").touch()
+        if rank == 1:
+            # snapshot, then wait for rank 0's extra (WAL-tail-only)
+            # traffic and crash WITHOUT closing anything
+            cluster.local.save(scratch_p / "snap-r1")
+            (scratch_p / "r1-snapshotted").touch()
+            _wait_for(scratch_p / "extra-sent")
+            # the forwarded events are in OUR WAL (logged at ingest
+            # accept time) but NOT in the snapshot — the recovery has
+            # real work to do
+            print("CLUSTER_CRASHING rank=1", flush=True)
+            sys.stdout.flush()
+            os._exit(17)    # simulated crash: no clean shutdown
         else:
-            # ---- restarted rank 1: WAL replayed over the snapshot -----
-            q = await blocking(cluster.local.query_events,
-                               device_token=toks1[0])
-            assert q["total"] == 3, q   # snapshot(2) + WAL tail(1)
+            _wait_for(scratch_p / "r1-snapshotted")
+            cluster.ingest_json_batch(
+                [_meas(toks1[0], "temp", 777.0, base_ms + 7777)])
+            cluster.flush()
+            (scratch_p / "extra-sent").touch()
+            # ---- phase 2: peer crashed; wait for its recovery ---------
+            _wait_for(scratch_p / "r1-recovered",
+                      timeout_s=PHASE_TIMEOUT_S * 2)
+            q = cluster.query_events(device_token=toks1[0])
+            assert q["total"] == 3, q   # 2 original + WAL-tail event
             assert q["events"][0]["measurements"]["temp"] == 777.0
-            print(f"CLUSTER_RECOVERED rank=1 "
-                  f"replayed_total={q['total']}", flush=True)
-            (scratch_p / "r1-recovered").touch()
-            # re-index this rank's partition (fresh in-memory index after
-            # the crash; the rebuilt feed replays it) for rank 0's
-            # phase-2 search-equality snapshot, then wait for the final
-            # post-recovery write to index it too
-            await blocking(_wait_for, scratch_p / "r0-pumped",
-                           timeout_s=PHASE_TIMEOUT_S * 2)
-            await inst.pump_outbound()
-            (scratch_p / "r1-pumped").touch()
-            await blocking(_wait_for, scratch_p / "r0-done",
-                           timeout_s=PHASE_TIMEOUT_S * 2)
-        asyncio.run_coroutine_threadsafe(srv.stop(), rpc_loop).result(15)
-        rpc_loop.call_soon_threadsafe(rpc_loop.stop)
-        await runner.cleanup()
-        cluster.close()
-
-    asyncio.new_event_loop().run_until_complete(main())
+            # the cluster stays writable through the recovered rank
+            cluster.ingest_json_batch(
+                [_meas(toks1[0], "temp", 888.0, base_ms + 8888)])
+            cluster.flush()
+            rt.pump_outbound()
+            (scratch_p / "r0-pumped").touch()
+            _wait_for(scratch_p / "r1-pumped")
+            mine, theirs = asyncio.run(both_snapshots())
+            assert mine == theirs, (mine, theirs)
+            assert mine["total"] == 2 * len(both) + 2
+            # the recovered rank re-indexed its partition from its
+            # rebuilt feed: search is complete again cluster-wide
+            assert len(mine["search"]) == mine["total"], mine["search"]
+            print(f"CLUSTER_OK rank=0 phase=2 "
+                  f"total={mine['total']} "
+                  f"recovered_peer_serves_history=1", flush=True)
+            (scratch_p / "r0-done").touch()
+            rt.stop()
+    else:
+        # ---- restarted rank 1: WAL replayed over the snapshot ---------
+        h = asyncio.run(health(rests[rank]))
+        assert h["recovered"] is True, h
+        q = cluster.local.query_events(device_token=toks1[0])
+        assert q["total"] == 3, q   # snapshot(2) + WAL tail(1)
+        assert q["events"][0]["measurements"]["temp"] == 777.0
+        print(f"CLUSTER_RECOVERED rank=1 "
+              f"replayed_total={q['total']}", flush=True)
+        (scratch_p / "r1-recovered").touch()
+        # re-index this rank's partition (fresh in-memory index after
+        # the crash; the rebuilt feed replays it) for rank 0's
+        # phase-2 search-equality snapshot, then wait for the final
+        # post-recovery write to index it too
+        _wait_for(scratch_p / "r0-pumped", timeout_s=PHASE_TIMEOUT_S * 2)
+        rt.pump_outbound()
+        (scratch_p / "r1-pumped").touch()
+        _wait_for(scratch_p / "r0-done", timeout_s=PHASE_TIMEOUT_S * 2)
+        rt.stop()
 
 
 def _ports(n: int) -> list[int]:

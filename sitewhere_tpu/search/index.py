@@ -37,6 +37,8 @@ def event_order_key(doc: dict):
 class SearchProviderInfo:
     provider_id: str = "embedded"
     name: str = "Embedded event index"
+    docs: int = 0           # corpus size behind this provider — for a
+                            # cluster provider, summed over every rank
 
 
 class EventSearchIndex:
@@ -46,11 +48,17 @@ class EventSearchIndex:
         self.capacity = capacity
         self.docs: dict[int, dict] = {}
         self.postings: dict[tuple[str, str], set[int]] = defaultdict(set)
-        self.info = SearchProviderInfo()
+        self.provider_id = "embedded"
         # indexing runs on the server event loop while searches may run
         # on worker threads (REST off-loop search): short critical
         # sections, one lock
         self._lock = threading.Lock()
+
+    @property
+    def info(self) -> SearchProviderInfo:
+        """Computed, not cached — ``docs`` must track the live corpus."""
+        return SearchProviderInfo(provider_id=self.provider_id,
+                                  docs=len(self.docs))
 
     def add(self, event: OutboundEvent) -> None:
         doc = event.to_json_dict()
@@ -147,7 +155,7 @@ class SearchProviderManager:
         self.providers: dict[str, EventSearchIndex] = {}
 
     def add_provider(self, provider_id: str, index: EventSearchIndex) -> None:
-        index.info.provider_id = provider_id
+        index.provider_id = provider_id
         self.providers[provider_id] = index
 
     def get(self, provider_id: str) -> EventSearchIndex | None:

@@ -150,7 +150,10 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
                                  headers={"X-Sitewhere-JWT": token})
 
     r.add_get("/api/authapi/jwt", get_jwt)
-    r.add_get("/api/instance/health", _sync(lambda req: json_response({"status": "UP"})))
+    # readiness probe: public (PUBLIC_PATHS), enriched by run_rank with
+    # rank/peer/port info so an orchestrator can gate traffic on it
+    r.add_get("/api/instance/health", _sync(lambda req: json_response(
+        {"status": "UP", **getattr(inst, "health_extra", {})})))
 
     # --- instance ---------------------------------------------------------
     r.add_get("/api/instance", _sync(lambda req: json_response(inst.info())))
@@ -963,8 +966,13 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response({"numResults": len(docs), "results": docs})
 
     r.add_get("/api/search/events", search_events)
-    r.add_get("/api/search/providers", _sync(lambda req: json_response(
-        [dataclasses.asdict(p) for p in inst.search.list_providers()])))
+    async def list_search_providers(request: web.Request):
+        # provider info fans out to peers on a cluster instance — keep
+        # the (blocking) peer RPC off the gateway loop
+        infos = await asyncio.to_thread(inst.search.list_providers)
+        return json_response([dataclasses.asdict(p) for p in infos])
+
+    r.add_get("/api/search/providers", list_search_providers)
 
     # --- streams ----------------------------------------------------------
     async def create_stream(request: web.Request):
@@ -1164,9 +1172,12 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         # stall other requests or the outbound pump
         res = await asyncio.to_thread(
             _analytics().score_all, update_stats=False)   # read-only poll
+        from sitewhere_tpu.engine import local_device_info
+
         out = []
         for did in np.nonzero(res["valid"])[0]:
-            info = inst.engine.devices.get(int(did))
+            # analytics tables hold THIS rank's local device ids
+            info = local_device_info(inst.engine, int(did))
             if info is None:
                 continue
             out.append({"device": info.token,

@@ -586,6 +586,43 @@ def test_cluster_event_search_spans_ranks(tmp_path):
         assert [d["deviceToken"] for d in p0.search("*:*", 50)] == \
                [d["deviceToken"] for d in p1.search("*:*", 50)]
         assert len(p0.search("*:*", 50)) == 4
+        # provider INFO describes the cluster corpus (what search()
+        # actually searches), not the local slice (VERDICT r4 weak #6)
+        assert p0.info.docs == p1.info.docs == 4
+        assert p0.info.provider_id == "embedded"
+        # ...while each rank's raw index still reports its partition
+        assert insts[0].search_index.info.docs < 4
+    finally:
+        _close(clusters, host)
+
+
+def test_merged_devices_by_id_get_is_explicitly_local(tmp_path):
+    """Device ids are rank-scoped: the dict-shaped ``get`` on the merged
+    view silently aliased across ranks (VERDICT r4 weak #2) — by-id
+    lookups must be explicitly local (get_local / local_device_info) or
+    token-routed (get_device)."""
+    from sitewhere_tpu.engine import local_device_info
+
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        toks = tokens_owned_by(0, 1, prefix="md") + \
+            tokens_owned_by(1, 1, prefix="md")
+        c0.ingest_json_batch([meas(t, "t", float(i), 20 + i)
+                              for i, t in enumerate(toks)])
+        for c in clusters:
+            c.flush()
+        with pytest.raises(TypeError, match="rank-local"):
+            c0.devices.get(0)
+        lid0, info0 = next(iter(c0.local.devices.items()))
+        assert c0.devices.get_local(lid0).token == info0.token
+        # the shared helper reads the local mirror on BOTH surfaces
+        assert local_device_info(c0, lid0).token == info0.token
+        assert local_device_info(c0.local, lid0).token == info0.token
+        assert local_device_info(c0, 10_000) is None
+        # fan-out surfaces still span the cluster
+        assert len(c0.devices) == 2
+        assert {i.token for i in c0.devices.values()} == set(toks)
     finally:
         _close(clusters, host)
 
@@ -1049,3 +1086,52 @@ def test_sync_peer_timeout_reconnects_cleanly(tmp_path):
     finally:
         peer.close()
         host.close()
+
+
+def test_run_rank_validates_wiring_before_serving(tmp_path):
+    """A mis-composed rank must fail at STARTUP with every problem
+    listed — not at the first cross-rank RPC (VERDICT r4 item 5)."""
+    from sitewhere_tpu.parallel.rank_runtime import (RankConfig,
+                                                     RankWiringError,
+                                                     run_rank)
+
+    # no WAL on a durable rank + truncated peers list: both reported
+    cc = ClusterConfig(rank=1, n_ranks=2, peers=["127.0.0.1:1"],
+                       secret="s", epoch_base_unix_s=BASE_S,
+                       engine=_engine_cfg())   # no wal_dir
+    with pytest.raises(RankWiringError) as ei:
+        run_rank(RankConfig(cluster=cc))
+    msg = str(ei.value)
+    assert "WAL" in msg and "peers list has 1" in msg
+
+
+def test_run_rank_boots_a_serving_rank_from_one_config(tmp_path):
+    """run_rank composes engine + cluster RPC + REST + pumps; the public
+    health route reports readiness; ingest->query->search work; stop()
+    tears it all down."""
+    import urllib.request
+
+    from sitewhere_tpu.parallel.rank_runtime import RankConfig, run_rank
+
+    [rpc_port] = _free_ports(1)
+    cc = ClusterConfig(rank=0, n_ranks=1, peers=[f"127.0.0.1:{rpc_port}"],
+                       secret="s", epoch_base_unix_s=BASE_S,
+                       engine=_engine_cfg(tmp_path))
+    rt = run_rank(RankConfig(cluster=cc))
+    try:
+        assert rt.rest_port and rt.rest_port > 0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{rt.rest_port}/api/instance/health",
+                timeout=10) as r:
+            h = json.loads(r.read())
+        assert h["status"] == "UP" and h["ready"] is True
+        assert h["rank"] == 0 and h["nRanks"] == 1
+        assert h["recovered"] is False
+        rt.cluster.ingest_json_batch([meas("rr-1", "t", 5.0, 100)])
+        rt.cluster.flush()
+        q = rt.cluster.query_events(device_token="rr-1")
+        assert q["total"] == 1
+        rt.pump_outbound()   # search connector indexes the partition
+        assert len(rt.instance.search_index.search("*:*")) == 1
+    finally:
+        rt.stop()
