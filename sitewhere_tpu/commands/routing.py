@@ -32,11 +32,20 @@ class CommandRegistry:
 
     def __init__(self):
         self._by_token: dict[str, DeviceCommand] = {}
+        # fires ("upsert"|"delete", "device-command", token, cmd) after
+        # each mutation — the cluster replicator's tap
+        self.on_change = None
+
+    def _notify(self, action: str, token: str, cmd) -> None:
+        cb = self.on_change
+        if cb is not None:
+            cb(action, "device-command", token, cmd)
 
     def create(self, command: DeviceCommand) -> DeviceCommand:
         if command.token in self._by_token:
             raise ValueError(f"duplicate command token {command.token!r}")
         self._by_token[command.token] = command
+        self._notify("upsert", command.token, command)
         return command
 
     def get(self, token: str) -> DeviceCommand | None:
@@ -49,10 +58,22 @@ class CommandRegistry:
         if cmd is None:
             raise KeyError(f"unknown command {token!r}")
         apply(cmd)
+        self._notify("upsert", token, cmd)
         return cmd
 
     def delete(self, token: str) -> bool:
-        return self._by_token.pop(token, None) is not None
+        existed = self._by_token.pop(token, None) is not None
+        if existed:
+            self._notify("delete", token, None)
+        return existed
+
+    def apply_replicated(self, token: str,
+                         command: "DeviceCommand | None") -> None:
+        """Peer-shipped state; no hook (must not re-broadcast)."""
+        if command is None:
+            self._by_token.pop(token, None)
+        else:
+            self._by_token[token] = command
 
     def list_for_type(self, device_type: str) -> list[DeviceCommand]:
         return [c for c in self._by_token.values() if c.device_type == device_type]

@@ -147,6 +147,16 @@ class UserManagement:
         self._lock = threading.Lock()
         self.users: dict[str, User] = {}
         self.roles: dict[str, list[str]] = dict(DEFAULT_ROLES)
+        # fires ("upsert"|"delete", "user"|"role", key, obj) after each
+        # mutation, outside the lock — the cluster replicator's tap.
+        # Ships the User with its HASHED password only (state-based
+        # replication never journals or transmits a plaintext password).
+        self.on_change = None
+
+    def _notify(self, action: str, kind: str, key: str, obj) -> None:
+        cb = self.on_change
+        if cb is not None:
+            cb(action, kind, key, obj)
 
     def create_user(self, username: str, password: str, roles: list[str] | None = None,
                     **kw) -> User:
@@ -159,7 +169,8 @@ class UserManagement:
             user = User(username=username, hashed_password=hash_password(password),
                         roles=roles or ["user"], created_ms=time.time() * 1000, **kw)
             self.users[username] = user
-            return user
+        self._notify("upsert", "user", username, user)
+        return user
 
     def authenticate(self, username: str, password: str) -> User:
         user = self.users.get(username)
@@ -196,7 +207,8 @@ class UserManagement:
                 user.enabled = enabled
             for k, v in kw.items():
                 setattr(user, k, v)
-            return user
+        self._notify("upsert", "user", username, user)
+        return user
 
     def add_roles(self, username: str, roles: list[str]) -> User:
         """Append roles (reference: Users.java @PUT /{username}/roles ->
@@ -211,7 +223,8 @@ class UserManagement:
             for r in roles:
                 if r not in user.roles:
                     user.roles.append(r)
-            return user
+        self._notify("upsert", "user", username, user)
+        return user
 
     def remove_roles(self, username: str, roles: list[str]) -> User:
         """Remove roles (reference: Users.java @DELETE /{username}/roles)."""
@@ -220,12 +233,33 @@ class UserManagement:
             if user is None:
                 raise KeyError(f"user {username!r} not found")
             user.roles = [r for r in user.roles if r not in set(roles)]
-            return user
+        self._notify("upsert", "user", username, user)
+        return user
 
     def delete_user(self, username: str) -> bool:
         with self._lock:
-            return self.users.pop(username, None) is not None
+            existed = self.users.pop(username, None) is not None
+        if existed:
+            self._notify("delete", "user", username, None)
+        return existed
 
     def create_role(self, role: str, authorities: list[str]) -> None:
         with self._lock:
             self.roles[role] = list(authorities)
+        self._notify("upsert", "role", role, list(authorities))
+
+    # ---- replication surface (no hook: peers must not re-broadcast) ----
+    def apply_replicated_user(self, username: str, user: "User | None") -> None:
+        with self._lock:
+            if user is None:
+                self.users.pop(username, None)
+            else:
+                self.users[username] = user
+
+    def apply_replicated_role(self, role: str,
+                              authorities: "list[str] | None") -> None:
+        with self._lock:
+            if authorities is None:
+                self.roles.pop(role, None)
+            else:
+                self.roles[role] = list(authorities)

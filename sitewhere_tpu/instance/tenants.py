@@ -158,6 +158,11 @@ class TenantManagement:
         except Exception:
             tenant.bootstrap_state = "Failed"
             raise
+        finally:
+            # the state above mutated the entity directly; a no-op store
+            # update stamps updated_ms and fires on_change so replicas
+            # see the FINAL bootstrap state, not the created default
+            self.tenants.update(tenant.meta.token, lambda t: None)
 
     def authorize_user(self, tenant_token: str, username: str) -> Tenant:
         def apply(t: Tenant) -> None:

@@ -166,6 +166,11 @@ class DeviceManagement:
         self.groups: EntityStore[DeviceGroup] = EntityStore("device-group")
         self._group_elements: dict[str, list[DeviceGroupElement]] = {}
         self._next_element_id = 1
+        # fires (group_token, elements) after every membership change —
+        # the cluster replicator ships the group's whole element list
+        # (group membership is one replicated value, like the reference's
+        # group-elements table rows for a group)
+        self.on_elements_change = None
         # default type exists from the engine config
         self.create_device_type(engine.config.default_device_type, "Default type")
 
@@ -384,9 +389,27 @@ class DeviceManagement:
                 roles=list(spec.get("roles", [])),
             )
             self._next_element_id += 1
-            self._group_elements[group_token].append(el)
+            # setdefault: a group replicated from a peer arrives without
+            # a membership slot (create_group ran at the origin only)
+            self._group_elements.setdefault(group_token, []).append(el)
             out.append(el)
+        self._notify_elements(group_token)
         return out
+
+    def _notify_elements(self, group_token: str) -> None:
+        cb = self.on_elements_change
+        if cb is not None:
+            cb(group_token, list(self._group_elements.get(group_token, [])))
+
+    def apply_replicated_elements(
+            self, group_token: str,
+            elements: list[DeviceGroupElement]) -> None:
+        """Peer-shipped membership; no hook (must not re-broadcast)."""
+        self._group_elements[group_token] = list(elements)
+        if elements:
+            self._next_element_id = max(
+                self._next_element_id,
+                max(e.element_id for e in elements) + 1)
 
     def group_elements(self, group_token: str) -> list[DeviceGroupElement]:
         return list(self._group_elements.get(group_token, []))
@@ -396,6 +419,7 @@ class DeviceManagement:
         for i, el in enumerate(elements):
             if el.element_id == element_id:
                 del elements[i]
+                self._notify_elements(group_token)
                 return True
         return False
 

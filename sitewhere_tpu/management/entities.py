@@ -13,7 +13,6 @@ these stores hold the host-side metadata the device tables don't carry.
 from __future__ import annotations
 
 import dataclasses
-import itertools
 import threading
 import time
 from typing import Any, Callable, Generic, Iterable, TypeVar
@@ -52,26 +51,55 @@ class EntityMeta:
 
 
 class EntityStore(Generic[T]):
-    """Token-addressed CRUD store for one entity kind."""
+    """Token-addressed CRUD store for one entity kind.
+
+    ``on_change(action, kind, token, entity)`` — when set — fires after
+    every successful mutation, OUTSIDE the lock (the cluster entity
+    replicator broadcasts from it; an RPC inside the store lock would
+    serialize all CRUD behind the network). ``apply_replicated`` /
+    ``remove_replicated`` upsert state received from a peer without
+    firing the hook (replication must not re-broadcast)."""
 
     def __init__(self, kind: str):
         self.kind = kind
         self._lock = threading.RLock()
-        self._ids = itertools.count(1)
+        self._next_id = 1
+        self._id_stride = 1
         self._by_id: dict[int, T] = {}
         self._by_token: dict[str, int] = {}
+        self.on_change: Callable[[str, str, str, T | None], None] | None = None
+
+    def configure_id_space(self, offset: int, stride: int) -> None:
+        """Namespace locally-assigned ids to ``offset (mod stride)`` —
+        the cluster replicator calls this with (rank, n_ranks) so two
+        ranks creating entities concurrently can never mint the SAME id
+        for different tokens (a replicated upsert would then clobber the
+        other rank's entity in ``_by_id``). Entities created before this
+        call (deterministic bootstrap, identical on every rank) keep
+        their low ids."""
+        with self._lock:
+            self._id_stride = max(1, stride)
+            while self._next_id % self._id_stride != offset % self._id_stride:
+                self._next_id += 1
+
+    def _notify(self, action: str, token: str, entity: T | None) -> None:
+        cb = self.on_change
+        if cb is not None:
+            cb(action, self.kind, token, entity)
 
     def create(self, token: str, build: Callable[[EntityMeta], T]) -> T:
         with self._lock:
             if token in self._by_token:
                 raise DuplicateToken(f"{self.kind} token {token!r} already exists")
             now = time.time() * 1000
-            meta = EntityMeta(id=next(self._ids), token=token,
+            meta = EntityMeta(id=self._next_id, token=token,
                               created_ms=now, updated_ms=now)
+            self._next_id += self._id_stride
             entity = build(meta)
             self._by_id[meta.id] = entity
             self._by_token[token] = meta.id
-            return entity
+        self._notify("upsert", token, entity)
+        return entity
 
     def get(self, token: str) -> T:
         with self._lock:
@@ -99,14 +127,40 @@ class EntityStore(Generic[T]):
             meta = getattr(entity, "meta", None)
             if meta is not None:
                 meta.updated_ms = time.time() * 1000
-            return entity
+        self._notify("upsert", token, entity)
+        return entity
 
     def delete(self, token: str) -> T:
         with self._lock:
             eid = self._by_token.pop(token, None)
             if eid is None:
                 raise EntityNotFound(f"{self.kind} {token!r} not found")
-            return self._by_id.pop(eid)
+            entity = self._by_id.pop(eid)
+        self._notify("delete", token, None)
+        return entity
+
+    # ---- replication surface (no hook: peers must not re-broadcast) ----
+    def apply_replicated(self, token: str, entity: T) -> None:
+        """Upsert an entity exactly as shipped from a peer — its meta
+        (id, timestamps) is authoritative; the local id counter jumps
+        past it so local creates never collide."""
+        with self._lock:
+            meta = getattr(entity, "meta", None)
+            eid = meta.id if meta is not None else self._by_token.get(
+                token, self._next_id)
+            old = self._by_token.get(token)
+            if old is not None and old != eid:
+                self._by_id.pop(old, None)
+            self._by_id[eid] = entity
+            self._by_token[token] = eid
+            while self._next_id <= eid:
+                self._next_id += self._id_stride
+
+    def remove_replicated(self, token: str) -> None:
+        with self._lock:
+            eid = self._by_token.pop(token, None)
+            if eid is not None:
+                self._by_id.pop(eid, None)
 
     def list(
         self,

@@ -124,6 +124,11 @@ class ScheduleManager:
         self.executors: dict[str, Callable] = {}
         self._task: asyncio.Task | None = None
         self.tick_s = 1.0
+        # cluster fire policy: with replicated schedules on every rank,
+        # exactly ONE rank may run each schedule's jobs (the replicator
+        # installs an owner-rank predicate; None = fire everything, the
+        # single-node behavior)
+        self.fire_filter: Callable[[str], bool] | None = None
 
     # CRUD ----------------------------------------------------------------
     def create_schedule(self, token: str, name: str, trigger_type: str,
@@ -190,6 +195,9 @@ class ScheduleManager:
             sched = self.schedules.try_get(job.schedule_token)
             if sched is None:
                 continue
+            if (self.fire_filter is not None
+                    and not self.fire_filter(job.schedule_token)):
+                continue   # another rank owns this schedule's firing
             if not self._due(sched, job, now_ms):
                 continue
             job.fired_count += 1

@@ -520,6 +520,86 @@ class ClusterEngine:
             token, lambda: self.local.get_device_state(token),
             "Cluster.getDeviceState", token=token)
 
+    # ----------------------------------------------------- assignments
+    # Assignments live at their DEVICE's owner rank (they expand on its
+    # shards), but assignment TOKENS don't encode the device — writes
+    # route by device token, by-token reads/updates resolve local-first
+    # then ask peers (Assignments.java REST surface, any-rank semantics).
+    def _as_info(self, a) -> AssignmentInfo | None:
+        if a is None or isinstance(a, AssignmentInfo):
+            return a
+        return AssignmentInfo(**a)
+
+    def create_assignment(self, device_token: str, token: str | None = None,
+                          asset: str | None = None, area: str | None = None,
+                          customer: str | None = None,
+                          metadata: dict | None = None) -> AssignmentInfo:
+        return self._as_info(self._route(
+            device_token,
+            lambda: self.local.create_assignment(device_token, token,
+                                                 asset, area, customer,
+                                                 metadata),
+            "Cluster.createAssignment", deviceToken=device_token,
+            token=token, asset=asset, area=area, customer=customer,
+            metadata=metadata))
+
+    def _assignment_rank(self, token: str) -> "int | None":
+        if self.local.get_assignment(token) is not None:
+            return self.rank
+        for r in range(self.n_ranks):
+            if r != self.rank and self._peer(r).call(
+                    "Cluster.getAssignment", token=token) is not None:
+                return r
+        return None
+
+    def get_assignment(self, token: str) -> AssignmentInfo | None:
+        a = self.local.get_assignment(token)
+        if a is not None:
+            return a
+        for r in range(self.n_ranks):
+            if r != self.rank:
+                d = self._peer(r).call("Cluster.getAssignment", token=token)
+                if d is not None:
+                    return self._as_info(d)
+        return None
+
+    def _assignment_op(self, token: str, local_fn, method: str, **params):
+        r = self._assignment_rank(token)
+        if r is None:
+            raise KeyError(f"assignment {token!r} not found")
+        if r == self.rank:
+            return local_fn()
+        return self._peer(r).call(method, token=token, **params)
+
+    def release_assignment(self, token: str) -> AssignmentInfo:
+        return self._as_info(self._assignment_op(
+            token, lambda: self.local.release_assignment(token),
+            "Cluster.releaseAssignment"))
+
+    def mark_assignment_missing(self, token: str) -> AssignmentInfo:
+        return self._as_info(self._assignment_op(
+            token, lambda: self.local.mark_assignment_missing(token),
+            "Cluster.markAssignmentMissing"))
+
+    def update_assignment(self, token: str, asset: str | None = None,
+                          area: str | None = None,
+                          customer: str | None = None,
+                          metadata: dict | None = None) -> AssignmentInfo:
+        return self._as_info(self._assignment_op(
+            token,
+            lambda: self.local.update_assignment(token, asset, area,
+                                                 customer, metadata),
+            "Cluster.updateAssignment", asset=asset, area=area,
+            customer=customer, metadata=metadata))
+
+    def delete_assignment(self, token: str) -> bool:
+        r = self._assignment_rank(token)
+        if r is None:
+            return False
+        if r == self.rank:
+            return self.local.delete_assignment(token)
+        return self._peer(r).call("Cluster.deleteAssignment", token=token)
+
     def search_device_states(self, **kw) -> list[dict]:
         out = [s for part in self._fanout(
             self.local.search_device_states(**kw),
@@ -863,6 +943,30 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def get_device_state(token: str):
         return engine.get_device_state(token)
 
+    def create_assignment(deviceToken: str, token: str = None,
+                          asset: str = None, area: str = None,
+                          customer: str = None, metadata: dict = None):
+        return dataclasses.asdict(engine.create_assignment(
+            deviceToken, token, asset, area, customer, metadata))
+
+    def get_assignment(token: str):
+        a = engine.get_assignment(token)
+        return dataclasses.asdict(a) if a is not None else None
+
+    def release_assignment(token: str):
+        return dataclasses.asdict(engine.release_assignment(token))
+
+    def mark_assignment_missing(token: str):
+        return dataclasses.asdict(engine.mark_assignment_missing(token))
+
+    def update_assignment(token: str, asset: str = None, area: str = None,
+                          customer: str = None, metadata: dict = None):
+        return dataclasses.asdict(engine.update_assignment(
+            token, asset, area, customer, metadata))
+
+    def delete_assignment(token: str):
+        return engine.delete_assignment(token)
+
     def search_device_states(**kw):
         return engine.search_device_states(**kw)
 
@@ -927,6 +1031,12 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.deleteDevice": delete_device,
         "Cluster.getDevice": get_device,
         "Cluster.listAssignments": list_assignments,
+        "Cluster.createAssignment": create_assignment,
+        "Cluster.getAssignment": get_assignment,
+        "Cluster.releaseAssignment": release_assignment,
+        "Cluster.markAssignmentMissing": mark_assignment_missing,
+        "Cluster.updateAssignment": update_assignment,
+        "Cluster.deleteAssignment": delete_assignment,
         "Cluster.getDeviceState": get_device_state,
         "Cluster.searchDeviceStates": search_device_states,
         "Cluster.queryEvents": query_events,

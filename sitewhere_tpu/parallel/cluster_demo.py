@@ -191,9 +191,23 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
         assert len(mine["search"]) == 2 * len(both), mine["search"]
         m = cluster.metrics()
         assert m["persisted"] == 2 * len(both), m
+        # ---- entity plane: admin ONCE at rank 0, usable at rank 1 -----
+        # (the reference's shared management DB; entity_sync.py)
+        if rank == 0:
+            inst.device_management.create_device_type("demo-type",
+                                                      "Demo type")
+            # pushes run on a background thread: drain before signaling
+            # the peer that the type is available
+            rt.replicator.drain_pushes()
+            (scratch_p / "entity-r0").touch()
+        else:
+            _wait_for(scratch_p / "entity-r0")
+            # the replicated type validates rank 1's create_device, and
+            # the new device routes to its owner as usual
+            inst.device_management.create_device("cd-extra", "demo-type")
         print(f"CLUSTER_OK rank={rank} phase=1 "
               f"total={mine['total']} persisted={m['persisted']} "
-              f"rest_agree=1", flush=True)
+              f"rest_agree=1 entity_plane=1", flush=True)
 
         if rank == 1:
             # snapshot, then wait for rank 0's extra (WAL-tail-only)
@@ -244,8 +258,11 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
         q = cluster.local.query_events(device_token=toks1[0])
         assert q["total"] == 3, q   # snapshot(2) + WAL tail(1)
         assert q["events"][0]["measurements"]["temp"] == 777.0
+        # the entity plane survived the SIGKILL too: the replicated
+        # device type replayed from this rank's entity journal
+        assert "demo-type" in inst.device_management.device_types
         print(f"CLUSTER_RECOVERED rank=1 "
-              f"replayed_total={q['total']}", flush=True)
+              f"replayed_total={q['total']} entity_replayed=1", flush=True)
         (scratch_p / "r1-recovered").touch()
         # re-index this rank's partition (fresh in-memory index after
         # the crash; the rebuilt feed replays it) for rank 0's

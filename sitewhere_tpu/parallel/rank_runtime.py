@@ -64,6 +64,9 @@ class RankConfig:
     analytics_interval_s: float = 5.0
     scheduler_tick_s: float = 1.0
     require_wal: bool = True            # a durable rank must journal ingest
+    entity_log_dir: str | None = None   # entity-op journal; None derives
+                                        # "<wal_dir>-entities"
+    entity_sync_interval_s: float = 5.0  # anti-entropy pull period
 
 
 class _LoopThread:
@@ -120,11 +123,13 @@ class RankRuntime:
     ``stop()`` tears everything down in reverse order."""
 
     def __init__(self, cfg: RankConfig, cluster: ClusterEngine,
-                 inst: SiteWhereTpuInstance, recovered: bool):
+                 inst: SiteWhereTpuInstance, recovered: bool,
+                 replicator=None):
         self.cfg = cfg
         self.cluster = cluster
         self.instance = inst
         self.recovered = recovered
+        self.replicator = replicator
         self.rank = cfg.cluster.rank
         self.rest_port: int | None = None
         self.instance_rpc_port: int | None = None
@@ -133,6 +138,7 @@ class RankRuntime:
         self._cluster_srv = None
         self._instance_srv = None
         self._server_handle = None
+        self._bg_tasks: list = []
         self._stopped = False
 
     # -- composed by run_rank ---------------------------------------------
@@ -146,6 +152,10 @@ class RankRuntime:
         # while the REST loop blocks inside a fan-out (rule 1)
         self._rpc_loop = _LoopThread(f"rank{self.rank}-cluster-rpc")
         self._cluster_srv = build_cluster_rpc(self.cluster.local, secret)
+        if self.replicator is not None:
+            # the entity-replication surface rides the same
+            # authenticated cluster RPC server
+            self.replicator.register_rpc(self._cluster_srv)
         self._rpc_loop.run(
             self._cluster_srv.start(host=cfg.rpc_host, port=rpc_port))
 
@@ -173,6 +183,24 @@ class RankRuntime:
                 presence_interval_s=cfg.presence_interval_s)
             self.instance.scheduler.tick_s = cfg.scheduler_tick_s
             await self.instance.scheduler.start()
+            if self.replicator is not None and cfg.cluster.n_ranks > 1:
+                rep = self.replicator
+
+                async def entity_sync_loop():
+                    # pull-based anti-entropy: catches up everything this
+                    # rank missed while down (pushes it never saw) and
+                    # the initial cold-start backlog, without blocking
+                    # startup on unreachable peers
+                    while True:
+                        try:
+                            await asyncio.to_thread(rep.sync_from_peers,
+                                                    True)
+                        except Exception:
+                            logger.exception("entity anti-entropy failed")
+                        await asyncio.sleep(cfg.entity_sync_interval_s)
+
+                self._bg_tasks.append(
+                    asyncio.create_task(entity_sync_loop()))
             return handle
 
         self._server_handle = self._main_loop.run(boot())
@@ -202,6 +230,13 @@ class RankRuntime:
         self._stopped = True
         if self._main_loop is not None:
             async def teardown():
+                for task in self._bg_tasks:
+                    task.cancel()
+                for task in self._bg_tasks:
+                    try:
+                        await task
+                    except (asyncio.CancelledError, Exception):
+                        pass
                 await self.instance.scheduler.stop()
                 if self._server_handle is not None:
                     await self._server_handle.cleanup()
@@ -218,6 +253,8 @@ class RankRuntime:
                         self._rpc_loop.run(srv.stop(), timeout_s)
             finally:
                 self._rpc_loop.close()
+        if self.replicator is not None:
+            self.replicator.close()
         self.cluster.close()
 
 
@@ -249,21 +286,32 @@ def run_rank(cfg: RankConfig) -> RankRuntime:
             "recovery explicitly to replay them)", cfg.cluster.rank,
             cfg.cluster.engine.wal_dir, cfg.snapshot_dir)
     cluster = None
+    replicator = None
     try:
         cluster = ClusterEngine(cfg.cluster, local=local)
         inst = SiteWhereTpuInstance(cfg.instance, engine=cluster)
         _validate_wiring(cfg, cluster, inst)
+        from sitewhere_tpu.parallel.entity_sync import EntityReplicator
+
+        elog = cfg.entity_log_dir
+        if elog is None and cfg.cluster.engine.wal_dir:
+            wd = pathlib.Path(cfg.cluster.engine.wal_dir)
+            elog = str(wd.with_name(wd.name + "-entities"))
+        replicator = EntityReplicator(cluster, inst, log_dir=elog)
+        replicator.attach()   # replays the journal (SIGKILL recovery)
     except Exception:
-        # fail-fast must not leak the constructed engine: a supervisor
-        # retrying run_rank in-process would otherwise accumulate open
-        # WAL segment handles on every attempt
+        # fail-fast must not leak the constructed engine or journals: a
+        # supervisor retrying run_rank in-process would otherwise
+        # accumulate open segment handles on every attempt
+        if replicator is not None:
+            replicator.close()
         eng = cluster.local if cluster is not None else local
         if cluster is not None:
             cluster.close()
         if eng is not None and getattr(eng, "wal", None) is not None:
             eng.wal.close()
         raise
-    rt = RankRuntime(cfg, cluster, inst, recovered)
+    rt = RankRuntime(cfg, cluster, inst, recovered, replicator=replicator)
     try:
         rt._serve()
     except Exception:

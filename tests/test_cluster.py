@@ -1135,3 +1135,38 @@ def test_run_rank_boots_a_serving_rank_from_one_config(tmp_path):
         assert len(rt.instance.search_index.search("*:*")) == 1
     finally:
         rt.stop()
+
+
+def test_assignments_administered_from_any_rank(tmp_path):
+    """Assignment CRUD routes across the cluster: create routes by the
+    device's owner, by-token reads/updates/release resolve the owning
+    rank from ANY rank (Assignments.java REST any-node semantics —
+    previously these fell through to the serving rank's local engine)."""
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        remote = tokens_owned_by(1, 1, prefix="asg")[0]   # owned by r1
+        c0.register_device(remote, "default")
+        # create at the NON-owner rank: routes to rank 1
+        a = c0.create_assignment(remote, token="asg-A", asset="truck-7")
+        assert a.device_token == remote and a.asset == "truck-7"
+        assert c1.local.get_assignment("asg-A") is not None
+        assert c0.local.get_assignment("asg-A") is None
+        # by-token read + update + missing + release from EITHER rank
+        assert c0.get_assignment("asg-A").asset == "truck-7"
+        assert c1.get_assignment("asg-A").asset == "truck-7"
+        upd = c0.update_assignment("asg-A", area="yard")
+        assert upd.area == "yard"
+        assert c1.get_assignment("asg-A").area == "yard"
+        m = c0.mark_assignment_missing("asg-A")
+        assert m.status == "MISSING"
+        rel = c0.release_assignment("asg-A")
+        assert rel.status == "RELEASED"
+        # delete resolves the owner too; unknown tokens are False
+        assert c0.delete_assignment("asg-A") is True
+        assert c0.get_assignment("asg-A") is None
+        assert c1.delete_assignment("asg-A") is False
+        with pytest.raises(KeyError):
+            c0.update_assignment("asg-A", area="x")
+    finally:
+        _close(clusters, host)

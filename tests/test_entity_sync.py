@@ -1,0 +1,311 @@
+"""Cluster-replicated management-entity plane (VERDICT r4 missing #1).
+
+Reference model: every replica of a service shares one per-tenant DB —
+a device type created via any node is instantly usable by all replicas
+(RdbDeviceManagement.java:127-159). Here entity mutations ship their
+post-state over the authenticated cluster RPC with per-origin sequences,
+a CRC'd journal for crash recovery, and pull anti-entropy for ranks that
+were down during a push (parallel/entity_sync.py).
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from sitewhere_tpu.engine import EngineConfig
+from sitewhere_tpu.instance.instance import InstanceConfig, SiteWhereTpuInstance
+from sitewhere_tpu.parallel.entity_sync import (EntityReplicator, from_state,
+                                                to_state)
+from tests.test_cluster import (BASE_MS, BASE_S, _free_ports, _mk_cluster,
+                                meas, tokens_owned_by)
+
+
+def _mk_replicated(tmp_path, with_logs=True):
+    """Two ranks with instances + attached replicators over live RPC."""
+    clusters, host, ports = _mk_cluster(tmp_path)
+    insts, reps = [], []
+    for i, c in enumerate(clusters):
+        inst = SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c)
+        rep = EntityReplicator(
+            c, inst,
+            log_dir=str(tmp_path / f"elog-r{i}") if with_logs else None)
+        rep.attach()
+        rep.register_rpc(host.servers[i])
+        insts.append(inst)
+        reps.append(rep)
+    return clusters, insts, reps, host
+
+
+def _close_all(clusters, reps, host):
+    for rep in reps:
+        rep.close()
+    for c in clusters:
+        c.close()
+    host.close()
+
+
+def test_entity_plane_replicates_from_any_rank(tmp_path):
+    """THE done-criterion: rank 0 creates a device type + command +
+    schedule; rank 1 ingests a device of that type, routes that command,
+    and fires that schedule — with no per-rank admin."""
+    from sitewhere_tpu.commands.destinations import (CommandDestination,
+                                                     LocalDeliveryProvider,
+                                                     mqtt_topic_extractor)
+    from sitewhere_tpu.commands.encoders import JsonCommandExecutionEncoder
+    from sitewhere_tpu.commands.model import DeviceCommand
+
+    clusters, insts, reps, host = _mk_replicated(tmp_path)
+    c0, c1 = clusters
+    try:
+        # ---- rank 0 administers EVERYTHING, exactly once --------------
+        insts[0].device_management.create_device_type("sensor-x",
+                                                      "Sensor X")
+        insts[0].command_registry.create(DeviceCommand(
+            token="calibrate", device_type="sensor-x", name="calibrate"))
+        # schedule whose token is OWNED by rank 1 (fires there only)
+        sched_tok = tokens_owned_by(1, 1, prefix="sch")[0]
+        insts[0].scheduler.register_executor("test", lambda job: None)
+        insts[1].scheduler.register_executor("test", lambda job: None)
+        insts[0].scheduler.create_schedule(sched_tok, "every-min",
+                                           "Simple", interval_s=60)
+        insts[0].scheduler.create_job("job-1", sched_tok, "test", {})
+        reps[0].drain_pushes()   # pushes are async (off the admin thread)
+
+        # ---- rank 1 uses all three with no admin of its own -----------
+        # the type validates against rank 1's OWN (replicated) store
+        dev = tokens_owned_by(0, 1, prefix="ent")[0]   # owned by rank 0
+        insts[1].device_management.create_device(dev, "sensor-x")
+        assert c0.get_device(dev).device_type == "sensor-x"
+        # the command definition replicated: invoke at rank 1 routes to
+        # the owner (rank 0), whose pump delivers it
+        p0 = LocalDeliveryProvider()
+        insts[0].commands.add_destination(CommandDestination(
+            "default", mqtt_topic_extractor(),
+            JsonCommandExecutionEncoder(), p0))
+        inv = insts[1].commands.invoke(dev, "calibrate", {})
+        assert inv.invocation_id % 2 == 0      # rank 0's id space
+        c1.flush()
+        loop = asyncio.new_event_loop()
+        try:
+            fired0 = loop.run_until_complete(insts[0].scheduler.fire_due())
+            fired1 = loop.run_until_complete(insts[1].scheduler.fire_due())
+            pumped = loop.run_until_complete(insts[0].commands.pump())
+        finally:
+            loop.close()
+        assert pumped == 1 and len(p0.delivered) == 1
+        # the replicated schedule fires at its OWNER rank only — not N
+        # times across the cluster
+        assert (fired0, fired1) == (0, 1)
+        job1 = insts[1].scheduler.jobs.get("job-1")
+        assert job1.fired_count == 1
+        # listings agree from both ranks (meta ids/timestamps shipped)
+        dt0 = insts[0].device_management.device_types.get("sensor-x")
+        dt1 = insts[1].device_management.device_types.get("sensor-x")
+        assert to_state(dt0) == to_state(dt1)
+    finally:
+        _close_all(clusters, reps, host)
+
+
+def test_closure_updates_groups_and_alarm_enums_replicate(tmp_path):
+    """The REST tier's closure-based PUT handlers, group membership, and
+    enum-bearing entities all replicate as POST-state."""
+    clusters, insts, reps, host = _mk_replicated(tmp_path)
+    c0, c1 = clusters
+    try:
+        dm0, dm1 = insts[0].device_management, insts[1].device_management
+        dm0.create_device_type("gw", "Gateway")
+        reps[0].drain_pushes()
+        # closure update (what rest.py _store_update does)
+        dm1.device_types.update(
+            "gw", lambda t: setattr(t, "description", "edge gateway"))
+        reps[1].drain_pushes()
+        assert dm0.device_types.get("gw").description == "edge gateway"
+        # groups + membership (elements ship as one replicated value)
+        dev = tokens_owned_by(0, 1, prefix="grp")[0]
+        c1.register_device(dev, "gw")
+        dm0.create_group("fleet", "Fleet", roles=["prod"])
+        reps[0].drain_pushes()
+        els = dm1.add_group_elements("fleet", [{"device": dev,
+                                                "roles": ["prod"]}])
+        reps[1].drain_pushes()
+        assert [e.device_token for e in dm0.group_elements("fleet")] == [dev]
+        assert dm0.expand_group_devices("fleet") == [dev]
+        dm0.remove_group_element("fleet", els[0].element_id)
+        reps[0].drain_pushes()
+        assert dm1.group_elements("fleet") == []
+        # alarms carry an Enum; ack at the OTHER rank round-trips it
+        dm0.create_alarm("al-1", dev, "overheat")
+        reps[0].drain_pushes()
+        a = dm1.acknowledge_alarm("al-1")
+        reps[1].drain_pushes()
+        from sitewhere_tpu.management.device_management import AlarmState
+
+        assert dm0.alarms.get("al-1").state is AlarmState.ACKNOWLEDGED
+        assert a.acknowledged_ms is not None
+        # deletes replicate too
+        dm1.device_types.delete("gw")
+        reps[1].drain_pushes()
+        assert "gw" not in dm0.device_types
+    finally:
+        _close_all(clusters, reps, host)
+
+
+def test_users_and_tenants_replicate(tmp_path):
+    """A user created at rank 0 logs in at rank 1 (only the PBKDF2 hash
+    crosses the wire); a tenant created at rank 0 exists at rank 1 with
+    its dataset-seeded entities and its engine lane interned."""
+    clusters, insts, reps, host = _mk_replicated(tmp_path)
+    try:
+        insts[0].users.create_user("operator", "s3cret", roles=["user"])
+        reps[0].drain_pushes()
+        u = insts[1].users.authenticate("operator", "s3cret")
+        assert u.username == "operator"
+        # plaintext never entered any op
+        for rep in reps:
+            for ops in rep._ops_by_origin.values():
+                for op in ops:
+                    assert "s3cret" not in json.dumps(op)
+        # role catalogs replicate
+        insts[1].users.create_role("auditor", ["VIEW_SERVER_INFORMATION"])
+        reps[1].drain_pushes()
+        assert "auditor" in insts[0].users.roles
+        # tenant + dataset bootstrap: the SEEDED entities arrive as their
+        # own ops; the tenant lane interns on the peer engine
+        insts[0].tenants.create_tenant("acme", "Acme",
+                                       dataset_template="construction")
+        reps[0].drain_pushes()
+        t1 = insts[1].tenants.tenants.get("acme")
+        assert t1.bootstrap_state == "Bootstrapped"
+        assert "acme-excavator" in insts[1].device_management.device_types
+        # the tenant LANE interned on the peer engine (ingest under
+        # tenant "acme" resolves there without any per-rank admin)
+        assert clusters[1].local.tenants.lookup("acme") is not None
+    finally:
+        _close_all(clusters, reps, host)
+
+
+def test_recovery_replay_and_anti_entropy(tmp_path):
+    """A SIGKILL'd rank replays its entity journal on restart; a rank
+    that was DOWN during pushes converges via one anti-entropy pull."""
+    clusters, insts, reps, host = _mk_replicated(tmp_path)
+    c0, c1 = clusters
+    try:
+        dm0 = insts[0].device_management
+        dm0.create_device_type("dur", "Durable")
+        dm0.create_area_type("region", "Region")
+        dm0.create_area("west", "region", "West")
+        insts[0].assets.create_asset_type("truck", "Truck")
+        reps[0].drain_pushes()
+        n_ops = sum(len(v) for v in reps[0]._ops_by_origin.values())
+        assert n_ops >= 4
+
+        # ---- crash-restart rank 0's entity plane (journal replay) -----
+        reps[0].close()
+        inst0b = SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c0)
+        rep0b = EntityReplicator(c0, inst0b,
+                                 log_dir=str(tmp_path / "elog-r0"))
+        rep0b.attach()
+        assert "dur" in inst0b.device_management.device_types
+        assert "west" in inst0b.device_management.areas
+        assert "truck" in inst0b.assets.asset_types
+        assert rep0b.vector == reps[0].vector
+        reps[0] = rep0b
+
+        # ---- a rank that missed pushes pulls the backlog --------------
+        inst1b = SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c1)
+        rep1b = EntityReplicator(c1, inst1b, log_dir=None)   # fresh, empty
+        rep1b.attach()
+        assert "dur" not in inst1b.device_management.device_types
+        pulled = rep1b.sync_from_peers(best_effort=False)
+        assert pulled >= n_ops
+        assert "dur" in inst1b.device_management.device_types
+        assert "west" in inst1b.device_management.areas
+        reps[1].close()
+        reps[1] = rep1b
+    finally:
+        _close_all(clusters, reps, host)
+
+
+def test_lww_converges_under_any_delivery_order(tmp_path):
+    """Concurrent writes to the same entity converge to the same value on
+    every rank regardless of delivery order: last-writer-wins on
+    (ts, origin)."""
+    from sitewhere_tpu.management.device_management import DeviceType
+    from sitewhere_tpu.management.entities import EntityMeta
+
+    clusters, insts, reps, host = _mk_replicated(tmp_path, with_logs=False)
+    try:
+        def op(origin, seq, ts, name):
+            state = to_state(DeviceType(
+                meta=EntityMeta(id=7, token="lww", created_ms=1.0,
+                                updated_ms=ts),
+                name=name))
+            return {"origin": origin, "seq": seq, "ts": ts,
+                    "action": "upsert", "kind": "device-type",
+                    "token": "lww", "state": state}
+
+        older = op(2, 1, 1000.0, "old-name")
+        newer = op(3, 1, 2000.0, "new-name")
+        # rank 0 sees newer first, rank 1 sees older first (apply_op =
+        # raw push delivery; apply_batch would sort)
+        reps[0].apply_op(newer)
+        reps[0].apply_op(older)
+        reps[1].apply_op(older)
+        reps[1].apply_op(newer)
+        n0 = insts[0].device_management.device_types.get("lww").name
+        n1 = insts[1].device_management.device_types.get("lww").name
+        assert n0 == n1 == "new-name"
+        assert reps[0].counters["lww_skipped"] == 1
+    finally:
+        _close_all(clusters, reps, host)
+
+
+def test_codec_roundtrips_nested_and_enum_fields():
+    from sitewhere_tpu.commands.model import (CommandParameter,
+                                              DeviceCommand, ParameterType)
+    from sitewhere_tpu.management.device_management import Zone
+    from sitewhere_tpu.management.entities import EntityMeta
+
+    cmd = DeviceCommand(
+        token="set", device_type="dt", name="set",
+        parameters=(CommandParameter("level", ParameterType.INT64, True),))
+    back = from_state(DeviceCommand, to_state(cmd))
+    assert back == cmd and isinstance(back.parameters, tuple)
+    assert back.parameters[0].type is ParameterType.INT64
+
+    z = Zone(meta=EntityMeta(id=1, token="z", created_ms=1, updated_ms=2),
+             name="z", area_token="a",
+             bounds=[(1.0, 2.0), (3.0, 4.0), (5.0, 6.0)])
+    zb = from_state(Zone, to_state(z))
+    assert zb.bounds == [(1.0, 2.0), (3.0, 4.0), (5.0, 6.0)]
+    assert isinstance(zb.bounds[0], tuple)
+
+
+def test_concurrent_creates_never_collide_on_ids(tmp_path):
+    """Rank-namespaced id allocation: two ranks creating DIFFERENT
+    entities concurrently must never mint the same id — a replicated
+    upsert would silently clobber the other rank's entity in _by_id."""
+    clusters, insts, reps, host = _mk_replicated(tmp_path, with_logs=False)
+    try:
+        dm0, dm1 = insts[0].device_management, insts[1].device_management
+        # both creates land in the same "next" slot before either push
+        dm0.create_device_type("cc-a", "A")
+        dm1.create_device_type("cc-b", "B")
+        reps[0].drain_pushes()
+        reps[1].drain_pushes()
+        for dm in (dm0, dm1):
+            a, b = dm.device_types.get("cc-a"), dm.device_types.get("cc-b")
+            assert (a.name, b.name) == ("A", "B")
+            assert a.meta.id != b.meta.id
+            assert len(dm.device_types.list(page_size=50).results) == \
+                len(dm.device_types)
+        # the two ranks agree on every id (shipped meta is authoritative)
+        assert to_state(dm0.device_types.get("cc-b")) == \
+            to_state(dm1.device_types.get("cc-b"))
+    finally:
+        _close_all(clusters, reps, host)
