@@ -350,8 +350,15 @@ class ClusterEngine:
         self.epoch = self.local.epoch
         self.search_index = None          # see attach_search_index
         self.command_service = None       # see attach_command_service
+        self.forward_queue = None         # see attach_forwarding
         self._peers: dict[int, _SyncPeer] = {}
         self._peers_lock = threading.Lock()
+        self._fid_seq = 0
+        # assignment-token -> owning rank. Ownership is IMMUTABLE (the
+        # assignment lives where its device's shards are, and device
+        # ownership is a pure token hash), so entries never go stale;
+        # capped so a scan-heavy workload can't grow it without bound.
+        self._assignment_ranks: dict[str, int] = {}
         self._token_factory = lambda: cluster_system_jwt(config.secret)
 
     # ------------------------------------------------------------- plumbing
@@ -404,6 +411,49 @@ class ClusterEngine:
         except (ValueError, AttributeError):
             return None
 
+    def attach_forwarding(self, queue, registry) -> None:
+        """Durable cross-rank forwarding (parallel/forward.py): the spill
+        QUEUE is this rank's sender-side buffer; the REGISTRY is placed
+        on the local engine so the rank's cluster RPC handlers suppress
+        redelivered forward ids (register_cluster_rpc binds engines, not
+        this facade)."""
+        self.forward_queue = queue
+        self.local.spill_registry = registry
+
+    def _next_fid(self) -> str:
+        """Unique forward id: rank + wall-clock ns + in-process seq —
+        unique across restarts without coordination."""
+        self._fid_seq += 1
+        return f"{self.rank}-{time.time_ns()}-{self._fid_seq}"
+
+    def _forward_batch(self, r: int, kind: str, plist: list[bytes],
+                       tenant: str) -> dict:
+        """One remote sub-batch. With a forward queue attached, delivery
+        is durable: tagged for owner-side dedup, spilled on failure
+        (returned as {"spilled": n}) instead of raising mid-batch with
+        part of the batch already applied locally."""
+        if self.forward_queue is None:
+            method = ("Cluster.ingestJson" if kind == "json"
+                      else "Cluster.ingestBinary")
+            return self._peer(r).call(method, payloads=_b64(plist),
+                                      tenant=tenant)
+        fid = self._next_fid()
+        if self.forward_queue.circuit_open(r):
+            # a known-down peer: spill without paying the connect
+            # timeout per batch; the retry pump closes the circuit
+            self.forward_queue.spill(r, kind, tenant, fid,
+                                     payloads=plist)
+            return {"spilled": len(plist)}
+        try:
+            return self._peer(r).call(
+                "Cluster.ingestForward", fid=fid, payloads=_b64(plist),
+                tenant=tenant, encoding=kind)
+        except (ConnectionError, TimeoutError):
+            self.forward_queue.trip(r)
+            self.forward_queue.spill(r, kind, tenant, fid,
+                                     payloads=plist)
+            return {"spilled": len(plist)}
+
     def ingest_json_batch(self, payloads: list[bytes],
                           tenant: str = "default") -> dict:
         """Partition the batch by owning rank (token-hash, like the Kafka
@@ -415,9 +465,8 @@ class ClusterEngine:
             if r == self.rank:
                 summaries.append(self.local.ingest_json_batch(plist, tenant))
             else:
-                summaries.append(self._peer(r).call(
-                    "Cluster.ingestJson", payloads=_b64(plist),
-                    tenant=tenant))
+                summaries.append(self._forward_batch(r, "json", plist,
+                                                     tenant))
         return _merge_counts(summaries)
 
     def ingest_binary_batch(self, payloads: list[bytes],
@@ -431,9 +480,8 @@ class ClusterEngine:
                 summaries.append(
                     self.local.ingest_binary_batch(plist, tenant))
             else:
-                summaries.append(self._peer(r).call(
-                    "Cluster.ingestBinary", payloads=_b64(plist),
-                    tenant=tenant))
+                summaries.append(self._forward_batch(r, "binary", plist,
+                                                     tenant))
         return _merge_counts(summaries)
 
     def process(self, req) -> None:
@@ -442,9 +490,23 @@ class ClusterEngine:
             return self.local.process(req)
         from sitewhere_tpu.ingest.decoders import envelope_from_request
 
-        self._peer(r).call("Cluster.processEnvelope",
-                           envelope=envelope_from_request(req),
-                           tenant=req.tenant)
+        env = envelope_from_request(req)
+        if self.forward_queue is None:
+            self._peer(r).call("Cluster.processEnvelope", envelope=env,
+                               tenant=req.tenant)
+            return
+        fid = self._next_fid()
+        if self.forward_queue.circuit_open(r):
+            self.forward_queue.spill(r, "envelope", req.tenant, fid,
+                                     envelope=env)
+            return
+        try:
+            self._peer(r).call("Cluster.forwardEnvelope", fid=fid,
+                               envelope=env, tenant=req.tenant)
+        except (ConnectionError, TimeoutError):
+            self.forward_queue.trip(r)
+            self.forward_queue.spill(r, "envelope", req.tenant, fid,
+                                     envelope=env)
 
     def _fanout(self, local_result, method: str, **params) -> list:
         """Local result + the same call on every peer (the one idiom
@@ -534,7 +596,7 @@ class ClusterEngine:
                           asset: str | None = None, area: str | None = None,
                           customer: str | None = None,
                           metadata: dict | None = None) -> AssignmentInfo:
-        return self._as_info(self._route(
+        info = self._as_info(self._route(
             device_token,
             lambda: self.local.create_assignment(device_token, token,
                                                  asset, area, customer,
@@ -542,24 +604,45 @@ class ClusterEngine:
             "Cluster.createAssignment", deviceToken=device_token,
             token=token, asset=asset, area=area, customer=customer,
             metadata=metadata))
+        self._cache_assignment_rank(info.token, self.owner(device_token))
+        return info
+
+    def _cache_assignment_rank(self, token: str, rank: int) -> None:
+        if len(self._assignment_ranks) > 65536:
+            self._assignment_ranks.clear()   # cap: a cache, not a table
+        self._assignment_ranks[token] = rank
 
     def _assignment_rank(self, token: str) -> "int | None":
+        cached = self._assignment_ranks.get(token)
+        if cached is not None:
+            return cached
         if self.local.get_assignment(token) is not None:
+            self._cache_assignment_rank(token, self.rank)
             return self.rank
         for r in range(self.n_ranks):
             if r != self.rank and self._peer(r).call(
                     "Cluster.getAssignment", token=token) is not None:
+                self._cache_assignment_rank(token, r)
                 return r
         return None
 
     def get_assignment(self, token: str) -> AssignmentInfo | None:
+        cached = self._assignment_ranks.get(token)
+        if cached is not None and cached != self.rank:
+            d = self._peer(cached).call("Cluster.getAssignment",
+                                        token=token)
+            if d is None:
+                self._assignment_ranks.pop(token, None)   # deleted
+            return self._as_info(d)
         a = self.local.get_assignment(token)
         if a is not None:
+            self._cache_assignment_rank(token, self.rank)
             return a
         for r in range(self.n_ranks):
             if r != self.rank:
                 d = self._peer(r).call("Cluster.getAssignment", token=token)
                 if d is not None:
+                    self._cache_assignment_rank(token, r)
                     return self._as_info(d)
         return None
 
@@ -596,6 +679,7 @@ class ClusterEngine:
         r = self._assignment_rank(token)
         if r is None:
             return False
+        self._assignment_ranks.pop(token, None)
         if r == self.rank:
             return self.local.delete_assignment(token)
         return self._peer(r).call("Cluster.deleteAssignment", token=token)
@@ -906,6 +990,23 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def ingest_binary(payloads: list, tenant: str = "default"):
         return engine.ingest_binary_batch(_unb64(payloads), tenant)
 
+    def ingest_forward(fid: str, payloads: list, tenant: str = "default",
+                       encoding: str = "json"):
+        """Tagged forward: the id registry suppresses redeliveries (a
+        retry after a lost response or a sender/owner restart must not
+        double-ingest). Record AFTER ingest: a crash in between costs a
+        duplicate (at-least-once), never a loss."""
+        reg = getattr(engine, "spill_registry", None)
+        if reg is not None and reg.seen(fid):
+            return {"duplicate_forward": 1}
+        if encoding == "binary":
+            summary = engine.ingest_binary_batch(_unb64(payloads), tenant)
+        else:
+            summary = engine.ingest_json_batch(_unb64(payloads), tenant)
+        if reg is not None:
+            reg.record(fid)
+        return summary
+
     def process_envelope(envelope: dict, tenant: str = "default"):
         from sitewhere_tpu.ingest.decoders import request_from_envelope
 
@@ -913,6 +1014,16 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         req.tenant = tenant
         engine.process(req)
         return {"accepted": True}
+
+    def forward_envelope(fid: str, envelope: dict,
+                         tenant: str = "default"):
+        reg = getattr(engine, "spill_registry", None)
+        if reg is not None and reg.seen(fid):
+            return {"duplicate_forward": 1}
+        res = process_envelope(envelope, tenant)
+        if reg is not None:
+            reg.record(fid)
+        return res
 
     def register_device(token: str, deviceType: str = None,
                         tenant: str = "default", area: str = None,
@@ -1025,7 +1136,9 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     for name, fn in {
         "Cluster.ingestJson": ingest_json,
         "Cluster.ingestBinary": ingest_binary,
+        "Cluster.ingestForward": ingest_forward,
         "Cluster.processEnvelope": process_envelope,
+        "Cluster.forwardEnvelope": forward_envelope,
         "Cluster.registerDevice": register_device,
         "Cluster.updateDevice": update_device,
         "Cluster.deleteDevice": delete_device,

@@ -97,16 +97,18 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
         store_capacity_per_shard=512, channels=4,
         batch_capacity_per_shard=16,
         wal_dir=str(scratch_p / f"wal-r{rank}"))
+    # connect timeout bounds the ONE stall a dead-peer forward pays
+    # before the circuit opens and everything spills instantly
     ccfg = ClusterConfig(rank=rank, n_ranks=2, peers=peers, secret=secret,
                          epoch_base_unix_s=base_s, engine=ecfg,
-                         connect_timeout_s=60.0)
+                         connect_timeout_s=15.0)
     # the WHOLE rank — engine (or crash recovery), cluster RPC on its own
     # loop, REST + pumps + presence + scheduler — from one config
     rt = run_rank(RankConfig(
         cluster=ccfg, instance=InstanceConfig(engine=EngineConfig()),
         rest_port=rests[rank],
         snapshot_dir=str(scratch_p / f"snap-r{rank}") if recover else None,
-        presence_interval_s=600.0))
+        presence_interval_s=600.0, forward_retry_interval_s=0.3))
     cluster, inst = rt.cluster, rt.instance
     assert rt.recovered == recover
     toks0 = _tokens_for(0, 2, N_PER_RANK)
@@ -227,6 +229,17 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
                 [_meas(toks1[0], "temp", 777.0, base_ms + 7777)])
             cluster.flush()
             (scratch_p / "extra-sent").touch()
+            # ---- phase 1.5: owner DEAD, ingest keeps accepting --------
+            # (durable forwarding: the remote share spills to disk
+            # instead of raising mid-batch; DecodedEventsProducer's
+            # Kafka-durability analog)
+            _wait_for(scratch_p / "r1-dead")
+            s = cluster.ingest_json_batch(
+                [_meas(toks1[1], "temp", 999.0, base_ms + 9999)])
+            assert s.get("spilled") == 1, s
+            fm = cluster.forward_queue.metrics()
+            assert fm["forward_queue_depth"] == 1, fm
+            (scratch_p / "spill-sent").touch()
             # ---- phase 2: peer crashed; wait for its recovery ---------
             _wait_for(scratch_p / "r1-recovered",
                       timeout_s=PHASE_TIMEOUT_S * 2)
@@ -237,18 +250,29 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
             cluster.ingest_json_batch(
                 [_meas(toks1[0], "temp", 888.0, base_ms + 8888)])
             cluster.flush()
+            # the background retry pump must redeliver the spilled event
+            # to the recovered owner — ZERO loss across the SIGKILL
+            deadline = time.monotonic() + 30.0
+            while cluster.query_events(
+                    device_token=toks1[1])["total"] < 3:
+                assert time.monotonic() < deadline, "spill not redelivered"
+                time.sleep(0.2)
+            fm = cluster.forward_queue.metrics()
+            assert fm["forward_redelivered_batches"] >= 1, fm
+            assert fm["forward_queue_depth"] == 0, fm
             rt.pump_outbound()
             (scratch_p / "r0-pumped").touch()
             _wait_for(scratch_p / "r1-pumped")
             mine, theirs = asyncio.run(both_snapshots())
             assert mine == theirs, (mine, theirs)
-            assert mine["total"] == 2 * len(both) + 2
+            assert mine["total"] == 2 * len(both) + 3
             # the recovered rank re-indexed its partition from its
             # rebuilt feed: search is complete again cluster-wide
             assert len(mine["search"]) == mine["total"], mine["search"]
             print(f"CLUSTER_OK rank=0 phase=2 "
                   f"total={mine['total']} "
-                  f"recovered_peer_serves_history=1", flush=True)
+                  f"recovered_peer_serves_history=1 "
+                  f"spill_redelivered=1", flush=True)
             (scratch_p / "r0-done").touch()
             rt.stop()
     else:
@@ -331,6 +355,12 @@ def spawn_cluster_demo(devices_per_proc: int = 2,
             raise RuntimeError(
                 f"rank1 phase1 failed rc={p1.returncode}\n{out1}\n"
                 f"{err1[-2000:]}")
+        # rank 1 is REAPED (truly dead): let rank 0 ingest against the
+        # dead owner — the durable forward queue must spill, not lose —
+        # BEFORE the replacement process comes up
+        pathlib.Path(scratch, "r1-dead").touch()
+        _wait_for(pathlib.Path(scratch, "spill-sent"),
+                  timeout_s=max(5.0, deadline - time.monotonic()))
         p1b = _spawn(1, scratch, ports, base_s, devices_per_proc, True)
         out1b, err1b = finish(p1b, "rank1-recovered")
         out0, err0 = finish(p0, "rank0")

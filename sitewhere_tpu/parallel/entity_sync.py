@@ -247,12 +247,17 @@ class EntityReplicator:
             self._remember(op)
             self._journal(op)
             self.counters["emitted"] += 1
-        if self.cluster.n_ranks > 1:
-            if self._push_thread is None or not self._push_thread.is_alive():
-                self._push_thread = threading.Thread(
-                    target=self._push_loop, name="entity-push", daemon=True)
-                self._push_thread.start()
-            self._push_q.put(op)
+            if self.cluster.n_ranks > 1:
+                # start-check under the lock: two concurrent mutators
+                # must not race a SECOND pusher into existence (per-
+                # origin push order relies on a single consumer)
+                if (self._push_thread is None
+                        or not self._push_thread.is_alive()):
+                    self._push_thread = threading.Thread(
+                        target=self._push_loop, name="entity-push",
+                        daemon=True)
+                    self._push_thread.start()
+                self._push_q.put(op)
 
     def _journal(self, op: dict) -> None:
         if self._log is not None:
@@ -295,8 +300,13 @@ class EntityReplicator:
                     res = c._peer(r).call("Cluster.entityOp", op=op)
                     if isinstance(res, dict) and res.get("gap"):
                         self._backfill(r, res.get("vector", {}))
-                except (ConnectionError, TimeoutError):
+                except Exception:
+                    # transport failures AND peer application errors: a
+                    # peer's handler raising must not kill the single
+                    # pusher thread — anti-entropy owns convergence
                     self.counters["push_failures"] += 1
+                    logger.debug("entity push to rank %d failed", r,
+                                 exc_info=True)
         finally:
             self._push_q.task_done()
 

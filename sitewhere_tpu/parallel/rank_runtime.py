@@ -67,6 +67,10 @@ class RankConfig:
     entity_log_dir: str | None = None   # entity-op journal; None derives
                                         # "<wal_dir>-entities"
     entity_sync_interval_s: float = 5.0  # anti-entropy pull period
+    forward_dir: str | None = None      # cross-rank spill queue; None
+                                        # derives "<wal_dir>-forward"
+    forward_retry_interval_s: float = 0.5
+    forward_retry_budget_s: float = 300.0
 
 
 class _LoopThread:
@@ -205,6 +209,8 @@ class RankRuntime:
 
         self._server_handle = self._main_loop.run(boot())
         self.rest_port = self._server_handle.port
+        if self.cluster.forward_queue is not None:
+            self.cluster.forward_queue.start()   # background redelivery
         # readiness surfaces on the public health route
         self.instance.health_extra = {
             "rank": self.rank,
@@ -255,6 +261,11 @@ class RankRuntime:
                 self._rpc_loop.close()
         if self.replicator is not None:
             self.replicator.close()
+        if self.cluster.forward_queue is not None:
+            self.cluster.forward_queue.stop()
+        reg = getattr(self.cluster.local, "spill_registry", None)
+        if reg is not None:
+            reg.close()
         self.cluster.close()
 
 
@@ -299,6 +310,21 @@ def run_rank(cfg: RankConfig) -> RankRuntime:
             elog = str(wd.with_name(wd.name + "-entities"))
         replicator = EntityReplicator(cluster, inst, log_dir=elog)
         replicator.attach()   # replays the journal (SIGKILL recovery)
+        if cfg.cluster.n_ranks > 1:
+            from sitewhere_tpu.parallel.forward import (ForwardQueue,
+                                                        SpillRegistry)
+
+            fdir = cfg.forward_dir
+            if fdir is None and cfg.cluster.engine.wal_dir:
+                wd = pathlib.Path(cfg.cluster.engine.wal_dir)
+                fdir = str(wd.with_name(wd.name + "-forward"))
+            if fdir is not None:
+                cluster.attach_forwarding(
+                    ForwardQueue(
+                        cluster, fdir,
+                        retry_interval_s=cfg.forward_retry_interval_s,
+                        retry_budget_s=cfg.forward_retry_budget_s),
+                    SpillRegistry(pathlib.Path(fdir) / "registry"))
     except Exception:
         # fail-fast must not leak the constructed engine or journals: a
         # supervisor retrying run_rank in-process would otherwise

@@ -1,0 +1,216 @@
+"""Durable cross-rank forwarding (VERDICT r4 missing #2).
+
+Reference model: the ingest edge hands events to a durable partitioned
+Kafka topic (DecodedEventsProducer.java:17-28) — a consumer replica being
+down never loses data. Here unreachable-owner sub-batches spill to a
+CRC'd per-peer disk queue, retry in the background, dead-letter after a
+budget, and redeliveries are suppressed by an owner-side forward-id
+registry (parallel/forward.py)."""
+
+import json
+import time
+
+import pytest
+
+from sitewhere_tpu.parallel.cluster import (ClusterConfig, ClusterEngine,
+                                            build_cluster_rpc)
+from sitewhere_tpu.parallel.distributed import DistributedEngine
+from sitewhere_tpu.parallel.forward import ForwardQueue, SpillRegistry
+from tests.test_cluster import (BASE_S, _engine_cfg, _free_ports,
+                                _ServerHost, meas, tokens_owned_by)
+
+
+def _mk_forwarding_cluster(tmp_path, connect_timeout_s=2.0):
+    """Two ranks with durable forwarding attached; rank 1's RPC server is
+    returned so tests can stop/restart it (the 'owner goes down' lever)."""
+    ports = _free_ports(2)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    host = _ServerHost()
+    clusters, queues, regs, servers = [], [], [], []
+    for r in range(2):
+        cc = ClusterConfig(rank=r, n_ranks=2, peers=peers,
+                           secret="fwd-secret", epoch_base_unix_s=BASE_S,
+                           engine=_engine_cfg(tmp_path, r),
+                           connect_timeout_s=connect_timeout_s)
+        c = ClusterEngine(cc)
+        q = ForwardQueue(c, tmp_path / f"fwd-r{r}", retry_budget_s=300.0)
+        reg = SpillRegistry(tmp_path / f"fwd-r{r}" / "registry")
+        c.attach_forwarding(q, reg)
+        srv = build_cluster_rpc(c.local, "fwd-secret")
+        host.start(srv, ports[r])
+        clusters.append(c)
+        queues.append(q)
+        regs.append(reg)
+        servers.append(srv)
+    return clusters, queues, regs, servers, host, ports
+
+
+def _close(clusters, regs, host):
+    for c in clusters:
+        c.close()
+    for reg in regs:
+        reg.close()
+    host.close()
+
+
+def test_down_owner_spills_instead_of_raising_and_redelivers(tmp_path):
+    """THE done-criterion: owner goes down mid-ingest, the batch is NOT
+    lost and ingest_json_batch does not raise mid-batch; after the owner
+    restarts, retry delivers everything exactly once."""
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        local = tokens_owned_by(0, 2, prefix="fw")
+        remote = tokens_owned_by(1, 2, prefix="fw")
+        both = local + remote
+        # warm path first: forwarding works while the owner is up
+        s = c0.ingest_json_batch([meas(t, "t", 1.0, 100 + i)
+                                  for i, t in enumerate(both)])
+        assert s.get("staged") == 4 and "spilled" not in s
+        # ---- owner rank 1 goes DOWN ----------------------------------
+        host.stop(servers[1])
+        s2 = c0.ingest_json_batch([meas(t, "t", 2.0, 200 + i)
+                                   for i, t in enumerate(both)])
+        # local share applied, remote share spilled — no exception, no
+        # partial-batch loss
+        assert s2["staged"] == 2 and s2["spilled"] == 2, s2
+        m = queues[0].metrics()
+        assert m["forward_queue_depth"] == 1
+        assert m["forward_spilled_payloads"] == 2
+        assert m["forward_queue_oldest_ms"] >= 0
+        # retry while still down: stays queued, order preserved
+        assert queues[0].retry_once() == 0
+        assert queues[0].metrics()["forward_queue_depth"] == 1
+        # ---- owner restarts (same engine, same port) -----------------
+        srv1b = build_cluster_rpc(c1.local, "fwd-secret")
+        host.start(srv1b, ports[1])
+        assert queues[0].retry_once() == 1
+        assert queues[0].metrics()["forward_queue_depth"] == 0
+        c0.flush()
+        # zero loss: every device has both rounds, exactly once
+        for t in both:
+            q = c0.query_events(device_token=t)
+            assert q["total"] == 2, (t, q)
+    finally:
+        _close(clusters, regs, host)
+
+
+def test_redelivery_is_suppressed_by_forward_registry(tmp_path):
+    """A retry after a LOST RESPONSE (owner applied, sender never heard)
+    must not double-ingest: the owner's registry remembers applied
+    forward ids — across an owner registry restart too."""
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        remote = tokens_owned_by(1, 1, prefix="dup")[0]
+        payloads = [meas(remote, "t", 5.0, 500)]
+        fid = c0._next_fid()
+        import base64
+
+        b64 = [base64.b64encode(p).decode() for p in payloads]
+        s1 = c0._peer(1).call("Cluster.ingestForward", fid=fid,
+                              payloads=b64, tenant="default",
+                              encoding="json")
+        assert s1["staged"] == 1
+        # the "response was lost" replay: same fid again
+        s2 = c0._peer(1).call("Cluster.ingestForward", fid=fid,
+                              payloads=b64, tenant="default",
+                              encoding="json")
+        assert s2 == {"duplicate_forward": 1}
+        # registry survives a restart (reload from its append log)
+        regs[1].close()
+        reg1b = SpillRegistry(tmp_path / "fwd-r1" / "registry")
+        c1.attach_forwarding(queues[1], reg1b)
+        regs[1] = reg1b
+        s3 = c0._peer(1).call("Cluster.ingestForward", fid=fid,
+                              payloads=b64, tenant="default",
+                              encoding="json")
+        assert s3 == {"duplicate_forward": 1}
+        c0.flush()
+        assert c0.query_events(device_token=remote)["total"] == 1
+    finally:
+        _close(clusters, regs, host)
+
+
+def test_retry_budget_moves_to_deadletter_not_drops(tmp_path):
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0 = clusters[0]
+    try:
+        host.stop(servers[1])
+        remote = tokens_owned_by(1, 1, prefix="dl")[0]
+        s = c0.ingest_json_batch([meas(remote, "t", 9.0, 900)])
+        assert s == {"spilled": 1}
+        queues[0].retry_budget_s = 0.0   # budget exhausted immediately
+        time.sleep(0.01)
+        assert queues[0].retry_once() == 0
+        m = queues[0].metrics()
+        assert m["forward_deadlettered_batches"] == 1
+        assert m["forward_queue_depth"] == 0
+        # the data is preserved on disk, not dropped
+        dl = list((tmp_path / "fwd-r0" / "deadletter").glob("*.json"))
+        assert len(dl) == 1
+        rec = json.loads(json.loads(dl[0].read_bytes())["body"])
+        assert rec["kind"] == "json" and len(rec["payloads"]) == 1
+    finally:
+        _close(clusters, regs, host)
+
+
+def test_envelope_forwarding_spills_and_redelivers(tmp_path):
+    """The single-envelope path (process/protocol edges) gets the same
+    durability as batches."""
+    from sitewhere_tpu.ingest.decoders import request_from_envelope
+
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        remote = tokens_owned_by(1, 1, prefix="env")[0]
+        env = {"deviceToken": remote, "type": "DeviceMeasurements",
+               "request": {"measurements": {"t": 3.0},
+                           "eventDate": int(BASE_S * 1000) + 300}}
+        host.stop(servers[1])
+        req = request_from_envelope(env)
+        req.tenant = "default"
+        c0.process(req)                 # spills, does not raise
+        assert queues[0].metrics()["forward_queue_depth"] == 1
+        srv1b = build_cluster_rpc(c1.local, "fwd-secret")
+        host.start(srv1b, ports[1])
+        assert queues[0].retry_once() == 1
+        c1.flush()
+        assert c1.query_events(device_token=remote)["total"] == 1
+    finally:
+        _close(clusters, regs, host)
+
+
+def test_circuit_breaker_spills_fast_after_first_failure(tmp_path):
+    """After one failed forward, later batches to the same peer spill
+    immediately (no per-batch connect timeout); the first successful
+    retry closes the circuit and normal forwarding resumes."""
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path, connect_timeout_s=1.0)
+    c0, c1 = clusters
+    try:
+        remote = tokens_owned_by(1, 1, prefix="cb")[0]
+        host.stop(servers[1])
+        s = c0.ingest_json_batch([meas(remote, "t", 1.0, 100)])
+        assert s == {"spilled": 1}
+        assert queues[0].circuit_open(1)
+        t0 = time.monotonic()
+        s2 = c0.ingest_json_batch([meas(remote, "t", 2.0, 101)])
+        fast = time.monotonic() - t0
+        assert s2 == {"spilled": 1}
+        assert fast < 0.5, f"open circuit should spill instantly ({fast}s)"
+        srv1b = build_cluster_rpc(c1.local, "fwd-secret")
+        host.start(srv1b, ports[1])
+        assert queues[0].retry_once() == 2
+        assert not queues[0].circuit_open(1)
+        # circuit closed: live forwarding again (not spilling)
+        s3 = c0.ingest_json_batch([meas(remote, "t", 3.0, 102)])
+        assert s3.get("staged") == 1 and "spilled" not in s3
+        c0.flush()
+        assert c0.query_events(device_token=remote)["total"] == 3
+    finally:
+        _close(clusters, regs, host)
