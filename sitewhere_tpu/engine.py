@@ -561,6 +561,26 @@ def _admin_set_device_active(state: PipelineState, device_id, active):
     )
 
 
+def tenant_cap(n_tenants: int) -> int:
+    """Static power-of-two tenant bucket for the segment-sum — one
+    formula for every engine flavor so their per-tenant series agree."""
+    return max(64, 1 << max(0, n_tenants - 1).bit_length())
+
+
+def tenant_counts_dict(counts, tenants, n_tenants: int) -> dict:
+    """[t_cap, E] count grid -> {tenant: {EventType: n}} (quiet tenants
+    skipped) — shared by Engine and DistributedEngine tenant_metrics."""
+    out: dict[str, dict[str, int]] = {}
+    for tid in range(min(n_tenants, counts.shape[0])):
+        if not counts[tid].any():
+            continue
+        out[tenants.token(tid)] = {
+            EventType(e).name: int(counts[tid, e])
+            for e in range(counts.shape[1])
+        }
+    return out
+
+
 @functools.partial(jax.jit, static_argnames=("t_cap",))
 def _tenant_event_counts(state: PipelineState, t_cap: int):
     """Segment-sum per-device event counters by tenant: [t_cap, E].
@@ -1887,17 +1907,9 @@ class Engine(IngestHostMixin):
         with self.lock:
             self._sync_mirrors()
             n_tenants = len(self.tenants)
-            t_cap = max(64, 1 << max(0, n_tenants - 1).bit_length())
-            counts = np.asarray(_tenant_event_counts(self.state, t_cap))
-        out: dict[str, dict[str, int]] = {}
-        for tid in range(min(n_tenants, counts.shape[0])):
-            if not counts[tid].any():
-                continue
-            out[self.tenants.token(tid)] = {
-                EventType(e).name: int(counts[tid, e])
-                for e in range(counts.shape[1])
-            }
-        return out
+            counts = np.asarray(_tenant_event_counts(
+                self.state, tenant_cap(n_tenants)))
+        return tenant_counts_dict(counts, self.tenants, n_tenants)
 
     # uniform name for "sweep THIS engine only" — the cluster facade
     # overrides presence_sweep with a fan-out but keeps this local form,

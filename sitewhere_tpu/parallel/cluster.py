@@ -418,6 +418,7 @@ class ClusterEngine:
         redelivered forward ids (register_cluster_rpc binds engines, not
         this facade)."""
         self.forward_queue = queue
+        self.local.forward_queue = queue     # rank metrics see the queue
         self.local.spill_registry = registry
 
     def _next_fid(self) -> str:
@@ -508,15 +509,35 @@ class ClusterEngine:
             self.forward_queue.spill(r, "envelope", req.tenant, fid,
                                      envelope=env)
 
-    def _fanout(self, local_result, method: str, **params) -> list:
-        """Local result + the same call on every peer (the one idiom
-        behind flush/metrics/sweeps; timeout/parallelism policy lives
-        here once)."""
-        out = [local_result]
+    def _fanout_keyed(self, local_result, method: str,
+                      tolerant: bool = False, **params) -> dict:
+        """Local result + the same call on every peer, keyed by rank —
+        the one idiom behind flush/metrics/sweeps/status; timeout,
+        parallelism, and down-peer policy live here once. ``tolerant``
+        marks an unreachable peer with a ``PeerDown`` sentinel (checking
+        the forward circuit first, so a known-dead peer costs nothing)
+        instead of raising — the scrape surfaces must degrade, queries
+        must stay loud."""
+        out = {self.rank: local_result}
         for r in range(self.n_ranks):
-            if r != self.rank:
-                out.append(self._peer(r).call(method, **params))
+            if r == self.rank:
+                continue
+            if (tolerant and self.forward_queue is not None
+                    and self.forward_queue.circuit_open(r)):
+                out[r] = PeerDown("forward circuit open")
+                continue
+            try:
+                out[r] = self._peer(r).call(method, **params)
+            except (ConnectionError, TimeoutError) as e:
+                if not tolerant:
+                    raise
+                out[r] = PeerDown(str(e))
         return out
+
+    def _fanout(self, local_result, method: str, **params) -> list:
+        """List form of ``_fanout_keyed`` (local first, then peers)."""
+        return list(self._fanout_keyed(local_result, method,
+                                       **params).values())
 
     def flush(self) -> dict:
         """Flush every rank — after this, queries anywhere see everything
@@ -856,9 +877,60 @@ class ClusterEngine:
         docs.sort(key=event_order_key)
         return docs[:max_results]
 
+    # metric keys that merge as MAX, not sum (ages/watermarks: a summed
+    # "oldest" is an age no spill has)
+    _MAX_MERGED = ("forward_queue_oldest_ms",)
+
     def metrics(self) -> dict:
-        return _merge_counts(self._fanout(
-            self.local.metrics(), "Cluster.metrics"))
+        """Cluster-merged counters PLUS per-rank attribution: the summed
+        view answers "how much", ``by_rank`` answers "which rank is hot"
+        (VERDICT r4 item 7 — a sum that loses the hot rank hides every
+        imbalance). Rank-local extras (forward queue, entity replication)
+        ride each rank's own metrics via ``local_rank_metrics``. A DOWN
+        peer degrades to an ``unreachable`` entry instead of failing the
+        whole scrape — the operator needs this surface most exactly when
+        a rank is missing."""
+        keyed = self._fanout_keyed(local_rank_metrics(self.local),
+                                   "Cluster.metrics", tolerant=True)
+        up = {str(r): m for r, m in keyed.items()
+              if not isinstance(m, PeerDown)}
+        merged = _merge_counts(list(up.values()))
+        for key in self._MAX_MERGED:
+            vals = [m[key] for m in up.values() if key in m]
+            if vals:
+                merged[key] = max(vals)
+        merged["by_rank"] = dict(up)
+        for r, m in keyed.items():
+            if isinstance(m, PeerDown):
+                merged["by_rank"][str(r)] = {"unreachable": 1,
+                                             "reason": m.reason}
+        return merged
+
+    def cluster_status(self) -> dict:
+        """The operator's cluster page: this rank's identity, every
+        rank's reachability + device count, and the durability gauges.
+        A peer with an OPEN forward circuit reports DOWN without paying
+        a connect timeout on the scrape."""
+        keyed = self._fanout_keyed(len(self.local.devices),
+                                   "Cluster.deviceCount", tolerant=True)
+        ranks: dict[str, dict] = {}
+        for r, res in keyed.items():
+            if isinstance(res, PeerDown):
+                ranks[str(r)] = {"status": "DOWN", "local": False,
+                                 "reason": res.reason}
+            else:
+                ranks[str(r)] = {"status": "UP", "local": r == self.rank,
+                                 "devices": res}
+        out = {"clustered": self.n_ranks > 1, "rank": self.rank,
+               "nRanks": self.n_ranks,
+               "peers": list(self.cluster_config.peers), "ranks": ranks,
+               "owned_devices": len(self.local.devices)}
+        if self.forward_queue is not None:
+            out["forwarding"] = self.forward_queue.metrics()
+        rep = getattr(self, "entity_replicator", None)
+        if rep is not None:
+            out["entities"] = rep.metrics()
+        return out
 
     @property
     def devices(self) -> _MergedDevices:
@@ -912,6 +984,29 @@ class ClusterSearchProvider:
         if docs is None:   # facade has no index attached: local behavior
             return self._local.search(query, max_results)
         return docs
+
+
+class PeerDown:
+    """Tolerant-fanout sentinel: the peer at this rank was unreachable."""
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+
+def local_rank_metrics(engine) -> dict:
+    """One rank's full metric set: engine counters plus the durability
+    components attached to it (forward queue, spill registry, entity
+    replicator) — the single source both the facade's local leg and the
+    Cluster.metrics RPC handler report, so every rank's entry in
+    ``by_rank`` carries the same schema."""
+    m = engine.metrics()
+    fq = getattr(engine, "forward_queue", None)
+    if fq is not None:
+        m.update(fq.metrics())
+    rep = getattr(engine, "entity_replicator", None)
+    if rep is not None:
+        m.update(rep.metrics())
+    return m
 
 
 def _owned_invocation(engine, invocation_id: int):
@@ -1094,7 +1189,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         return len(engine.devices)
 
     def metrics():
-        return engine.metrics()
+        return local_rank_metrics(engine)
 
     def presence_sweep():
         return engine.presence_sweep()

@@ -1424,6 +1424,25 @@ class DistributedEngine(IngestHostMixin):
         m = dict(self.host_counters) | m
         return m
 
+    def tenant_metrics(self) -> dict[str, dict[str, int]]:
+        """Per-tenant event counts over ALL shards: vmap the single-state
+        segment-sum (engine._tenant_event_counts) across the stacked
+        state and reduce — tenant ids are engine-global, so summing the
+        per-shard [t_cap, E] grids is exact (Engine.tenant_metrics
+        parity for the Prometheus per-tenant series)."""
+        from sitewhere_tpu.engine import (_tenant_event_counts, tenant_cap,
+                                          tenant_counts_dict)
+
+        with self.lock:
+            self._sync_mirrors()
+            n_tenants = len(self.tenants)
+            t_cap = tenant_cap(n_tenants)
+            per_shard = jax.vmap(
+                lambda st: _tenant_event_counts(st, t_cap))(
+                    self.sharded.state)                    # [S, T, E]
+            counts = np.asarray(per_shard).sum(axis=0)
+        return tenant_counts_dict(counts, self.tenants, n_tenants)
+
     def shard_metrics(self) -> list[dict]:
         """Per-shard counters (the per-partition consumer-lag analog)."""
         mm = jax.device_get(self.state.metrics)

@@ -203,6 +203,11 @@ class EntityReplicator:
         dm.on_elements_change = self._on_elements_change
         inst.users.on_change = self._on_user_change
         inst.command_registry.on_change = self._on_command_change
+        # surface replication metrics on the rank's metric schema (both
+        # the facade's local leg and the Cluster.metrics handler read
+        # these via local_rank_metrics)
+        self.cluster.entity_replicator = self
+        self.cluster.local.entity_replicator = self
         # replicated schedules exist on every rank: fire each at exactly
         # one (its token's owner under the device partitioner)
         if self.cluster.n_ranks > 1:

@@ -173,9 +173,27 @@ def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
     counts export labeled, mirroring the reference's buildLabels() tenant
     labeling on every metric."""
     reg = registry or REGISTRY
-    for name, value in engine.metrics().items():
+    metrics = engine.metrics()
+    by_rank = metrics.pop("by_rank", None)
+
+    def _numeric(items):
+        return ((n, v) for n, v in items
+                if isinstance(v, (int, float)) and not isinstance(v, bool))
+
+    for name, value in _numeric(metrics.items()):
+        labels = {"tenant": tenant}
+        if by_rank is not None:
+            labels["rank"] = "all"   # cluster-merged series
         reg.gauge(f"swtpu_engine_{name}",
-                  f"engine counter {name}").set(value, tenant=tenant)
+                  f"engine counter {name}").set(value, **labels)
+    if by_rank is not None:
+        # per-rank series: the "which rank is hot" view the reference
+        # gets from scraping each microservice replica separately
+        for rank, rank_metrics in by_rank.items():
+            for name, value in _numeric(rank_metrics.items()):
+                reg.gauge(f"swtpu_engine_{name}",
+                          f"engine counter {name}").set(
+                    value, tenant=tenant, rank=str(rank))
     g = reg.gauge("swtpu_tenant_events",
                   "persisted event count per tenant and type")
     current: set[tuple] = set()

@@ -180,6 +180,18 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_get("/api/instance/metrics/prometheus", prometheus_metrics)
 
+    async def cluster_status(request: web.Request):
+        """Cluster topology + per-rank health/durability (VERDICT r4
+        item 7). Off-loop: probing peers blocks, and a DOWN peer without
+        an open forward circuit costs a connect attempt."""
+        status = getattr(inst.engine, "cluster_status", None)
+        if status is None:
+            return json_response({"clustered": False, "rank": 0,
+                                  "nRanks": 1})
+        return json_response(await asyncio.to_thread(status))
+
+    r.add_get("/api/instance/cluster", cluster_status)
+
     # --- script management (reference: Instance.java scripting @Path
     # family — script CRUD, versions, content, clone, activate) -----------
     # ADMIN-ONLY: scripts execute as in-process Python and config pushes

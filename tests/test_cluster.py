@@ -1133,6 +1133,26 @@ def test_run_rank_boots_a_serving_rank_from_one_config(tmp_path):
         assert q["total"] == 1
         rt.pump_outbound()   # search connector indexes the partition
         assert len(rt.instance.search_index.search("*:*")) == 1
+        # observability surfaces: the cluster page + rank-labeled
+        # Prometheus series (single rank: by_rank has one entry)
+        basic = __import__("base64").b64encode(b"admin:password").decode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rt.rest_port}/api/authapi/jwt",
+            headers={"Authorization": f"Basic {basic}"})
+        jwt = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        hdr = {"Authorization": f"Bearer {jwt['token']}"}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rt.rest_port}/api/instance/cluster",
+            headers=hdr)
+        cs = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert cs["rank"] == 0 and cs["ranks"]["0"]["status"] == "UP"
+        assert "entities" in cs   # replication gauges ride the page
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rt.rest_port}"
+            "/api/instance/metrics/prometheus", headers=hdr)
+        text = urllib.request.urlopen(req, timeout=10).read().decode()
+        assert 'rank="0"' in text and 'rank="all"' in text
+        assert "swtpu_engine_persisted" in text
     finally:
         rt.stop()
 
@@ -1168,5 +1188,43 @@ def test_assignments_administered_from_any_rank(tmp_path):
         assert c1.delete_assignment("asg-A") is False
         with pytest.raises(KeyError):
             c0.update_assignment("asg-A", area="x")
+    finally:
+        _close(clusters, host)
+
+
+def test_cluster_metrics_carry_rank_attribution(tmp_path):
+    """metrics() keeps the cluster-merged sums AND reports by_rank, so
+    an operator can see WHICH rank is hot (VERDICT r4 item 7 — a pure
+    sum hides every imbalance); cluster_status() is the topology/health
+    page behind /api/instance/cluster."""
+    clusters, host, _ = _mk_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        toks = tokens_owned_by(0, 3, prefix="mr") + \
+            tokens_owned_by(1, 1, prefix="mr")
+        c0.ingest_json_batch([meas(t, "t", float(i), 40 + i)
+                              for i, t in enumerate(toks)])
+        c0.flush()
+        m = c0.metrics()
+        assert m["persisted"] == 4
+        assert set(m["by_rank"]) == {"0", "1"}
+        assert sum(r["persisted"] for r in m["by_rank"].values()) == 4
+        # the imbalance is visible: rank 0 owns 3 of the 4 devices
+        assert m["by_rank"]["0"]["persisted"] == 3
+        # per-tenant counts exist on the mesh engine too (the Prometheus
+        # per-tenant series; Engine.tenant_metrics parity)
+        tm = c0.local.tenant_metrics()
+        assert tm["default"]["MEASUREMENT"] == 3
+        # entity-replication gauges ride each rank's schema when attached
+        s = c0.cluster_status()
+        assert s["clustered"] is True and s["rank"] == 0
+        assert s["ranks"]["0"]["local"] and s["ranks"]["0"]["status"] == "UP"
+        assert s["ranks"]["1"]["status"] == "UP"
+        assert s["ranks"]["0"]["devices"] == 3
+        assert s["ranks"]["1"]["devices"] == 1
+        # the same page from the other rank agrees on topology
+        s1 = c1.cluster_status()
+        assert s1["rank"] == 1 and s1["nRanks"] == 2
+        assert s1["ranks"]["0"]["devices"] == 3
     finally:
         _close(clusters, host)

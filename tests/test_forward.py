@@ -214,3 +214,37 @@ def test_circuit_breaker_spills_fast_after_first_failure(tmp_path):
         assert c0.query_events(device_token=remote)["total"] == 3
     finally:
         _close(clusters, regs, host)
+
+
+def test_cluster_status_reports_down_peer_via_circuit(tmp_path):
+    """With forwarding attached, a dead peer shows DOWN on the cluster
+    status page WITHOUT the scrape paying a connect timeout (the open
+    circuit answers), and the durability gauges ride along."""
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path, connect_timeout_s=1.0)
+    c0 = clusters[0]
+    try:
+        remote = tokens_owned_by(1, 1, prefix="st")[0]
+        host.stop(servers[1])
+        c0.ingest_json_batch([meas(remote, "t", 1.0, 100)])  # trips circuit
+        t0 = time.monotonic()
+        s = c0.cluster_status()
+        assert time.monotonic() - t0 < 0.5   # no connect attempt
+        assert s["ranks"]["1"]["status"] == "DOWN"
+        assert "circuit" in s["ranks"]["1"]["reason"]
+        assert s["forwarding"]["forward_queue_depth"] == 1
+        assert s["forwarding"]["forward_open_circuits"] == 1
+        # per-rank metrics schema includes the forward gauges
+        from sitewhere_tpu.parallel.cluster import local_rank_metrics
+
+        lm = local_rank_metrics(c0.local)
+        assert lm["forward_queue_depth"] == 1
+        # the metrics surface DEGRADES, never 500s: the down rank shows
+        # unreachable and the merged sums cover the live ranks only
+        m = c0.metrics()
+        assert m["by_rank"]["1"] == {"unreachable": 1,
+                                     "reason": "forward circuit open"}
+        assert m["by_rank"]["0"]["persisted"] == 0   # spilled, not local
+        assert m["forward_queue_oldest_ms"] >= 0     # max-merged age
+    finally:
+        _close(clusters, regs, host)
