@@ -906,6 +906,23 @@ class ClusterEngine:
                                              "reason": m.reason}
         return merged
 
+    def tenant_metrics(self) -> dict:
+        """Cluster-wide per-tenant event counts (each rank counts ITS
+        partition; sums merge) — the Prometheus per-tenant series must
+        cover the same corpus as the rank=\"all\" counters on the same
+        page. Down peers degrade like metrics()."""
+        keyed = self._fanout_keyed(self.local.tenant_metrics(),
+                                   "Cluster.tenantMetrics", tolerant=True)
+        merged: dict[str, dict[str, int]] = {}
+        for res in keyed.values():
+            if isinstance(res, PeerDown):
+                continue
+            for ten, counts in res.items():
+                slot = merged.setdefault(ten, {})
+                for etype, n in counts.items():
+                    slot[etype] = slot.get(etype, 0) + n
+        return merged
+
     def cluster_status(self) -> dict:
         """The operator's cluster page: this rank's identity, every
         rank's reachability + device count, and the durability gauges.
@@ -1191,6 +1208,9 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def metrics():
         return local_rank_metrics(engine)
 
+    def tenant_metrics():
+        return engine.tenant_metrics()
+
     def presence_sweep():
         return engine.presence_sweep()
 
@@ -1252,6 +1272,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.listDeviceInfos": list_device_infos,
         "Cluster.deviceCount": device_count,
         "Cluster.metrics": metrics,
+        "Cluster.tenantMetrics": tenant_metrics,
         "Cluster.presenceSweep": presence_sweep,
         "Cluster.invokeCommand": invoke_command,
         "Cluster.getInvocation": get_invocation,

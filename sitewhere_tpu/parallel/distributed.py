@@ -1737,12 +1737,19 @@ def restore_distributed(directory) -> DistributedEngine:
     return eng
 
 
-def recover_distributed(snapshot_dir, wal_dir=None) -> DistributedEngine:
+def recover_distributed(snapshot_dir, wal_dir=None,
+                        adopt_wal: bool = False) -> DistributedEngine:
     """Crash recovery for the mesh engine: restore the snapshot, replay the
     WAL tail past its watermark through the wire format that accepted each
     record (at-least-once; the sharded state merge is timestamp-idempotent
     like the single-node path). The replay mechanism is shared with
-    recover_engine (utils/checkpoint.replay_wal_into)."""
+    recover_engine (utils/checkpoint.replay_wal_into).
+
+    ``adopt_wal=True``: when the snapshot itself carries no WAL (migrated
+    or resharded manifests set wal_dir=None), the engine ADOPTS ``wal_dir``
+    as its live log after replaying it — the serving-rank boot path. The
+    default keeps an explicitly named log READ-ONLY (a preserved recovery
+    copy stays byte-identical)."""
     import json
     import pathlib
 
@@ -1753,5 +1760,13 @@ def recover_distributed(snapshot_dir, wal_dir=None) -> DistributedEngine:
     host = json.loads((snapshot_dir / "host_distributed.json").read_text())
     if wal_dir is None and eng.config.wal_dir is None:
         return eng
+    if adopt_wal and eng.wal is None and wal_dir is not None:
+        # the tail in wal_dir replays first, then new ingest journals
+        # into the same log (replay never re-logs: replay_wal_into
+        # detaches the live WAL while feeding records)
+        from sitewhere_tpu.utils.ingestlog import IngestLog
+
+        eng.config.wal_dir = str(wal_dir)
+        eng.wal = IngestLog(wal_dir)
     replay_wal_into(eng, host["store_cursor"], wal_dir)
     return eng

@@ -279,8 +279,11 @@ def run_rank(cfg: RankConfig) -> RankRuntime:
     if cfg.snapshot_dir is not None and (
             pathlib.Path(cfg.snapshot_dir) /
             "sharded_manifest.json").exists():
+        # adopt_wal: a serving rank must journal new ingest even when the
+        # snapshot (migrated/resharded) carries no wal_dir of its own
         local = recover_distributed(cfg.snapshot_dir,
-                                    cfg.cluster.engine.wal_dir)
+                                    cfg.cluster.engine.wal_dir,
+                                    adopt_wal=True)
         recovered = True
     elif cfg.cluster.engine.wal_dir and sorted(
             pathlib.Path(cfg.cluster.engine.wal_dir).glob("segment-*.log")

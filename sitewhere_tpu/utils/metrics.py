@@ -60,6 +60,14 @@ class Gauge(Counter):
         for key, val in sorted(self._values.items()):
             yield f"{self.name}{_fmt_labels(dict(key))} {val}"
 
+    def retain(self, keys: set) -> None:
+        """Drop series not written by the current export — a drained
+        queue's age gauge or a dead rank's counters must disappear, not
+        freeze at their last sample."""
+        with self._lock:
+            for key in [k for k in self._values if k not in keys]:
+                del self._values[key]
+
 
 class Histogram:
     def __init__(self, name: str, help_text: str,
@@ -180,20 +188,30 @@ def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
         return ((n, v) for n, v in items
                 if isinstance(v, (int, float)) and not isinstance(v, bool))
 
+    written: dict[str, set] = {}
+
+    def _set(name: str, value, **labels) -> None:
+        g = reg.gauge(f"swtpu_engine_{name}", f"engine counter {name}")
+        g.set(value, **labels)
+        written.setdefault(g.name, set()).add(
+            tuple(sorted(labels.items())))
+
     for name, value in _numeric(metrics.items()):
         labels = {"tenant": tenant}
         if by_rank is not None:
             labels["rank"] = "all"   # cluster-merged series
-        reg.gauge(f"swtpu_engine_{name}",
-                  f"engine counter {name}").set(value, **labels)
+        _set(name, value, **labels)
     if by_rank is not None:
         # per-rank series: the "which rank is hot" view the reference
         # gets from scraping each microservice replica separately
         for rank, rank_metrics in by_rank.items():
             for name, value in _numeric(rank_metrics.items()):
-                reg.gauge(f"swtpu_engine_{name}",
-                          f"engine counter {name}").set(
-                    value, tenant=tenant, rank=str(rank))
+                _set(name, value, tenant=tenant, rank=str(rank))
+    # conditional keys (a drained queue's age) and dead ranks must
+    # DISAPPEAR from the exposition, not freeze at their last sample
+    for mname, metric in list(reg._metrics.items()):
+        if mname.startswith("swtpu_engine_") and isinstance(metric, Gauge):
+            metric.retain(written.get(mname, set()))
     g = reg.gauge("swtpu_tenant_events",
                   "persisted event count per tenant and type")
     current: set[tuple] = set()

@@ -174,9 +174,13 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     async def prometheus_metrics(request: web.Request):
         from sitewhere_tpu.utils.metrics import REGISTRY, export_engine_metrics
 
-        export_engine_metrics(inst.engine)
-        return web.Response(text=REGISTRY.expose_text(),
-                            content_type="text/plain")
+        # a clustered engine fans out to peers inside metrics() — keep
+        # the scrape off the gateway loop or a down peer freezes REST
+        # (including the readiness probe) for its connect timeout
+        text = await asyncio.to_thread(
+            lambda: (export_engine_metrics(inst.engine),
+                     REGISTRY.expose_text())[1])
+        return web.Response(text=text, content_type="text/plain")
 
     r.add_get("/api/instance/metrics/prometheus", prometheus_metrics)
 

@@ -1,0 +1,243 @@
+"""Snapshot+archive rank-count migration (VERDICT r4 missing #3).
+
+The replay-based ``reshard_cluster`` is O(all history) and refuses pruned
+WALs; ``migrate_cluster_snapshots`` re-partitions live snapshots and
+row-copies archives, with only the post-snapshot WAL tails re-decoded.
+THE done-criterion: prune the WALs first and the migrated cluster still
+serves IDENTICAL query results."""
+
+import json
+import time
+
+import pytest
+
+from sitewhere_tpu.parallel.cluster import (ClusterConfig, ClusterEngine,
+                                            build_cluster_rpc, owner_rank)
+from sitewhere_tpu.parallel.cluster_reshard import (migrate_cluster_snapshots,
+                                                    replay_wal_tails)
+from sitewhere_tpu.parallel.distributed import (DistributedConfig,
+                                                recover_distributed)
+from tests.test_cluster import BASE_MS, BASE_S, _free_ports, _ServerHost
+
+CH = ("temp", "hum", "psi")
+
+
+def _cfg(tmp_path, tag, rank):
+    return DistributedConfig(
+        n_shards=2, device_capacity_per_shard=64,
+        token_capacity_per_shard=128, assignment_capacity_per_shard=128,
+        store_capacity_per_shard=64, channels=4,
+        batch_capacity_per_shard=8, archive_segment_rows=8,
+        wal_dir=str(tmp_path / f"{tag}-wal-r{rank}"),
+        archive_dir=str(tmp_path / f"{tag}-arch-r{rank}"))
+
+
+def _mk(tmp_path, tag, n_ranks, locals_=None):
+    ports = _free_ports(n_ranks)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    host = _ServerHost()
+    clusters = []
+    for r in range(n_ranks):
+        cc = ClusterConfig(rank=r, n_ranks=n_ranks, peers=peers,
+                           secret=f"{tag}-secret",
+                           epoch_base_unix_s=BASE_S,
+                           engine=_cfg(tmp_path, tag, r),
+                           connect_timeout_s=10.0)
+        c = ClusterEngine(cc, local=locals_[r] if locals_ else None)
+        host.start(build_cluster_rpc(c.local, f"{tag}-secret"), ports[r])
+        clusters.append(c)
+    return clusters, host
+
+
+def _tokens(n_old, n, prefix):
+    """n tokens per OLD rank, chosen so the NEW 3-rank partitioning also
+    spreads (any tokens do — ownership is just a hash)."""
+    out, i = {r: [] for r in range(n_old)}, 0
+    while any(len(v) < n for v in out.values()):
+        t = f"{prefix}-{i}"
+        r = owner_rank(t, n_old)
+        if len(out[r]) < n:
+            out[r].append(t)
+        i += 1
+    return [t for r in range(n_old) for t in out[r]]
+
+
+def _meas(token, pairs, ts_rel, alt=None):
+    req = {"measurements": dict(pairs), "eventDate": BASE_MS + ts_rel}
+    if alt:
+        req["alternateId"] = alt
+    return json.dumps({"deviceToken": token, "type": "DeviceMeasurements",
+                       "request": req}).encode()
+
+
+def _loc(token, lat, lon, ts_rel):
+    return json.dumps({
+        "deviceToken": token, "type": "DeviceLocation",
+        "request": {"latitude": lat, "longitude": lon, "elevation": 5.0,
+                    "eventDate": BASE_MS + ts_rel}}).encode()
+
+
+def _alert(token, atype, level, ts_rel):
+    return json.dumps({
+        "deviceToken": token, "type": "DeviceAlert",
+        "request": {"type": atype, "level": level, "message": "m",
+                    "eventDate": BASE_MS + ts_rel}}).encode()
+
+
+def _norm(events):
+    """Topology-independent event identity: ids/assignment ids live in
+    rank-local spaces and legitimately change across a migration."""
+    out = []
+    for e in events:
+        out.append((e["deviceToken"], e["type"], e["eventDateMs"],
+                    e.get("measurements"), e.get("latitude"),
+                    e.get("longitude"), e.get("alertType"),
+                    e.get("level"), e.get("attribute"),
+                    e.get("stateChange")))
+    return out
+
+
+def test_pruned_wal_snapshot_archive_migration_identical_queries(tmp_path):
+    old, old_host = _mk(tmp_path, "old", 2)
+    toks = _tokens(2, 3, "mig")
+    news = None
+    new_host = None
+    try:
+        # devices with metadata; one extra assignment with an asset
+        for i, t in enumerate(toks):
+            old[0].register_device(t, "default", area=f"area-{i % 2}",
+                                   customer="acme")
+        old[0].create_assignment(toks[0], token="mig-asg",
+                                 asset="truck-1")
+        # lane-order divergence: rank 0 interns temp->hum, rank 1
+        # interns hum->temp (the migration must realign by NAME)
+        r0_toks = [t for t in toks if owner_rank(t, 2) == 0]
+        r1_toks = [t for t in toks if owner_rank(t, 2) == 1]
+        old[0].ingest_json_batch([_meas(r0_toks[0], [("temp", 1.0)], 0)])
+        old[1].local.ingest_json_batch(
+            [_meas(r1_toks[0], [("hum", 2.0)], 1)])
+        # bulk history: overflow the tiny rings into the archive
+        batch = []
+        for i in range(40):
+            for j, t in enumerate(toks):
+                ts = 10 + i * len(toks) + j
+                if i % 7 == 3:
+                    batch.append(_loc(t, 45.0 + i, -122.0 - j, ts))
+                elif i % 11 == 5:
+                    batch.append(_alert(t, "overheat" if j % 2 else
+                                        "lowbatt", 2, ts))
+                else:
+                    batch.append(_meas(
+                        t, [(CH[(i + j) % 3], float(i))], ts))
+        old[0].ingest_json_batch(batch)
+        # alternate ids + state changes ride the per-request path (the
+        # envelope decoder interns them into event_ids — the aux lanes
+        # whose interner ids the migration must remap)
+        from sitewhere_tpu.ingest.decoders import request_from_envelope
+
+        req = request_from_envelope(json.loads(_meas(
+            toks[0], [("temp", 7.0)], 4000, alt=f"alt-{toks[0]}-1")))
+        req.tenant = "default"
+        old[1].process(req)    # routes to the owner
+        sc = request_from_envelope({
+            "deviceToken": toks[1], "type": "DeviceStateChange",
+            "request": {"attribute": "fw", "type": "upgrade",
+                        "previousState": "1", "newState": "2",
+                        "eventDate": BASE_MS + 4001}})
+        sc.tenant = "default"
+        old[0].process(sc)
+        old[0].flush()
+
+        # ---- snapshot, rotate + PRUNE the WALs, then a live tail -----
+        snaps = []
+        for r, c in enumerate(old):
+            d = tmp_path / f"snap-r{r}"
+            c.local.save(d)
+            snaps.append(d)
+            c.local.wal._seg_index += 1
+            c.local.wal._open_segment()   # tail lands in a new segment
+            pruned = c.local.wal.prune(keep_segments=1)
+            assert pruned >= 1            # the snapshot-covered span is GONE
+        tail = [_meas(t, [("temp", 99.5)], 5000 + i)
+                for i, t in enumerate(toks)]
+        old[1].ingest_json_batch(tail)
+        old[0].flush()
+
+        # reference answers from the OLD live cluster
+        ref_all = old[0].query_events(limit=500)
+        ref_dev = {t: old[0].query_events(device_token=t, limit=500)
+                   for t in toks}
+        ref_state = {t: old[0].get_device_state(t) for t in toks}
+        ref_alt = old[0].query_events(alternate_id=f"alt-{toks[0]}-1",
+                                      limit=10)
+        # toks[0] carries TWO active assignments, so the event expanded
+        # to two rows — the premise is presence, not a fixed count
+        assert ref_alt["total"] == 2
+        from sitewhere_tpu.core.types import EventType
+
+        ref_sc = old[1].query_events(device_token=toks[1],
+                                     etype=int(EventType.STATE_CHANGE),
+                                     limit=10)
+        assert ref_sc["total"] == 1
+        ref_asg = old[0].get_assignment("mig-asg")
+
+        # ---- migrate 2 -> 3 ranks off the snapshots + archives -------
+        stats = migrate_cluster_snapshots(
+            snaps, 3, tmp_path / "new",
+            old_archive_dirs=[tmp_path / "old-arch-r0",
+                              tmp_path / "old-arch-r1"])
+        assert sum(s["devices"] for s in stats["targets"]) == len(toks)
+        assert sum(s["archive_rows"] for s in stats["targets"]) > 0
+        # all three targets actually own devices (hash spreads)
+        assert all(s["devices"] > 0 for s in stats["targets"])
+
+        locals_ = [recover_distributed(
+            tmp_path / "new" / f"rank-{t}" / "snapshot",
+            tmp_path / f"new-wal-r{t}") for t in range(3)]
+        news, new_host = _mk(tmp_path, "new", 3, locals_=locals_)
+
+        # ---- O(tail) finish: replay ONLY the pruned WALs' tails ------
+        replayed = replay_wal_tails(news[0], snaps,
+                                    [tmp_path / "old-wal-r0",
+                                     tmp_path / "old-wal-r1"])
+        assert replayed == len(toks)      # just the post-snapshot batch
+
+        # ---- identical answers from any new rank ---------------------
+        for c in news:
+            got_all = c.query_events(limit=500)
+            assert got_all["total"] == ref_all["total"]
+            assert _norm(got_all["events"]) == _norm(ref_all["events"])
+        for t in toks:
+            got = news[1].query_events(device_token=t, limit=500)
+            assert got["total"] == ref_dev[t]["total"], t
+            assert _norm(got["events"]) == _norm(ref_dev[t]["events"]), t
+            st_old, st_new = ref_state[t], news[2].get_device_state(t)
+            assert st_new["measurements"] == st_old["measurements"], t
+            assert st_new["presence"] == st_old["presence"], t
+            info = news[0].get_device(t)
+            assert info.area == f"area-{toks.index(t) % 2}"
+            assert info.customer == "acme"
+        # alternate-id lookups cross the interner remap
+        got_alt = news[0].query_events(alternate_id=f"alt-{toks[0]}-1",
+                                       limit=10)
+        assert got_alt["total"] == ref_alt["total"] == 2
+        assert _norm(got_alt["events"]) == _norm(ref_alt["events"])
+        # state-change aux0 (event_ids interner) crossed the remap too
+        got_sc = news[0].query_events(device_token=toks[1],
+                                      etype=int(EventType.STATE_CHANGE),
+                                      limit=10)
+        assert got_sc["total"] == 1
+        assert _norm(got_sc["events"]) == _norm(ref_sc["events"])
+        assert got_sc["events"][0]["attribute"] == "fw"
+        # assignments survive with associations intact
+        a = news[0].get_assignment("mig-asg")
+        assert a is not None and a.asset == ref_asg.asset == "truck-1"
+        assert a.device_token == toks[0]
+    finally:
+        for c in old:
+            c.close()
+        old_host.close()
+        if news is not None:
+            for c in news:
+                c.close()
+            new_host.close()
