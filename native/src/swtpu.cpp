@@ -309,12 +309,18 @@ void swtpu_decoder_destroy(Decoder* d) {
 //   out_level     int32: alert level
 // Measurement names map to channel = name_id % channels; collisions counted
 // in *out_collisions. Returns number successfully decoded.
-int32_t swtpu_decode_batch(
-    Decoder* d,
-    const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
+}  // extern "C" (templates cannot carry C linkage; the batch decode
+   // loops are templated over a message accessor so the packed-buffer
+   // entry points and the Python-list entry points — swtpu_py.cpp —
+   // share ONE loop body with zero indirection cost)
+
+template <class GetMsg>
+static int32_t decode_json_impl(
+    Decoder* d, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
-    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
+    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions,
+    GetMsg get_msg) {
     int32_t ok_count = 0;
     int32_t collisions = 0;
     char sbuf[512];
@@ -328,7 +334,8 @@ int32_t swtpu_decode_batch(
         memset(out_values + (size_t)i * channels, 0, sizeof(float) * channels);
         memset(out_chmask + (size_t)i * channels, 0, channels);
 
-        Scanner sc{buf + offsets[i], buf + offsets[i + 1], true};
+        auto mm = get_msg(i);
+        Scanner sc{mm.first, mm.second, true};
         if (!expect(sc, '{')) continue;
         int rtype = RT_UNKNOWN;
         int32_t token = -1;
@@ -504,12 +511,13 @@ int32_t swtpu_decode_batch(
 //   type 3 alert:       u16le tlen type  u8 level  u16le mlen message
 //   type 4 register / 5 ack: header only
 // Outputs use the same contract as swtpu_decode_batch.
-int32_t swtpu_decode_binary_batch(
-    Decoder* d,
-    const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
+template <class GetMsg>
+static int32_t decode_binary_impl(
+    Decoder* d, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
-    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
+    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions,
+    GetMsg get_msg) {
     // wire type id -> ReqType (ingest/decoders.py _BIN_TYPES)
     static const int32_t WIRE2RT[6] = {RT_UNKNOWN, RT_MEASUREMENT,
                                        RT_LOCATION, RT_ALERT, RT_REGISTER,
@@ -526,8 +534,9 @@ int32_t swtpu_decode_binary_batch(
                sizeof(float) * channels);
         memset(out_chmask + (size_t)i * channels, 0, channels);
 
-        const uint8_t* p = (const uint8_t*)(buf + offsets[i]);
-        const uint8_t* end = (const uint8_t*)(buf + offsets[i + 1]);
+        auto mm = get_msg(i);
+        const uint8_t* p = (const uint8_t*)mm.first;
+        const uint8_t* end = (const uint8_t*)mm.second;
         auto need = [&](size_t n) { return (size_t)(end - p) >= n; };
         auto u16 = [&]() { uint16_t v = (uint16_t)(p[0] | (p[1] << 8)); p += 2; return v; };
 
@@ -596,6 +605,42 @@ int32_t swtpu_decode_binary_batch(
     }
     *out_collisions = collisions;
     return ok_count;
+}
+
+// packed-buffer entry points (the ctypes ABI): message i lives at
+// [offsets[i], offsets[i+1]) of one contiguous buffer
+struct PackedMsgs {
+    const char* buf;
+    const int64_t* offsets;
+    std::pair<const char*, const char*> operator()(int32_t i) const {
+        return {buf + offsets[i], buf + offsets[i + 1]};
+    }
+};
+
+extern "C" {
+
+int32_t swtpu_decode_batch(
+    Decoder* d,
+    const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
+    int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
+    float* out_values, uint8_t* out_chmask,
+    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
+    return decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
+                            out_ts, out_values, out_chmask, out_aux0,
+                            out_level, out_collisions,
+                            PackedMsgs{buf, offsets});
+}
+
+int32_t swtpu_decode_binary_batch(
+    Decoder* d,
+    const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
+    int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
+    float* out_values, uint8_t* out_chmask,
+    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
+    return decode_binary_impl(d, n_msgs, channels, out_rtype, out_token,
+                              out_ts, out_values, out_chmask, out_aux0,
+                              out_level, out_collisions,
+                              PackedMsgs{buf, offsets});
 }
 
 }  // extern "C"

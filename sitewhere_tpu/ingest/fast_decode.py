@@ -15,7 +15,8 @@ from typing import NamedTuple
 
 import numpy as np
 
-from sitewhere_tpu.native.binding import NativeInterner, load_library
+from sitewhere_tpu.native.binding import (NativeInterner, load_library,
+                                          load_py_library)
 
 # native rtype codes (swtpu.cpp ReqType) -> core EventType / registration
 RT_REGISTER = 0
@@ -67,15 +68,56 @@ class NativeBatchDecoder:
         self.alert_types = NativeInterner(
             alert_capacity, self.lib, self.lib.swtpu_decoder_alert_types(self.handle)
         )
+        # zero-copy list[bytes] entry point (libswtpu_py.so): skips the
+        # b"".join + per-payload length scan + offsets cumsum the packed
+        # ABI makes Python pay per batch (~1ms of a 16k batch on the
+        # 1-core host). None -> packed path.
+        self.py_lib = load_py_library()
 
     def decode(self, payloads: list[bytes]) -> DecodedArrays:
-        """Batched JSON DeviceRequest decode."""
-        return self._decode(payloads, self.lib.swtpu_decode_batch)
+        """Batched JSON DeviceRequest decode. No thread may mutate
+        ``payloads`` until the call returns (the zero-copy path scans
+        the payload buffers in place)."""
+        return self._decode(payloads, binary=False)
 
     def decode_binary(self, payloads: list[bytes]) -> DecodedArrays:
         """Batched flat-binary decode (the "protobuf" ingest slot; wire
-        format of ingest/decoders.py encode_binary_request)."""
-        return self._decode(payloads, self.lib.swtpu_decode_binary_batch)
+        format of ingest/decoders.py encode_binary_request). Same
+        no-concurrent-mutation contract as :meth:`decode`."""
+        return self._decode(payloads, binary=True)
+
+    def _decode_pylist(self, payloads: list[bytes],
+                       binary: bool) -> "DecodedArrays | None":
+        """List-direct decode; None = not eligible (fall back packed)."""
+        if self.py_lib is None or type(payloads) is not list:
+            return None
+        n = len(payloads)
+        c = self.channels
+        rtype = np.empty(n, np.int32)
+        token = np.empty(n, np.int32)
+        ts = np.empty(n, np.int64)
+        values = np.empty((n, c), np.float32)
+        chmask = np.empty((n, c), np.uint8)
+        aux0 = np.empty(n, np.int32)
+        level = np.empty(n, np.int32)
+        collisions = ctypes.c_int32(0)
+
+        def ptr(a, t):
+            return a.ctypes.data_as(ctypes.POINTER(t))
+
+        n_ok = int(self.py_lib.swtpu_decode_pylist(
+            self.handle, payloads, np.int32(n), np.int32(c),
+            ptr(rtype, ctypes.c_int32), ptr(token, ctypes.c_int32),
+            ptr(ts, ctypes.c_int64), ptr(values, ctypes.c_float),
+            ptr(chmask, ctypes.c_uint8), ptr(aux0, ctypes.c_int32),
+            ptr(level, ctypes.c_int32), ctypes.byref(collisions),
+            np.int32(1 if binary else 0)))
+        if n_ok < 0:
+            return None   # non-bytes item: packed path handles/raises
+        return DecodedArrays(
+            n_ok=n_ok, rtype=rtype, token_id=token, ts_ms64=ts,
+            values=values, chmask=chmask.astype(bool), aux0=aux0,
+            level=level, collisions=int(collisions.value))
 
     def decode_packed(self, buf, offsets: np.ndarray, n: int,
                       rtype: np.ndarray, token: np.ndarray, ts: np.ndarray,
@@ -106,15 +148,19 @@ class NativeBatchDecoder:
         ))
         return n_ok, int(collisions.value)
 
-    def _decode(self, payloads: list[bytes], fn) -> DecodedArrays:
+    def _decode(self, payloads: list[bytes], binary: bool) -> DecodedArrays:
+        fast = self._decode_pylist(payloads, binary=binary)
+        if fast is not None:
+            return fast
         n = len(payloads)
         c = self.channels
         buf = b"".join(payloads)
         offsets = np.zeros(n + 1, np.int64)
-        # fromiter keeps cumsum on the fast ndarray path (a list argument
-        # routes numpy through the boxed _wrapit fallback — measured ~20%
-        # of the non-scanner decode overhead at 16k-payload batches)
-        np.cumsum(np.fromiter((len(p) for p in payloads), np.int64, n),
+        # map(len) iterates at C level; fromiter keeps cumsum on the fast
+        # ndarray path (a list argument routes numpy through the boxed
+        # _wrapit fallback — measured ~20% of the non-scanner decode
+        # overhead at 16k-payload batches)
+        np.cumsum(np.fromiter(map(len, payloads), np.int64, n),
                   out=offsets[1:])
         rtype = np.empty(n, np.int32)
         token = np.empty(n, np.int32)
@@ -125,7 +171,7 @@ class NativeBatchDecoder:
         level = np.empty(n, np.int32)
         n_ok, collisions = self.decode_packed(
             buf, offsets, n, rtype, token, ts, values, chmask, aux0, level,
-            binary=fn is self.lib.swtpu_decode_binary_batch)
+            binary=binary)
         return DecodedArrays(
             n_ok=n_ok, rtype=rtype, token_id=token, ts_ms64=ts,
             values=values, chmask=chmask.astype(bool), aux0=aux0, level=level,

@@ -19,12 +19,16 @@ logger = logging.getLogger(__name__)
 
 _REPO = pathlib.Path(__file__).resolve().parents[2]
 _SRC = _REPO / "native" / "src" / "swtpu.cpp"
+_PY_SRC = _REPO / "native" / "src" / "swtpu_py.cpp"
 _BUILD = _REPO / "native" / "build"
 _SO = _BUILD / "libswtpu.so"
+_PY_SO = _BUILD / "libswtpu_py.so"
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
 _tried = False
+_py_lib = None
+_py_tried = False
 
 
 def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -84,6 +88,30 @@ def build_library(force: bool = False) -> pathlib.Path | None:
     return _SO
 
 
+def build_py_library(force: bool = False) -> pathlib.Path | None:
+    """Compile the CPython-aware variant (list[bytes] decode entry point;
+    native/src/swtpu_py.cpp). Optional: failure only loses the
+    zero-copy path, never the base library."""
+    import sysconfig
+
+    if not _PY_SRC.exists():
+        return None
+    _BUILD.mkdir(parents=True, exist_ok=True)
+    newest = max(_SRC.stat().st_mtime, _PY_SRC.stat().st_mtime)
+    if _PY_SO.exists() and not force and _PY_SO.stat().st_mtime >= newest:
+        return _PY_SO
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           f"-I{sysconfig.get_path('include')}",
+           f"-I{_SRC.parent}", str(_PY_SRC), "-o", str(_PY_SO)]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        logger.info("py-bridge build failed (%s); packed path only",
+                    getattr(e, "stderr", e))
+        return None
+    return _PY_SO
+
+
 def load_library() -> ctypes.CDLL | None:
     """Build (if needed) and load libswtpu; None when unavailable."""
     global _lib, _tried
@@ -100,6 +128,38 @@ def load_library() -> ctypes.CDLL | None:
             logger.warning("failed to load %s: %s", so, e)
             _lib = None
         return _lib
+
+
+def load_py_library() -> "ctypes.PyDLL | None":
+    """The CPython-aware lib, loaded as PyDLL (its list entry point runs
+    under the GIL until it drops it itself). None = use the packed ABI."""
+    global _py_lib, _py_tried
+    with _lock:
+        if _py_lib is not None or _py_tried:
+            return _py_lib
+        _py_tried = True
+        so = build_py_library()
+        if so is None:
+            return None
+        try:
+            # configure ONLY the list entry point: this handle holds the
+            # GIL for every call, so the packed batch functions must
+            # never be reached through it (they'd serialize the whole
+            # scan under the GIL — use the CDLL handle for those)
+            lib = ctypes.PyDLL(str(so))
+            c = ctypes
+            lib.swtpu_decode_pylist.restype = c.c_int32
+            lib.swtpu_decode_pylist.argtypes = [
+                c.c_void_p, c.py_object, c.c_int32, c.c_int32,
+                c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                c.POINTER(c.c_int64), c.POINTER(c.c_float),
+                c.POINTER(c.c_uint8), c.POINTER(c.c_int32),
+                c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32]
+            _py_lib = lib
+        except OSError as e:
+            logger.info("py-bridge load failed (%s); packed path only", e)
+            _py_lib = None
+        return _py_lib
 
 
 class NativeInterner:

@@ -1032,3 +1032,44 @@ def test_mqtt_receiver_reconnects_after_broker_restart():
         assert engine.metrics()["registered"] == 2   # rc-1 AND rc-2 arrived
 
     asyncio.run(run())
+
+
+def test_pylist_and_packed_decode_paths_agree():
+    """decode() silently routes through the zero-copy list entry point
+    when libswtpu_py.so builds — BOTH paths must stay covered and
+    byte-identical (a packed-fallback regression must not pass green on
+    hosts where the bridge builds, and vice versa)."""
+    import numpy as np
+
+    from sitewhere_tpu.ingest.decoders import encode_binary_request
+    from sitewhere_tpu.ingest.fast_decode import (NativeBatchDecoder,
+                                                  native_available)
+    from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+    from sitewhere_tpu.native.binding import NativeInterner
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    payloads = []
+    for i in range(257):
+        if i % 41 == 0:
+            payloads.append(b"{torn")
+        else:
+            payloads.append(measurement_json(
+                f"pp-{i % 9}", name=f"ch{i % 5}", value=float(i)))
+    bpayloads = [encode_binary_request(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT, device_token=f"pb-{i % 9}",
+        measurements={"a": float(i)})) for i in range(64)]
+    for batch, binary in ((payloads, False), (bpayloads, True)):
+        fast_dec = NativeBatchDecoder(NativeInterner(1 << 12), 8)
+        packed_dec = NativeBatchDecoder(NativeInterner(1 << 12), 8)
+        packed_dec.py_lib = None        # force the packed fallback
+        if fast_dec.py_lib is None:
+            pytest.skip("py-bridge unavailable: packed path already "
+                        "the only (tested) path")
+        fast = fast_dec._decode(batch, binary=binary)
+        ref = packed_dec._decode(batch, binary=binary)
+        assert fast.n_ok == ref.n_ok
+        assert fast.collisions == ref.collisions
+        for f in ("rtype", "token_id", "ts_ms64", "aux0", "level",
+                  "values", "chmask"):
+            assert np.array_equal(getattr(fast, f), getattr(ref, f)), f
