@@ -455,6 +455,40 @@ def test_native_decode_tolerates_json_literals():
     assert st["recent_locations"][0]["latitude"] == 1.0
 
 
+def test_native_decode_escaped_strings():
+    """JSON escapes (\\", \\\\, \\uXXXX) in tokens, names, and alert types
+    must take the unescape path and intern the DECODED bytes — the
+    zero-copy string-view fast path only covers escape-free strings, and
+    a view/unescape mix-up would intern raw backslash sequences."""
+    import json as _json
+
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.ingest.fast_decode import native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    token = 'esc "quoted" back\\slash'
+    name = "température"      # é -> é under ensure_ascii
+    payloads = [
+        _json.dumps({"deviceToken": token, "type": "DeviceMeasurement",
+                     "request": {"name": name, "value": 7.25}},
+                    ensure_ascii=True).encode(),
+        _json.dumps({"deviceToken": token, "type": "DeviceAlert",
+                     "request": {"type": 'over\\heat "now"',
+                                 "level": "Critical"}}).encode(),
+    ]
+    res = eng.ingest_json_batch(payloads)
+    assert res["failed"] == 0, res
+    eng.flush()
+    st = eng.get_device_state(token)   # escaped token round-trips exactly
+    assert st["measurements"][name]["value"] == 7.25
+    assert st["recent_alerts"][0]["type"] == 'over\\heat "now"'
+    assert st["recent_alerts"][0]["level"] == 3
+
+
 def test_python_decoder_tolerates_json_literals():
     """REST / non-native path accepts the same null-bearing payloads as the
     native batch decoder (parity)."""
