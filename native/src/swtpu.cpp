@@ -22,13 +22,29 @@ extern "C" {
 
 // ---------------------------------------------------------------- interner
 
+struct Slot {
+    int32_t id;       // index into strings, -1 empty
+    int32_t len;      // string length
+    uint64_t prefix;  // first <=8 bytes, zero-padded
+};
+
 struct Interner {
-    // open addressing, power-of-two capacity
-    std::vector<int32_t> slots;     // index into strings, -1 empty
+    // open addressing, power-of-two capacity. Each slot inlines the
+    // string's length + 8-byte prefix: a probe for a short string
+    // (tokens like "lg-1234") resolves WITHOUT dereferencing the heap
+    // std::string — one cache line instead of two dependent misses —
+    // and longer strings memcmp only after the prefix matches.
+    std::vector<Slot> slots;
     std::vector<std::string> strings;
     uint64_t mask;
     int32_t max_entries;
 };
+
+static inline uint64_t prefix8(const char* s, int32_t n) {
+    uint64_t p = 0;
+    memcpy(&p, s, n < 8 ? n : 8);
+    return p;
+}
 
 static uint64_t hash_bytes(const char* s, int n) {
     // FNV-1a folded over 8-byte lanes: ~4x fewer multiplies than the
@@ -48,6 +64,13 @@ static uint64_t hash_bytes(const char* s, int n) {
         h ^= (unsigned char)*s++;
         h *= 1099511628211ull;
     }
+    // Finalizer (murmur3 fmix64): multiplication only propagates bits
+    // UPWARD, so without this a trailing lane's high bytes (the LAST
+    // char of an 8/16-byte token like "device-7") never reach the
+    // masked low bits and every such token lands on one slot.
+    h ^= h >> 33;
+    h *= 0xff51afd7ed558ccdull;
+    h ^= h >> 33;
     return h;
 }
 
@@ -55,7 +78,7 @@ Interner* swtpu_interner_create(int32_t max_entries) {
     uint64_t cap = 64;
     while (cap < (uint64_t)max_entries * 2) cap <<= 1;
     auto* in = new Interner();
-    in->slots.assign(cap, -1);
+    in->slots.assign(cap, Slot{-1, 0, 0});
     in->mask = cap - 1;
     in->max_entries = max_entries;
     in->strings.reserve(1024);
@@ -66,28 +89,32 @@ void swtpu_interner_destroy(Interner* in) { delete in; }
 
 int32_t swtpu_intern(Interner* in, const char* s, int32_t n) {
     uint64_t h = hash_bytes(s, n) & in->mask;
+    const uint64_t pfx = prefix8(s, n);
     while (true) {
-        int32_t idx = in->slots[h];
-        if (idx < 0) {
+        Slot& sl = in->slots[h];
+        if (sl.id < 0) {
             if ((int32_t)in->strings.size() >= in->max_entries) return -1;
             int32_t id = (int32_t)in->strings.size();
             in->strings.emplace_back(s, n);
-            in->slots[h] = id;
+            sl = Slot{id, n, pfx};
             return id;
         }
-        const std::string& cand = in->strings[idx];
-        if ((int32_t)cand.size() == n && memcmp(cand.data(), s, n) == 0) return idx;
+        if (sl.len == n && sl.prefix == pfx &&
+            (n <= 8 || memcmp(in->strings[sl.id].data(), s, n) == 0))
+            return sl.id;
         h = (h + 1) & in->mask;
     }
 }
 
 int32_t swtpu_interner_lookup(Interner* in, const char* s, int32_t n) {
     uint64_t h = hash_bytes(s, n) & in->mask;
+    const uint64_t pfx = prefix8(s, n);
     while (true) {
-        int32_t idx = in->slots[h];
-        if (idx < 0) return -1;
-        const std::string& cand = in->strings[idx];
-        if ((int32_t)cand.size() == n && memcmp(cand.data(), s, n) == 0) return idx;
+        const Slot& sl = in->slots[h];
+        if (sl.id < 0) return -1;
+        if (sl.len == n && sl.prefix == pfx &&
+            (n <= 8 || memcmp(in->strings[sl.id].data(), s, n) == 0))
+            return sl.id;
         h = (h + 1) & in->mask;
     }
 }
@@ -101,7 +128,7 @@ int32_t swtpu_interner_size(Interner* in) { return (int32_t)in->strings.size(); 
 void swtpu_interner_truncate(Interner* in, int32_t n) {
     if (n < 0 || n >= (int32_t)in->strings.size()) return;
     for (auto& s : in->slots)
-        if (s >= n) s = -1;
+        if (s.id >= n) s = Slot{-1, 0, 0};
     in->strings.resize(n);
 }
 

@@ -116,7 +116,7 @@ class NativeBatchDecoder:
             return None   # non-bytes item: packed path handles/raises
         return DecodedArrays(
             n_ok=n_ok, rtype=rtype, token_id=token, ts_ms64=ts,
-            values=values, chmask=chmask.astype(bool), aux0=aux0,
+            values=values, chmask=chmask.view(bool), aux0=aux0,
             level=level, collisions=int(collisions.value))
 
     def decode_packed(self, buf, offsets: np.ndarray, n: int,
@@ -174,7 +174,7 @@ class NativeBatchDecoder:
             binary=binary)
         return DecodedArrays(
             n_ok=n_ok, rtype=rtype, token_id=token, ts_ms64=ts,
-            values=values, chmask=chmask.astype(bool), aux0=aux0, level=level,
+            values=values, chmask=chmask.view(bool), aux0=aux0, level=level,
             collisions=collisions,
         )
 
