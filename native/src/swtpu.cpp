@@ -191,7 +191,39 @@ static int parse_string(Scanner& sc, char* buf, int cap) {
                         else if (h >= 'A' && h <= 'F') code |= h - 'A' + 10;
                         else { sc.ok = false; return -1; }
                     }
-                    // UTF-8 encode (BMP only; surrogate pairs unhandled -> '?')
+                    // surrogate pair -> one non-BMP code point: the
+                    // escaped and raw-UTF-8 forms of the same token must
+                    // produce IDENTICAL bytes (intern identity + the
+                    // route hash). Lone surrogates become '?'.
+                    if (code >= 0xD800 && code < 0xDC00) {
+                        int lo = -1;
+                        if (sc.end - sc.p >= 6 && sc.p[0] == '\\'
+                            && sc.p[1] == 'u') {
+                            lo = 0;
+                            for (int i = 2; i < 6 && lo >= 0; i++) {
+                                char h = sc.p[i];
+                                lo <<= 4;
+                                if (h >= '0' && h <= '9') lo |= h - '0';
+                                else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+                                else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+                                else lo = -1;
+                            }
+                        }
+                        if (lo >= 0xDC00 && lo < 0xE000) {
+                            sc.p += 6;
+                            int cp = 0x10000 + ((code - 0xD800) << 10)
+                                     + (lo - 0xDC00);
+                            if (n + 4 <= cap) {
+                                buf[n++] = (char)(0xF0 | (cp >> 18));
+                                buf[n++] = (char)(0x80 | ((cp >> 12) & 0x3F));
+                                buf[n++] = (char)(0x80 | ((cp >> 6) & 0x3F));
+                                c = (char)(0x80 | (cp & 0x3F));
+                            } else c = '?';
+                        } else c = '?';
+                        break;
+                    }
+                    if (code >= 0xDC00 && code < 0xE000) { c = '?'; break; }
+                    // UTF-8 encode (BMP)
                     if (code < 0x80) { c = (char)code; }
                     else {
                         if (n + 3 < cap) {
@@ -687,6 +719,123 @@ static int32_t decode_binary_impl(
     }
     *out_collisions = collisions;
     return ok_count;
+}
+
+// ------------------------------------------------------------ cluster route
+// Owning-rank partition WITHOUT a decode: the cluster facade needs only
+// the device token's FNV-1a hash to pick the owner rank (the Kafka
+// producer partitioner analog, parallel/cluster.py:owner_rank — byte-
+// exact same hash). The JSON scan stops at the top level and skips every
+// value except deviceToken/hardwareId, so routing costs a fraction of a
+// full decode; the Python fallback paid a complete json.loads per
+// payload here.
+
+static uint64_t fnv1a_route(const char* s, int n) {
+    uint64_t h = 0xcbf29ce484222325ull;
+    for (int i = 0; i < n; i++) {
+        h ^= (unsigned char)s[i];
+        h *= 0x100000001b3ull;
+    }
+    return h;
+}
+
+static bool utf8_valid(const unsigned char* s, int n) {
+    // strict (matches Python bytes.decode): rejects overlongs (E0 needs
+    // 2nd byte >= A0, F0 needs >= 90), encoded surrogates (ED needs 2nd
+    // byte <= 9F), and beyond-U+10FFFF (F4 needs 2nd byte <= 8F)
+    int i = 0;
+    while (i < n) {
+        unsigned char c = s[i];
+        int follow;
+        if (c < 0x80) { i++; continue; }
+        else if ((c & 0xE0) == 0xC0 && c >= 0xC2) follow = 1;
+        else if ((c & 0xF0) == 0xE0) follow = 2;
+        else if ((c & 0xF8) == 0xF0 && c <= 0xF4) follow = 3;
+        else return false;
+        if (i + follow >= n) return false;
+        for (int k = 1; k <= follow; k++)
+            if ((s[i + k] & 0xC0) != 0x80) return false;
+        unsigned char c1 = s[i + 1];
+        if ((c == 0xE0 && c1 < 0xA0) || (c == 0xED && c1 > 0x9F) ||
+            (c == 0xF0 && c1 < 0x90) || (c == 0xF4 && c1 > 0x8F))
+            return false;
+        i += follow + 1;
+    }
+    return true;
+}
+
+// out_rank[i] = owner rank, or -1 when unroutable (no usable token /
+// parse failure) — the caller keeps those local, where the engine's
+// dead-letter path owns them. Mirrors the Python fallback exactly:
+// deviceToken takes precedence over hardwareId, last occurrence of a
+// repeated key wins (json.loads dict semantics), empty/non-string
+// values fall through.
+template <class GetMsg>
+static void route_json_impl(int32_t n_msgs, int32_t n_ranks,
+                            int32_t* out_rank, GetMsg get_msg) {
+    char kbuf[512];
+    // value cap MUST equal the decoder's sbuf cap: the interner sees at
+    // most 512 token bytes, so hashing more would route two tokens that
+    // intern identically (same 512-byte prefix) to different ranks
+    char vbuf[512];
+    for (int32_t i = 0; i < n_msgs; i++) {
+        out_rank[i] = -1;
+        auto mm = get_msg(i);
+        Scanner sc{mm.first, mm.second, true};
+        if (!expect(sc, '{')) continue;
+        bool first = true;
+        bool have_dt = false, have_hw = false;
+        uint64_t h_dt = 0, h_hw = 0;
+        while (sc.ok) {
+            skip_ws(sc);
+            if (sc.p < sc.end && *sc.p == '}') { sc.p++; break; }
+            if (!first && !expect(sc, ',')) break;
+            first = false;
+            const char* kp;
+            int klen = parse_string_view(sc, &kp, kbuf, sizeof(kbuf));
+            if (klen < 0 || !expect(sc, ':')) break;
+            bool is_dt = (klen == 11 && !memcmp(kp, "deviceToken", 11));
+            bool is_hw = (klen == 10 && !memcmp(kp, "hardwareId", 10));
+            if (is_dt || is_hw) {
+                skip_ws(sc);
+                if (sc.p < sc.end && *sc.p == '"') {
+                    const char* vp;
+                    int n = parse_string_view(sc, &vp, vbuf, sizeof(vbuf));
+                    if (n < 0) break;
+                    if (is_dt) { have_dt = n > 0; h_dt = fnv1a_route(vp, n); }
+                    else       { have_hw = n > 0; h_hw = fnv1a_route(vp, n); }
+                } else {
+                    skip_value(sc);   // non-string token: key is absent
+                    if (is_dt) have_dt = false;
+                    else have_hw = false;
+                }
+            } else {
+                skip_value(sc);
+            }
+        }
+        if (have_dt) out_rank[i] = (int32_t)(h_dt % (uint64_t)n_ranks);
+        else if (have_hw) out_rank[i] = (int32_t)(h_hw % (uint64_t)n_ranks);
+    }
+}
+
+// Binary wire: token at [4, 4+tlen) after u8 ver, u8 type, u16le tlen
+// (ingest/decoders.py:binary_token_of — including its UTF-8 validity
+// gate, so native and fallback route identically).
+template <class GetMsg>
+static void route_binary_impl(int32_t n_msgs, int32_t n_ranks,
+                              int32_t* out_rank, GetMsg get_msg) {
+    for (int32_t i = 0; i < n_msgs; i++) {
+        out_rank[i] = -1;
+        auto mm = get_msg(i);
+        const unsigned char* p = (const unsigned char*)mm.first;
+        int64_t len = mm.second - mm.first;
+        if (len < 4 || p[0] != 1) continue;
+        uint16_t tlen = (uint16_t)(p[2] | (p[3] << 8));
+        if (len < 4 + (int64_t)tlen) continue;
+        if (!utf8_valid(p + 4, tlen)) continue;
+        out_rank[i] = (int32_t)(fnv1a_route((const char*)p + 4, tlen)
+                                % (uint64_t)n_ranks);
+    }
 }
 
 // packed-buffer entry points (the ctypes ABI): message i lives at

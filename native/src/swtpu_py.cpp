@@ -83,4 +83,39 @@ int32_t swtpu_decode_pylist(
     return ok;
 }
 
+// Owning-rank partition of a list[bytes] batch without decoding (the
+// cluster facade's token-hash router; same GIL contract as
+// swtpu_decode_pylist). Returns 0, or -1 when the object is not a list
+// of bytes (caller falls back to the Python partitioner).
+int32_t swtpu_route_pylist(
+    void* pylist, int32_t n_msgs, int32_t n_ranks,
+    int32_t* out_rank, int32_t binary) {
+    PyObject* list = (PyObject*)pylist;
+    if (!PyList_CheckExact(list) || PyList_GET_SIZE(list) < n_msgs)
+        return -1;
+    t_ptrs.resize(n_msgs);
+    t_lens.resize(n_msgs);
+    t_objs.resize(n_msgs);
+    for (int32_t i = 0; i < n_msgs; i++) {
+        PyObject* o = PyList_GET_ITEM(list, i);
+        if (!PyBytes_CheckExact(o)) {
+            for (int32_t j = 0; j < i; j++) Py_DECREF(t_objs[j]);
+            return -1;
+        }
+        Py_INCREF(o);
+        t_objs[i] = o;
+        t_ptrs[i] = PyBytes_AS_STRING(o);
+        t_lens[i] = (int64_t)PyBytes_GET_SIZE(o);
+    }
+    SpanMsgs get{t_ptrs.data(), t_lens.data()};
+    Py_BEGIN_ALLOW_THREADS
+    if (binary)
+        route_binary_impl(n_msgs, n_ranks, out_rank, get);
+    else
+        route_json_impl(n_msgs, n_ranks, out_rank, get);
+    Py_END_ALLOW_THREADS
+    for (int32_t i = 0; i < n_msgs; i++) Py_DECREF(t_objs[i]);
+    return 0;
+}
+
 }  // extern "C"

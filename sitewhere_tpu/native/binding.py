@@ -73,17 +73,23 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
 
 
 def build_library(force: bool = False) -> pathlib.Path | None:
-    """Compile the shared library (cached by source mtime)."""
+    """Compile the shared library (cached by source mtime). The link
+    writes a temp file that RENAMES over the target: a process that
+    already dlopen'd the old .so keeps its mapping of the old inode —
+    linking in place would truncate pages out from under it."""
     _BUILD.mkdir(parents=True, exist_ok=True)
     if _SO.exists() and not force and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
         return _SO
+    tmp = _SO.with_suffix(f".tmp{os.getpid()}.so")
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           str(_SRC), "-o", str(_SO)]
+           str(_SRC), "-o", str(tmp)]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        tmp.rename(_SO)
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
         logger.warning("native build failed (%s); using Python fallback",
                        getattr(e, "stderr", e))
+        tmp.unlink(missing_ok=True)
         return None
     return _SO
 
@@ -100,14 +106,17 @@ def build_py_library(force: bool = False) -> pathlib.Path | None:
     newest = max(_SRC.stat().st_mtime, _PY_SRC.stat().st_mtime)
     if _PY_SO.exists() and not force and _PY_SO.stat().st_mtime >= newest:
         return _PY_SO
+    tmp = _PY_SO.with_suffix(f".tmp{os.getpid()}.so")
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
            f"-I{sysconfig.get_path('include')}",
-           f"-I{_SRC.parent}", str(_PY_SRC), "-o", str(_PY_SO)]
+           f"-I{_SRC.parent}", str(_PY_SRC), "-o", str(tmp)]
     try:
         subprocess.run(cmd, check=True, capture_output=True, text=True)
-    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        tmp.rename(_PY_SO)
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError) as e:
         logger.info("py-bridge build failed (%s); packed path only",
                     getattr(e, "stderr", e))
+        tmp.unlink(missing_ok=True)
         return None
     return _PY_SO
 
@@ -155,11 +164,36 @@ def load_py_library() -> "ctypes.PyDLL | None":
                 c.POINTER(c.c_int64), c.POINTER(c.c_float),
                 c.POINTER(c.c_uint8), c.POINTER(c.c_int32),
                 c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32]
+            lib.swtpu_route_pylist.restype = c.c_int32
+            lib.swtpu_route_pylist.argtypes = [
+                c.py_object, c.c_int32, c.c_int32,
+                c.POINTER(c.c_int32), c.c_int32]
             _py_lib = lib
         except OSError as e:
             logger.info("py-bridge load failed (%s); packed path only", e)
             _py_lib = None
         return _py_lib
+
+
+def route_payloads(payloads: list[bytes], n_ranks: int,
+                   binary: bool = False):
+    """Owning rank per payload via the native token-hash router (one C
+    call over the whole batch, no decode). Returns an int32 ndarray
+    (-1 = unroutable, caller keeps local), or None when the native list
+    path is unavailable — the caller falls back to the Python
+    partitioner. Byte-exact with parallel/cluster.py:owner_rank."""
+    import numpy as np
+
+    lib = load_py_library()
+    if lib is None or type(payloads) is not list:
+        return None
+    n = len(payloads)
+    out = np.empty(n, np.int32)
+    rc = int(lib.swtpu_route_pylist(
+        payloads, np.int32(n), np.int32(n_ranks),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        np.int32(1 if binary else 0)))
+    return out if rc == 0 else None
 
 
 class NativeInterner:

@@ -392,24 +392,33 @@ class ClusterEngine:
 
     # --------------------------------------------------------------- ingest
     def _partition_payloads(self, payloads: list[bytes],
-                            token_of) -> dict[int, list[bytes]]:
+                            kind: str) -> dict[int, list[bytes]]:
+        """Owner-rank partition (the Kafka producer partitioner analog).
+        Every implementation must route a payload IDENTICALLY — the
+        authoritative semantics are the scanner's (it is also how the
+        batch decoder reads envelopes). Fast path: ONE native C call
+        hashes every token; fallback: the byte-exact Python port in
+        native/route_fallback.py. Unroutable payloads (-1) stay local,
+        where the engine's dead-letter path owns them."""
         by_rank: dict[int, list[bytes]] = {}
-        for p in payloads:
-            tok = token_of(p)
-            # undecodable/tokenless payloads stay local: the local engine's
-            # dead-letter path owns them
-            r = self.rank if tok is None else owner_rank(tok, self.n_ranks)
-            by_rank.setdefault(r, []).append(p)
-        return by_rank
+        me = self.rank
+        from sitewhere_tpu.native.binding import route_payloads
 
-    @staticmethod
-    def _json_token(p: bytes) -> str | None:
-        try:
-            env = json.loads(p)
-            tok = env.get("deviceToken") or env.get("hardwareId")
-            return str(tok) if tok else None
-        except (ValueError, AttributeError):
-            return None
+        ranks = route_payloads(payloads, self.n_ranks,
+                               binary=(kind == "binary"))
+        if ranks is not None:
+            for p, r in zip(payloads, ranks.tolist()):
+                by_rank.setdefault(me if r < 0 else r, []).append(p)
+            return by_rank
+        from sitewhere_tpu.native.route_fallback import (route_binary_payload,
+                                                         route_json_payload)
+
+        route_one = (route_binary_payload if kind == "binary"
+                     else route_json_payload)
+        for p in payloads:
+            r = route_one(p, self.n_ranks)
+            by_rank.setdefault(me if r < 0 else r, []).append(p)
+        return by_rank
 
     def attach_forwarding(self, queue, registry) -> None:
         """Durable cross-rank forwarding (parallel/forward.py): the spill
@@ -460,7 +469,7 @@ class ClusterEngine:
         """Partition the batch by owning rank (token-hash, like the Kafka
         producer partitioner) and forward raw remote payloads — WAL,
         decode, and registration happen once, at each owner."""
-        by_rank = self._partition_payloads(payloads, self._json_token)
+        by_rank = self._partition_payloads(payloads, kind="json")
         summaries = []
         for r, plist in by_rank.items():
             if r == self.rank:
@@ -472,9 +481,7 @@ class ClusterEngine:
 
     def ingest_binary_batch(self, payloads: list[bytes],
                             tenant: str = "default") -> dict:
-        from sitewhere_tpu.ingest.decoders import binary_token_of
-
-        by_rank = self._partition_payloads(payloads, binary_token_of)
+        by_rank = self._partition_payloads(payloads, kind="binary")
         summaries = []
         for r, plist in by_rank.items():
             if r == self.rank:

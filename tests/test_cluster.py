@@ -980,6 +980,129 @@ def test_envelope_round_trip():
             assert getattr(rt, f) == getattr(req, f), (env["type"], f)
 
 
+def test_native_route_matches_python_partitioner():
+    """The native batch router (swtpu_route_pylist) and its Python port
+    (native/route_fallback.py) must agree payload-for-payload — a
+    divergence would send a device's events to a rank that registers it
+    under a second identity. Covers: precedence (deviceToken over
+    hardwareId), last-duplicate-key-wins, empty/numeric/null tokens,
+    broken and TRUNCATED JSON, trailing garbage, control characters in
+    strings, escapes (incl. surrogate pairs and non-BMP raw UTF-8),
+    >2048-byte tokens, and overlong/surrogate/invalid-UTF-8 binary
+    tokens."""
+    import json as _json
+
+    from sitewhere_tpu.ingest.decoders import (encode_binary_request,
+                                               request_from_envelope)
+    from sitewhere_tpu.native.binding import route_payloads
+    from sitewhere_tpu.native.route_fallback import (route_binary_payload,
+                                                     route_json_payload)
+    from sitewhere_tpu.parallel.cluster import owner_rank
+
+    n_ranks = 5
+    long_tok = "L" * 3000
+    payloads = [
+        _json.dumps({"deviceToken": f"dev-{i}", "type": "DeviceMeasurement",
+                     "request": {"name": "t", "value": 1.0}}).encode()
+        for i in range(40)
+    ] + [
+        b'{"hardwareId": "hw-7", "type": "DeviceMeasurement"}',
+        b'{"deviceToken": "", "hardwareId": "hw-8"}',
+        b'{"deviceToken": 12345}',
+        b'{"deviceToken": null, "hardwareId": "hw-9"}',
+        b'{"type": "DeviceMeasurement"}',
+        b'{broken json',
+        b'[1,2,3]',
+        _json.dumps({"deviceToken": 'esc"tok\\en'}).encode(),
+        _json.dumps({"deviceToken": "télémetre"}).encode(),
+        b'{"deviceToken": "first", "deviceToken": "second"}',
+        b'{"deviceToken": "keep", "deviceToken": 42}',
+        _json.dumps({"deviceToken": "dt-wins",
+                     "hardwareId": "hw-loses"}).encode(),
+        # review repros: token extracted, then the envelope goes bad
+        b'{"deviceToken": "x", "request": {"na',       # truncated mid-doc
+        b'{"deviceToken": "x"} garbage',               # trailing garbage
+        b'{"deviceToken": "a\nb"}',                    # raw control char
+        b'{"a": "c\rd", "deviceToken": "y"}',          # ctrl in other string
+        # surrogate pair: escaped and raw forms of the same token
+        b'{"deviceToken": "\\ud83d\\ude00-dev"}',
+        '{"deviceToken": "\U0001F600-dev"}'.encode(),
+        b'{"deviceToken": "\\ud83d lonely"}',          # lone high surrogate
+        ('{"deviceToken": "%s"}' % long_tok).encode(),  # > vbuf cap
+        ('{"a": 1.5e3, "deviceToken": "after-num", "b": true,'
+         ' "c": null, "d": [1, {"x": "y"}]}').encode(),
+    ]
+    ranks = route_payloads(payloads, n_ranks)
+    if ranks is None:
+        pytest.skip("native list router unavailable")
+    for i, p in enumerate(payloads):
+        want = route_json_payload(p, n_ranks)
+        assert int(ranks[i]) == want, (i, p[:60], int(ranks[i]), want)
+    # the escaped and raw forms of the same non-BMP token route together
+    i_esc = payloads.index(b'{"deviceToken": "\\ud83d\\ude00-dev"}')
+    i_raw = payloads.index('{"deviceToken": "\U0001F600-dev"}'.encode())
+    assert int(ranks[i_esc]) == int(ranks[i_raw]) >= 0
+    # plain tokens still match the string-level owner_rank contract
+    assert int(ranks[0]) == owner_rank("dev-0", n_ranks)
+    # >512-byte tokens intern to their 512-byte prefix, so two tokens
+    # sharing that prefix are ONE device to the decoder — the router
+    # must send both to the same rank
+    twins = [('{"deviceToken": "%s"}' % ("P" * 512 + sfx)).encode()
+             for sfx in ("-a", "-b")]
+    tr = route_payloads(twins, n_ranks)
+    assert int(tr[0]) == int(tr[1]) >= 0
+    assert route_json_payload(twins[0], n_ranks) == int(tr[0])
+
+    bp = [encode_binary_request(request_from_envelope({
+            "deviceToken": f"bt-{i}", "type": "DeviceMeasurement",
+            "request": {"measurements": {"x": 1.0}}})) for i in range(20)]
+    bp += [b"", b"\x02\x01\x00\x00", b"\x01\x01\x05\x00ab",
+           b"\x01\x01\x02\x00\xff\xfe" + b"\x00" * 8,
+           b"\x01\x01\x03\x00\xed\xa0\x80" + b"\x00" * 8,   # surrogate
+           b"\x01\x01\x03\x00\xe0\x80\x80" + b"\x00" * 8,   # overlong
+           b"\x01\x01\x04\x00\xf4\x90\x80\x80" + b"\x00" * 8,  # >U+10FFFF
+           b"\x01\x01\x04\x00\xf0\x9f\x98\x80" + b"\x00" * 8]  # valid emoji
+    br = route_payloads(bp, n_ranks, binary=True)
+    for i, p in enumerate(bp):
+        want = route_binary_payload(p, n_ranks)
+        assert int(br[i]) == want, (i, p[:30], int(br[i]), want)
+    assert int(br[-1]) >= 0          # valid 4-byte UTF-8 routes
+    assert int(br[-2]) == int(br[-3]) == int(br[-4]) == -1
+
+
+def test_surrogate_pair_tokens_intern_identically():
+    """Escaped (\\ud83d\\ude00) and raw UTF-8 forms of a non-BMP token
+    must decode to the SAME device — CESU-8 interning would split one
+    physical device into two identities."""
+    import json as _json
+
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.ingest.fast_decode import native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    eng = Engine(EngineConfig(
+        device_capacity=32, token_capacity=64, assignment_capacity=64,
+        store_capacity=512, batch_capacity=8, channels=4))
+    tok = "\U0001F600-dev"
+    base = int(eng.epoch.base_unix_s * 1000)
+    raw = _json.dumps({"deviceToken": tok, "type": "DeviceMeasurement",
+                       "request": {"name": "t", "value": 1.0,
+                                   "eventDate": base + 1}},
+                      ensure_ascii=False).encode()
+    esc = _json.dumps({"deviceToken": tok, "type": "DeviceMeasurement",
+                       "request": {"name": "t", "value": 2.0,
+                                   "eventDate": base + 2}},
+                      ensure_ascii=True).encode()
+    assert b"\\ud83d" in esc and b"\\u" not in raw
+    res = eng.ingest_json_batch([raw, esc])
+    assert res["failed"] == 0
+    eng.flush()
+    assert eng.metrics()["registered"] == 1   # ONE device, not two
+    st = eng.get_device_state(tok)
+    assert st["measurements"]["t"]["value"] == 2.0
+
+
 def test_binary_token_of():
     from sitewhere_tpu.ingest.decoders import (binary_token_of,
                                                encode_binary_request,
