@@ -45,7 +45,9 @@ import dataclasses
 import enum
 import json
 import logging
+import pathlib
 import queue
+import shutil
 import threading
 import time
 import types
@@ -130,7 +132,9 @@ class EntityReplicator:
     journals + broadcasts ops, applies peer ops, and serves the
     anti-entropy surface."""
 
-    def __init__(self, cluster, instance, log_dir=None):
+    def __init__(self, cluster, instance, log_dir=None,
+                 compact_threshold: int = 20_000,
+                 compact_keep: int = 2_048):
         self.cluster = cluster
         self.instance = instance
         self.rank = cluster.rank
@@ -143,16 +147,46 @@ class EntityReplicator:
         # not a scan — anti-entropy stays O(result), not O(history).
         self.vector: dict[int, int] = {}
         self._ops_by_origin: dict[int, list[dict]] = {}
-        # LWW register per entity: (kind, token) -> (ts, origin)
+        # LWW register per entity: (kind, token) -> (ts, origin).
+        # Deleted entities keep their entry as a TOMBSTONE — state
+        # transfer ships it so a late joiner deletes too.
         self._last: dict[tuple[str, str], tuple[float, int]] = {}
+        # memory/disk bound: past compact_threshold indexed ops, the
+        # index truncates to the newest compact_keep per origin and the
+        # journal rewrites as one state dump + the kept tail. A peer
+        # behind the truncation floor converges by LWW state transfer
+        # (Cluster.entityState) instead of op backfill.
+        self.compact_threshold = int(compact_threshold)
+        self.compact_keep = int(compact_keep)
+        # adaptive re-arm: when a wide cluster's per-origin tails alone
+        # exceed the configured threshold (n_ranks * keep > threshold),
+        # the next trigger moves to 2x the post-compaction residue so
+        # compaction never fires on every single mutation
+        self._next_compact_at = self.compact_threshold
+        self._total_ops = 0
         self.counters = {"emitted": 0, "applied": 0, "lww_skipped": 0,
                          "push_failures": 0, "gap_backfills": 0,
-                         "sync_pulls": 0, "apply_errors": 0}
+                         "sync_pulls": 0, "apply_errors": 0,
+                         "compactions": 0, "state_transfers": 0}
         self._log = None
+        self._log_dir = None
         if log_dir is not None:
             from sitewhere_tpu.utils.ingestlog import IngestLog
 
-            self._log = IngestLog(log_dir, segment_bytes=8 << 20)
+            d = pathlib.Path(log_dir)
+            self._log_dir = d
+            # finish a compaction swap the process died inside of: the
+            # .new journal was fully synced BEFORE any rename started,
+            # so it wins when the live dir is missing
+            new_dir = d.with_name(d.name + ".new")
+            old_dir = d.with_name(d.name + ".old")
+            if not d.exists() and new_dir.exists():
+                new_dir.rename(d)
+            elif not d.exists() and old_dir.exists():
+                old_dir.rename(d)
+            shutil.rmtree(new_dir, ignore_errors=True)
+            shutil.rmtree(old_dir, ignore_errors=True)
+            self._log = IngestLog(d, segment_bytes=8 << 20)
         self._types = _entity_types()
         self._stores: dict[str, object] = {}
         # pushes run on a dedicated thread: the mutating caller (often a
@@ -189,15 +223,22 @@ class EntityReplicator:
         if self._log is not None:
             replayed = 0
             for payload in self._log.replay():
-                op = json.loads(payload)
+                rec = json.loads(payload)
                 with self._lock:
-                    if self._count_receipt(op):
-                        self._remember(op)
-                        self._apply_effect(op)
+                    if "dump" in rec:
+                        # a compaction / state-transfer marker: restore
+                        # the dumped state + vector, then the journal's
+                        # tail ops count contiguously above it
+                        self._apply_dump_locked(rec["dump"], journal=False)
+                        replayed += 1
+                        continue
+                    if self._count_receipt(rec):
+                        self._remember(rec)
+                        self._apply_effect(rec)
                         replayed += 1
             if replayed:
-                logger.info("rank %d: replayed %d entity ops from journal",
-                            self.rank, replayed)
+                logger.info("rank %d: replayed %d entity records from "
+                            "journal", self.rank, replayed)
         for store in self._stores.values():
             store.on_change = self._on_store_change
         dm.on_elements_change = self._on_elements_change
@@ -240,6 +281,13 @@ class EntityReplicator:
     def _remember(self, op: dict) -> None:
         """Index one counted op (lock held)."""
         self._ops_by_origin.setdefault(int(op["origin"]), []).append(op)
+        self._total_ops += 1
+
+    def _maybe_compact_locked(self) -> None:
+        if self._total_ops > self._next_compact_at:
+            self._compact_locked(self.compact_keep)
+            self._next_compact_at = max(self.compact_threshold,
+                                        2 * self._total_ops)
 
     def _emit(self, action, kind, token, state) -> None:
         with self._lock:
@@ -252,6 +300,7 @@ class EntityReplicator:
             self._remember(op)
             self._journal(op)
             self.counters["emitted"] += 1
+            self._maybe_compact_locked()
             if self.cluster.n_ranks > 1:
                 # start-check under the lock: two concurrent mutators
                 # must not race a SECOND pusher into existence (per-
@@ -317,6 +366,12 @@ class EntityReplicator:
 
     def _backfill(self, peer_rank: int, their_vector: dict) -> None:
         missing = self.ops_since(their_vector)
+        if isinstance(missing, dict):
+            # peer is behind our compaction floor: it converges by
+            # pulling Cluster.entityState on its next anti-entropy pass
+            logger.info("peer %d behind the entity compaction floor; "
+                        "deferring to its state-transfer pull", peer_rank)
+            return
         if missing:
             self.counters["gap_backfills"] += 1
             self.cluster._peer(peer_rank).call("Cluster.entityOps",
@@ -404,6 +459,7 @@ class EntityReplicator:
             self._remember(op)
             self._journal(op)
             self._apply_effect(op)
+            self._maybe_compact_locked()
         return {"applied": True}
 
     def apply_batch(self, ops: list[dict]) -> int:
@@ -416,19 +472,158 @@ class EntityReplicator:
                 applied += 1
         return applied
 
-    def ops_since(self, vector: dict) -> list[dict]:
+    def ops_since(self, vector: dict) -> "list[dict] | dict":
         """Everything the caller lacks, sliced per origin (each origin's
-        list is contiguous by seq, so this is O(result))."""
+        list is contiguous by seq, so this is O(result)). When the caller
+        is behind a compaction floor — we no longer hold the ops it needs
+        — returns ``{"reset": True}``: the caller must converge by LWW
+        state transfer (:meth:`state_dump`) instead of op backfill."""
         out = []
         with self._lock:
-            for origin, ops in self._ops_by_origin.items():
-                if not ops:
-                    continue
+            for origin, have in self.vector.items():
                 seen = int(vector.get(str(origin), vector.get(origin, 0)))
-                start = max(0, seen - ops[0]["seq"] + 1)
-                out.extend(ops[start:])
+                if seen >= have:
+                    continue
+                ops = self._ops_by_origin.get(origin) or []
+                if not ops or ops[0]["seq"] > seen + 1:
+                    return {"reset": True}
+                out.extend(ops[seen - ops[0]["seq"] + 1:])
         out.sort(key=lambda o: (o["origin"], o["seq"]))
         return out
+
+    # --------------------------------------------- state dump / compaction
+    def _current_state(self, kind: str, token: str):
+        """The entity's live post-state (None = deleted/absent)."""
+        inst = self.instance
+        if kind == "user":
+            u = inst.users.users.get(token)
+            return to_state(u) if u is not None else None
+        if kind == "role":
+            r = inst.users.roles.get(token)
+            return list(r) if r is not None else None
+        if kind == "device-command":
+            c = inst.command_registry.get(token)
+            return to_state(c) if c is not None else None
+        if kind == "group-elements":
+            els = inst.device_management._group_elements.get(token)
+            return ([to_state(e) for e in els]
+                    if els is not None else None)
+        store = self._stores.get(kind)
+        if store is None:
+            return None
+        e = store.try_get(token)
+        return to_state(e) if e is not None else None
+
+    def _state_dump_locked(self, vector: dict | None = None) -> dict:
+        """Every entity the plane has ever touched (tombstones included)
+        with its LWW key, plus a receipt vector. ``vector`` overrides the
+        shipped vector: compaction journals the dump with the vector
+        REWOUND to just below the kept tail so replay re-counts (and
+        re-indexes) the tail contiguously above it."""
+        entries = [{"kind": k, "token": t, "ts": ts, "origin": origin,
+                    "state": self._current_state(k, t)}
+                   for (k, t), (ts, origin) in self._last.items()]
+        return {"vector": dict(self.vector if vector is None else vector),
+                "entries": entries}
+
+    def state_dump(self) -> dict:
+        """The anti-entropy state-transfer payload (Cluster.entityState)."""
+        with self._lock:
+            return self._state_dump_locked()
+
+    def _apply_dump_locked(self, dump: dict, journal: bool) -> int:
+        """Converge onto a peer's (or the journal's) state dump: apply
+        each entry last-writer-wins, then adopt the dump's vector. Safe
+        against anything we already hold — LWW keys decide, exactly as
+        for pushed ops."""
+        applied = 0
+        for e in dump["entries"]:
+            key = (float(e["ts"]), int(e["origin"]))
+            kt = (e["kind"], e["token"])
+            existing = self._last.get(kt)
+            if existing is not None and tuple(existing) >= key:
+                continue
+            self._last[kt] = key
+            try:
+                self._apply_state(
+                    e["kind"], e["token"],
+                    "delete" if e["state"] is None else "upsert",
+                    e["state"])
+                applied += 1
+            except Exception:
+                self.counters["apply_errors"] += 1
+                logger.exception("state-transfer apply failed: %s %s",
+                                 e["kind"], e["token"])
+        for o, s in dump["vector"].items():
+            o, s = int(o), int(s)
+            if s > self.vector.get(o, 0):
+                self.vector[o] = s
+                # any indexed ops now sit BELOW the adopted watermark:
+                # they are already reflected in the transferred state,
+                # and keeping them would break per-origin contiguity
+                # (ops_since slices, compaction floors, replay counting)
+                # the moment the origin's next op appends above the jump
+                stale = self._ops_by_origin.get(o)
+                if stale:
+                    self._total_ops -= len(stale)
+                    self._ops_by_origin[o] = []
+                if o == self.rank:
+                    self._my_seq = max(self._my_seq, s)
+        if journal:
+            self._journal({"dump": dump})
+        return applied
+
+    def apply_state_dump(self, dump: dict) -> int:
+        """Adopt a peer's full state (the reset path of sync_from_peers)."""
+        with self._lock:
+            n = self._apply_dump_locked(dump, journal=True)
+            self.counters["state_transfers"] += 1
+            return n
+
+    def _compact_locked(self, keep_recent: int) -> None:
+        """Truncate the op index to the newest ``keep_recent`` per origin
+        and rewrite the journal as one state dump + the kept tail. Disk
+        and memory stay O(live entities + tail) for the cluster's whole
+        lifetime. The swap is crash-safe: the new journal is fully synced
+        before any rename, and __init__ finishes an interrupted swap."""
+        for origin in list(self._ops_by_origin):
+            ops = self._ops_by_origin[origin]
+            if len(ops) > keep_recent:
+                self._ops_by_origin[origin] = ops[len(ops) - keep_recent:]
+        self._total_ops = sum(len(v)
+                              for v in self._ops_by_origin.values())
+        self.counters["compactions"] += 1
+        if self._log is None:
+            return
+        from sitewhere_tpu.utils.ingestlog import IngestLog
+
+        # journal vector rewound to below each kept tail so replay
+        # re-counts the tail and rebuilds the op index
+        floor_vec = dict(self.vector)
+        for origin, ops in self._ops_by_origin.items():
+            if ops:
+                floor_vec[origin] = ops[0]["seq"] - 1
+        dump = self._state_dump_locked(vector=floor_vec)
+        d = self._log_dir
+        new_dir = d.with_name(d.name + ".new")
+        old_dir = d.with_name(d.name + ".old")
+        shutil.rmtree(new_dir, ignore_errors=True)
+        nlog = IngestLog(new_dir, segment_bytes=8 << 20)
+        nlog.append(json.dumps({"dump": dump}).encode())
+        for op in sorted((o for ops in self._ops_by_origin.values()
+                          for o in ops),
+                         key=lambda o: (o["origin"], o["seq"])):
+            nlog.append(json.dumps(op).encode())
+        nlog.sync()
+        nlog.close()
+        self._log.close()
+        shutil.rmtree(old_dir, ignore_errors=True)
+        d.rename(old_dir)
+        new_dir.rename(d)
+        shutil.rmtree(old_dir, ignore_errors=True)
+        self._log = IngestLog(d, segment_bytes=8 << 20)
+        logger.info("rank %d: entity journal compacted to %d ops",
+                    self.rank, self._total_ops)
 
     # ---------------------------------------------------- anti-entropy
     def sync_from_peers(self, best_effort: bool = True) -> int:
@@ -443,7 +638,13 @@ class EntityReplicator:
                 with self._lock:
                     vec = dict(self.vector)
                 ops = c._peer(r).call("Cluster.entityOpsSince", vector=vec)
-                total += self.apply_batch(ops)
+                if isinstance(ops, dict) and ops.get("reset"):
+                    # we are behind the peer's compaction floor: pull its
+                    # full LWW state instead of an op backfill
+                    dump = c._peer(r).call("Cluster.entityState")
+                    total += self.apply_state_dump(dump)
+                else:
+                    total += self.apply_batch(ops)
             except (ConnectionError, TimeoutError):
                 if not best_effort:
                     raise
@@ -473,6 +674,7 @@ class EntityReplicator:
                      lambda ops: {"applied": self.apply_batch(ops)})
         srv.register("Cluster.entityOpsSince",
                      lambda vector: self.ops_since(vector))
+        srv.register("Cluster.entityState", lambda: self.state_dump())
         srv.register("Cluster.entityVector",
                      lambda: {str(k): v for k, v in self.vector.items()})
 

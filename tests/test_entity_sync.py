@@ -309,3 +309,109 @@ def test_concurrent_creates_never_collide_on_ids(tmp_path):
             to_state(dm1.device_types.get("cc-b"))
     finally:
         _close_all(clusters, reps, host)
+
+
+def test_compaction_bounds_index_and_late_joiner_state_transfer(tmp_path):
+    """A long-running plane compacts: the op index/journal stay
+    O(live + tail), and a rank behind the compaction floor converges by
+    LWW state transfer (tombstones included) instead of op backfill —
+    the cluster never grows without bound and never strands a late
+    joiner (the reference's shared DB has both properties trivially)."""
+    clusters, insts, reps, host = _mk_cluster_staggered(tmp_path)
+    c0, c1 = clusters
+    rep0 = EntityReplicator(c0, insts[0],
+                            log_dir=str(tmp_path / "elog-r0"),
+                            compact_threshold=30, compact_keep=5)
+    rep0.attach()
+    rep0.register_rpc(host.servers[0])
+    reps.append(rep0)
+    try:
+        dm0 = insts[0].device_management
+        for i in range(40):
+            dm0.create_device_type(f"ct-{i}", f"Type {i}")
+        dm0.device_types.delete("ct-3")          # tombstones must ship
+        dm0.device_types.delete("ct-7")
+        rep0.drain_pushes()
+        assert rep0.counters["compactions"] >= 1
+        assert rep0._total_ops <= 30             # bounded index
+        # journal too: replaying it must NOT need the pruned ops
+        # (checked structurally: the floor sits above seq 1)
+        ops0 = rep0._ops_by_origin[0]
+        assert ops0[0]["seq"] > 1
+
+        # ---- late joiner: behind the floor -> full state transfer -----
+        rep1 = EntityReplicator(c1, insts[1],
+                                log_dir=str(tmp_path / "elog-r1"))
+        rep1.attach()
+        rep1.register_rpc(host.servers[1])
+        reps.append(rep1)
+        dm1 = insts[1].device_management
+        assert "ct-0" not in dm1.device_types
+        # the compacted rank answers an empty vector with the reset
+        # marker — op backfill below the floor must be refused, loudly
+        assert rep0.ops_since({}) == {"reset": True}
+        pulled = rep1.sync_from_peers(best_effort=False)
+        assert rep1.counters["state_transfers"] == 1
+        assert pulled >= 38
+        assert "ct-0" in dm1.device_types and "ct-39" in dm1.device_types
+        assert "ct-3" not in dm1.device_types    # tombstone applied
+        assert "ct-7" not in dm1.device_types
+        assert to_state(dm1.device_types.get("ct-5")) == \
+            to_state(dm0.device_types.get("ct-5"))
+        # vector adopted: the NEXT push applies as a normal op
+        dm0.create_device_type("ct-after", "After")
+        rep0.drain_pushes()
+        assert "ct-after" in dm1.device_types
+        assert rep1.counters["state_transfers"] == 1   # no second reset
+    finally:
+        _close_all(clusters, reps, host)
+
+
+def _mk_cluster_staggered(tmp_path):
+    """Cluster + instances WITHOUT replicators (tests attach their own,
+    at different times, with different compaction budgets)."""
+    clusters, host, ports = _mk_cluster(tmp_path)
+    insts = [SiteWhereTpuInstance(InstanceConfig(engine=EngineConfig()),
+                                  engine=c) for c in clusters]
+    return clusters, insts, [], host
+
+
+def test_compacted_journal_restart_replays_dump_plus_tail(tmp_path):
+    """After compaction the journal is one state dump + the kept tail;
+    a crash-restart replays both: full state back, vector preserved,
+    op index rebuilt to exactly the tail."""
+    clusters, insts, reps, host = _mk_cluster_staggered(tmp_path)
+    c0 = clusters[0]
+    rep0 = EntityReplicator(c0, insts[0],
+                            log_dir=str(tmp_path / "elog-r0"),
+                            compact_threshold=20, compact_keep=4)
+    rep0.attach()
+    rep0.register_rpc(host.servers[0])
+    reps.append(rep0)
+    try:
+        dm0 = insts[0].device_management
+        for i in range(30):
+            dm0.create_device_type(f"rt-{i}", f"T{i}")
+        dm0.device_types.delete("rt-1")
+        rep0.drain_pushes()
+        assert rep0.counters["compactions"] >= 1
+        vec_before = dict(rep0.vector)
+        tail_before = [o["seq"] for o in rep0._ops_by_origin[0]]
+
+        rep0.close()
+        inst0b = SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c0)
+        rep0b = EntityReplicator(c0, inst0b,
+                                 log_dir=str(tmp_path / "elog-r0"),
+                                 compact_threshold=20, compact_keep=4)
+        rep0b.attach()
+        reps[0] = rep0b
+        dmb = inst0b.device_management
+        assert "rt-0" in dmb.device_types and "rt-29" in dmb.device_types
+        assert "rt-1" not in dmb.device_types     # tombstone survives
+        assert rep0b.vector == vec_before
+        assert [o["seq"] for o in rep0b._ops_by_origin[0]] == tail_before
+        assert to_state(dmb.device_types.get("rt-29")) == \
+            to_state(dm0.device_types.get("rt-29"))
+    finally:
+        _close_all(clusters, reps, host)
