@@ -38,6 +38,19 @@ def _free_ports(n):
     return ports
 
 
+def _jwt_headers(rest_port, timeout=10):
+    """Admin Bearer headers for a rank's REST gateway (Basic -> JWT)."""
+    import base64
+    import urllib.request
+
+    basic = base64.b64encode(b"admin:password").decode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{rest_port}/api/authapi/jwt",
+        headers={"Authorization": f"Basic {basic}"})
+    jwt = json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+    return {"Authorization": f"Bearer {jwt['token']}"}
+
+
 def _engine_cfg(tmp_path=None, rank=0, **kw):
     cfg = dict(n_shards=2, device_capacity_per_shard=64,
                token_capacity_per_shard=128,
@@ -1258,12 +1271,7 @@ def test_run_rank_boots_a_serving_rank_from_one_config(tmp_path):
         assert len(rt.instance.search_index.search("*:*")) == 1
         # observability surfaces: the cluster page + rank-labeled
         # Prometheus series (single rank: by_rank has one entry)
-        basic = __import__("base64").b64encode(b"admin:password").decode()
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{rt.rest_port}/api/authapi/jwt",
-            headers={"Authorization": f"Basic {basic}"})
-        jwt = json.loads(urllib.request.urlopen(req, timeout=10).read())
-        hdr = {"Authorization": f"Bearer {jwt['token']}"}
+        hdr = _jwt_headers(rt.rest_port)
         req = urllib.request.Request(
             f"http://127.0.0.1:{rt.rest_port}/api/instance/cluster",
             headers=hdr)
@@ -1351,3 +1359,69 @@ def test_cluster_metrics_carry_rank_attribution(tmp_path):
         assert s1["ranks"]["0"]["devices"] == 3
     finally:
         _close(clusters, host)
+
+
+def test_run_rank_three_rank_cluster_from_one_config(tmp_path):
+    """The operator story at N=3: three ranks from the SAME config shape,
+    administered once, ingesting anywhere, reading identically everywhere
+    (run_rank generality beyond the 2-rank demo)."""
+    from sitewhere_tpu.parallel.rank_runtime import RankConfig, run_rank
+
+    n = 3
+    ports = _free_ports(n)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    rts = []
+    try:
+        for r in range(n):
+            cc = ClusterConfig(
+                rank=r, n_ranks=n, peers=peers, secret="three",
+                epoch_base_unix_s=BASE_S,
+                engine=_engine_cfg(tmp_path, rank=r))
+            rts.append(run_rank(RankConfig(
+                cluster=cc, entity_sync_interval_s=3600.0)))
+
+        # one admin call, at rank 0 only
+        rts[0].instance.device_management.create_device_type(
+            "tri-type", "Triple")
+        rts[0].replicator.drain_pushes()
+        for rt in rts:
+            assert "tri-type" in rt.instance.device_management.device_types
+
+        # ingest at rank 1 a batch whose tokens are owned by ALL ranks
+        toks = [tokens_owned_by(r, 2, n_ranks=n, prefix="tri") for r in range(n)]
+        flat = [t for per in toks for t in per]
+        batch = [meas(t, "temp", 10.0 + i, 100 + i)
+                 for i, t in enumerate(flat)]
+        s = rts[1].cluster.ingest_json_batch(batch)
+        assert s.get("failed", 0) == 0 and s.get("spilled", 0) == 0
+        for rt in rts:
+            rt.cluster.flush()
+        # every rank answers every token identically (owner-routed reads)
+        for rt in rts:
+            for t in flat:
+                q = rt.cluster.query_events(device_token=t)
+                assert q["total"] == 1, (t, q)
+            assert len(rt.cluster.devices) == len(flat)
+
+        # cluster-wide search agrees from any rank
+        for rt in rts:
+            rt.pump_outbound()
+        hits = {len(rt.instance.search.get("embedded").search("*:*"))
+                for rt in rts}
+        assert hits == {len(flat)}
+
+        # the cluster status page at rank 2 sees all three ranks UP
+        import urllib.request
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rts[2].rest_port}/api/instance/cluster",
+            headers=_jwt_headers(rts[2].rest_port))
+        cs = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert cs["nRanks"] == 3
+        assert {r for r, v in cs["ranks"].items()
+                if v["status"] == "UP"} == {"0", "1", "2"}
+    finally:
+        for rt in rts:
+            try:
+                rt.stop()
+            except Exception:
+                pass   # one rank's teardown must not strand the rest
