@@ -1107,3 +1107,85 @@ def test_pylist_and_packed_decode_paths_agree():
         for f in ("rtype", "token_id", "ts_ms64", "aux0", "level",
                   "values", "chmask"):
             assert np.array_equal(getattr(fast, f), getattr(ref, f)), f
+
+
+def test_scanner_and_router_randomized_differential():
+    """Seeded fuzz over the native scanner + router: every randomly
+    generated valid envelope (unicode/escapes/nulls/extra keys) must
+    decode, the native router must agree with its Python port on every
+    payload, and random mutations (truncation, byte flips, inserts) must
+    never crash the scanner or break route parity."""
+    import json as _json
+    import random
+
+    from sitewhere_tpu.ingest.fast_decode import (NativeBatchDecoder,
+                                                  native_available)
+    from sitewhere_tpu.native.binding import NativeInterner, route_payloads
+    from sitewhere_tpu.native.route_fallback import route_json_payload
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    rng = random.Random(1234)
+    alphabet = "abcXYZ0189-_.é😀\"\\\n\t"
+
+    def rand_token():
+        return "".join(rng.choice(alphabet)
+                       for _ in range(rng.randint(1, 24)))
+
+    def rand_envelope():
+        t = rng.choice(["DeviceMeasurement", "DeviceMeasurements",
+                        "DeviceLocation", "DeviceAlert", "Acknowledge"])
+        req = {}
+        if t == "DeviceMeasurement":
+            req = {"name": rand_token(), "value": rng.choice(
+                [rng.uniform(-1e6, 1e6), rng.randint(0, 10**14), None])}
+        elif t == "DeviceMeasurements":
+            req = {"measurements": {rand_token(): rng.uniform(-100, 100)
+                                    for _ in range(rng.randint(0, 5))}}
+        elif t == "DeviceLocation":
+            req = {"latitude": rng.uniform(-90, 90),
+                   "longitude": rng.uniform(-180, 180),
+                   "elevation": rng.choice([rng.uniform(0, 1000), None])}
+        elif t == "DeviceAlert":
+            req = {"type": rand_token(),
+                   "level": rng.choice(["Info", "Warning", "Error",
+                                        "Critical", 2, None]),
+                   "message": rand_token()}
+        if rng.random() < 0.8:
+            req["eventDate"] = rng.randint(1, 2**45)
+        env = {"deviceToken": rand_token(), "type": t, "request": req}
+        if rng.random() < 0.2:
+            env["extraKey"] = rng.choice([None, True, [1, {"a": "b"}], "x"])
+        return env
+
+    payloads = [
+        _json.dumps(rand_envelope(),
+                    ensure_ascii=rng.random() < 0.5).encode()
+        for _ in range(1500)]
+    dec = NativeBatchDecoder(NativeInterner(1 << 16), 8)
+    res = dec.decode(payloads)
+    assert res.n_ok == len(payloads)
+
+    ranks = route_payloads(payloads, 7)
+    if ranks is None:
+        pytest.skip("py-bridge (list router) unavailable")
+    for i, p in enumerate(payloads):
+        assert int(ranks[i]) == route_json_payload(p, 7), p[:80]
+
+    mut = []
+    for p in payloads[:800]:
+        b = bytearray(p)
+        for _ in range(rng.randint(1, 4)):
+            op = rng.random()
+            if op < 0.4 and len(b) > 2:
+                del b[rng.randrange(len(b)):]
+            elif op < 0.8 and b:
+                b[rng.randrange(len(b))] = rng.randrange(256)
+            else:
+                b.insert(rng.randrange(len(b) + 1), rng.randrange(256))
+        mut.append(bytes(b))
+    res2 = dec.decode(mut)          # must not crash; count stays sane
+    assert 0 <= res2.n_ok <= len(mut)
+    ranks2 = route_payloads(mut, 7)
+    for i, p in enumerate(mut):
+        assert int(ranks2[i]) == route_json_payload(p, 7), p[:80]
