@@ -229,12 +229,39 @@ class _SyncPeer:
         self._thread.join(timeout=5)
 
 
-def _b64(payloads: list[bytes]) -> list[str]:
-    return [base64.b64encode(p).decode() for p in payloads]
-
-
 def _unb64(payloads: list[str]) -> list[bytes]:
     return [base64.b64decode(p) for p in payloads]
+
+
+def _split_blob(blob: bytes, lens: list) -> list[bytes]:
+    """Inverse of the sender's b"".join: one attachment blob back into
+    payload list form. Rejects non-integer/negative lengths BEFORE the
+    sum check (a float that sums right would silently misalign every
+    boundary after int() truncation)."""
+    import operator
+
+    lens = [operator.index(n) for n in lens]   # raises on floats/strings
+    if any(n < 0 for n in lens) or sum(lens) != len(blob):
+        raise ValueError(
+            f"attachment length {len(blob)} does not match lens")
+    out, off = [], 0
+    for n in lens:
+        out.append(bytes(blob[off:off + n]))
+        off += n
+    return out
+
+
+def _wire_payloads(payloads=None, lens=None, _attachment=None) -> list[bytes]:
+    """Payload list from either wire form: raw attachment blob + lens
+    (the hot path — no base64, no json escaping) or the b64 list (spill
+    records, older senders). An attachment WITHOUT lens is malformed and
+    must fail loudly — silently ingesting zero events would report
+    success to a sender that shipped data."""
+    if _attachment is not None:
+        if lens is None:
+            raise ValueError("attachment requires lens")
+        return _split_blob(_attachment, lens)
+    return _unb64(payloads or [])
 
 
 def _merge_counts(dicts: list[dict]) -> dict:
@@ -441,25 +468,48 @@ class ClusterEngine:
         """One remote sub-batch. With a forward queue attached, delivery
         is durable: tagged for owner-side dedup, spilled on failure
         (returned as {"spilled": n}) instead of raising mid-batch with
-        part of the batch already applied locally."""
+        part of the batch already applied locally. Payload bytes ride the
+        frame as a RAW attachment blob (protocol.py ATTACH_BIT) — the
+        base64-in-JSON form cost ~10x the owner's actual decode."""
+        from sitewhere_tpu.rpc.protocol import MAX_FRAME, RpcError
+
+        lens = [len(p) for p in plist]
+        if sum(lens) > MAX_FRAME - (1 << 16) and len(plist) > 1:
+            # split BEFORE any join so an oversized batch never copies
+            # its full byte payload at every recursion level
+            mid = len(plist) // 2
+            return _merge_counts([
+                self._forward_batch(r, kind, plist[:mid], tenant),
+                self._forward_batch(r, kind, plist[mid:], tenant)])
         if self.forward_queue is None:
             method = ("Cluster.ingestJson" if kind == "json"
                       else "Cluster.ingestBinary")
-            return self._peer(r).call(method, payloads=_b64(plist),
-                                      tenant=tenant)
+            return self._peer(r).call(method, lens=lens, tenant=tenant,
+                                      _attachment=b"".join(plist))
         fid = self._next_fid()
         if self.forward_queue.circuit_open(r):
             # a known-down peer: spill without paying the connect
-            # timeout per batch; the retry pump closes the circuit
+            # timeout (or the blob join) per batch; the retry pump
+            # closes the circuit
             self.forward_queue.spill(r, kind, tenant, fid,
                                      payloads=plist)
             return {"spilled": len(plist)}
         try:
             return self._peer(r).call(
-                "Cluster.ingestForward", fid=fid, payloads=_b64(plist),
-                tenant=tenant, encoding=kind)
+                "Cluster.ingestForward", fid=fid, lens=lens,
+                tenant=tenant, encoding=kind,
+                _attachment=b"".join(plist))
         except (ConnectionError, TimeoutError):
             self.forward_queue.trip(r)
+            self.forward_queue.spill(r, kind, tenant, fid,
+                                     payloads=plist)
+            return {"spilled": len(plist)}
+        except RpcError:
+            # oversize single payload (unsplittable) or an owner-side
+            # application error: spill WITHOUT tripping the circuit (the
+            # peer is up) — the retry pump re-attempts and the retry
+            # budget dead-letters a poison batch; data is never lost to
+            # an exception racing out of a half-applied ingest call
             self.forward_queue.spill(r, kind, tenant, fid,
                                      payloads=plist)
             return {"spilled": len(plist)}
@@ -1103,14 +1153,19 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     (DeviceStateRouter.java:62-72). Handlers bind to the concrete engine,
     never the ClusterEngine facade, so routed calls cannot recurse."""
 
-    def ingest_json(payloads: list, tenant: str = "default"):
-        return engine.ingest_json_batch(_unb64(payloads), tenant)
+    def ingest_json(payloads: list = None, tenant: str = "default",
+                    lens: list = None, _attachment: bytes = None):
+        return engine.ingest_json_batch(
+            _wire_payloads(payloads, lens, _attachment), tenant)
 
-    def ingest_binary(payloads: list, tenant: str = "default"):
-        return engine.ingest_binary_batch(_unb64(payloads), tenant)
+    def ingest_binary(payloads: list = None, tenant: str = "default",
+                      lens: list = None, _attachment: bytes = None):
+        return engine.ingest_binary_batch(
+            _wire_payloads(payloads, lens, _attachment), tenant)
 
-    def ingest_forward(fid: str, payloads: list, tenant: str = "default",
-                       encoding: str = "json"):
+    def ingest_forward(fid: str, payloads: list = None,
+                       tenant: str = "default", encoding: str = "json",
+                       lens: list = None, _attachment: bytes = None):
         """Tagged forward: the id registry suppresses redeliveries (a
         retry after a lost response or a sender/owner restart must not
         double-ingest). Record AFTER ingest: a crash in between costs a
@@ -1118,10 +1173,11 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         reg = getattr(engine, "spill_registry", None)
         if reg is not None and reg.seen(fid):
             return {"duplicate_forward": 1}
+        plist = _wire_payloads(payloads, lens, _attachment)
         if encoding == "binary":
-            summary = engine.ingest_binary_batch(_unb64(payloads), tenant)
+            summary = engine.ingest_binary_batch(plist, tenant)
         else:
-            summary = engine.ingest_json_batch(_unb64(payloads), tenant)
+            summary = engine.ingest_json_batch(plist, tenant)
         if reg is not None:
             reg.record(fid)
         return summary

@@ -16,7 +16,8 @@ import itertools
 import time
 from typing import Any
 
-from sitewhere_tpu.rpc.protocol import RpcError, encode_frame, read_frame
+from sitewhere_tpu.rpc.protocol import (RpcError, frame_chunks,
+                                        read_frame)
 
 
 class RpcClient:
@@ -99,6 +100,9 @@ class RpcClient:
             # writes to a lost asyncio transport do not raise; without this
             # check a post-disconnect call would park a future forever
             raise ConnectionError(f"rpc connection dead: {self._dead}")
+        # reserved: a bytes blob under _attachment rides the frame RAW
+        # (no base64/json escaping) — the cross-rank payload hot path
+        attachment = params.pop("_attachment", None)
         rid = next(self._ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[rid] = fut
@@ -107,7 +111,8 @@ class RpcClient:
             req["tenant"] = self.tenant
         try:
             async with self._send_lock:
-                self._writer.write(encode_frame(req))
+                for chunk in frame_chunks(req, attachment):
+                    self._writer.write(chunk)
                 await self._writer.drain()
         except BaseException:
             self._pending.pop(rid, None)   # never leak an unsent call

@@ -52,6 +52,7 @@ class RpcServer:
                  unbound_authority: str | None = None):
         self.methods: dict[str, Handler] = {}
         self._tenant_scoped: dict[str, bool] = {}
+        self._wants_attachment: dict[str, bool] = {}
         self._authority: dict[str, str | None] = {}
         self._tenant_validator = tenant_validator
         self._authenticator = authenticator
@@ -69,8 +70,9 @@ class RpcServer:
 
         self.methods[name] = fn
         self._authority[name] = authority
-        self._tenant_scoped[name] = (
-            "tenant" in inspect.signature(fn).parameters)
+        sig = inspect.signature(fn).parameters
+        self._tenant_scoped[name] = "tenant" in sig
+        self._wants_attachment[name] = "_attachment" in sig
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self._server = await asyncio.start_server(self._serve, host, port)
@@ -129,6 +131,14 @@ class RpcServer:
         try:
             method = frame.get("method", "")
             params = frame.get("params") or {}
+            # spoof-proofing: only a REAL wire attachment (bytes, set by
+            # read_frame) may appear under the reserved key — a json
+            # string impostor inside params is discarded. Injected only
+            # for handlers that declare it; stray attachments drop.
+            params.pop("_attachment", None)
+            if (isinstance(frame.get("_attachment"), (bytes, bytearray))
+                    and self._wants_attachment.get(method)):
+                params["_attachment"] = frame["_attachment"]
             if method == "Auth.handshake":
                 if self._authenticator is None:
                     resp = {"id": rid, "result": {"user": None,

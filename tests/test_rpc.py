@@ -494,3 +494,71 @@ def test_rpc_tenant_binding_enforced():
             await srv.stop()
 
     asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_attachment_frames_round_trip_and_spoof_protection():
+    """Binary attachment frames (protocol.py ATTACH_BIT): a bytes blob
+    rides the frame raw after the JSON body. Covers: round-trip through
+    a live server, json-borne "_attachment" impostors discarded,
+    attachments dropped for handlers that don't declare one, and the
+    oversize guards."""
+    import asyncio
+
+    import pytest as _pytest
+
+    from sitewhere_tpu.rpc.client import RpcClient
+    from sitewhere_tpu.rpc.protocol import (MAX_FRAME, RpcError,
+                                            encode_frame)
+    from sitewhere_tpu.rpc.server import RpcServer
+
+    srv = RpcServer()
+    got: dict = {}
+
+    def takes_blob(lens: list, _attachment: bytes = None):
+        got["blob"] = _attachment
+        got["type"] = type(_attachment).__name__
+        return {"n": len(_attachment) if _attachment is not None else -1,
+                "lens_ok": sum(lens) == (len(_attachment)
+                                         if _attachment else 0)}
+
+    def no_blob(x: int):
+        return {"x": x}
+
+    srv.register("T.blob", takes_blob)
+    srv.register("T.plain", no_blob)
+
+    async def drive():
+        port = await srv.start()
+        cli = await RpcClient(port=port).connect()
+        try:
+            blob = bytes(range(256)) * 64
+            r = await cli.call("T.blob", lens=[256] * 64,
+                               _attachment=blob)
+            assert r == {"n": len(blob), "lens_ok": True}
+            assert got["blob"] == blob and got["type"] == "bytes"
+            # no attachment at all: handler sees None
+            r = await cli.call("T.blob", lens=[5])
+            assert r == {"n": -1, "lens_ok": False}
+            # handler without the param never sees a stray attachment
+            r = await cli.call("T.plain", x=7, _attachment=b"stray")
+            assert r == {"x": 7}
+            # spoofed json impostor: encode by hand, bypassing the client
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(encode_frame(
+                {"id": 99, "method": "T.blob",
+                 "params": {"lens": [5], "_attachment": "fake"}}))
+            await writer.drain()
+            from sitewhere_tpu.rpc.protocol import read_frame
+            resp = await read_frame(reader)
+            assert resp["id"] == 99
+            assert resp["result"] == {"n": -1, "lens_ok": False}
+            writer.close()
+        finally:
+            await cli.close()
+            await srv.stop()
+
+    asyncio.new_event_loop().run_until_complete(drive())
+
+    with _pytest.raises(RpcError, match="attachment too large"):
+        encode_frame({"id": 1}, b"\0" * (MAX_FRAME + 1))
