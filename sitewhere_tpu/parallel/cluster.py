@@ -565,6 +565,12 @@ class ClusterEngine:
             self.forward_queue.trip(r)
             self.forward_queue.spill(r, "envelope", req.tenant, fid,
                                      envelope=env)
+        # an owner-side application error (RpcError) RAISES here, unlike
+        # the batch path's spill: this is the synchronous all-or-nothing
+        # single-request contract — a deterministic validation refusal
+        # must reach the caller exactly as it does for a locally-owned
+        # device, not turn into a false success + a poison spill record
+        # that head-of-line blocks the peer's queue until dead-letter
 
     def _fanout_keyed(self, local_result, method: str,
                       tolerant: bool = False, **params) -> dict:
