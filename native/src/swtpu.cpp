@@ -279,10 +279,15 @@ static int parse_string_view(Scanner& sc, const char** out, char* buf,
     return n;
 }
 
-// std::from_chars: locale-independent, correctly rounded, BOUNDED by
-// sc.end (strtod was locale-aware, ~10x slower, and read past the message
-// boundary — saved only by the buffer's trailing NUL), and as fast as a
-// hand-rolled digit loop.
+// Locale-independent, BOUNDED number parse (strtod was locale-aware,
+// ~10x slower, and read past the message boundary — saved only by the
+// buffer's trailing NUL). std::from_chars where the stdlib has the
+// floating-point overload (gcc >= 11); otherwise a hand-rolled scan
+// whose fast path (<= 15 mantissa digits, |exp10| <= 22 — every wire
+// number this system emits) is exactly rounded via the classic Clinger
+// power-of-ten argument, with a bounded-copy strtod fallback for the
+// exotic rest.
+#if defined(__cpp_lib_to_chars)
 static double parse_number(Scanner& sc) {
     skip_ws(sc);
     double v = 0;
@@ -291,6 +296,81 @@ static double parse_number(Scanner& sc) {
     sc.p = res.ptr;
     return v;
 }
+#else
+static double parse_number(Scanner& sc) {
+    skip_ws(sc);
+    const char* p = sc.p;
+    const char* end = sc.end;
+    const char* start = p;
+    bool neg = false;
+    if (p < end && *p == '-') { neg = true; p++; }
+    uint64_t mant = 0;
+    int ndig = 0;        // mantissa digits accumulated (cap 19 fits u64)
+    int extra_exp = 0;   // integer digits past the cap shift the exponent
+    bool any = false;
+    while (p < end && *p >= '0' && *p <= '9') {
+        any = true;
+        if (ndig < 19) { mant = mant * 10 + (uint64_t)(*p - '0'); ndig++; }
+        else extra_exp++;
+        p++;
+    }
+    int frac = 0;
+    if (p < end && *p == '.') {
+        const char* fp = p + 1;
+        while (fp < end && *fp >= '0' && *fp <= '9') {
+            any = true;
+            if (ndig < 19) {
+                mant = mant * 10 + (uint64_t)(*fp - '0');
+                ndig++;
+                frac++;
+            }
+            fp++;
+        }
+        if (fp > p + 1) p = fp;   // lone '.' is not part of the number
+    }
+    if (!any) { sc.ok = false; return 0; }
+    int esign = 1, eval = 0;
+    if (p < end && (*p == 'e' || *p == 'E')) {
+        const char* ep = p + 1;
+        if (ep < end && (*ep == '+' || *ep == '-')) {
+            if (*ep == '-') esign = -1;
+            ep++;
+        }
+        bool edig = false;
+        while (ep < end && *ep >= '0' && *ep <= '9') {
+            if (eval < 10000) eval = eval * 10 + (*ep - '0');
+            edig = true;
+            ep++;
+        }
+        if (edig) p = ep;   // digit-less exponent: 'e' is not consumed
+    }
+    int exp10 = esign * eval - frac + extra_exp;
+    double v;
+    if (ndig <= 15 && exp10 >= -22 && exp10 <= 22) {
+        static const double P10[23] = {
+            1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,
+            1e8,  1e9,  1e10, 1e11, 1e12, 1e13, 1e14, 1e15,
+            1e16, 1e17, 1e18, 1e19, 1e20, 1e21, 1e22};
+        // mant is exact (< 2^53), P10[k] is exact: ONE rounding step
+        v = exp10 >= 0 ? (double)mant * P10[exp10]
+                       : (double)mant / P10[-exp10];
+    } else {
+        // bounded copy: strtod never sees past the number. 512 matches
+        // the string landing pads; a >511-char number token still
+        // truncates (no real wire shape comes close)
+        char nbuf[512];
+        size_t ln = (size_t)(p - start);
+        if (ln >= sizeof nbuf) ln = sizeof nbuf - 1;
+        memcpy(nbuf, start, ln);
+        nbuf[ln] = 0;
+        v = strtod(nbuf, nullptr);
+        sc.p = p;
+        return v;        // sign already in the copied text
+    }
+    sc.p = p;
+    return neg ? -v : v;
+}
+#endif
 
 // skip any JSON value
 static void skip_value(Scanner& sc);
@@ -416,12 +496,16 @@ void swtpu_decoder_destroy(Decoder* d) {
    // entry points and the Python-list entry points — swtpu_py.cpp —
    // share ONE loop body with zero indirection cost)
 
+// ``aux0_stride`` lets the caller aim out_aux0 at a strided column of a
+// wider staging arena (row i lands at out_aux0[i * aux0_stride]); the
+// plain batch entry points pass 1.
 template <class GetMsg>
 static int32_t decode_json_impl(
     Decoder* d, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
-    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions,
+    int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_level, int32_t* out_collisions,
     GetMsg get_msg) {
     int32_t ok_count = 0;
     int32_t collisions = 0;
@@ -431,7 +515,7 @@ static int32_t decode_json_impl(
         out_rtype[i] = -1;
         out_token[i] = -1;
         out_ts[i] = -1;
-        out_aux0[i] = -1;
+        out_aux0[(size_t)i * aux0_stride] = -1;
         out_level[i] = 0;
         memset(out_values + (size_t)i * channels, 0, sizeof(float) * channels);
         memset(out_chmask + (size_t)i * channels, 0, channels);
@@ -440,7 +524,11 @@ static int32_t decode_json_impl(
         Scanner sc{mm.first, mm.second, true};
         if (!expect(sc, '{')) continue;
         int rtype = RT_UNKNOWN;
-        int32_t token = -1;
+        // deviceToken takes precedence over hardwareId (route_json_impl
+        // and the Python partitioner agree); within one key the last
+        // occurrence wins (json.loads dict semantics)
+        int32_t token_dt = -1;
+        int32_t token_hw = -1;
         bool in_request_done = false;
         bool first = true;
         bool failed = false;
@@ -454,12 +542,14 @@ static int32_t decode_json_impl(
             int klen = parse_string_view(sc, &kp, sbuf, sizeof(sbuf));
             if (klen < 0 || !expect(sc, ':')) { failed = true; break; }
 
-            if ((klen == 11 && !memcmp(kp, "deviceToken", 11)) ||
-                (klen == 10 && !memcmp(kp, "hardwareId", 10))) {
+            bool k_dt = (klen == 11 && !memcmp(kp, "deviceToken", 11));
+            if (k_dt || (klen == 10 && !memcmp(kp, "hardwareId", 10))) {
                 const char* vp;
                 int n = parse_string_view(sc, &vp, sbuf, sizeof(sbuf));
                 if (n < 0) { failed = true; break; }
-                token = swtpu_intern(d->tokens, vp, n);
+                int32_t tid = swtpu_intern(d->tokens, vp, n);
+                if (k_dt) token_dt = tid;
+                else token_hw = tid;
             } else if (klen == 4 && !memcmp(kp, "type", 4)) {
                 const char* vp;
                 int n = parse_string_view(sc, &vp, sbuf, sizeof(sbuf));
@@ -574,7 +664,9 @@ static int32_t decode_json_impl(
                         if (memcmp(rkp, "type", 4)) { handled = false; break; }
                         const char* vp;
                         int n = parse_string_view(sc, &vp, sbuf, sizeof(sbuf));
-                        if (n >= 0) out_aux0[i] = swtpu_intern(d->alert_types, vp, n);
+                        if (n >= 0)
+                            out_aux0[(size_t)i * aux0_stride] =
+                                swtpu_intern(d->alert_types, vp, n);
                         break;
                     }
                     default:
@@ -606,6 +698,7 @@ static int32_t decode_json_impl(
             }
         }
 
+        int32_t token = token_dt >= 0 ? token_dt : token_hw;
         if (!failed && sc.ok && rtype != RT_UNKNOWN && token >= 0) {
             out_rtype[i] = rtype;
             out_token[i] = token;
@@ -630,7 +723,8 @@ static int32_t decode_binary_impl(
     Decoder* d, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
-    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions,
+    int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_level, int32_t* out_collisions,
     GetMsg get_msg) {
     // wire type id -> ReqType (ingest/decoders.py _BIN_TYPES)
     static const int32_t WIRE2RT[6] = {RT_UNKNOWN, RT_MEASUREMENT,
@@ -642,7 +736,7 @@ static int32_t decode_binary_impl(
         out_rtype[i] = -1;
         out_token[i] = -1;
         out_ts[i] = -1;
-        out_aux0[i] = -1;
+        out_aux0[(size_t)i * aux0_stride] = -1;
         out_level[i] = 0;
         memset(out_values + (size_t)i * channels, 0,
                sizeof(float) * channels);
@@ -707,7 +801,8 @@ static int32_t decode_binary_impl(
             if (!need(2)) continue;
             uint16_t tl = u16();
             if (!need((size_t)tl + 1)) continue;
-            out_aux0[i] = swtpu_intern(d->alert_types, (const char*)p, tl);
+            out_aux0[(size_t)i * aux0_stride] =
+                swtpu_intern(d->alert_types, (const char*)p, tl);
             p += tl;
             out_level[i] = *p++;
         }
@@ -857,7 +952,7 @@ int32_t swtpu_decode_batch(
     float* out_values, uint8_t* out_chmask,
     int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
     return decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
-                            out_ts, out_values, out_chmask, out_aux0,
+                            out_ts, out_values, out_chmask, out_aux0, 1,
                             out_level, out_collisions,
                             PackedMsgs{buf, offsets});
 }
@@ -869,9 +964,34 @@ int32_t swtpu_decode_binary_batch(
     float* out_values, uint8_t* out_chmask,
     int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
     return decode_binary_impl(d, n_msgs, channels, out_rtype, out_token,
-                              out_ts, out_values, out_chmask, out_aux0,
+                              out_ts, out_values, out_chmask, out_aux0, 1,
                               out_level, out_collisions,
                               PackedMsgs{buf, offsets});
+}
+
+// Arena-fill entry point: identical decode contract, but out_aux0 is a
+// STRIDED column (row i at out_aux0[i * aux0_stride]) so the scanner
+// writes straight into the aux[:, 0] lane of a preallocated SoA staging
+// arena — the engine's zero-copy batch ingest path points every output
+// at arena column slices and no intermediate decode buffer ever exists.
+// ``binary`` selects the flat-binary wire decoder over the JSON scanner.
+int32_t swtpu_decode_arena_batch(
+    Decoder* d,
+    const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
+    int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
+    float* out_values, uint8_t* out_chmask,
+    int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_level, int32_t* out_collisions, int32_t binary) {
+    return binary
+               ? decode_binary_impl(d, n_msgs, channels, out_rtype,
+                                    out_token, out_ts, out_values,
+                                    out_chmask, out_aux0, aux0_stride,
+                                    out_level, out_collisions,
+                                    PackedMsgs{buf, offsets})
+               : decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
+                                  out_ts, out_values, out_chmask, out_aux0,
+                                  aux0_stride, out_level, out_collisions,
+                                  PackedMsgs{buf, offsets});
 }
 
 }  // extern "C"

@@ -74,10 +74,55 @@ int32_t swtpu_decode_pylist(
     ok = binary
              ? decode_binary_impl(d, n_msgs, channels, out_rtype, out_token,
                                   out_ts, out_values, out_chmask, out_aux0,
-                                  out_level, out_collisions, get)
+                                  1, out_level, out_collisions, get)
              : decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
                                 out_ts, out_values, out_chmask, out_aux0,
-                                out_level, out_collisions, get);
+                                1, out_level, out_collisions, get);
+    Py_END_ALLOW_THREADS
+    for (int32_t i = 0; i < n_msgs; i++) Py_DECREF(t_objs[i]);
+    return ok;
+}
+
+// Arena-fill variant of swtpu_decode_pylist: out_aux0 is a strided
+// column (row i at out_aux0[i * aux0_stride]) aimed at the aux[:, 0]
+// lane of a SoA staging arena; every other output points at arena
+// column slices. Same GIL contract as swtpu_decode_pylist.
+int32_t swtpu_decode_arena_pylist(
+    Decoder* d, void* pylist, int32_t n_msgs, int32_t channels,
+    int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
+    float* out_values, uint8_t* out_chmask,
+    int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_level, int32_t* out_collisions,
+    int32_t binary) {
+    PyObject* list = (PyObject*)pylist;
+    if (!PyList_CheckExact(list) || PyList_GET_SIZE(list) < n_msgs)
+        return -1;
+    t_ptrs.resize(n_msgs);
+    t_lens.resize(n_msgs);
+    t_objs.resize(n_msgs);
+    for (int32_t i = 0; i < n_msgs; i++) {
+        PyObject* o = PyList_GET_ITEM(list, i);
+        if (!PyBytes_CheckExact(o)) {
+            for (int32_t j = 0; j < i; j++) Py_DECREF(t_objs[j]);
+            return -1;
+        }
+        Py_INCREF(o);
+        t_objs[i] = o;
+        t_ptrs[i] = PyBytes_AS_STRING(o);
+        t_lens[i] = (int64_t)PyBytes_GET_SIZE(o);
+    }
+    SpanMsgs get{t_ptrs.data(), t_lens.data()};
+    int32_t ok;
+    Py_BEGIN_ALLOW_THREADS
+    ok = binary
+             ? decode_binary_impl(d, n_msgs, channels, out_rtype, out_token,
+                                  out_ts, out_values, out_chmask, out_aux0,
+                                  aux0_stride, out_level, out_collisions,
+                                  get)
+             : decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
+                                out_ts, out_values, out_chmask, out_aux0,
+                                aux0_stride, out_level, out_collisions,
+                                get);
     Py_END_ALLOW_THREADS
     for (int32_t i = 0; i < n_msgs; i++) Py_DECREF(t_objs[i]);
     return ok;

@@ -1,0 +1,62 @@
+"""Version-bridging shims over the jax API surface.
+
+The codebase targets the current jax API (``jax.shard_map``,
+``pallas.tpu.CompilerParams``); older runtimes (jax 0.4.x) ship the same
+functionality under previous names. Every version-sensitive call goes
+through this module so a runtime bump is a one-file change.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` on current jax; the ``jax.experimental.shard_map``
+    spelling (with ``check_vma`` mapped to its old ``check_rep`` name) on
+    0.4.x runtimes."""
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
+
+
+def set_cpu_device_count(n: int) -> None:
+    """Force ``n`` virtual CPU devices BEFORE any backend initializes:
+    ``jax_num_cpu_devices`` on current jax, the
+    ``--xla_force_host_platform_device_count`` XLA flag on 0.4.x."""
+    import os
+
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+
+
+def pcast(x, axis_name, to):
+    """``jax.lax.pcast`` on current jax (the manual-axes varying-type
+    cast inside shard_map); identity on 0.4.x runtimes, whose shard_map
+    has no varying/manual-axes type system to satisfy."""
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to=to)
+    return x
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (current) / ``pltpu.TPUCompilerParams``
+    (jax 0.4.x) — identical field set for the options used here."""
+    import jax.experimental.pallas.tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kwargs)

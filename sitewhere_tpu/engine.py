@@ -165,10 +165,11 @@ class IngestHostMixin:
         if self.wal is None or getattr(self._wal_local, "depth", 0):
             return
         head = tag + tenant.encode() + b"\x00"
-        for p in payloads:
-            self.wal.append(head + p)
-        # push to the OS now: an accepted event must survive a process
-        # crash (fsync cadence stays the operator's sync() call)
+        # ONE buffered write for the whole group, then one flush: an
+        # accepted event must survive a process crash (fsync cadence
+        # stays the operator's sync() call), and a write() per record
+        # was a measurable slice of the batch staging budget
+        self.wal.append_many(payloads, head)
         self.wal.flush()
 
     @contextlib.contextmanager
@@ -182,7 +183,7 @@ class IngestHostMixin:
             self._wal_local.depth -= 1
 
     def _ingest_batch(self, payloads: list[bytes], tenant: str, tag: bytes,
-                      dec, native_fn) -> dict:
+                      dec, native_fn, binary: bool = False) -> dict:
         """Common batch-ingest skeleton: strict validation -> WAL -> stage.
         ``native_fn`` is the native SoA decoder call (None = Python path)."""
         if native_fn is None:
@@ -201,6 +202,14 @@ class IngestHostMixin:
                 self._check_strict_native(res, names_before)
                 self._wal_append(tag, payloads, tenant)
                 return self._ingest_decoded(res, payloads, tenant, dec)
+        if getattr(self, "_arena_pool", None) is not None \
+                and not self.config.fair_tenancy:
+            # zero-copy path: the native scanner fills the staging arena
+            # directly — no decode output arrays, no staging copy. Decode
+            # runs UNDER the lock (the arena is shared mutable state);
+            # cross-thread decode parallelism is the worker pool's job.
+            return self._ingest_batch_arena(payloads, tenant, tag, dec,
+                                            binary)
         # lenient fast path: decode OUTSIDE the lock (concurrent receivers
         # decode in parallel); log + stage atomically
         res = native_fn(payloads)
@@ -454,6 +463,12 @@ class EngineConfig:
                                        # tenant's burst can only evict its
                                        # own arena's rows (hard retention
                                        # isolation)
+    ingest_arenas: int = 0             # staging-arena pool for the
+                                       # zero-copy batch ingest path:
+                                       # 0 = auto (dispatch_depth + 2),
+                                       # -1 disables (legacy copy staging).
+                                       # Each arena holds
+                                       # batch_capacity * scan_chunk rows
 
 
 @dataclasses.dataclass
@@ -735,6 +750,32 @@ class Engine(IngestHostMixin):
                                           # scan-chunk dispatch
         self._sweep = make_presence_sweep()
         self._buf = HostEventBuffer(c.batch_capacity, c.channels)
+        # zero-copy arena ingest (native batch decode only): the scanner
+        # writes straight into pooled SoA staging buffers that the jit
+        # step transfers without any intermediate copy. At scan_chunk==1
+        # an arena batch has the SAME shape as a legacy staged batch, so
+        # both paths share ONE compiled program; scan_chunk>1 consumes a
+        # whole K-lane arena with make_arena_scan_step.
+        self._arena_pool = None
+        self._arena_fill = None
+        self._arena_step = None
+        self._arena_committing = False
+        self._arena_dispatches = 0
+        if (self._native_decoder is not None and c.ingest_arenas >= 0
+                and self._native_decoder.has_arena):
+            from sitewhere_tpu.ingest.arena import ArenaPool
+
+            k = max(1, c.scan_chunk)
+            n_arenas = c.ingest_arenas or max(1, c.dispatch_depth) + 2
+            self._arena_pool = ArenaPool(
+                n_arenas, c.batch_capacity * k, c.channels, lanes=k)
+            if k > 1:
+                from sitewhere_tpu.pipeline import make_arena_scan_step
+
+                self._arena_step = make_arena_scan_step(
+                    PipelineConfig(auto_register=c.auto_register,
+                                   default_device_type=0),
+                    c.batch_capacity, c.channels, k)
         self._last_flush = time.monotonic()
         # host mirrors
         self.devices: dict[int, DeviceInfo] = {}      # device_id -> info
@@ -795,12 +836,21 @@ class Engine(IngestHostMixin):
     @property
     def staged_count(self) -> int:
         return (len(self._buf) + self._fair_queued
+                + (self._arena_fill.cursor if self._arena_fill is not None
+                   else 0)
                 + sum(int(np.sum(b.valid)) for b in self._staged_batches))
 
     def _sync_mirrors(self) -> None:
         """Make host mirrors current: run any staged batch and absorb any
-        pending async outputs. Caller holds the lock."""
-        while len(self._buf) or self._fair_queued:
+        pending async outputs. Caller holds the lock. The fill arena is
+        NOT waited on mid-commit (a registration envelope's admin path
+        re-enters here while the arena's valid mask is still being
+        built — flush_async refuses to dispatch it, so waiting would
+        spin forever); the committed rows dispatch when the commit
+        finishes."""
+        while (len(self._buf) or self._fair_queued
+               or (self._arena_fill is not None and self._arena_fill.cursor
+                   and not self._arena_committing)):
             self.flush_async()
         if self._staged_batches:
             self._dispatch_staged(all_batches=True)
@@ -812,6 +862,8 @@ class Engine(IngestHostMixin):
                    aux0, aux1):
         """Stage one converted event row (called by the mixin's process());
         flushes when the batch fills. Caller holds the lock."""
+        self.host_counters["staged_copy_rows"] = \
+            self.host_counters.get("staged_copy_rows", 0) + 1
         if self.config.fair_tenancy:
             i32 = np.int32
             has_vals = mask is not None and (mask.any() or values.any())
@@ -906,7 +958,8 @@ class Engine(IngestHostMixin):
 
         return self._ingest_batch(
             payloads, tenant, WAL_JSON, JsonDeviceRequestDecoder(),
-            self._native_decoder.decode if self._native_decoder else None)
+            self._native_decoder.decode if self._native_decoder else None,
+            binary=False)
 
     def ingest_binary_batch(self, payloads: list[bytes],
                             tenant: str = "default") -> dict:
@@ -917,12 +970,174 @@ class Engine(IngestHostMixin):
         return self._ingest_batch(
             payloads, tenant, WAL_BINARY, BinaryEventDecoder(),
             self._native_decoder.decode_binary if self._native_decoder
-            else None)
+            else None, binary=True)
+
+    # ------------------------------------------------------------ arena ingest
+    def _ingest_batch_arena(self, payloads, tenant, tag, reg_decoder,
+                            binary: bool) -> dict:
+        """Zero-copy batch ingest: the native scanner decodes straight
+        into the fill arena at its cursor, the commit pass runs a few
+        vectorized in-place transforms, and full arenas dispatch without
+        any staging copy. WAL-before-stage ordering is preserved: the
+        group append (one write + one flush per chunk) lands before any
+        row of the chunk can dispatch."""
+        summary = {"decoded": 0, "failed": 0, "staged": 0}
+        n = len(payloads)
+        with self.lock:
+            now = self.epoch.now_ms()
+            base_ms = int(self.epoch.base_unix_s * 1000)
+            pos = 0
+            while pos < n:
+                arena = self._arena_fill
+                if arena is None:
+                    arena = self._arena_fill = self._arena_pool.acquire()
+                take = min(n - pos, arena.room)
+                chunk = (payloads if take == n
+                         else payloads[pos:pos + take])
+                lo = arena.cursor
+                n_ok, collisions = self._native_decoder.decode_into(
+                    chunk, arena, lo, binary=binary)
+                self._wal_append(tag, chunk, tenant)
+                self._arena_commit(arena, lo, take, chunk, tenant,
+                                   reg_decoder, now, base_ms, summary)
+                self.channel_map.collisions += collisions
+                arena.cursor = lo + take
+                if arena.room == 0:
+                    self._dispatch_arena()
+                pos += take
+        return summary
+
+    def _ingest_decoded_arena(self, res, payloads, tenant,
+                              reg_decoder) -> dict:
+        """Stage an externally decoded SoA batch (the worker pool's
+        shared-memory outputs, or the in-process fallback) through the
+        arena path: ONE vectorized copy of the decode columns into the
+        fill arena, then the shared commit — no DecodedArrays copies, no
+        HostEventBuffer, no emit-time reallocation. Caller has already
+        WAL-logged the raw batch."""
+        summary = {"decoded": 0, "failed": 0, "staged": 0}
+        n = len(res.rtype)
+        with self.lock:
+            now = self.epoch.now_ms()
+            base_ms = int(self.epoch.base_unix_s * 1000)
+            pos = 0
+            while pos < n:
+                arena = self._arena_fill
+                if arena is None:
+                    arena = self._arena_fill = self._arena_pool.acquire()
+                take = min(n - pos, arena.room)
+                lo, hi = arena.cursor, arena.cursor + take
+                sl = slice(pos, pos + take)
+                arena.rtype[lo:hi] = res.rtype[sl]
+                arena.token_id[lo:hi] = res.token_id[sl]
+                arena.ts64[lo:hi] = res.ts_ms64[sl]
+                arena.values[lo:hi] = res.values[sl]
+                arena.vmask[lo:hi] = res.chmask[sl]
+                arena.aux[lo:hi, 0] = res.aux0[sl]
+                arena.level[lo:hi] = res.level[sl]
+                self._arena_commit(arena, lo, take,
+                                   payloads[pos:pos + take], tenant,
+                                   reg_decoder, now, base_ms, summary)
+                arena.cursor = hi
+                if arena.room == 0:
+                    self._dispatch_arena()
+                pos += take
+            self.channel_map.collisions += res.collisions
+        return summary
+
+    def _arena_commit(self, arena, lo, n, payloads, tenant, reg_decoder,
+                      now, base_ms, summary) -> None:
+        """Make arena rows [lo, lo+n) live: map request types to event
+        types, relativize timestamps, fold alert levels, fill the
+        batch-constant columns — all vectorized, in place, no row-level
+        Python. Registration/mapping/ack envelopes re-route through the
+        per-request path (they carry string payloads the fast columns
+        don't extract). Caller holds the lock."""
+        from sitewhere_tpu.ingest.fast_decode import (
+            RT_ACK,
+            RT_MAP,
+            RT_REGISTER,
+            RTYPE_TO_ETYPE,
+        )
+
+        hi = lo + n
+        rt = arena.rtype[lo:hi]
+        etype = arena.etype[lo:hi]
+        np.take(RTYPE_TO_ETYPE, np.clip(rt, -1, 7), out=etype)
+        ok = (rt >= 0) & (etype >= 0)
+        regs = ((rt == RT_REGISTER) | (rt == RT_MAP) | (rt == RT_ACK))
+        ok &= ~regs
+        failed = int(np.sum(rt < 0))
+        n_reg_ok = 0
+        if regs.any():
+            # slow-path envelopes may stage per-request rows into _buf,
+            # whose fill-triggered flush must NOT dispatch this arena
+            # mid-commit (its valid mask is not set yet)
+            self._arena_committing = True
+            try:
+                with self._wal_suppress():   # raw batch already logged
+                    for i in np.nonzero(regs)[0]:
+                        try:
+                            for req in reg_decoder.decode(
+                                    payloads[int(i)], {}):
+                                req.tenant = tenant
+                                self.process(req)
+                            n_reg_ok += 1
+                        except Exception:
+                            failed += 1
+            finally:
+                self._arena_committing = False
+        ts64 = arena.ts64[lo:hi]
+        # relative int32 timestamps (absent -> now); the clip bounds the
+        # int64->int32 cast of the slice assignment
+        rel = np.clip(ts64 - base_ms, -(2**31) + 1, 2**31 - 1)
+        arena.ts_ms[lo:hi] = np.where(ts64 >= 0, rel, now)
+        arena.received_ms[lo:hi] = now
+        arena.tenant_id[lo:hi] = self.tenants.intern(tenant)
+        arena.aux[lo:hi, 1] = NULL_ID   # aux0 was written by the decoder
+        alert_rows = ok & (etype == int(EventType.ALERT))
+        if alert_rows.any():
+            # alert rows carry their level in values[:, 0]
+            arena.values[lo:hi][alert_rows, 0] = \
+                arena.level[lo:hi][alert_rows]
+        arena.valid[lo:hi] = ok
+        staged = int(np.sum(ok))
+        summary["decoded"] += staged + n_reg_ok
+        summary["failed"] += failed
+        summary["staged"] += staged
+        self.host_counters["arena_rows"] = \
+            self.host_counters.get("arena_rows", 0) + staged
+
+    def _dispatch_arena(self) -> None:
+        """Dispatch the fill arena (full or partial — rows past the
+        cursor are masked invalid, free padding) and retire it to the
+        pool; it recycles once its step output is ready, which proves
+        the host->device transfer of its buffers completed. Caller holds
+        the lock."""
+        arena = self._arena_fill
+        if arena is None or arena.cursor == 0:
+            return
+        arena.valid[arena.cursor:] = False
+        step = self._arena_step or self._step
+        self.state, out = step(self.state, arena.view_batch())
+        self._enqueue_out(out)
+        self._arena_pool.retire(arena, out.n_persisted)
+        self._archive_account(arena.cursor * MAX_ACTIVE_ASSIGNMENTS)
+        self._arena_fill = None
+        # plain attribute, NOT a metrics key: dispatch counts differ by
+        # batching shape (scan_chunk), and metrics() equality across
+        # dispatch configs is a tested parity property
+        self._arena_dispatches += 1
+        self._last_flush = time.monotonic()
 
     def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
         """Stage a natively decoded SoA batch (shared by the JSON and binary
         fast paths); registration envelopes re-decode on the slow path for
         their string metadata."""
+        if (getattr(self, "_arena_pool", None) is not None
+                and not self.config.fair_tenancy):
+            return self._ingest_decoded_arena(res, payloads, tenant,
+                                              reg_decoder)
         with self.lock:
             now = self.epoch.now_ms()
             base_ms = int(self.epoch.base_unix_s * 1000)
@@ -983,6 +1198,10 @@ class Engine(IngestHostMixin):
             if self._buf.full:
                 self.flush_async()
             self.channel_map.collisions += res.collisions
+            # rows that took the copy-staging path (bench reports these
+            # per batch to prove the arena path stays copy-free)
+            self.host_counters["staged_copy_rows"] = \
+                self.host_counters.get("staged_copy_rows", 0) + staged
             return {"decoded": int(np.sum(ok)) + n_reg_ok, "failed": failed,
                     "staged": staged}
 
@@ -993,8 +1212,9 @@ class Engine(IngestHostMixin):
         with self.lock:
             expired = (time.monotonic() - self._last_flush
                        >= self.config.flush_interval_s)
-            if (len(self._buf) or self._fair_queued
-                    or self._staged_batches) and expired:
+            if (len(self._buf) or self._fair_queued or self._staged_batches
+                    or (self._arena_fill is not None
+                        and self._arena_fill.cursor)) and expired:
                 return self.flush()
             if self._pending_outs and expired:
                 return _merge_summaries(self.drain())
@@ -1029,6 +1249,12 @@ class Engine(IngestHostMixin):
             # was toggled off afterwards — queued rows must never strand)
             if self._fair_queued:
                 self._form_fair_batch()
+            # a partially filled arena flushes too — but never mid-commit
+            # (its valid mask is not final) — so the latency budget bounds
+            # the arena path exactly like the legacy buffer
+            if (self._arena_fill is not None and self._arena_fill.cursor
+                    and not self._arena_committing):
+                self._dispatch_arena()
             if not len(self._buf):
                 return
             n_staged = len(self._buf)
@@ -1096,7 +1322,9 @@ class Engine(IngestHostMixin):
         steady-state ingest loop synchronizes with this barrier and defers
         drain() — which does read — to reporting boundaries."""
         with self.lock:
-            while len(self._buf) or self._fair_queued:
+            while (len(self._buf) or self._fair_queued
+                   or (self._arena_fill is not None
+                       and self._arena_fill.cursor)):
                 self.flush_async()
             self._dispatch_staged(all_batches=True)
             if self._pending_outs:
@@ -1929,6 +2157,9 @@ class Engine(IngestHostMixin):
             "reg_overflow": int(m.reg_overflow),
             "channel_collisions": self.channel_map.collisions,
             "staged": len(self._buf),
+            **({"arena_pool_waits": self._arena_pool.waits,
+                "arena_pool_size": self._arena_pool.n_arenas}
+               if self._arena_pool is not None else {}),
             **({"archived_rows": self.archive.total_rows(),
                 "archive_lost_rows": self.archive.lost_rows}
                if self.archive is not None else {}),

@@ -1,0 +1,171 @@
+"""Zero-copy ingest staging arenas.
+
+The legacy batch path moves every decoded event through three host
+buffers before the chip sees it: the decoder allocates fresh SoA output
+arrays, ``_ingest_decoded`` copies the accepted rows into the
+``HostEventBuffer``, and ``emit()`` re-allocates the buffer for the next
+batch. On a 1-core driver those copies and allocations are a large slice
+of the ~30x gap between the fused device step and the host e2e rate
+(ISSUE 2 / BENCH_r05).
+
+A :class:`StagingArena` is ONE preallocated SoA buffer holding both the
+decoder's scratch columns (``rtype``/``ts64``/``level``) and the final
+``EventBatch`` columns. The native scanner writes straight into the
+final columns (``swtpu_decode_arena_*`` entry points take the arena's
+column slices, including a strided ``aux[:, 0]`` lane), the commit pass
+runs a handful of vectorized in-place transforms (type map, timestamp
+relativization, alert-level fold), and the dispatch hands the SAME
+arrays to the jit step — zero row-level Python, zero staging copies,
+zero per-batch allocation.
+
+The :class:`ArenaPool` rotates a small fixed set of arenas through
+in-flight dispatches: an arena is recycled only once the step output it
+fed reports ready (``jax.block_until_ready``), which guarantees the
+host->device transfer of its arrays has completed — mutating a numpy
+buffer while a transfer is still reading it would corrupt the batch.
+With ``dispatch_depth`` >= 2 and ``n_arenas`` > depth, decode of batch
+N+1 overlaps transfer/execution of batch N. An exhausted pool blocks on
+the OLDEST in-flight dispatch (backpressure, counted in
+``waits``) rather than allocating.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from sitewhere_tpu.core.events import EventBatch
+from sitewhere_tpu.core.types import AUX_LANES, NULL_ID
+
+
+class StagingArena:
+    """One preallocated SoA staging buffer of ``rows`` event slots.
+
+    ``rows`` is ``batch_capacity * scan_chunk``: with ``scan_chunk`` K > 1
+    the arena is consumed as K scan lanes of ``rows // K`` by the arena
+    scan step (``pipeline.make_arena_scan_step``) — the ``seq`` column is
+    pre-tiled per lane. ``cursor`` is the fill position; rows past the
+    cursor at dispatch are masked invalid (free padding)."""
+
+    __slots__ = ("rows", "channels", "lanes", "cursor",
+                 "valid", "etype", "token_id", "tenant_id", "ts_ms",
+                 "received_ms", "values", "vmask", "aux", "seq",
+                 "rtype", "ts64", "level")
+
+    def __init__(self, rows: int, channels: int, lanes: int = 1):
+        if rows % max(1, lanes):
+            raise ValueError(f"arena rows {rows} not divisible by "
+                             f"{lanes} scan lanes")
+        self.rows = rows
+        self.channels = channels
+        self.lanes = max(1, lanes)
+        self.cursor = 0
+        # final EventBatch columns (the decoder + commit write these)
+        self.valid = np.zeros(rows, np.bool_)
+        self.etype = np.zeros(rows, np.int32)
+        self.token_id = np.full(rows, NULL_ID, np.int32)
+        self.tenant_id = np.full(rows, NULL_ID, np.int32)
+        self.ts_ms = np.zeros(rows, np.int32)
+        self.received_ms = np.zeros(rows, np.int32)
+        self.values = np.zeros((rows, channels), np.float32)
+        # uint8 storage, viewed as bool for the EventBatch (same layout);
+        # the native decoder ABI wants uint8
+        self.vmask = np.zeros((rows, channels), np.uint8)
+        self.aux = np.full((rows, AUX_LANES), NULL_ID, np.int32)
+        self.seq = np.tile(np.arange(rows // self.lanes, dtype=np.int32),
+                           self.lanes)
+        # decoder scratch columns (host-only, never transferred)
+        self.rtype = np.empty(rows, np.int32)
+        self.ts64 = np.empty(rows, np.int64)
+        self.level = np.empty(rows, np.int32)
+
+    @property
+    def room(self) -> int:
+        return self.rows - self.cursor
+
+    def view_batch(self) -> EventBatch:
+        """The full-capacity numpy-backed EventBatch over the arena's
+        arrays (no copies; rows past the cursor must already be masked
+        invalid by the dispatcher)."""
+        return EventBatch(
+            valid=self.valid,
+            etype=self.etype,
+            token_id=self.token_id,
+            tenant_id=self.tenant_id,
+            ts_ms=self.ts_ms,
+            received_ms=self.received_ms,
+            values=self.values,
+            vmask=self.vmask.view(np.bool_),
+            aux=self.aux,
+            seq=self.seq,
+        )
+
+    def reset(self) -> None:
+        """Make the arena fillable again. Stale column contents are inert
+        (every row is dead until the next commit sets its ``valid``); the
+        valid mask itself is cleared so a stale True can never leak
+        through a partial dispatch."""
+        self.cursor = 0
+        self.valid[:] = False
+
+
+class ArenaPool:
+    """Fixed pool of staging arenas rotating through in-flight dispatches.
+
+    Not thread-safe by itself — the engine serializes acquire/retire
+    under its lock (the same discipline as every other staging mutation).
+    """
+
+    def __init__(self, n_arenas: int, rows: int, channels: int,
+                 lanes: int = 1):
+        if n_arenas < 1:
+            raise ValueError("arena pool needs at least one arena")
+        self.n_arenas = n_arenas
+        self._free: list[StagingArena] = [
+            StagingArena(rows, channels, lanes) for _ in range(n_arenas)]
+        # (arena, ticket): ticket is any array from the dispatch that fed
+        # on the arena; ticket-ready implies the transfer out of the
+        # arena's host buffers has completed
+        self._inflight: collections.deque = collections.deque()
+        self.waits = 0   # times acquire had to block on the oldest dispatch
+
+    def acquire(self) -> StagingArena:
+        """A fillable arena; blocks on the oldest in-flight dispatch when
+        every arena is tied up (ingest backpressure)."""
+        self._reclaim_ready()
+        if not self._free:
+            self.waits += 1
+            self._reclaim_oldest()
+        return self._free.pop()
+
+    def retire(self, arena: StagingArena, ticket) -> None:
+        """Hand a dispatched arena back; it recycles once ``ticket`` is
+        ready."""
+        self._inflight.append((arena, ticket))
+
+    def _reclaim_oldest(self) -> None:
+        import jax
+
+        arena, ticket = self._inflight.popleft()
+        jax.block_until_ready(ticket)
+        arena.reset()
+        self._free.append(arena)
+
+    def _reclaim_ready(self) -> None:
+        """Opportunistically recycle arenas whose dispatches already
+        finished (no blocking)."""
+        while self._inflight:
+            ticket = self._inflight[0][1]
+            is_ready = getattr(ticket, "is_ready", None)
+            if is_ready is None or not is_ready():
+                return
+            arena, _ = self._inflight.popleft()
+            arena.reset()
+            self._free.append(arena)
+
+    def drain(self) -> None:
+        """Block until every in-flight arena is reclaimable (shutdown /
+        test barrier)."""
+        while self._inflight:
+            self._reclaim_oldest()

@@ -148,6 +148,57 @@ class NativeBatchDecoder:
         ))
         return n_ok, int(collisions.value)
 
+    @property
+    def has_arena(self) -> bool:
+        """Arena-fill entry points present in the loaded libraries."""
+        return bool(getattr(self.lib, "_swtpu_has_arena", False))
+
+    def decode_into(self, payloads: list[bytes], arena, lo: int,
+                    *, binary: bool = False) -> tuple[int, int]:
+        """Decode ``payloads`` straight into ``arena`` rows
+        [lo, lo + len(payloads)): the scanner's outputs are the arena's
+        own column slices (zero-copy staging; the aux[:, 0] lane is
+        written strided in place). Same no-concurrent-mutation contract
+        as :meth:`decode`. Returns (n_ok, channel_collisions)."""
+        n = len(payloads)
+        hi = lo + n
+        if hi > arena.rows:
+            raise ValueError(f"{n} payloads exceed arena room "
+                             f"{arena.rows - lo}")
+        c = ctypes
+        collisions = c.c_int32(0)
+
+        def ptr(a, t):
+            return a.ctypes.data_as(c.POINTER(t))
+
+        args = (
+            ptr(arena.rtype[lo:hi], c.c_int32),
+            ptr(arena.token_id[lo:hi], c.c_int32),
+            ptr(arena.ts64[lo:hi], c.c_int64),
+            ptr(arena.values[lo:hi], c.c_float),
+            ptr(arena.vmask[lo:hi], c.c_uint8),
+            ptr(arena.aux[lo:hi], c.c_int32), c.c_int64(arena.aux.shape[1]),
+            ptr(arena.level[lo:hi], c.c_int32),
+            c.byref(collisions), np.int32(1 if binary else 0),
+        )
+        if (self.py_lib is not None and type(payloads) is list
+                and getattr(self.py_lib, "_swtpu_has_arena", False)):
+            n_ok = int(self.py_lib.swtpu_decode_arena_pylist(
+                self.handle, payloads, np.int32(n),
+                np.int32(self.channels), *args))
+            if n_ok >= 0:
+                return n_ok, int(collisions.value)
+        # packed fallback (also covers non-list iterables of bytes)
+        payloads = list(payloads)
+        buf = b"".join(payloads)
+        offsets = np.zeros(n + 1, np.int64)
+        np.cumsum(np.fromiter(map(len, payloads), np.int64, n),
+                  out=offsets[1:])
+        n_ok = int(self.lib.swtpu_decode_arena_batch(
+            self.handle, buf, ptr(offsets, c.c_int64), np.int32(n),
+            np.int32(self.channels), *args))
+        return n_ok, int(collisions.value)
+
     def _decode(self, payloads: list[bytes], binary: bool) -> DecodedArrays:
         fast = self._decode_pylist(payloads, binary=binary)
         if fast is not None:

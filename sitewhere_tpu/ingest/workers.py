@@ -269,8 +269,12 @@ class DecodeWorkerPool:
                                                       RT_MEASUREMENT,
                                                       DecodedArrays)
 
+        # shm views, NOT copies: the engine's staging (arena copy or
+        # legacy buffer slices) completes synchronously inside
+        # _ingest_decoded below, before this worker can be handed its
+        # next batch — so the worker never overwrites a view in use.
         o = w.out
-        rtype = o["rtype"][:n].copy()
+        rtype = o["rtype"][:n]
         token = o["token"][:n]
         gtok = (w.tok_map[np.clip(token, 0, max(0, len(w.tok_map) - 1))]
                 if len(w.tok_map) else np.full(n, -1, np.int32))
@@ -279,7 +283,7 @@ class DecodeWorkerPool:
         # lane has a name behind it, hence an entry in lane_owner);
         # unmapped lanes must never overwrite a mapped engine lane
         if all(wl == el for wl, el in w.lane_owner.items()):
-            values = o["values"][:n].copy()
+            values = o["values"][:n]
             chmask = o["chmask"][:n].astype(bool)
         else:
             wl = np.fromiter(w.lane_owner.keys(), np.int64,
@@ -300,17 +304,22 @@ class DecodeWorkerPool:
             if np.any(nonmeas):
                 values[nonmeas] = raw_v[nonmeas]
                 chmask[nonmeas] = raw_m[nonmeas]
-        aux0 = o["aux0"][:n].copy()
+        aux0 = o["aux0"][:n]
         alert_rows = rtype == RT_ALERT
         if np.any(alert_rows) and len(w.alert_map):
+            # in-place alert-type translation on the shm view is safe:
+            # this slot is dead until the worker's next batch overwrites it
             aux0[alert_rows] = w.alert_map[
                 np.clip(aux0[alert_rows], 0, len(w.alert_map) - 1)]
         res = DecodedArrays(
             n_ok=int(np.sum(rtype >= 0)), rtype=rtype, token_id=gtok,
-            ts_ms64=o["ts"][:n].copy(), values=values, chmask=chmask,
-            aux0=aux0, level=o["level"][:n].copy(), collisions=collisions)
+            ts_ms64=o["ts"][:n], values=values, chmask=chmask,
+            aux0=aux0, level=o["level"][:n], collisions=collisions)
         with eng.lock:
             eng._wal_append(WAL_JSON, payloads, tenant)
+            # _ingest_decoded routes through the engine's staging arenas
+            # when they exist: ONE vectorized shm->arena copy replaces
+            # the DecodedArrays copies + HostEventBuffer staging pass
             return eng._ingest_decoded(res, payloads, tenant,
                                        JsonDeviceRequestDecoder())
 

@@ -69,6 +69,22 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.swtpu_decode_binary_batch.restype = c.c_int32
     lib.swtpu_decode_binary_batch.argtypes = lib.swtpu_decode_batch.argtypes
+    try:
+        # arena-fill entry point (strided aux0 column + json/binary flag);
+        # absent only in a stale prebuilt library — the arena ingest path
+        # then stays off while everything else keeps working
+        lib.swtpu_decode_arena_batch.restype = c.c_int32
+        lib.swtpu_decode_arena_batch.argtypes = [
+            c.c_void_p, c.c_char_p, c.POINTER(c.c_int64),
+            c.c_int32, c.c_int32,
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+            c.POINTER(c.c_int64), c.POINTER(c.c_float),
+            c.POINTER(c.c_uint8), c.POINTER(c.c_int32), c.c_int64,
+            c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32,
+        ]
+        lib._swtpu_has_arena = True
+    except AttributeError:
+        lib._swtpu_has_arena = False
     return lib
 
 
@@ -168,6 +184,17 @@ def load_py_library() -> "ctypes.PyDLL | None":
             lib.swtpu_route_pylist.argtypes = [
                 c.py_object, c.c_int32, c.c_int32,
                 c.POINTER(c.c_int32), c.c_int32]
+            try:
+                lib.swtpu_decode_arena_pylist.restype = c.c_int32
+                lib.swtpu_decode_arena_pylist.argtypes = [
+                    c.c_void_p, c.py_object, c.c_int32, c.c_int32,
+                    c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                    c.POINTER(c.c_int64), c.POINTER(c.c_float),
+                    c.POINTER(c.c_uint8), c.POINTER(c.c_int32), c.c_int64,
+                    c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32]
+                lib._swtpu_has_arena = True
+            except AttributeError:
+                lib._swtpu_has_arena = False
             _py_lib = lib
         except OSError as e:
             logger.info("py-bridge load failed (%s); packed path only", e)

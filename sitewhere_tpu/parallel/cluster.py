@@ -66,6 +66,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import concurrent.futures
 import dataclasses
 import json
 import logging
@@ -138,12 +139,16 @@ class _SyncPeer:
         fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
         try:
             return fut.result(self.timeout_s + self.grace_s)
-        except TimeoutError:
+        except (TimeoutError, concurrent.futures.TimeoutError) as e:
             # the coroutine is still running on the background loop —
             # cancel it so the shared client isn't left with a pending
             # future silently consuming the next response off the wire
             fut.cancel()
-            raise
+            if isinstance(e, TimeoutError):
+                raise
+            # Python < 3.11: the futures TimeoutError is NOT the builtin
+            # one — normalize so every downstream handler catches it
+            raise TimeoutError(*e.args) from None
 
     def _connect(self):
         from sitewhere_tpu.rpc.client import RpcClient

@@ -74,7 +74,9 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", devices_per_proc)
+    from sitewhere_tpu.compat import set_cpu_device_count
+
+    set_cpu_device_count(devices_per_proc)
 
     import asyncio
 
@@ -191,7 +193,18 @@ def worker_main(rank: int, scratch: str, rpc0: int, rpc1: int, rest0: int,
         assert mine == theirs, (rank, mine, theirs)
         assert mine["total"] == 2 * len(both), mine["total"]
         assert len(mine["search"]) == 2 * len(both), mine["search"]
-        m = cluster.metrics()
+        # the first metrics fan-out can catch the peer mid-compile on a
+        # starved host (one 45s RPC window < two ranks' worth of jax
+        # compiles on 2 cores) — retry unreachable peers within the
+        # phase budget instead of failing on the first window
+        deadline = time.monotonic() + PHASE_TIMEOUT_S
+        while True:
+            m = cluster.metrics()
+            unreachable = any(isinstance(v, dict) and v.get("unreachable")
+                              for v in m.get("by_rank", {}).values())
+            if not unreachable or time.monotonic() > deadline:
+                break
+            time.sleep(1.0)
         assert m["persisted"] == 2 * len(both), m
         # ---- entity plane: admin ONCE at rank 0, usable at rank 1 -----
         # (the reference's shared management DB; entity_sync.py)

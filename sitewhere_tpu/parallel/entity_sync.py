@@ -170,6 +170,8 @@ class EntityReplicator:
                          "compactions": 0, "state_transfers": 0}
         self._log = None
         self._log_dir = None
+        self._compacting = False           # journal snapshot in flight
+        self._compact_extra: list[dict] = []   # ops journaled mid-snapshot
         if log_dir is not None:
             from sitewhere_tpu.utils.ingestlog import IngestLog
 
@@ -283,11 +285,20 @@ class EntityReplicator:
         self._ops_by_origin.setdefault(int(op["origin"]), []).append(op)
         self._total_ops += 1
 
-    def _maybe_compact_locked(self) -> None:
-        if self._total_ops > self._next_compact_at:
-            self._compact_locked(self.compact_keep)
-            self._next_compact_at = max(self.compact_threshold,
-                                        2 * self._total_ops)
+    def _maybe_compact_prepare(self):
+        """Threshold check + in-memory compaction + journal snapshot,
+        all under the lock (caller holds it). Returns the prepared
+        payload for :meth:`_finish_compaction` — which the caller MUST
+        run after releasing the lock — or None when no compaction is
+        due. The journal rewrite (write + fsync of the whole dump) is
+        the expensive half and must not stall every concurrent mutator
+        behind the replicator lock."""
+        if self._total_ops <= self._next_compact_at or self._compacting:
+            return None
+        prep = self._compact_prepare_locked(self.compact_keep)
+        self._next_compact_at = max(self.compact_threshold,
+                                    2 * self._total_ops)
+        return prep
 
     def _emit(self, action, kind, token, state) -> None:
         with self._lock:
@@ -300,7 +311,7 @@ class EntityReplicator:
             self._remember(op)
             self._journal(op)
             self.counters["emitted"] += 1
-            self._maybe_compact_locked()
+            compact_prep = self._maybe_compact_prepare()
             if self.cluster.n_ranks > 1:
                 # start-check under the lock: two concurrent mutators
                 # must not race a SECOND pusher into existence (per-
@@ -312,6 +323,8 @@ class EntityReplicator:
                         daemon=True)
                     self._push_thread.start()
                 self._push_q.put(op)
+        if compact_prep is not None:
+            self._finish_compaction(compact_prep)
 
     def _journal(self, op: dict) -> None:
         if self._log is not None:
@@ -319,6 +332,12 @@ class EntityReplicator:
             # fsync per op: the admin plane is low-rate and a SIGKILL'd
             # rank must replay every acknowledged mutation
             self._log.sync()
+            if self._compacting:
+                # a compaction snapshot is being written out: this op is
+                # durable in the OLD journal, but the new journal's
+                # snapshot predates it — queue it so the swap appends it
+                # to the new journal before the rename
+                self._compact_extra.append(op)
 
     # ------------------------------------------------------- broadcast
     def _push_loop(self) -> None:
@@ -459,7 +478,9 @@ class EntityReplicator:
             self._remember(op)
             self._journal(op)
             self._apply_effect(op)
-            self._maybe_compact_locked()
+            compact_prep = self._maybe_compact_prepare()
+        if compact_prep is not None:
+            self._finish_compaction(compact_prep)
         return {"applied": True}
 
     def apply_batch(self, ops: list[dict]) -> int:
@@ -580,12 +601,12 @@ class EntityReplicator:
             self.counters["state_transfers"] += 1
             return n
 
-    def _compact_locked(self, keep_recent: int) -> None:
-        """Truncate the op index to the newest ``keep_recent`` per origin
-        and rewrite the journal as one state dump + the kept tail. Disk
-        and memory stay O(live entities + tail) for the cluster's whole
-        lifetime. The swap is crash-safe: the new journal is fully synced
-        before any rename, and __init__ finishes an interrupted swap."""
+    def _compact_prepare_locked(self, keep_recent: int):
+        """Phase 1 of compaction (lock held): truncate the op index to
+        the newest ``keep_recent`` per origin and SNAPSHOT everything the
+        journal rewrite needs. Disk and memory stay O(live entities +
+        tail) for the cluster's whole lifetime. Returns the payload for
+        :meth:`_finish_compaction`, or None when there is no journal."""
         for origin in list(self._ops_by_origin):
             ops = self._ops_by_origin[origin]
             if len(ops) > keep_recent:
@@ -594,9 +615,7 @@ class EntityReplicator:
                               for v in self._ops_by_origin.values())
         self.counters["compactions"] += 1
         if self._log is None:
-            return
-        from sitewhere_tpu.utils.ingestlog import IngestLog
-
+            return None
         # journal vector rewound to below each kept tail so replay
         # re-counts the tail and rebuilds the op index
         floor_vec = dict(self.vector)
@@ -604,24 +623,64 @@ class EntityReplicator:
             if ops:
                 floor_vec[origin] = ops[0]["seq"] - 1
         dump = self._state_dump_locked(vector=floor_vec)
+        tail = sorted((o for ops in self._ops_by_origin.values()
+                       for o in ops),
+                      key=lambda o: (o["origin"], o["seq"]))
+        # from here until the swap, _journal mirrors every new op into
+        # _compact_extra (while still writing the old journal, so
+        # durability never lapses)
+        self._compacting = True
+        self._compact_extra = []
+        return {"dump": dump, "tail": tail}
+
+    def _finish_compaction(self, prep: dict) -> None:
+        """Phase 2: write + fsync the new journal OUTSIDE the lock (the
+        expensive half — a full state dump plus the kept tail must not
+        stall every mutator behind the replicator lock), then swap
+        ``self._log`` back under the lock. Crash-safe: the new journal is
+        fully synced before any rename, and __init__ finishes an
+        interrupted swap."""
+        from sitewhere_tpu.utils.ingestlog import IngestLog
+
         d = self._log_dir
         new_dir = d.with_name(d.name + ".new")
         old_dir = d.with_name(d.name + ".old")
-        shutil.rmtree(new_dir, ignore_errors=True)
-        nlog = IngestLog(new_dir, segment_bytes=8 << 20)
-        nlog.append(json.dumps({"dump": dump}).encode())
-        for op in sorted((o for ops in self._ops_by_origin.values()
-                          for o in ops),
-                         key=lambda o: (o["origin"], o["seq"])):
-            nlog.append(json.dumps(op).encode())
-        nlog.sync()
-        nlog.close()
-        self._log.close()
+        try:
+            shutil.rmtree(new_dir, ignore_errors=True)
+            nlog = IngestLog(new_dir, segment_bytes=8 << 20)
+            nlog.append(json.dumps({"dump": prep["dump"]}).encode())
+            for op in prep["tail"]:
+                nlog.append(json.dumps(op).encode())
+            nlog.sync()
+            with self._lock:
+                # ops journaled while the snapshot was written: durable
+                # in the old journal, appended to the new one before the
+                # swap so the rename never drops them
+                for op in self._compact_extra:
+                    nlog.append(json.dumps(op).encode())
+                nlog.sync()
+                nlog.close()
+                self._log.close()
+                shutil.rmtree(old_dir, ignore_errors=True)
+                try:
+                    d.rename(old_dir)
+                    new_dir.rename(d)
+                finally:
+                    # a failed half-swap must not leave the replicator on
+                    # a closed journal: roll the live dir back if needed
+                    # and reopen whatever now lives at ``d``
+                    if not d.exists() and old_dir.exists():
+                        old_dir.rename(d)
+                    self._log = IngestLog(d, segment_bytes=8 << 20)
+        finally:
+            # ALWAYS re-arm: a failed compaction (ENOSPC, rename error)
+            # must not wedge _compacting=True forever — that would grow
+            # _compact_extra unboundedly and disable compaction for the
+            # process lifetime
+            with self._lock:
+                self._compacting = False
+                self._compact_extra = []
         shutil.rmtree(old_dir, ignore_errors=True)
-        d.rename(old_dir)
-        new_dir.rename(d)
-        shutil.rmtree(old_dir, ignore_errors=True)
-        self._log = IngestLog(d, segment_bytes=8 << 20)
         logger.info("rank %d: entity journal compacted to %d ops",
                     self.rank, self._total_ops)
 
@@ -629,6 +688,8 @@ class EntityReplicator:
     def sync_from_peers(self, best_effort: bool = True) -> int:
         """Pull everything we lack from every reachable peer (startup
         catch-up + the periodic heal for pushes we missed while down)."""
+        from sitewhere_tpu.rpc.protocol import RpcError
+
         total = 0
         c = self.cluster
         for r in range(c.n_ranks):
@@ -645,7 +706,10 @@ class EntityReplicator:
                     total += self.apply_state_dump(dump)
                 else:
                     total += self.apply_batch(ops)
-            except (ConnectionError, TimeoutError):
+            except (ConnectionError, TimeoutError, RpcError):
+                # RpcError too: one peer answering garbage (version skew,
+                # mid-restart handler) must not abort best-effort healing
+                # from the remaining healthy peers
                 if not best_effort:
                     raise
         self.counters["sync_pulls"] += 1

@@ -110,7 +110,9 @@ def _sharded_step(
             jax.tree_util.tree_map(lambda x: x[None], out),
         )
 
-    return jax.shard_map(
+    from sitewhere_tpu.compat import shard_map
+
+    return shard_map(
         local_step,
         mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),

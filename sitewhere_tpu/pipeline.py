@@ -287,6 +287,42 @@ def make_packed_scan_step(config: PipelineConfig, capacity: int,
 
 
 @functools.cache
+def make_arena_scan_step(config: PipelineConfig, capacity: int,
+                         channels: int, k: int):
+    """Consume ONE staging arena of ``k * capacity`` rows as a k-lane
+    ``lax.scan``: each SoA column arrives as a single flat array and is
+    reshaped to [k, capacity] INSIDE the jit (free relayout — no
+    host-side packing or per-batch slicing copy, unlike
+    :func:`make_packed_scan_step` whose K batches must first be
+    concatenated by ``pack_batches``). This is the dispatch program of
+    the zero-copy arena ingest path at ``scan_chunk`` > 1."""
+    from sitewhere_tpu.core.types import AUX_LANES
+
+    def multi(state: PipelineState, batch: EventBatch):
+        stacked = EventBatch(
+            valid=batch.valid.reshape(k, capacity),
+            etype=batch.etype.reshape(k, capacity),
+            token_id=batch.token_id.reshape(k, capacity),
+            tenant_id=batch.tenant_id.reshape(k, capacity),
+            ts_ms=batch.ts_ms.reshape(k, capacity),
+            received_ms=batch.received_ms.reshape(k, capacity),
+            values=batch.values.reshape(k, capacity, channels),
+            vmask=batch.vmask.reshape(k, capacity, channels),
+            aux=batch.aux.reshape(k, capacity, AUX_LANES),
+            seq=batch.seq.reshape(k, capacity),
+        )
+
+        def body(st, b):
+            return pipeline_step(st, b, config)
+
+        return jax.lax.scan(body, state, stacked)
+
+    # donate ONLY the state (see make_packed_scan_step: donating the
+    # input batch would just warn — it has no same-shaped output)
+    return jax.jit(multi, donate_argnums=(0,))
+
+
+@functools.cache
 def make_presence_sweep():
     """Compiled presence sweep (DevicePresenceManager analog)."""
 

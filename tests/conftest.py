@@ -19,7 +19,12 @@ if "host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices; the XLA_FLAGS fallback above
+    # already forces the 8-device host platform
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,332 @@
+"""Zero-copy ingest arena path: equivalence, backpressure, durability.
+
+The arena path (ISSUE 2 tentpole) replaces the decode->copy->emit staging
+chain with pooled SoA buffers the native scanner fills directly. These
+tests pin its contract:
+
+  * pipeline results are BYTE-IDENTICAL to the legacy copy-staging path
+    on mixed JSON/binary traffic (including the scan_chunk>1 arena scan
+    step);
+  * an exhausted pool applies backpressure (blocks on the oldest
+    in-flight dispatch) instead of allocating or corrupting;
+  * WAL-before-dispatch ordering holds: every accepted row is in the WAL
+    before the device program that persists it is dispatched.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ingest.arena import ArenaPool, StagingArena
+from sitewhere_tpu.ingest.decoders import encode_binary_request
+from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
+from sitewhere_tpu.loadgen import generate_measurements_message
+
+SMALL = dict(device_capacity=1 << 10, token_capacity=1 << 11,
+             assignment_capacity=1 << 11, store_capacity=1 << 12,
+             batch_capacity=128)
+
+
+def _mixed_payloads():
+    jpay = [generate_measurements_message(f"ar-{i % 40}", i,
+                                          value=float(i % 90))
+            for i in range(300)]
+    # a couple of alert + location envelopes exercise the non-default
+    # transforms (level fold, fixed location lanes)
+    jpay += [json.dumps({
+        "deviceToken": f"ar-{i % 40}", "type": "DeviceAlert",
+        "request": {"type": "engine.overheat", "level": "Critical",
+                    "eventDate": None}}).encode() for i in range(10)]
+    jpay += [json.dumps({
+        "deviceToken": f"ar-{i % 40}", "type": "DeviceLocation",
+        "request": {"latitude": 33.75 + i, "longitude": -84.39,
+                    "elevation": 300.0}}).encode() for i in range(10)]
+    bpay = [encode_binary_request(DecodedRequest(
+        type=RequestType.DEVICE_MEASUREMENT, device_token=f"ar-{i % 50}",
+        measurements={"fuel.level": float(i % 100)},
+        event_ts_ms=1700000000000 + i)) for i in range(180)]
+    return jpay, bpay
+
+
+def _run_engine(**overrides):
+    eng = Engine(EngineConfig(**SMALL, **overrides))
+    # pin the time base so arena/legacy runs produce identical columns
+    eng.epoch.base_unix_s = 1700000000.0 - 1000.0
+    eng.epoch.now_ms = lambda: 12345
+    jpay, bpay = _mixed_payloads()
+    eng.ingest_json_batch(jpay)
+    eng.ingest_binary_batch(bpay)
+    eng.flush()
+    return eng
+
+
+def _store_arrays(eng):
+    import jax
+
+    st = jax.device_get(eng.state.store)
+    return {f.name: np.asarray(getattr(st, f.name))
+            for f in dataclasses.fields(st)}
+
+
+def test_arena_path_matches_legacy_byte_identical():
+    arena_eng = _run_engine()
+    if arena_eng._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    legacy_eng = _run_engine(ingest_arenas=-1)
+    assert legacy_eng._arena_pool is None
+    a, b = _store_arrays(arena_eng), _store_arrays(legacy_eng)
+    for name in a:
+        assert np.array_equal(a[name], b[name]), f"store.{name} diverges"
+    import jax
+
+    dsa = jax.device_get(arena_eng.state.device_state)
+    dsb = jax.device_get(legacy_eng.state.device_state)
+    for f in dataclasses.fields(dsa):
+        assert np.array_equal(np.asarray(getattr(dsa, f.name)),
+                              np.asarray(getattr(dsb, f.name))), \
+            f"device_state.{f.name} diverges"
+    ma, mb = arena_eng.metrics(), legacy_eng.metrics()
+    for k in ("processed", "found", "missed", "registered", "persisted"):
+        assert ma[k] == mb[k]
+    # the arena run staged every batch row copy-free
+    assert arena_eng.host_counters.get("staged_copy_rows", 0) == 0
+    assert arena_eng.host_counters["arena_rows"] == 500
+
+
+def test_arena_scan_chunk_matches_single_step():
+    base = _run_engine()
+    if base._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    scan = _run_engine(scan_chunk=4)
+    assert scan._arena_step is not None
+    a, b = _store_arrays(base), _store_arrays(scan)
+    for name in a:
+        assert np.array_equal(a[name], b[name]), f"store.{name} diverges"
+
+
+class _FakeTicket:
+    """Stand-in for a dispatch output array: not ready until blocked on."""
+
+    def __init__(self):
+        self.blocked = False
+
+    def is_ready(self):
+        return self.blocked
+
+    def block_until_ready(self):
+        self.blocked = True
+        return self
+
+
+def test_arena_pool_exhaustion_blocks_on_oldest():
+    pool = ArenaPool(2, 64, 8)
+    a1 = pool.acquire()
+    t1 = _FakeTicket()
+    pool.retire(a1, t1)
+    a2 = pool.acquire()
+    t2 = _FakeTicket()
+    pool.retire(a2, t2)
+    # both arenas in flight, neither ready: the next acquire must wait
+    # on the OLDEST dispatch and recycle its arena
+    a3 = pool.acquire()
+    assert pool.waits == 1
+    assert t1.blocked and not t2.blocked
+    assert a3 is a1
+    assert a3.cursor == 0 and not a3.valid.any()
+
+
+def test_arena_pool_recycles_ready_without_waiting():
+    pool = ArenaPool(2, 64, 8)
+    a1 = pool.acquire()
+    t1 = _FakeTicket()
+    t1.blocked = True   # dispatch already finished
+    pool.retire(a1, t1)
+    a2 = pool.acquire()   # reclaims a1 opportunistically, no wait
+    a3 = pool.acquire()
+    assert pool.waits == 0
+    assert a2 is not a3
+    assert a1 in (a2, a3)
+
+
+def test_engine_single_arena_backpressure_correctness():
+    """ingest_arenas=1 forces constant recycle-through-the-oldest: every
+    event must still persist exactly once."""
+    eng = Engine(EngineConfig(**SMALL, ingest_arenas=1, dispatch_depth=2))
+    if eng._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    assert eng._arena_pool.n_arenas == 1
+    for b in range(6):
+        eng.ingest_json_batch([
+            generate_measurements_message(f"bp-{i % 30}", b * 128 + i)
+            for i in range(128)])
+    eng.flush()
+    assert eng.metrics()["persisted"] == 6 * 128
+    assert "arena_pool_waits" in eng.metrics()
+
+
+def test_wal_records_precede_arena_dispatch(tmp_path):
+    """Durability ordering: by the time a device program is dispatched,
+    every row it carries is already group-appended (and flushed) to the
+    WAL — accepted => durable => dispatched, never the reverse."""
+    from sitewhere_tpu.utils.ingestlog import IngestLog
+
+    wal_dir = tmp_path / "wal"
+    eng = Engine(EngineConfig(**SMALL, wal_dir=str(wal_dir)))
+    if eng._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    real_step = eng._step
+    dispatched = []
+
+    def checking_step(state, batch):
+        n_valid = int(np.sum(np.asarray(batch.valid)))
+        wal_records = sum(
+            1 for _ in IngestLog(wal_dir, readonly=True).replay())
+        assert wal_records >= sum(dispatched) + n_valid, \
+            "dispatch ran ahead of the WAL"
+        dispatched.append(n_valid)
+        return real_step(state, batch)
+
+    eng._step = checking_step
+    eng.ingest_json_batch([
+        generate_measurements_message(f"wd-{i % 20}", i)
+        for i in range(300)])   # 2 full arenas dispatch mid-ingest
+    eng.flush()
+    assert sum(dispatched) == 300
+    assert len(dispatched) >= 2
+
+
+def test_wal_group_append_replays_identically(tmp_path):
+    """append_many frames records byte-identically to per-record append:
+    replay returns the same payload sequence either way."""
+    from sitewhere_tpu.utils.ingestlog import IngestLog
+
+    payloads = [f"payload-{i}".encode() for i in range(50)]
+    head = b"\x01tenant\x00"
+    a = IngestLog(tmp_path / "a")
+    for p in payloads:
+        a.append(head + p)
+    a.sync()
+    b = IngestLog(tmp_path / "b")
+    b.append_many(payloads, head)
+    b.sync()
+    assert list(IngestLog(tmp_path / "a", readonly=True).replay()) == \
+        list(IngestLog(tmp_path / "b", readonly=True).replay())
+
+
+def test_native_device_token_precedence():
+    """An envelope carrying BOTH deviceToken and hardwareId must decode
+    to the deviceToken in either key order (routing and registration
+    agree; ADVICE r5)."""
+    from sitewhere_tpu.ingest.fast_decode import (NativeBatchDecoder,
+                                                  native_available)
+    from sitewhere_tpu.native.binding import NativeInterner
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    tokens = NativeInterner(1 << 10)
+    dec = NativeBatchDecoder(tokens, 8)
+    body = {"type": "DeviceMeasurement",
+            "request": {"name": "t", "value": 1.0}}
+    p1 = json.dumps({"hardwareId": "hw-1", "deviceToken": "dt-1",
+                     **body}).encode()
+    p2 = json.dumps({"deviceToken": "dt-1", "hardwareId": "hw-1",
+                     **body}).encode()
+    res = dec.decode([p1, p2])
+    want = tokens.lookup("dt-1")
+    assert want >= 0
+    assert res.token_id[0] == want
+    assert res.token_id[1] == want
+
+
+def test_strict_channels_arena_staging_and_rollback():
+    """Strict engines keep the all-or-nothing native decode + rollback,
+    then stage the validated batch through the arenas: accepted batches
+    match the legacy strict path byte-for-byte, and a rejected batch
+    leaks neither lanes nor rows on either path."""
+    import jax
+
+    from sitewhere_tpu.engine import ChannelCapacityError
+
+    def run(**kw):
+        eng = Engine(EngineConfig(**SMALL, channels=3,
+                                  strict_channels=True, **kw))
+        eng.epoch.base_unix_s = 1700000000.0 - 1000.0
+        eng.epoch.now_ms = lambda: 12345
+        ok_pay = [json.dumps({
+            "deviceToken": f"sc-{i % 8}", "type": "DeviceMeasurement",
+            "request": {"measurements": {"a": float(i), "b": float(i + 1)},
+                        "eventDate": None}}).encode() for i in range(40)]
+        eng.ingest_json_batch(ok_pay)
+        with pytest.raises(ChannelCapacityError):
+            eng.ingest_json_batch([json.dumps({
+                "deviceToken": "sc-x", "type": "DeviceMeasurement",
+                "request": {"measurements": {"c": 3.0, "d": 4.0},
+                            "eventDate": None}}).encode()])
+        eng.flush()
+        return eng
+
+    arena_eng = run()
+    if arena_eng._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    legacy_eng = run(ingest_arenas=-1)
+    assert arena_eng.metrics()["persisted"] == 40
+    assert legacy_eng.metrics()["persisted"] == 40
+    # the rejected batch rolled its interned names back on both paths
+    assert len(arena_eng.channel_map.names) == 2
+    assert len(legacy_eng.channel_map.names) == 2
+    sa = jax.device_get(arena_eng.state.store)
+    sb = jax.device_get(legacy_eng.state.store)
+    for f in dataclasses.fields(sa):
+        assert np.array_equal(np.asarray(getattr(sa, f.name)),
+                              np.asarray(getattr(sb, f.name))), \
+            f"store.{f.name} diverges"
+
+
+def test_register_envelope_mid_batch_does_not_hang():
+    """A RegisterDevice envelope inside a batch re-enters the admin path
+    (register_device -> _sync_mirrors) while the arena commit is still
+    building its valid mask; that re-entry must neither deadlock nor
+    dispatch the half-committed arena."""
+    eng = Engine(EngineConfig(**SMALL))
+    if eng._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    # first fill the arena partially so the commit re-entry happens with
+    # cursor > 0 (the case where _sync_mirrors could otherwise spin on a
+    # fill arena that flush_async refuses to dispatch mid-commit)
+    eng.ingest_json_batch([generate_measurements_message(f"rg-{i % 10}", i)
+                           for i in range(50)])
+    assert eng._arena_fill is not None and eng._arena_fill.cursor == 50
+    payloads = [generate_measurements_message(f"rg-{i % 10}", 50 + i)
+                for i in range(60)]
+    payloads.insert(30, json.dumps({
+        "deviceToken": "rg-admin", "type": "RegisterDevice",
+        "request": {"deviceTypeToken": "mega2560"}}).encode())
+    s = eng.ingest_json_batch(payloads)
+    assert s["decoded"] == 61 and s["failed"] == 0 and s["staged"] == 60
+    eng.flush()
+    assert eng.metrics()["persisted"] == 110
+    assert eng.get_device("rg-admin").device_type == "mega2560"
+
+
+@pytest.mark.slow
+def test_arena_stress_many_cycles():
+    """Pool-churn stress: hundreds of partial and full arena dispatches
+    with interleaved flushes keep counts exact."""
+    eng = Engine(EngineConfig(**SMALL, ingest_arenas=2))
+    if eng._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    total = 0
+    rng = np.random.default_rng(3)
+    for b in range(200):
+        n = int(rng.integers(1, 200))
+        eng.ingest_json_batch([
+            generate_measurements_message(f"st-{i % 64}", b * 256 + i)
+            for i in range(n)])
+        total += n
+        if b % 7 == 0:
+            eng.flush_async()
+    eng.flush()
+    assert eng.metrics()["persisted"] == total

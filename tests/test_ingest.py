@@ -275,6 +275,8 @@ def test_socket_receiver_framings():
 
 
 def test_websocket_receiver():
+    pytest.importorskip("websockets")
+
     async def run():
         engine = _mini_engine()
         mgr = _wire(engine)
