@@ -154,7 +154,8 @@ class IngestHostMixin:
     ``Engine`` and the mesh ``DistributedEngine`` — one implementation so
     durability and strictness semantics can never diverge between them.
     Hosts provide: ``lock``, ``wal``, ``_wal_local``, ``channel_map``,
-    ``config.strict_channels``, ``process()``, ``_ingest_decoded()``."""
+    ``config.strict_channels``, ``process()``, ``_ingest_decoded()``,
+    ``flight`` (utils/flight.FlightRecorder), ``_staged_traces``."""
 
     def _wal_append(self, tag: bytes, payloads: list[bytes],
                     tenant: str) -> None:
@@ -169,8 +170,22 @@ class IngestHostMixin:
         # accepted event must survive a process crash (fsync cadence
         # stays the operator's sync() call), and a write() per record
         # was a measurable slice of the batch staging budget
+        rec = self.flight.current()
+        t0 = time.perf_counter()
         self.wal.append_many(payloads, head)
         self.wal.flush()
+        rec.mark("wal_append")
+        rec.add("wal_flush_ms", round((time.perf_counter() - t0) * 1000, 3))
+
+    # ------------------------------------------------------- flight recorder
+    def get_trace(self, trace_id: str) -> dict:
+        """Lifecycle records for one trace id (this engine's recorder;
+        the cluster facade overrides with a rank fan-out)."""
+        return {"traceId": trace_id,
+                "records": self.flight.records_of(trace_id)}
+
+    def recent_traces(self, limit: int = 50) -> list[dict]:
+        return self.flight.recent(limit)
 
     @contextlib.contextmanager
     def _wal_suppress(self):
@@ -183,15 +198,54 @@ class IngestHostMixin:
             self._wal_local.depth -= 1
 
     def _ingest_batch(self, payloads: list[bytes], tenant: str, tag: bytes,
-                      dec, native_fn, binary: bool = False) -> dict:
-        """Common batch-ingest skeleton: strict validation -> WAL -> stage.
-        ``native_fn`` is the native SoA decoder call (None = Python path)."""
+                      dec, native_fn, binary: bool = False,
+                      traceparent: str | None = None) -> dict:
+        """Common batch-ingest skeleton: strict validation -> WAL -> stage,
+        wrapped in one flight-recorder lifecycle record (the batch's trace;
+        ``traceparent`` — explicit or bound by the RPC server — joins a
+        cross-rank trace instead of opening a new one). ``native_fn`` is
+        the native SoA decoder call (None = Python path)."""
+        from sitewhere_tpu.utils.tracing import current_traceparent
+
+        rec = self.flight.begin(
+            "ingest", tenant=tenant, n_payloads=len(payloads),
+            traceparent=traceparent or current_traceparent())
+        with self.flight.bind(rec):
+            summary = self._ingest_batch_inner(payloads, tenant, tag, dec,
+                                               native_fn, binary, rec)
+        if rec.trace_id is not None:
+            rec.add_counts(summary)
+            if rec.meta.get("path") != "arena" and summary.get("staged"):
+                with self.lock:
+                    if self.staged_count:
+                        # rows await dispatch via the shared buffer: the
+                        # next flush stamps this record's dispatch
+                        self._staged_traces.append(rec)
+                    else:
+                        # a mid-ingest buffer-fill flush already
+                        # dispatched every row of this batch (the record
+                        # was not yet queued): join the newest in-flight
+                        # program so drain stamps the tail stages
+                        # instead of stranding an incomplete trace
+                        rec.mark("dispatch")
+                        if self._pending_traces:
+                            self._pending_traces[-1].append(rec)
+                        else:
+                            rec.mark("device_ready")
+            summary["trace_id"] = rec.trace_id
+        return summary
+
+    def _ingest_batch_inner(self, payloads, tenant, tag, dec, native_fn,
+                            binary, rec) -> dict:
         if native_fn is None:
             with self.lock:
                 predecoded = self._strict_predecode(payloads, dec)
                 self._wal_append(tag, payloads, tenant)
-                return self._ingest_python_fallback(payloads, tenant, dec,
-                                                    predecoded)
+                summary = self._ingest_python_fallback(payloads, tenant,
+                                                       dec, predecoded)
+                rec.mark("decode")
+                rec.mark("commit")
+                return summary
         if self.config.strict_channels:
             # strict serializes the native decode under the lock so a
             # rejected batch can roll back the names it interned without
@@ -199,9 +253,12 @@ class IngestHostMixin:
             with self.lock:
                 names_before = len(self.channel_map.names)
                 res = native_fn(payloads)
+                rec.mark("decode")
                 self._check_strict_native(res, names_before)
                 self._wal_append(tag, payloads, tenant)
-                return self._ingest_decoded(res, payloads, tenant, dec)
+                summary = self._ingest_decoded(res, payloads, tenant, dec)
+                rec.mark("commit")
+                return summary
         if getattr(self, "_arena_pool", None) is not None \
                 and not self.config.fair_tenancy:
             # zero-copy path: the native scanner fills the staging arena
@@ -213,9 +270,12 @@ class IngestHostMixin:
         # lenient fast path: decode OUTSIDE the lock (concurrent receivers
         # decode in parallel); log + stage atomically
         res = native_fn(payloads)
+        rec.mark("decode")
         with self.lock:
             self._wal_append(tag, payloads, tenant)
-            return self._ingest_decoded(res, payloads, tenant, dec)
+            summary = self._ingest_decoded(res, payloads, tenant, dec)
+            rec.mark("commit")
+            return summary
 
     def _strict_predecode(self, payloads, dec):
         """Strict pre-pass for the Python-fallback path: decode ONCE and
@@ -469,6 +529,11 @@ class EngineConfig:
                                        # -1 disables (legacy copy staging).
                                        # Each arena holds
                                        # batch_capacity * scan_chunk rows
+    flight_recorder: bool = True       # batch-lifecycle flight recorder
+                                       # (utils/flight.py); overhead is a
+                                       # few dict writes per BATCH — bench
+                                       # gates it at <= 3% of host e2e
+    flight_capacity: int = 1024        # lifecycle records retained
 
 
 @dataclasses.dataclass
@@ -580,6 +645,25 @@ def tenant_cap(n_tenants: int) -> int:
     """Static power-of-two tenant bucket for the segment-sum — one
     formula for every engine flavor so their per-tenant series agree."""
     return max(64, 1 << max(0, n_tenants - 1).bit_length())
+
+
+def format_tenant_counter_grid(grid, tenants) -> dict[str, dict[str, int]]:
+    """[T_BUCKETS, C] device counter grid -> {tenant: {lane: n}} (quiet
+    buckets omitted; buckets past the named-tenant range label as
+    ``bucketN``) — the ONE formatting rule behind Engine and
+    DistributedEngine ``tenant_pipeline_counters`` and therefore the
+    Prometheus ``swtpu_pipeline_*`` series shape."""
+    from sitewhere_tpu.pipeline import (TENANT_COUNTER_BUCKETS,
+                                        TENANT_COUNTER_LANES)
+
+    names = {tid % TENANT_COUNTER_BUCKETS: tenants.token(tid)
+             for tid in range(min(len(tenants), TENANT_COUNTER_BUCKETS))}
+    return {
+        names.get(b, f"bucket{b}"): {
+            lane: int(grid[b, i])
+            for i, lane in enumerate(TENANT_COUNTER_LANES)}
+        for b in range(grid.shape[0]) if grid[b].any()
+    }
 
 
 def tenant_counts_dict(counts, tenants, n_tenants: int) -> dict:
@@ -791,6 +875,16 @@ class Engine(IngestHostMixin):
         self._pending_outs: list[StepOutput] = []     # un-absorbed step outputs
         self._fair_queues: dict[int, list] = {}       # tenant_id -> staged rows
         self._fair_queued = 0
+        # flight recorder: one lifecycle record per ingest batch
+        # (utils/flight.py); _staged_traces holds records whose rows sit
+        # in the copy-staging buffer awaiting dispatch, _pending_traces
+        # parallels _pending_outs for readback stamping in drain()
+        from sitewhere_tpu.utils.flight import FlightRecorder
+
+        self.flight = FlightRecorder(capacity=c.flight_capacity,
+                                     enabled=c.flight_recorder)
+        self._staged_traces: list = []
+        self._pending_traces: list[list] = []
         # durability: accepted payloads append to the WAL BEFORE staging,
         # tagged by wire format so recovery replays each through the right
         # decoder (utils/checkpoint.recover_engine)
@@ -948,21 +1042,24 @@ class Engine(IngestHostMixin):
             del self._fair_queues[tid]
 
     def ingest_json_batch(self, payloads: list[bytes],
-                          tenant: str = "default") -> dict:
+                          tenant: str = "default",
+                          traceparent: str | None = None) -> dict:
         """Fast path: decode a batch of JSON device-request payloads in one
         native call and stage them vectorized (no per-event Python). Returns
-        a summary with decode failures (failed-decode DLQ analog).
-        Registration envelopes fall back to the per-request path (they carry
-        string metadata the hot path doesn't extract)."""
+        a summary with decode failures (failed-decode DLQ analog) and the
+        batch's flight-recorder ``trace_id``. Registration envelopes fall
+        back to the per-request path (they carry string metadata the hot
+        path doesn't extract)."""
         from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
 
         return self._ingest_batch(
             payloads, tenant, WAL_JSON, JsonDeviceRequestDecoder(),
             self._native_decoder.decode if self._native_decoder else None,
-            binary=False)
+            binary=False, traceparent=traceparent)
 
     def ingest_binary_batch(self, payloads: list[bytes],
-                            tenant: str = "default") -> dict:
+                            tenant: str = "default",
+                            traceparent: str | None = None) -> dict:
         """Fast path for the flat-binary wire format (the "protobuf" ingest
         slot): one native C call decodes the whole batch."""
         from sitewhere_tpu.ingest.decoders import BinaryEventDecoder
@@ -970,7 +1067,7 @@ class Engine(IngestHostMixin):
         return self._ingest_batch(
             payloads, tenant, WAL_BINARY, BinaryEventDecoder(),
             self._native_decoder.decode_binary if self._native_decoder
-            else None, binary=True)
+            else None, binary=True, traceparent=traceparent)
 
     # ------------------------------------------------------------ arena ingest
     def _ingest_batch_arena(self, payloads, tenant, tag, reg_decoder,
@@ -983,6 +1080,8 @@ class Engine(IngestHostMixin):
         row of the chunk can dispatch."""
         summary = {"decoded": 0, "failed": 0, "staged": 0}
         n = len(payloads)
+        rec = self.flight.current()
+        rec.add("path", "arena")
         with self.lock:
             now = self.epoch.now_ms()
             base_ms = int(self.epoch.base_unix_s * 1000)
@@ -997,9 +1096,14 @@ class Engine(IngestHostMixin):
                 lo = arena.cursor
                 n_ok, collisions = self._native_decoder.decode_into(
                     chunk, arena, lo, binary=binary)
+                rec.mark("decode")
+                rec.mark("arena_fill")
                 self._wal_append(tag, chunk, tenant)
                 self._arena_commit(arena, lo, take, chunk, tenant,
                                    reg_decoder, now, base_ms, summary)
+                rec.mark("commit")
+                if rec.trace_id is not None:
+                    arena.traces.append(rec)
                 self.channel_map.collisions += collisions
                 arena.cursor = lo + take
                 if arena.room == 0:
@@ -1017,6 +1121,8 @@ class Engine(IngestHostMixin):
         WAL-logged the raw batch."""
         summary = {"decoded": 0, "failed": 0, "staged": 0}
         n = len(res.rtype)
+        rec = self.flight.current()
+        rec.add("path", "arena")
         with self.lock:
             now = self.epoch.now_ms()
             base_ms = int(self.epoch.base_unix_s * 1000)
@@ -1035,9 +1141,13 @@ class Engine(IngestHostMixin):
                 arena.vmask[lo:hi] = res.chmask[sl]
                 arena.aux[lo:hi, 0] = res.aux0[sl]
                 arena.level[lo:hi] = res.level[sl]
+                rec.mark("arena_fill")
                 self._arena_commit(arena, lo, take,
                                    payloads[pos:pos + take], tenant,
                                    reg_decoder, now, base_ms, summary)
+                rec.mark("commit")
+                if rec.trace_id is not None:
+                    arena.traces.append(rec)
                 arena.cursor = hi
                 if arena.room == 0:
                     self._dispatch_arena()
@@ -1118,10 +1228,15 @@ class Engine(IngestHostMixin):
         if arena is None or arena.cursor == 0:
             return
         arena.valid[arena.cursor:] = False
+        traces, arena.traces = arena.traces, []
+        for rec in traces:
+            rec.mark("dispatch")
         step = self._arena_step or self._step
         self.state, out = step(self.state, arena.view_batch())
-        self._enqueue_out(out)
-        self._arena_pool.retire(arena, out.n_persisted)
+        self._enqueue_out(out, traces)
+        # the recycle wait that proves the transfer completed ALSO proves
+        # the device program ran: device_ready harvests there, free
+        self._arena_pool.retire(arena, out.n_persisted, traces)
         self._archive_account(arena.cursor * MAX_ACTIVE_ASSIGNMENTS)
         self._arena_fill = None
         # plain attribute, NOT a metrics key: dispatch counts differ by
@@ -1223,15 +1338,21 @@ class Engine(IngestHostMixin):
     def flush(self) -> dict:
         """Run the staged work through the pipeline and sync host mirrors;
         returns the AGGREGATE summary of everything drained (a flush may
-        cover several scan lanes, including empty padding lanes)."""
+        cover several scan lanes, including empty padding lanes). On a
+        pipeline error the flight recorder dumps the recent batch
+        lifecycles before the error propagates."""
         from sitewhere_tpu.utils.tracing import stage
 
-        with self.lock, stage("pipeline_step"):
-            self.flush_async()
-            while self._fair_queued:   # fair mode: one batch per dispatch
+        try:
+            with self.lock, stage("pipeline_step"):
                 self.flush_async()
-            self._dispatch_staged(all_batches=True)
-            return _merge_summaries(self.drain())
+                while self._fair_queued:  # fair mode: one batch per dispatch
+                    self.flush_async()
+                self._dispatch_staged(all_batches=True)
+                return _merge_summaries(self.drain())
+        except Exception:
+            self.flight.dump_error(logging.getLogger(__name__))
+            raise
 
     def flush_async(self) -> None:
         """Dispatch a step on the staged batch WITHOUT a mirror readback:
@@ -1263,8 +1384,11 @@ class Engine(IngestHostMixin):
                 self._staged_batches.append(batch)
                 self._dispatch_staged(all_batches=False)
             else:
+                traces, self._staged_traces = self._staged_traces, []
+                for rec in traces:
+                    rec.mark("dispatch")
                 self.state, out = self._step(self.state, batch)
-                self._enqueue_out(out)
+                self._enqueue_out(out, traces)
                 # ring head has advanced: each staged row persists up to
                 # one event per active assignment — count the upper bound
                 # so rows always spill before the ring wraps over them
@@ -1291,9 +1415,14 @@ class Engine(IngestHostMixin):
             while len(chunk) < k:   # pad the tail chunk with empty batches
                 chunk.append(_empty_host_batch(self.config.batch_capacity,
                                                self.config.channels))
+            # records for every batch in the chunk (K-batch granularity:
+            # the chunk IS the dispatch unit)
+            traces, self._staged_traces = self._staged_traces, []
+            for rec in traces:
+                rec.mark("dispatch")
             self.state, outs = self._scan_step(self.state,
                                                pack_batches(chunk))
-            self._enqueue_out(outs)
+            self._enqueue_out(outs, traces)
             # spool accounting happens HERE, where the ring head actually
             # advances — NOT at staging time (a staged-but-undispatched
             # batch would reset the counter while contributing no rows,
@@ -1301,7 +1430,7 @@ class Engine(IngestHostMixin):
             self._archive_account(
                 k * self.config.batch_capacity * MAX_ACTIVE_ASSIGNMENTS)
 
-    def _enqueue_out(self, out: StepOutput) -> None:
+    def _enqueue_out(self, out: StepOutput, traces: list = ()) -> None:
         """Queue a step output for drain, bounding outstanding device
         programs to ``dispatch_depth``. At the default depth 1 the wait
         lands on the just-dispatched program — deliberate for remote-tunnel
@@ -1310,9 +1439,15 @@ class Engine(IngestHostMixin):
         wait costs ~the step itself. Colocated deployments raise the depth
         to overlap host staging with device execution."""
         self._pending_outs.append(out)
+        self._pending_traces.append(list(traces))
         d = max(1, self.config.dispatch_depth)
         if len(self._pending_outs) >= d:
             jax.block_until_ready(self._pending_outs[-d].n_persisted)
+            # the wait observed that program's completion — stamp
+            # device_ready on its batches at zero extra sync cost
+            # (overwrite: a multi-chunk batch keeps its LAST chunk)
+            for rec in self._pending_traces[-d]:
+                rec.mark("device_ready")
 
     def barrier(self) -> None:
         """Dispatch ALL staged work and wait for completion WITHOUT any
@@ -1377,9 +1512,18 @@ class Engine(IngestHostMixin):
                 return [{"found": 0, "missed": 0, "registered": 0,
                          "persisted": 0, "new_tokens": [], "dead_tokens": []}]
             outs, self._pending_outs = self._pending_outs, []
+            trace_lists, self._pending_traces = self._pending_traces, []
             scalars = jax.device_get([
                 (o.n_found, o.n_missed, o.n_registered, o.n_persisted)
                 for o in outs])
+            # the device_get above observed every drained program: stamp
+            # readback (and device_ready for batches whose arena was
+            # never recycled before this point) on their records
+            for recs in trace_lists:
+                for rec in recs:
+                    if "device_ready" not in rec.stages:
+                        rec.mark("device_ready")
+                    rec.mark("readback")
             summaries = []
             for out, s in zip(outs, scalars):
                 if np.ndim(s[0]) == 0:           # single step
@@ -2143,6 +2287,35 @@ class Engine(IngestHostMixin):
     # overrides presence_sweep with a fan-out but keeps this local form,
     # so per-rank background loops never trigger N^2 sweeps
     presence_sweep_local = presence_sweep
+
+    def set_geofence_zones(self, polygons, max_vertices: int = 16) -> None:
+        """Install geofence polygons into the pipeline state so the jit
+        step counts zone containment per tenant (the ``geofence_hit``
+        counter lane) inside the already-running program — no extra
+        dispatch, no host round trip per batch. Pass an empty list to
+        remove the zones (the lane freezes at its cumulative value)."""
+        from sitewhere_tpu.ops.geofence import pack_zones
+        from sitewhere_tpu.pipeline import ZoneTable
+
+        with self.lock:
+            if not polygons:
+                self.state = dataclasses.replace(self.state, zones=None)
+                return
+            verts, valid = pack_zones(polygons, max_vertices)
+            self.state = dataclasses.replace(
+                self.state, zones=ZoneTable(jnp.asarray(verts),
+                                            jnp.asarray(valid)))
+
+    def tenant_pipeline_counters(self) -> dict[str, dict[str, int]]:
+        """The device-side per-tenant counter grid (accepted /
+        dedup_dropped / geofence_hit / invalid), accumulated inside the
+        jit step and read back here on the SCRAPE path only — the ingest
+        hot loop never syncs for it. Tenants bucket by ``id % 64``
+        (pipeline.TENANT_COUNTER_BUCKETS); quiet buckets are omitted."""
+        with self.lock:
+            grid = np.asarray(jax.device_get(
+                self.state.metrics.tenant_counters))
+            return format_tenant_counter_grid(grid, self.tenants)
 
     def metrics(self) -> dict:
         m = self.state.metrics

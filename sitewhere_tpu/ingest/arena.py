@@ -48,7 +48,7 @@ class StagingArena:
     pre-tiled per lane. ``cursor`` is the fill position; rows past the
     cursor at dispatch are masked invalid (free padding)."""
 
-    __slots__ = ("rows", "channels", "lanes", "cursor",
+    __slots__ = ("rows", "channels", "lanes", "cursor", "traces",
                  "valid", "etype", "token_id", "tenant_id", "ts_ms",
                  "received_ms", "values", "vmask", "aux", "seq",
                  "rtype", "ts64", "level")
@@ -61,6 +61,7 @@ class StagingArena:
         self.channels = channels
         self.lanes = max(1, lanes)
         self.cursor = 0
+        self.traces: list = []   # flight records of batches staged here
         # final EventBatch columns (the decoder + commit write these)
         self.valid = np.zeros(rows, np.bool_)
         self.etype = np.zeros(rows, np.int32)
@@ -108,6 +109,7 @@ class StagingArena:
         through a partial dispatch."""
         self.cursor = 0
         self.valid[:] = False
+        self.traces = []
 
 
 class ArenaPool:
@@ -130,6 +132,14 @@ class ArenaPool:
         self._inflight: collections.deque = collections.deque()
         self.waits = 0   # times acquire had to block on the oldest dispatch
 
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def inflight_count(self) -> int:
+        return len(self._inflight)
+
     def acquire(self) -> StagingArena:
         """A fillable arena; blocks on the oldest in-flight dispatch when
         every arena is tied up (ingest backpressure)."""
@@ -139,16 +149,28 @@ class ArenaPool:
             self._reclaim_oldest()
         return self._free.pop()
 
-    def retire(self, arena: StagingArena, ticket) -> None:
+    def retire(self, arena: StagingArena, ticket, traces: list = ()) -> None:
         """Hand a dispatched arena back; it recycles once ``ticket`` is
-        ready."""
-        self._inflight.append((arena, ticket))
+        ready. ``traces`` are the flight records of the batches it
+        carried — the recycle wait already observes the step output, so
+        stamping their ``device_ready`` here costs no extra sync."""
+        self._inflight.append((arena, ticket, tuple(traces)))
+
+    @staticmethod
+    def _mark_ready(traces) -> None:
+        # overwrite, like every other stage mark: a batch spanning
+        # several arenas keeps the LAST chunk's readiness, matching its
+        # last-dispatch stamp (drain's backfill, by contrast, only fills
+        # the stage when no reclaim ever observed it)
+        for rec in traces:
+            rec.mark("device_ready")
 
     def _reclaim_oldest(self) -> None:
         import jax
 
-        arena, ticket = self._inflight.popleft()
+        arena, ticket, traces = self._inflight.popleft()
         jax.block_until_ready(ticket)
+        self._mark_ready(traces)
         arena.reset()
         self._free.append(arena)
 
@@ -160,7 +182,8 @@ class ArenaPool:
             is_ready = getattr(ticket, "is_ready", None)
             if is_ready is None or not is_ready():
                 return
-            arena, _ = self._inflight.popleft()
+            arena, _, traces = self._inflight.popleft()
+            self._mark_ready(traces)
             arena.reset()
             self._free.append(arena)
 

@@ -201,6 +201,16 @@ class _SyncPeer:
             "indeterminate — not auto-retried)") from None
 
     def call(self, method: str, **params: Any) -> Any:
+        # capture the CALLING thread's traceparent here: the coroutine
+        # runs on the background loop, whose context never sees it —
+        # this one line threads trace context through every cluster and
+        # entity-sync peer call without touching their call sites
+        if "_tp" not in params:
+            from sitewhere_tpu.utils.tracing import current_traceparent
+
+            tp = current_traceparent()
+            if tp is not None:
+                params["_tp"] = tp
         with self._lock:
             if self._client is None:
                 self._client = self._connect()
@@ -380,6 +390,9 @@ class ClusterEngine:
             self.local = DistributedEngine(config.engine)
         self.local.epoch = EpochBase(config.epoch_base_unix_s)
         self.epoch = self.local.epoch
+        # the rank stamps every flight record (and trace-id generation),
+        # so cross-rank trace views attribute records correctly
+        self.local.flight.rank = config.rank
         self.search_index = None          # see attach_search_index
         self.command_service = None       # see attach_command_service
         self.forward_queue = None         # see attach_forwarding
@@ -519,33 +532,58 @@ class ClusterEngine:
                                      payloads=plist)
             return {"spilled": len(plist)}
 
+    def _ingest_routed(self, payloads: list[bytes], tenant: str,
+                       kind: str) -> dict:
+        """Shared facade ingest: ONE trace spans the partition, the local
+        sub-batch, and every cross-rank forward. The route record lives in
+        the local rank's flight recorder; owner-side records join the same
+        trace id via the RPC frame's traceparent, so
+        `/api/instance/trace/<id>` reconstructs the full journey from any
+        rank."""
+        from sitewhere_tpu.utils.tracing import (bind_traceparent,
+                                                 current_traceparent,
+                                                 new_traceparent)
+
+        tp = current_traceparent() or new_traceparent(self.rank)
+        route_rec = self.local.flight.begin(
+            "route", tenant=tenant, n_payloads=len(payloads),
+            traceparent=tp)
+        with bind_traceparent(tp):
+            by_rank = self._partition_payloads(payloads, kind=kind)
+            route_rec.mark("commit")   # partition decided
+            local_ingest = (self.local.ingest_json_batch if kind == "json"
+                            else self.local.ingest_binary_batch)
+            summaries = []
+            forwarded = 0
+            for r, plist in by_rank.items():
+                if r == self.rank:
+                    summaries.append(local_ingest(plist, tenant,
+                                                  traceparent=tp))
+                else:
+                    forwarded += len(plist)
+                    summaries.append(self._forward_batch(r, kind, plist,
+                                                         tenant))
+            if forwarded:
+                route_rec.add("forwarded", forwarded)
+                route_rec.add("forward_ranks",
+                              sorted(r for r in by_rank if r != self.rank))
+                route_rec.mark("dispatch")   # last forward left this rank
+        merged = _merge_counts(summaries)
+        if route_rec.trace_id is not None:
+            route_rec.add_counts(merged)
+            merged["trace_id"] = route_rec.trace_id
+        return merged
+
     def ingest_json_batch(self, payloads: list[bytes],
                           tenant: str = "default") -> dict:
         """Partition the batch by owning rank (token-hash, like the Kafka
         producer partitioner) and forward raw remote payloads — WAL,
         decode, and registration happen once, at each owner."""
-        by_rank = self._partition_payloads(payloads, kind="json")
-        summaries = []
-        for r, plist in by_rank.items():
-            if r == self.rank:
-                summaries.append(self.local.ingest_json_batch(plist, tenant))
-            else:
-                summaries.append(self._forward_batch(r, "json", plist,
-                                                     tenant))
-        return _merge_counts(summaries)
+        return self._ingest_routed(payloads, tenant, kind="json")
 
     def ingest_binary_batch(self, payloads: list[bytes],
                             tenant: str = "default") -> dict:
-        by_rank = self._partition_payloads(payloads, kind="binary")
-        summaries = []
-        for r, plist in by_rank.items():
-            if r == self.rank:
-                summaries.append(
-                    self.local.ingest_binary_batch(plist, tenant))
-            else:
-                summaries.append(self._forward_batch(r, "binary", plist,
-                                                     tenant))
-        return _merge_counts(summaries)
+        return self._ingest_routed(payloads, tenant, kind="binary")
 
     def process(self, req) -> None:
         r = self.owner(req.device_token)
@@ -816,6 +854,29 @@ class ClusterEngine:
         if ev is not None:
             ev["eventId"] = event_id
         return ev
+
+    def get_trace(self, trace_id: str) -> dict:
+        """Cluster-wide trace resolution: a batch forwarded across ranks
+        left lifecycle records on EVERY rank it touched, all under one
+        trace id — collect them from the local recorder plus every
+        reachable peer (tolerant: a down rank degrades the view, it
+        must not 500 the trace endpoint)."""
+        keyed = self._fanout_keyed(
+            self.local.flight.records_of(trace_id), "Cluster.traceGet",
+            tolerant=True, traceId=trace_id)
+        records: list[dict] = []
+        for r, res in keyed.items():
+            if isinstance(res, PeerDown) or not res:
+                continue
+            records.extend(res)
+        records.sort(key=lambda d: d.get("startedMs", 0))
+        return {"traceId": trace_id, "records": records}
+
+    def recent_traces(self, limit: int = 50) -> list[dict]:
+        """This rank's recent batch records (per-rank surface, like the
+        reference scraping one replica; cross-rank journeys resolve via
+        get_trace)."""
+        return self.local.flight.recent(limit)
 
     def make_feed_consumer(self, group_id: str, **kw):
         """Rank-local feed (outbound connectors run per-rank over the
@@ -1322,6 +1383,12 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def flush():
         return engine.flush()
 
+    def trace_get(traceId: str):
+        return engine.flight.records_of(traceId)
+
+    def trace_recent(limit: int = 50):
+        return engine.flight.recent(limit)
+
     for name, fn in {
         "Cluster.ingestJson": ingest_json,
         "Cluster.ingestBinary": ingest_binary,
@@ -1353,6 +1420,8 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.commandResponses": command_responses,
         "Cluster.searchEvents": search_events,
         "Cluster.searchInfo": search_info,
+        "Cluster.traceGet": trace_get,
+        "Cluster.traceRecent": trace_recent,
         "Cluster.flush": flush,
     }.items():
         srv.register(name, fn)

@@ -446,9 +446,12 @@ def migrate_cluster_snapshots(old_snap_dirs, n_ranks_new: int, out_root,
             data0[".next_assignment"].dtype)
         for key in data0:
             if key.startswith(".metrics."):
-                new = np.zeros(s_sh, data0[key].dtype)
+                # shard-axis fold only: the per-tenant counter grid keeps
+                # its trailing [T, C] shape
+                new = np.zeros((s_sh,) + data0[key].shape[1:],
+                               data0[key].dtype)
                 if t == 0:   # global totals, exact, attributed once
-                    new[0] = sum(d[key].sum() for _, d in olds)
+                    new[0] = sum(d[key].sum(axis=0) for _, d in olds)
                 out[key] = new
         np.savez_compressed(snap_dir / "sharded_state.npz", **out)
 

@@ -86,6 +86,8 @@ class DistributedConfig:
     archive_segment_rows: int = 4096
     archive_max_rows: int | None = None  # per-(shard,arena) retention cap
     archive_max_age_ms: int | None = None  # event-time retention horizon
+    flight_recorder: bool = True       # batch-lifecycle flight recorder
+    flight_capacity: int = 1024        # lifecycle records retained
 
 
 class _StackedBuffer:
@@ -360,6 +362,15 @@ class DistributedEngine(IngestHostMixin):
         self.outputs: list[dict] = []
         self._pending_outs: list[StepOutput] = []
         self._pending_tenant_fixups: list[tuple[int, int, int]] = []
+        # flight recorder (utils/flight.py): Engine-parity lifecycle
+        # records for every ingest batch; the mixin's _ingest_batch binds
+        # records, flush_async/drain stamp dispatch/device_ready/readback
+        from sitewhere_tpu.utils.flight import FlightRecorder
+
+        self.flight = FlightRecorder(capacity=c.flight_capacity,
+                                     enabled=c.flight_recorder)
+        self._staged_traces: list = []
+        self._pending_traces: list[list] = []
         # fair tenancy: per-shard {tenant_id: deque[_FairChunk]}
         self._fair_queues: list[dict[int, collections.deque]] = [
             {} for _ in range(self.n_shards)]
@@ -446,23 +457,26 @@ class DistributedEngine(IngestHostMixin):
             self.flush_async()
 
     def ingest_json_batch(self, payloads: list[bytes],
-                          tenant: str = "default") -> dict:
+                          tenant: str = "default",
+                          traceparent: str | None = None) -> dict:
         """Fast path: one native decode call for the batch, vectorized
         shard routing + staging (no per-event Python)."""
         from sitewhere_tpu.ingest.decoders import JsonDeviceRequestDecoder
 
         return self._ingest_batch(
             payloads, tenant, WAL_JSON, JsonDeviceRequestDecoder(),
-            self._native_decoder.decode if self._native_decoder else None)
+            self._native_decoder.decode if self._native_decoder else None,
+            traceparent=traceparent)
 
     def ingest_binary_batch(self, payloads: list[bytes],
-                            tenant: str = "default") -> dict:
+                            tenant: str = "default",
+                            traceparent: str | None = None) -> dict:
         from sitewhere_tpu.ingest.decoders import BinaryEventDecoder
 
         return self._ingest_batch(
             payloads, tenant, WAL_BINARY, BinaryEventDecoder(),
             self._native_decoder.decode_binary if self._native_decoder
-            else None)
+            else None, traceparent=traceparent)
 
     def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
         """Stage a natively decoded SoA batch, grouped by owning shard with
@@ -595,13 +609,19 @@ class DistributedEngine(IngestHostMixin):
             return None
 
     def flush(self) -> dict:
+        import logging
+
         from sitewhere_tpu.utils.tracing import stage
 
-        with self.lock, stage("sharded_step"):
-            self.flush_async()
-            while self._fair_queued.sum():
+        try:
+            with self.lock, stage("sharded_step"):
                 self.flush_async()
-            return self.drain()[-1]
+                while self._fair_queued.sum():
+                    self.flush_async()
+                return self.drain()[-1]
+        except Exception:
+            self.flight.dump_error(logging.getLogger(__name__))
+            raise
 
     def flush_async(self) -> None:
         """Dispatch one stacked step (no host sync); outputs queue for
@@ -615,8 +635,12 @@ class DistributedEngine(IngestHostMixin):
                 return
             n_staged = int(max(self._buf.counts))  # worst shard's rows
             batch = self._buf.emit()
+            traces, self._staged_traces = self._staged_traces, []
+            for rec in traces:
+                rec.mark("dispatch")
             out = self.sharded.step(batch)
             self._pending_outs.append(out)
+            self._pending_traces.append(traces)
             self._last_flush = time.monotonic()
             if self.archive is not None:
                 # per-shard bound: each staged row persists at most one
@@ -670,9 +694,15 @@ class DistributedEngine(IngestHostMixin):
                 return [{"found": 0, "missed": 0, "registered": 0,
                          "persisted": 0, "new_tokens": [], "dead_tokens": []}]
             outs, self._pending_outs = self._pending_outs, []
+            trace_lists, self._pending_traces = self._pending_traces, []
             scalars = jax.device_get([
                 (o.n_found, o.n_missed, o.n_registered, o.n_persisted)
                 for o in outs])
+            for recs in trace_lists:   # the device_get observed completion
+                for rec in recs:
+                    if "device_ready" not in rec.stages:
+                        rec.mark("device_ready")
+                    rec.mark("readback")
             summaries = [self._absorb_output(o, s)
                          for o, s in zip(outs, scalars)]
             self._mirror_new_device_tenants()
@@ -1444,14 +1474,28 @@ class DistributedEngine(IngestHostMixin):
         return tenant_counts_dict(counts, self.tenants, n_tenants)
 
     def shard_metrics(self) -> list[dict]:
-        """Per-shard counters (the per-partition consumer-lag analog)."""
+        """Per-shard counters (the per-partition consumer-lag analog).
+        Only scalar counter fields report here; the packed per-tenant
+        grid has its own accessor (tenant_pipeline_counters)."""
         mm = jax.device_get(self.state.metrics)
-        fields = [f.name for f in dataclasses.fields(mm)]
+        fields = [f.name for f in dataclasses.fields(mm)
+                  if np.ndim(getattr(mm, f.name)) == 1]   # [S] scalars only
         return [
             {name: int(np.asarray(getattr(mm, name))[s]) for name in fields}
             | {"devices": int(self._next_device[s])}
             for s in range(self.n_shards)
         ]
+
+    def tenant_pipeline_counters(self) -> dict[str, dict[str, int]]:
+        """Engine-parity device-side per-tenant counter grid, summed over
+        shards (tenant ids are engine-global, so the per-shard [T, C]
+        grids add exactly). Read back on the scrape path only."""
+        from sitewhere_tpu.engine import format_tenant_counter_grid
+
+        with self.lock:
+            grid = np.asarray(jax.device_get(
+                self.state.metrics.tenant_counters)).sum(axis=0)
+            return format_tenant_counter_grid(grid, self.tenants)
 
     # ------------------------------------------------------------- durability
     def total_cursor(self) -> int:

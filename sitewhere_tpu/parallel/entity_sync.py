@@ -55,6 +55,12 @@ import typing
 
 logger = logging.getLogger(__name__)
 
+# state-transfer paging defaults: each page must comfortably clear the
+# RPC frame cap (rpc/protocol.MAX_FRAME, 16 MiB) with json overhead
+STATE_PAGE_ENTRIES = 512
+STATE_PAGE_BYTES = 2 << 20
+_MAX_TRANSFERS = 4   # concurrent in-progress state transfers retained
+
 
 # --------------------------------------------------------------------------
 # generic dataclass <-> JSON-state codec
@@ -158,6 +164,18 @@ class EntityReplicator:
         # (Cluster.entityState) instead of op backfill.
         self.compact_threshold = int(compact_threshold)
         self.compact_keep = int(compact_keep)
+        # paged state transfer (ADVICE r5 medium): Cluster.entityState
+        # ships fixed-size chunks with a continuation cursor so an LWW
+        # dump larger than one RPC frame (MAX_FRAME) can still converge a
+        # late joiner. Knobs are instance attrs so tests can shrink them.
+        self.state_page_entries = STATE_PAGE_ENTRIES
+        self.state_page_bytes = STATE_PAGE_BYTES
+        # in-progress transfers: tid -> (key snapshot, vector snapshot).
+        # The snapshot pins ordering (no mid-transfer insert can shift the
+        # cursor past an unseen entity) and the vector is captured BEFORE
+        # the first page, so any op that lands mid-transfer has a seq
+        # ABOVE it and back-fills through the puller's next ops_since.
+        self._transfers: "dict[str, tuple[list, dict]]" = {}
         # adaptive re-arm: when a wide cluster's per-origin tails alone
         # exceed the configured threshold (n_ranks * keep > threshold),
         # the next trigger moves to 2x the post-compaction residue so
@@ -167,7 +185,8 @@ class EntityReplicator:
         self.counters = {"emitted": 0, "applied": 0, "lww_skipped": 0,
                          "push_failures": 0, "gap_backfills": 0,
                          "sync_pulls": 0, "apply_errors": 0,
-                         "compactions": 0, "state_transfers": 0}
+                         "compactions": 0, "state_transfers": 0,
+                         "state_pages_served": 0}
         self._log = None
         self._log_dir = None
         self._compacting = False           # journal snapshot in flight
@@ -548,9 +567,70 @@ class EntityReplicator:
                 "entries": entries}
 
     def state_dump(self) -> dict:
-        """The anti-entropy state-transfer payload (Cluster.entityState)."""
+        """The FULL state-transfer payload — journal/compaction form (the
+        journal has no frame cap). The RPC surface serves the PAGED form
+        (:meth:`state_page`) instead, so a dump larger than MAX_FRAME can
+        still cross the wire."""
         with self._lock:
             return self._state_dump_locked()
+
+    def state_page(self, cursor: dict | None = None) -> dict:
+        """One page of the LWW state transfer (Cluster.entityState).
+
+        First call (``cursor=None``) snapshots the entity KEY list and
+        the receipt vector, then every page resolves entries lazily
+        against CURRENT state (mid-transfer mutations are LWW-safe: the
+        entry ships the newer state, and its op's seq sits above the
+        snapshot vector, so the puller's next ops_since heals any
+        ordering edge). The final page carries the snapshot ``vector``;
+        earlier pages carry a continuation ``cursor``. A page never
+        exceeds ~``state_page_bytes`` of entry payload or
+        ``state_page_entries`` entries, bounding the frame well under
+        MAX_FRAME (ADVICE r5 medium: one oversized dump permanently
+        prevented a late joiner from converging)."""
+        with self._lock:
+            if cursor is None:
+                tid = f"{self.rank}-{time.time_ns()}"
+                keys = sorted(self._last)
+                self._transfers[tid] = (keys, dict(self.vector))
+                # cap scales with the cluster: every OTHER rank may be a
+                # late joiner paging concurrently, and evicting an active
+                # transfer makes its puller restart (mutual-eviction
+                # thrash); oldest-first eviction only bounds abandonment
+                cap = max(_MAX_TRANSFERS, self.cluster.n_ranks)
+                while len(self._transfers) > cap:
+                    # oldest first (insertion-ordered dict)
+                    self._transfers.pop(next(iter(self._transfers)))
+                pos = 0
+            else:
+                tid = cursor.get("tid")
+                entry = self._transfers.get(tid)
+                if entry is None:
+                    # snapshot evicted (server restart / LRU): the caller
+                    # restarts the transfer — LWW application makes the
+                    # repeated entries idempotent
+                    return {"expired": True}
+                keys = entry[0]
+                pos = int(cursor.get("pos", 0))
+            keys_snap, vector = self._transfers[tid]
+            entries, size = [], 0
+            while (pos < len(keys_snap) and len(entries) <
+                   self.state_page_entries and size < self.state_page_bytes):
+                kind, token = keys_snap[pos]
+                pos += 1
+                lww = self._last.get((kind, token))
+                if lww is None:
+                    continue
+                e = {"kind": kind, "token": token, "ts": lww[0],
+                     "origin": lww[1],
+                     "state": self._current_state(kind, token)}
+                entries.append(e)
+                size += len(json.dumps(e, default=str))
+            self.counters["state_pages_served"] += 1
+            if pos >= len(keys_snap):
+                del self._transfers[tid]
+                return {"entries": entries, "vector": vector}
+            return {"entries": entries, "cursor": {"tid": tid, "pos": pos}}
 
     def _apply_dump_locked(self, dump: dict, journal: bool) -> int:
         """Converge onto a peer's (or the journal's) state dump: apply
@@ -702,8 +782,7 @@ class EntityReplicator:
                 if isinstance(ops, dict) and ops.get("reset"):
                     # we are behind the peer's compaction floor: pull its
                     # full LWW state instead of an op backfill
-                    dump = c._peer(r).call("Cluster.entityState")
-                    total += self.apply_state_dump(dump)
+                    total += self._pull_state(r)
                 else:
                     total += self.apply_batch(ops)
             except (ConnectionError, TimeoutError, RpcError):
@@ -714,6 +793,34 @@ class EntityReplicator:
                     raise
         self.counters["sync_pulls"] += 1
         return total
+
+    def _pull_state(self, peer_rank: int) -> int:
+        """Paged LWW state transfer from one peer: walk the continuation
+        cursor until the final page (which carries the vector), assemble
+        the full dump, then apply + journal it atomically through the
+        existing apply_state_dump path. Each page is bounded under
+        MAX_FRAME, so an entity plane of ANY size converges."""
+        peer = self.cluster._peer(peer_rank)
+        entries: list[dict] = []
+        cursor = None
+        restarts = 0
+        while True:
+            page = peer.call("Cluster.entityState", cursor=cursor)
+            if page.get("expired"):
+                # the peer evicted our transfer snapshot (restart / LRU
+                # pressure): start over — entries re-apply idempotently
+                restarts += 1
+                if restarts > 3:
+                    raise ConnectionError(
+                        f"entity state transfer from rank {peer_rank} "
+                        "kept expiring")
+                entries, cursor = [], None
+                continue
+            entries.extend(page.get("entries", ()))
+            if "vector" in page:
+                return self.apply_state_dump(
+                    {"entries": entries, "vector": page["vector"]})
+            cursor = page["cursor"]
 
     def metrics(self) -> dict:
         with self._lock:
@@ -738,7 +845,10 @@ class EntityReplicator:
                      lambda ops: {"applied": self.apply_batch(ops)})
         srv.register("Cluster.entityOpsSince",
                      lambda vector: self.ops_since(vector))
-        srv.register("Cluster.entityState", lambda: self.state_dump())
+        # paged: a dump larger than one frame ships as cursor-chained
+        # pages (ADVICE r5 medium — see state_page)
+        srv.register("Cluster.entityState",
+                     lambda cursor=None: self.state_page(cursor))
         srv.register("Cluster.entityVector",
                      lambda: {str(k): v for k, v in self.vector.items()})
 

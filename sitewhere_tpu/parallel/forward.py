@@ -152,9 +152,16 @@ class ForwardQueue:
               payloads: list[bytes] | None = None,
               envelope: dict | None = None) -> None:
         """Persist one undeliverable forward (kind: "json" | "binary" |
-        "envelope"). Atomic write: tmp + rename, CRC over the body."""
+        "envelope"). Atomic write: tmp + rename, CRC over the body. The
+        bound traceparent rides the record so a redelivery hours later
+        still joins the original batch's trace."""
+        from sitewhere_tpu.utils.tracing import current_traceparent
+
         rec = {"fid": fid, "kind": kind, "tenant": tenant,
                "spilled_ms": time.time() * 1000}
+        tp = current_traceparent()
+        if tp is not None:
+            rec["tp"] = tp
         if payloads is not None:
             rec["payloads"] = [base64.b64encode(p).decode() for p in payloads]
         if envelope is not None:
@@ -190,15 +197,18 @@ class ForwardQueue:
 
     # ------------------------------------------------------------ retry
     def _deliver(self, rank: int, rec: dict) -> None:
+        from sitewhere_tpu.utils.tracing import bind_traceparent
+
         peer = self.cluster._peer(rank)
         kind = rec["kind"]
-        if kind == "envelope":
-            peer.call("Cluster.forwardEnvelope", fid=rec["fid"],
-                      envelope=rec["envelope"], tenant=rec["tenant"])
-        else:
-            peer.call("Cluster.ingestForward", fid=rec["fid"],
-                      payloads=rec["payloads"], tenant=rec["tenant"],
-                      encoding=kind)
+        with bind_traceparent(rec.get("tp")):
+            if kind == "envelope":
+                peer.call("Cluster.forwardEnvelope", fid=rec["fid"],
+                          envelope=rec["envelope"], tenant=rec["tenant"])
+            else:
+                peer.call("Cluster.ingestForward", fid=rec["fid"],
+                          payloads=rec["payloads"], tenant=rec["tenant"],
+                          encoding=kind)
 
     def retry_once(self) -> int:
         """One pass over every peer queue, oldest-first; returns batches

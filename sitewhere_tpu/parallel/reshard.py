@@ -292,9 +292,12 @@ def reshard_snapshot(src_dir, dst_dir, n_shards_new: int,
     for key in data:
         if key.startswith(".metrics."):
             # per-shard attribution doesn't survive a reshard; keep the
-            # global totals exact by folding them onto shard 0
-            new = np.zeros(m, data[key].dtype)
-            new[0] = data[key].sum()
+            # global totals exact by folding them onto shard 0 (summing
+            # over the shard axis only — the packed per-tenant counter
+            # grid keeps its [T, C] shape)
+            arr = data[key]
+            new = np.zeros((m,) + arr.shape[1:], arr.dtype)
+            new[0] = arr.sum(axis=0)
             out[key] = new
 
     np.savez_compressed(dst / "sharded_state.npz", **out)

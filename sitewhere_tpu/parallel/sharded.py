@@ -175,11 +175,15 @@ class ShardedEngine:
         return out
 
     def global_metrics(self):
-        """Sum per-shard metrics (host-side psum analog for reporting)."""
+        """Sum per-shard metrics (host-side psum analog for reporting).
+        Only scalar counters ([S] after stacking) fold here — the packed
+        per-tenant counter grid is served whole by the engine's
+        tenant_pipeline_counters accessor, not as one meaningless sum."""
         m = self.state.metrics
         return {
             f.name: int(jnp.sum(getattr(m, f.name)))
             for f in dataclasses.fields(m)
+            if jnp.ndim(getattr(m, f.name)) <= 1
         }
 
     # --------------------------------------------------------------- queries
@@ -302,6 +306,12 @@ class ShardedEngine:
         leaves = []
         for (p, cur), sh in zip(flat, shardings_flat):
             key = jax.tree_util.keystr(p)
+            if key.startswith(".metrics.") and key not in data.files:
+                # a counter added after the snapshot was written (e.g.
+                # tenant_counters, PR 3): keep the fresh zeros — restore
+                # old history rather than refusing it
+                leaves.append(cur)
+                continue
             arr = data[key]
             if arr.shape != cur.shape:
                 raise ValueError(

@@ -40,6 +40,27 @@ from sitewhere_tpu.ops.segment import compact_valid_front
 from sitewhere_tpu.ops.window import merge_batch_state, presence_sweep
 
 
+# per-tenant device-side counter grid: tenants bucket by ``id %
+# TENANT_COUNTER_BUCKETS`` (static, so the compiled program never
+# re-traces as tenants grow; deployments beyond 64 tenants alias buckets
+# — exact attribution stays with the readback-based tenant_metrics path)
+TENANT_COUNTER_BUCKETS = 64
+TENANT_COUNTER_LANES = ("accepted", "dedup_dropped", "geofence_hit",
+                        "invalid")
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class ZoneTable:
+    """Device-resident geofence polygons (ops/geofence.pack_zones layout)
+    for the in-step geofence-hit counter — the zone monitor's polygons,
+    resident in HBM so the already-running program can count containment
+    without any extra dispatch."""
+
+    verts: jax.Array    # float32[Z, V, 2] (lat, lon), padded per pack_zones
+    valid: jax.Array    # bool[Z]
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class PipelineMetrics:
@@ -53,11 +74,19 @@ class PipelineMetrics:
     registered: jax.Array   # int32[] devices auto-registered
     persisted: jax.Array    # int32[] event rows appended to the store
     reg_overflow: jax.Array # int32[] batches that hit registry capacity
+    # packed per-tenant lifecycle grid, accumulated INSIDE the step (no
+    # extra dispatch, no readback until a metrics scrape):
+    # int32[TENANT_COUNTER_BUCKETS, len(TENANT_COUNTER_LANES)]
+    tenant_counters: jax.Array
 
     @staticmethod
     def zeros() -> "PipelineMetrics":
         # distinct arrays: aliased buffers break donation in jitted steps
-        return PipelineMetrics(*(jnp.zeros((), jnp.int32) for _ in range(6)))
+        return PipelineMetrics(
+            *(jnp.zeros((), jnp.int32) for _ in range(6)),
+            tenant_counters=jnp.zeros(
+                (TENANT_COUNTER_BUCKETS, len(TENANT_COUNTER_LANES)),
+                jnp.int32))
 
 
 @jax.tree_util.register_dataclass
@@ -74,6 +103,9 @@ class PipelineState:
     # optional HBM-resident telemetry windows feeding the analytics service
     # (BASELINE.json north star); None disables the update stage.
     windows: TelemetryWindows | None = None
+    # optional geofence polygons for the in-step geofence-hit counter
+    # (Engine.set_geofence_zones); None keeps the lane at zero.
+    zones: ZoneTable | None = None
 
     @staticmethod
     def create(
@@ -115,6 +147,60 @@ class PipelineConfig:
     default_device_type: int = 0
     default_area: int = NULL_ID
     default_customer: int = NULL_ID
+
+
+def _tenant_counter_delta(batch: EventBatch, accepted: jax.Array,
+                          invalid: jax.Array,
+                          zones: ZoneTable | None) -> jax.Array:
+    """[T_BUCKETS, 4] per-tenant lifecycle deltas for this batch, computed
+    entirely inside the already-running program:
+
+      accepted       rows matched to a registered device
+      dedup_dropped  in-batch alternate-id duplicates (same token + same
+                     aux1 correlation id appearing more than once — the
+                     AlternateIdDeduplicator's redelivery signature,
+                     detected with one stable sort instead of a host
+                     LRU). Only rows whose staging path populates aux1
+                     can count: the per-request process() path does; the
+                     native batch decoders do not yet extract
+                     alternateId, so batch-staged rows read 0 here.
+      geofence_hit   location rows inside any configured zone polygon
+      invalid        rows still unmatched after auto-registration
+
+    The reduction is a one-hot matmul (MXU-friendly, no scatter), the
+    pattern of engine._tenant_event_counts."""
+    b = batch.capacity
+    aux1 = batch.aux[:, 1]
+    has_alt = batch.valid & (aux1 != NULL_ID)
+    # rows without an alternate id get unique sentinel keys so they can
+    # never pair; two-pass stable argsort = lexsort by (token, aux1)
+    alt_key = jnp.where(has_alt, aux1, -2 - jnp.arange(b, dtype=jnp.int32))
+    order1 = jnp.argsort(alt_key)
+    order = order1[jnp.argsort(batch.token_id[order1])]
+    st = batch.token_id[order]
+    sa = alt_key[order]
+    dup_sorted = jnp.concatenate([
+        jnp.zeros((1,), bool), (st[1:] == st[:-1]) & (sa[1:] == sa[:-1])])
+    dedup = jnp.zeros(b, bool).at[order].set(dup_sorted) & has_alt
+
+    if zones is not None:
+        from sitewhere_tpu.ops.geofence import points_in_zones
+
+        is_loc = (batch.valid & (batch.etype == int(EventType.LOCATION))
+                  & batch.vmask[:, 0])
+        inz = points_in_zones(batch.values[:, :2], zones.verts, zones.valid)
+        geo = is_loc & jnp.any(inz, axis=1)
+    else:
+        geo = jnp.zeros(b, bool)
+
+    bucket = jnp.where(batch.valid,
+                       batch.tenant_id % TENANT_COUNTER_BUCKETS, -1)
+    onehot = (bucket[:, None]
+              == jnp.arange(TENANT_COUNTER_BUCKETS)[None, :]).astype(
+                  jnp.int32)                                      # [B, T]
+    lanes = jnp.stack([accepted, dedup, geo, invalid],
+                      axis=-1).astype(jnp.int32)                  # [B, 4]
+    return jnp.einsum("bt,bc->tc", onehot, lanes)
 
 
 class StepOutput(NamedTuple):
@@ -229,6 +315,9 @@ def pipeline_step(
         registered=m.registered + n_registered,
         persisted=m.persisted + persist.appended,
         reg_overflow=m.reg_overflow + reg_overflow,
+        tenant_counters=m.tenant_counters + _tenant_counter_delta(
+            batch, accepted=res.found, invalid=res.miss,
+            zones=state.zones),
     )
 
     new_state = PipelineState(
@@ -239,6 +328,7 @@ def pipeline_step(
         next_assignment=next_assignment,
         metrics=metrics,
         windows=windows,
+        zones=state.zones,
     )
     out = StepOutput(
         n_found=n_found,

@@ -103,10 +103,20 @@ class RpcClient:
         # reserved: a bytes blob under _attachment rides the frame RAW
         # (no base64/json escaping) — the cross-rank payload hot path
         attachment = params.pop("_attachment", None)
+        # reserved: _tp carries the W3C traceparent OUTSIDE params (the
+        # handler never sees it as an argument); explicit wins over the
+        # caller task's bound context
+        traceparent = params.pop("_tp", None)
+        if traceparent is None:
+            from sitewhere_tpu.utils.tracing import current_traceparent
+
+            traceparent = current_traceparent()
         rid = next(self._ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending[rid] = fut
         req = {"id": rid, "method": method, "params": params}
+        if traceparent is not None:
+            req["tp"] = traceparent
         if self.tenant is not None:
             req["tenant"] = self.tenant
         try:

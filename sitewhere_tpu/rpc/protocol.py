@@ -11,6 +11,12 @@ equivalent keeps that role: a compact length-prefixed framing over TCP
 ``{"id", "result"} | {"id", "error", "code"}`` responses. Streams
 multiplex by id, so one connection carries concurrent in-flight calls the
 way HTTP/2 does for gRPC.
+
+Request frames may additionally carry a ``"tp"`` field — a W3C-shaped
+``traceparent`` (utils/tracing.py) that the server binds around the
+handler, so a batch forwarded across ranks keeps ONE trace id end to end
+(the Dapper-context header of the reference's Istio mesh). It rides the
+frame, never ``params``: handlers are traceparent-oblivious.
 """
 
 from __future__ import annotations
@@ -20,6 +26,9 @@ import struct
 from typing import Any
 
 MAX_FRAME = 16 << 20  # 16 MiB, mirrors gRPC's default max message scale
+
+# reserved top-level frame key for the cross-rank traceparent
+TRACEPARENT_KEY = "tp"
 
 # high bit of the length word marks a BINARY ATTACHMENT following the
 # JSON body (4-byte length + raw bytes). The hot cross-rank forwarding

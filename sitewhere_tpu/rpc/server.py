@@ -201,9 +201,15 @@ class RpcServer:
                     raise RpcError(
                         f"unknown tenant {params['tenant']!r}", 404)
                 authorize(params["tenant"])
-            result = fn(**params)
-            if isinstance(result, Awaitable):
-                result = await result
+            # bind the frame's traceparent (contextvar: per-task, so
+            # multiplexed calls cannot cross-talk) around the handler —
+            # the owner-side ingest joins the sender's trace through it
+            from sitewhere_tpu.utils.tracing import bind_traceparent
+
+            with bind_traceparent(frame.get("tp")):
+                result = fn(**params)
+                if isinstance(result, Awaitable):
+                    result = await result
             resp = {"id": rid, "result": result}
         except _Respond as r:
             resp = r.resp

@@ -100,12 +100,18 @@ def restore_engine(directory: str | pathlib.Path) -> Engine:
     engine = Engine(config)
     engine.epoch = EpochBase(host["epoch_base_unix_s"])
 
-    # device state arrays: rebuild the pytree with saved leaves
+    # device state arrays: rebuild the pytree with saved leaves. A
+    # metrics counter the snapshot predates (e.g. tenant_counters, added
+    # in PR 3) keeps its fresh zeros — counters start over rather than
+    # refusing to restore pre-upgrade history
     data = np.load(directory / "state.npz")
     flat, treedef = jax.tree_util.tree_flatten_with_path(engine.state)
     leaves = []
     for path, leaf in flat:
         key = jax.tree_util.keystr(path)
+        if key.startswith(".metrics.") and key not in data.files:
+            leaves.append(leaf)
+            continue
         arr = data[key]
         leaves.append(jax.numpy.asarray(arr))
     engine.state = jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,213 @@
+"""Flight recorder: fixed-size, lock-light ring of batch-lifecycle records.
+
+The reference reconstructs a message's journey from Istio/Zipkin spans and
+per-stage Prometheus histograms (SURVEY.md §5.1); the TPU-native engine's
+batch path is a single process, so a hosted tracer would cost more than
+the stages it measures. Instead every ingest batch gets ONE preallocated
+record slot carrying monotonic timestamps for each lifecycle stage:
+
+    ingest -> decode -> arena fill -> WAL append -> commit -> dispatch
+           -> device-ready -> readback
+
+``device_ready`` is harvested opportunistically: the arena-recycle wait
+(ingest/arena.ArenaPool) already observes the step output before reusing
+the staging buffers, so observing it costs ZERO extra host<->device
+syncs; ``drain()`` backfills it for records whose arena was never
+recycled before the readback. Records are dicts + a couple of lists —
+marking a stage is one monotonic clock read and one dict store under the
+GIL, no lock on the hot path (the ring lock covers only slot allocation
+and index maintenance).
+
+Trace ids are W3C-shaped (utils/tracing.py) and shared across ranks: a
+forwarded sub-batch's owner-side record carries the SAME trace id as the
+sender's, so `/api/instance/trace/<id>` resolves the full cross-rank
+journey from any rank (parallel/cluster.get_trace fans out).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from sitewhere_tpu.utils.tracing import (current_traceparent, new_trace_id,
+                                         trace_id_of)
+
+# canonical stage ordering for rendering (records carry only the stages
+# their path actually visited)
+STAGE_ORDER = ("decode", "arena_fill", "wal_append", "commit", "dispatch",
+               "device_ready", "readback")
+
+
+class FlightRecord:
+    """One batch's lifecycle. Stage marks are idempotent-overwrite (a
+    multi-chunk ingest keeps the LAST completion per stage); ``meta``
+    carries counts and path annotations."""
+
+    __slots__ = ("trace_id", "kind", "tenant", "rank", "n_payloads",
+                 "t0_unix_ms", "t0_ns", "stages", "meta")
+
+    def __init__(self, trace_id: str | None, kind: str, tenant: str,
+                 rank: int, n_payloads: int):
+        self.trace_id = trace_id
+        self.kind = kind
+        self.tenant = tenant
+        self.rank = rank
+        self.n_payloads = n_payloads
+        self.t0_unix_ms = int(time.time() * 1000)
+        self.t0_ns = time.perf_counter_ns()
+        self.stages: dict[str, int] = {}
+        self.meta: dict[str, object] = {}
+
+    def mark(self, stage: str) -> None:
+        self.stages[stage] = time.perf_counter_ns()
+
+    def add(self, key: str, value) -> None:
+        self.meta[key] = value
+
+    def add_counts(self, summary: dict) -> None:
+        for k in ("decoded", "failed", "staged", "spilled", "persisted"):
+            v = summary.get(k)
+            if v:
+                self.meta[k] = v
+
+    def to_dict(self) -> dict:
+        """JSON-able view: per-stage offsets in microseconds from record
+        creation (monotonic), plus identity and counts. Snapshots the
+        stage dict first (C-level copy, atomic under the GIL): a scrape
+        may read a record the ingest thread is still marking."""
+        stages = dict(self.stages)
+        meta = dict(self.meta)
+        return {"traceId": self.trace_id, "kind": self.kind,
+                "tenant": self.tenant, "rank": self.rank,
+                "payloads": self.n_payloads, "startedMs": self.t0_unix_ms,
+                "stagesUs": {name: round((ns - self.t0_ns) / 1000.0, 1)
+                             for name, ns in stages.items()},
+                **meta}
+
+
+class _NullRecord:
+    """No-op record handed out while the recorder is disabled — the hot
+    path stays branch-free (mark/add are called unconditionally)."""
+
+    trace_id = None
+    stages: dict = {}
+    meta: dict = {}
+
+    def mark(self, stage: str) -> None:
+        pass
+
+    def add(self, key: str, value) -> None:
+        pass
+
+    def add_counts(self, summary: dict) -> None:
+        pass
+
+    def to_dict(self) -> dict:
+        return {}
+
+
+NULL_RECORD = _NullRecord()
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of FlightRecords with a trace-id index.
+
+    ``begin`` allocates a slot (evicting the oldest) under a short lock;
+    everything after that is lock-free record mutation. ``bind`` exposes
+    the batch's record to nested layers (the WAL append lives three
+    frames below the ingest entry point) via a thread-local.
+    """
+
+    def __init__(self, capacity: int = 1024, rank: int = 0,
+                 enabled: bool = True):
+        if capacity < 1:
+            raise ValueError("flight recorder needs capacity >= 1")
+        self.capacity = capacity
+        self.rank = rank
+        self.enabled = enabled
+        self._ring: list[FlightRecord | None] = [None] * capacity
+        self._head = 0
+        self._by_id: dict[str, list[FlightRecord]] = {}
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.dropped = 0    # records evicted before ever being read
+
+    # ------------------------------------------------------------ record
+    def begin(self, kind: str, tenant: str = "default", n_payloads: int = 0,
+              traceparent: str | None = None) -> FlightRecord:
+        """Start a record. ``traceparent`` (or the bound context's) names
+        the trace this batch belongs to — a forwarded batch's owner-side
+        record JOINS the sender's trace instead of opening a new one."""
+        if not self.enabled:
+            return NULL_RECORD
+        tid = trace_id_of(traceparent) or new_trace_id(self.rank)
+        rec = FlightRecord(tid, kind, tenant, self.rank, n_payloads)
+        with self._lock:
+            old = self._ring[self._head]
+            if old is not None:
+                peers = self._by_id.get(old.trace_id)
+                if peers is not None:
+                    try:
+                        peers.remove(old)
+                    except ValueError:
+                        pass
+                    if not peers:
+                        del self._by_id[old.trace_id]
+                self.dropped += 1
+            self._ring[self._head] = rec
+            self._head = (self._head + 1) % self.capacity
+            self._by_id.setdefault(tid, []).append(rec)
+        return rec
+
+    def bind(self, rec):
+        """Context manager making ``rec`` this thread's current record."""
+        recorder = self
+
+        class _Bind:
+            def __enter__(self):
+                self.prev = getattr(recorder._local, "rec", None)
+                recorder._local.rec = rec
+                return rec
+
+            def __exit__(self, *exc):
+                recorder._local.rec = self.prev
+
+        return _Bind()
+
+    def current(self) -> FlightRecord | _NullRecord:
+        rec = getattr(self._local, "rec", None)
+        return rec if rec is not None else NULL_RECORD
+
+    # ------------------------------------------------------------- query
+    def records_of(self, trace_id: str) -> list[dict]:
+        with self._lock:
+            recs = list(self._by_id.get(trace_id, ()))
+        return [r.to_dict() for r in recs]
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        """Newest-first records (bounded by ``limit``)."""
+        out = []
+        with self._lock:
+            i = (self._head - 1) % self.capacity
+            for _ in range(min(limit, self.capacity)):
+                rec = self._ring[i]
+                if rec is not None:
+                    out.append(rec)
+                i = (i - 1) % self.capacity
+        return [r.to_dict() for r in out]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._ring if r is not None)
+
+    def dump_error(self, logger) -> None:
+        """Emit the recent lifecycle records on a pipeline error — the
+        post-mortem the operator would otherwise reconstruct from logs."""
+        try:
+            import json
+
+            recs = self.recent(16)
+            logger.error("pipeline error — last %d flight records: %s",
+                         len(recs), json.dumps(recs, default=str))
+        except Exception:       # the dump must never mask the real error
+            logger.exception("flight recorder dump failed")

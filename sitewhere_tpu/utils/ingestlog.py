@@ -21,11 +21,19 @@ import json
 import pathlib
 import struct
 import threading
+import time
 import zlib
 from typing import Iterator
 
+from sitewhere_tpu.utils.metrics import REGISTRY
+
 _WATERMARK = 0xFFFFFFFF
 _MAGIC = b"SWAL1\n"   # segment format marker; absent = legacy length-only
+
+# fsync dominates the durability tail; the histogram makes a slow disk
+# visible on the same scrape page as the e2e latency it inflates
+_FSYNC_HIST = REGISTRY.histogram("swtpu_wal_fsync_seconds",
+                                 "WAL fsync latency")
 
 
 class IngestLog:
@@ -114,7 +122,9 @@ class IngestLog:
             self._fh.flush()
             import os
 
+            t0 = time.perf_counter()
             os.fsync(self._fh.fileno())
+            _FSYNC_HIST.observe(time.perf_counter() - t0)
 
     def close(self) -> None:
         with self._lock:

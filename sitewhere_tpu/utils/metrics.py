@@ -22,43 +22,88 @@ _DEFAULT_BUCKETS = (
 )
 
 
+# Prometheus text-format label escaping: backslash first (escaping the
+# escapes), then quote and newline — a label value containing any of the
+# three must not corrupt the line structure of the exposition
+_LABEL_ESCAPES = {"\\": "\\\\", '"': '\\"', "\n": "\\n"}
+
+
+def _escape_label(value) -> str:
+    s = str(value)
+    if "\\" in s or '"' in s or "\n" in s:
+        for raw, esc in _LABEL_ESCAPES.items():
+            s = s.replace(raw, esc)
+    return s
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 
-class Counter:
+class _LabeledSeries:
+    """Shared labeled-value storage behind Counter and Gauge. NOT a metric
+    kind itself: Counter and Gauge expose disjoint APIs (a counter only
+    increases; a gauge moves freely), so neither inherits the other."""
+
+    kind = "untyped"
+
     def __init__(self, name: str, help_text: str):
         self.name = name
         self.help = help_text
         self._values: dict[tuple, float] = {}
         self._lock = threading.Lock()
 
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def expose(self) -> Iterator[str]:
+        yield f"# HELP {self.name} {self.help}"
+        yield f"# TYPE {self.name} {self.kind}"
+        with self._lock:       # snapshot: a concurrent write mid-iteration
+            items = sorted(self._values.items())
+        for key, val in items:
+            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+
+
+class Counter(_LabeledSeries):
+    """Monotonically increasing count. There is deliberately no ``set``:
+    a sample that can move backwards is a Gauge, and Prometheus rate()
+    over a counter that decreased reads as a counter reset."""
+
+    kind = "counter"
+
     def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(
+                f"counter {self.name} cannot decrease; use a gauge")
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
+
+
+class Gauge(_LabeledSeries):
+    """Point-in-time sample: settable, and inc/dec move it either way."""
+
+    kind = "gauge"
 
     def set(self, value: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
         with self._lock:
             self._values[key] = value
 
-    def expose(self) -> Iterator[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} counter"
-        for key, val in sorted(self._values.items()):
-            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
 
-
-class Gauge(Counter):
-    def expose(self) -> Iterator[str]:
-        yield f"# HELP {self.name} {self.help}"
-        yield f"# TYPE {self.name} gauge"
-        for key, val in sorted(self._values.items()):
-            yield f"{self.name}{_fmt_labels(dict(key))} {val}"
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
 
     def retain(self, keys: set) -> None:
         """Drop series not written by the current export — a drained
@@ -126,17 +171,22 @@ class Histogram:
     def expose(self) -> Iterator[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
-        for key in sorted(self._counts):
+        with self._lock:       # snapshot: observe() mutates these in place
+            keys = sorted(self._counts)
+            counts = {k: list(self._counts[k]) for k in keys}
+            sums = dict(self._sums)
+            totals = dict(self._totals)
+        for key in keys:
             labels = dict(key)
             acc = 0
-            for bound, c in zip(self.buckets, self._counts[key]):
+            for bound, c in zip(self.buckets, counts[key]):
                 acc += c
                 le = dict(labels, le=repr(bound))
                 yield f"{self.name}_bucket{_fmt_labels(le)} {acc}"
             inf = dict(labels, le="+Inf")
-            yield f"{self.name}_bucket{_fmt_labels(inf)} {self._totals[key]}"
-            yield f"{self.name}_sum{_fmt_labels(labels)} {self._sums[key]}"
-            yield f"{self.name}_count{_fmt_labels(labels)} {self._totals[key]}"
+            yield f"{self.name}_bucket{_fmt_labels(inf)} {totals[key]}"
+            yield f"{self.name}_sum{_fmt_labels(labels)} {sums[key]}"
+            yield f"{self.name}_count{_fmt_labels(labels)} {totals[key]}"
 
 
 class MetricsRegistry:
@@ -165,8 +215,10 @@ class MetricsRegistry:
             return m
 
     def expose_text(self) -> str:
+        with self._lock:       # snapshot the registry: a concurrent
+            metrics = list(self._metrics.values())   # register() mid-scrape
         lines: list[str] = []
-        for m in self._metrics.values():
+        for m in metrics:
             lines.extend(m.expose())
         return "\n".join(lines) + "\n"
 
@@ -227,3 +279,58 @@ def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
         stale = [k for k in g._values if k not in current]
     for key in stale:
         g.set(0, **dict(key))
+    export_observability_metrics(engine, reg)
+
+
+def export_observability_metrics(engine, registry: MetricsRegistry | None
+                                 = None) -> None:
+    """Scrape-time export of the telemetry surfaces PR 3 added: the
+    device-side per-tenant pipeline counter grid (computed INSIDE the jit
+    step — zero extra host<->device syncs on the ingest path; the grid is
+    read back here, on the scrape path, like every other device counter),
+    plus host gauges for arena-pool occupancy, in-flight dispatch depth,
+    and the cross-rank spill queue."""
+    reg = registry or REGISTRY
+
+    tpc = getattr(engine, "tenant_pipeline_counters", None)
+    if callable(tpc):
+        for ten, lanes in tpc().items():
+            for lane, n in lanes.items():
+                reg.gauge(f"swtpu_pipeline_{lane}",
+                          f"device-side per-tenant {lane} event count "
+                          "(computed in the jit step)").set(n, tenant=ten)
+
+    pool = getattr(engine, "_arena_pool", None)
+    if pool is not None:
+        reg.gauge("swtpu_arena_pool_arenas",
+                  "staging arenas in the ingest pool").set(pool.n_arenas)
+        reg.gauge("swtpu_arena_pool_free",
+                  "staging arenas currently fillable").set(pool.free_count)
+        reg.gauge("swtpu_arena_pool_inflight",
+                  "staging arenas tied to in-flight dispatches").set(
+                      pool.inflight_count)
+        reg.gauge("swtpu_arena_pool_waits",
+                  "times ingest blocked on arena recycle").set(pool.waits)
+
+    pending = getattr(engine, "_pending_outs", None)
+    if pending is not None:
+        reg.gauge("swtpu_dispatch_inflight",
+                  "device programs dispatched but not yet drained").set(
+                      len(pending))
+
+    fq = getattr(engine, "forward_queue", None)
+    if fq is not None:
+        fm = fq.metrics()
+        reg.gauge("swtpu_spill_queue_depth",
+                  "cross-rank forward batches spilled to disk").set(
+                      fm.get("forward_queue_depth", 0))
+        oldest = fm.get("forward_queue_oldest_ms")
+        if oldest is not None:
+            reg.gauge("swtpu_spill_queue_oldest_ms",
+                      "age of the oldest spilled forward").set(oldest)
+
+    flight = getattr(engine, "flight", None)
+    if flight is not None:
+        reg.gauge("swtpu_flight_records",
+                  "batch lifecycle records held by the flight "
+                  "recorder").set(len(flight))

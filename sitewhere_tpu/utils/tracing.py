@@ -2,13 +2,24 @@
 
 The reference defers tracing to the Istio mesh and measures stages with
 Prometheus histograms (SURVEY.md §5.1). Here: lightweight host-side stage
-spans feeding the metrics histograms, plus a wrapper around the JAX
-profiler for device traces (viewable in TensorBoard/Perfetto).
+spans feeding the metrics histograms, a wrapper around the JAX profiler
+for device traces (viewable in TensorBoard/Perfetto), and the
+``traceparent`` context that the flight recorder (utils/flight.py) and
+the cluster RPC use to follow one batch across ranks (the Dapper-style
+trace-context propagation the reference gets from Istio headers).
+
+Trace ids are W3C-traceparent shaped (``00-<32 hex>-<16 hex>-01``) so a
+future OTLP exporter can forward them unchanged. The CURRENT traceparent
+lives in a :mod:`contextvars` variable — per-thread AND per-asyncio-task,
+so the RPC server can bind it around a handler without cross-talk between
+multiplexed calls.
 """
 
 from __future__ import annotations
 
 import contextlib
+import contextvars
+import itertools
 import threading
 import time
 
@@ -19,6 +30,57 @@ _STAGE_HIST = REGISTRY.histogram(
 )
 
 _local = threading.local()
+
+# ------------------------------------------------------------ traceparent
+_TRACEPARENT: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "swtpu_traceparent", default=None)
+_SPAN_SEQ = itertools.count(1)
+
+
+def new_trace_id(rank: int = 0) -> str:
+    """A 32-hex trace id: rank + wall-clock ns + in-process sequence —
+    unique across ranks and restarts without coordination (the forward-id
+    recipe of parallel/cluster._next_fid, in W3C shape)."""
+    return (f"{rank & 0xFFFF:04x}"
+            f"{time.time_ns() & 0xFFFFFFFFFFFFFFFF:016x}"
+            f"{next(_SPAN_SEQ) & 0xFFFFFFFFFFFF:012x}")
+
+
+def new_traceparent(rank: int = 0, trace_id: str | None = None) -> str:
+    """A W3C-style traceparent header value for a (possibly new) trace."""
+    tid = trace_id or new_trace_id(rank)
+    span = f"{(next(_SPAN_SEQ) ^ (rank << 48)) & 0xFFFFFFFFFFFFFFFF:016x}"
+    return f"00-{tid}-{span}-01"
+
+
+def trace_id_of(traceparent: str | None) -> str | None:
+    """The 32-hex trace id inside a traceparent; None on malformed input
+    (a peer shipping garbage must not poison the recorder index)."""
+    if not traceparent:
+        return None
+    parts = traceparent.split("-")
+    if len(parts) >= 2 and len(parts[1]) == 32:
+        return parts[1]
+    return None
+
+
+def current_traceparent() -> str | None:
+    """The traceparent bound to this thread/task, or None."""
+    return _TRACEPARENT.get()
+
+
+@contextlib.contextmanager
+def bind_traceparent(traceparent: str | None):
+    """Bind ``traceparent`` for the enclosed block (no-op on None, so an
+    unpropagated call keeps whatever context it inherited)."""
+    if traceparent is None:
+        yield
+        return
+    token = _TRACEPARENT.set(traceparent)
+    try:
+        yield
+    finally:
+        _TRACEPARENT.reset(token)
 
 
 @contextlib.contextmanager

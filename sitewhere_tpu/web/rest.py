@@ -196,6 +196,35 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_get("/api/instance/cluster", cluster_status)
 
+    # --- flight recorder (batch-lifecycle tracing; PR 3) -----------------
+    async def trace_recent(request: web.Request):
+        recent = getattr(inst.engine, "recent_traces", None)
+        if recent is None:
+            return json_response({"error": "no flight recorder"},
+                                 status=404)
+        try:
+            limit = max(1, min(int(request.query.get("limit", 50)), 1000))
+        except ValueError:
+            return json_response({"error": "bad limit"}, status=400)
+        return json_response(await asyncio.to_thread(recent, limit))
+
+    async def trace_get(request: web.Request):
+        get = getattr(inst.engine, "get_trace", None)
+        if get is None:
+            return json_response({"error": "no flight recorder"},
+                                 status=404)
+        # clustered engines fan out to peers inside get_trace — off-loop,
+        # like every other peer-touching scrape
+        res = await asyncio.to_thread(get, request.match_info["traceId"])
+        if not res.get("records"):
+            return json_response({"error": "trace not found"}, status=404)
+        return json_response(res)
+
+    # register /recent BEFORE the {traceId} pattern: aiohttp resolves in
+    # registration order and "recent" must not parse as a trace id
+    r.add_get("/api/instance/trace/recent", trace_recent)
+    r.add_get("/api/instance/trace/{traceId}", trace_get)
+
     # --- script management (reference: Instance.java scripting @Path
     # family — script CRUD, versions, content, clone, activate) -----------
     # ADMIN-ONLY: scripts execute as in-process Python and config pushes

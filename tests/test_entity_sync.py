@@ -376,6 +376,67 @@ def _mk_cluster_staggered(tmp_path):
     return clusters, insts, [], host
 
 
+def test_state_transfer_pages_over_shrunken_frame_cap(tmp_path,
+                                                      monkeypatch):
+    """ADVICE r5 medium: an LWW state dump larger than MAX_FRAME used to
+    permanently strand a late joiner (the one-frame Cluster.entityState
+    raised 413 on every anti-entropy pass). The paged transfer must
+    converge it through a frame cap the FULL dump cannot fit — forced
+    here by shrinking MAX_FRAME under the dump size and the page size
+    under the cap."""
+    import sitewhere_tpu.parallel.entity_sync as es
+    import sitewhere_tpu.rpc.protocol as proto
+
+    clusters, insts, reps, host = _mk_cluster_staggered(tmp_path)
+    c0, c1 = clusters
+    rep0 = EntityReplicator(c0, insts[0],
+                            log_dir=str(tmp_path / "elog-r0"),
+                            compact_threshold=30, compact_keep=5)
+    rep0.attach()
+    rep0.register_rpc(host.servers[0])
+    reps.append(rep0)
+    try:
+        dm0 = insts[0].device_management
+        pad = "x" * 300          # make each entity's state body meaty
+        for i in range(60):
+            dm0.create_device_type(f"pg-{i}", f"Type {i} {pad}")
+        rep0.drain_pushes()
+        assert rep0.counters["compactions"] >= 1   # floor above seq 1
+        full = json.dumps(rep0.state_dump()).encode()
+        cap = 16384
+        assert len(full) > cap, "test premise: dump must exceed the cap"
+        # shrink the wire cap below the dump AND the page size below the
+        # cap — every entityState page must now fit where the old
+        # one-frame dump could not
+        monkeypatch.setattr(proto, "MAX_FRAME", cap)
+        rep0.state_page_bytes = 4096
+        rep0.state_page_entries = 16
+
+        rep1 = EntityReplicator(c1, insts[1],
+                                log_dir=str(tmp_path / "elog-r1"))
+        rep1.attach()
+        rep1.register_rpc(host.servers[1])
+        reps.append(rep1)
+        assert rep0.ops_since({}) == {"reset": True}   # behind the floor
+        pulled = rep1.sync_from_peers(best_effort=False)
+        assert pulled >= 60
+        assert rep0.counters["state_pages_served"] >= 2, (
+            "the transfer must actually have paged")
+        dm1 = insts[1].device_management
+        assert "pg-0" in dm1.device_types and "pg-59" in dm1.device_types
+        assert to_state(dm1.device_types.get("pg-30")) == \
+            to_state(dm0.device_types.get("pg-30"))
+        # vector adopted from the final page: later ops apply normally
+        dm0.create_device_type("pg-after", "After")
+        rep0.drain_pushes()
+        assert "pg-after" in dm1.device_types
+        # an expired cursor (snapshot evicted) restarts, not wedges
+        page = rep0.state_page(cursor={"tid": "gone", "pos": 3})
+        assert page == {"expired": True}
+    finally:
+        _close_all(clusters, reps, host)
+
+
 def test_compacted_journal_restart_replays_dump_plus_tail(tmp_path):
     """After compaction the journal is one state dump + the kept tail;
     a crash-restart replays both: full state back, vector preserved,

@@ -142,6 +142,7 @@ def test_retry_budget_moves_to_deadletter_not_drops(tmp_path):
         host.stop(servers[1])
         remote = tokens_owned_by(1, 1, prefix="dl")[0]
         s = c0.ingest_json_batch([meas(remote, "t", 9.0, 900)])
+        assert s.pop("trace_id", None)   # every ingest is traced
         assert s == {"spilled": 1}
         queues[0].retry_budget_s = 0.0   # budget exhausted immediately
         time.sleep(0.01)
@@ -196,11 +197,13 @@ def test_circuit_breaker_spills_fast_after_first_failure(tmp_path):
         remote = tokens_owned_by(1, 1, prefix="cb")[0]
         host.stop(servers[1])
         s = c0.ingest_json_batch([meas(remote, "t", 1.0, 100)])
+        assert s.pop("trace_id", None)   # every ingest is traced
         assert s == {"spilled": 1}
         assert queues[0].circuit_open(1)
         t0 = time.monotonic()
         s2 = c0.ingest_json_batch([meas(remote, "t", 2.0, 101)])
         fast = time.monotonic() - t0
+        assert s2.pop("trace_id", None)
         assert s2 == {"spilled": 1}
         assert fast < 0.5, f"open circuit should spill instantly ({fast}s)"
         srv1b = build_cluster_rpc(c1.local, "fwd-secret")

@@ -1,0 +1,197 @@
+"""Prometheus exposition lint + Counter/Gauge API split (PR 3 satellites).
+
+A promtool-style checker over the text format: HELP/TYPE ordering, family
+contiguity, cumulative histogram buckets ending in ``+Inf`` with
+count == +Inf, label escaping, and no duplicate series — run against both
+a synthetic registry and the full engine export that
+``/api/instance/metrics/prometheus`` serves.
+"""
+
+import re
+
+import pytest
+
+from sitewhere_tpu.utils.metrics import (Counter, Gauge, MetricsRegistry,
+                                         export_engine_metrics)
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?P<labels>\{[^{}]*\})? (?P<value>[^ ]+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_labels(text):
+    if not text:
+        return ()
+    body = text[1:-1]
+    labels = _LABEL_RE.findall(body)
+    # the full body must be consumed by well-formed pairs — an unescaped
+    # quote or raw newline would leave residue
+    rebuilt = ",".join(f'{k}="{v}"' for k, v in labels)
+    assert rebuilt == body, f"malformed label set: {text!r}"
+    return tuple(sorted(labels))
+
+
+def lint_prometheus(text: str) -> None:
+    """Promtool-style structural lint of one exposition payload."""
+    families: dict[str, dict] = {}
+    current = None
+    seen_series: set = set()
+    family_done: set = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split()[2]
+            assert name not in families, f"duplicate HELP for {name}"
+            families[name] = {"help": True, "type": None, "samples": []}
+            current = name
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            name, kind = parts[2], parts[3]
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), line
+            assert current == name, f"TYPE {name} not preceded by its HELP"
+            assert families[name]["type"] is None, f"duplicate TYPE {name}"
+            families[name]["type"] = kind
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name = m.group("name")
+        base = re.sub(r"_(bucket|sum|count|total)$", "", name)
+        fam = name if name in families else base
+        assert fam in families, f"sample {name} has no HELP/TYPE"
+        assert fam == current, (
+            f"family {fam} not contiguous: sample after {current}")
+        assert fam not in family_done, f"family {fam} reopened"
+        float(m.group("value"))       # value parses
+        labels = _parse_labels(m.group("labels"))
+        key = (name, labels)
+        assert key not in seen_series, f"duplicate series {key}"
+        seen_series.add(key)
+        families[fam]["samples"].append((name, dict(labels),
+                                         float(m.group("value"))))
+    # histogram invariants. A family with HELP/TYPE and no samples yet is
+    # LEGAL exposition (e.g. a registered histogram that never observed —
+    # the WAL fsync histogram on an engine without a WAL); the invariants
+    # apply per label set that does expose.
+    for fam, info in families.items():
+        if info["type"] != "histogram":
+            continue
+        by_labelset: dict = {}
+        for name, labels, value in info["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            slot = by_labelset.setdefault(
+                key, {"buckets": [], "sum": None, "count": None})
+            if name == f"{fam}_bucket":
+                slot["buckets"].append((labels["le"], value))
+            elif name == f"{fam}_sum":
+                slot["sum"] = value
+            elif name == f"{fam}_count":
+                slot["count"] = value
+        for key, slot in by_labelset.items():
+            assert slot["buckets"], f"{fam}{key}: no buckets"
+            assert slot["buckets"][-1][0] == "+Inf", (
+                f"{fam}{key}: buckets must end with +Inf")
+            counts = [v for _, v in slot["buckets"]]
+            assert counts == sorted(counts), (
+                f"{fam}{key}: buckets not cumulative: {counts}")
+            assert slot["count"] is not None and slot["sum"] is not None
+            assert slot["count"] == counts[-1], (
+                f"{fam}{key}: count != +Inf bucket")
+
+
+# ------------------------------------------------------------------- lint
+def test_lint_synthetic_registry():
+    reg = MetricsRegistry()
+    c = reg.counter("swtpu_lint_total", "events")
+    c.inc(tenant="a")
+    c.inc(2, tenant="b")
+    g = reg.gauge("swtpu_lint_depth", "queue depth")
+    g.set(3, queue="q1")
+    h = reg.histogram("swtpu_lint_seconds", "latency")
+    h.observe(0.001, stage="x")
+    h.observe(9.0, stage="x")
+    h.observe(99.0, stage="x")       # beyond the last finite bucket
+    lint_prometheus(reg.expose_text())
+
+
+def test_sampleless_histogram_family_lints():
+    """A registered-but-never-observed histogram (the WAL fsync histogram
+    on a WAL-less engine) exposes HELP/TYPE with no samples — legal."""
+    reg = MetricsRegistry()
+    reg.histogram("swtpu_empty_seconds", "never observed")
+    lint_prometheus(reg.expose_text())
+
+
+def test_label_values_escaped():
+    reg = MetricsRegistry()
+    g = reg.gauge("swtpu_esc", "escaping")
+    hostile = 'a"b\\c\nd'
+    g.set(1, tenant=hostile)
+    text = reg.expose_text()
+    assert '\\"b' in text and "\\\\c" in text and "\\nd" in text
+    # the hostile value must not break line structure: every line lints
+    lint_prometheus(text)
+
+
+def test_full_engine_exposition_lints():
+    """The payload /api/instance/metrics/prometheus actually serves:
+    engine export + stage histogram, linted end to end."""
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.utils.tracing import stage
+
+    reg = MetricsRegistry()
+    eng = Engine(EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=1024, batch_capacity=16, channels=4))
+    import json as _json
+
+    eng.ingest_json_batch([_json.dumps(
+        {"deviceToken": f"mx-{i}", "type": "DeviceMeasurements",
+         "request": {"measurements": {"t": float(i)}}}).encode()
+        for i in range(6)])
+    eng.flush()
+    export_engine_metrics(eng, reg)
+    h = reg.histogram("swtpu_stage_seconds", "host pipeline stage latency")
+    with h.time(stage="unit"):
+        pass
+    text = reg.expose_text()
+    lint_prometheus(text)
+    assert 'swtpu_engine_processed{tenant="all"} 6' in text
+    assert 'swtpu_pipeline_accepted{tenant="default"} 6' in text
+    assert "swtpu_dispatch_inflight" in text
+
+
+# --------------------------------------------------------- API separation
+def test_counter_has_no_set_and_rejects_decrease():
+    c = Counter("c_total", "")
+    assert not hasattr(c, "set")
+    c.inc(2, tenant="a")
+    with pytest.raises(ValueError):
+        c.inc(-1, tenant="a")
+    assert c.value(tenant="a") == 2
+
+
+def test_gauge_moves_freely():
+    g = Gauge("g", "")
+    g.set(5, q="x")
+    g.inc(q="x")
+    g.dec(2, q="x")
+    assert g.value(q="x") == 4
+    g.retain(set())
+    assert g.value(q="x") == 0.0     # retained away
+
+
+def test_registry_kind_mismatch_both_directions():
+    reg = MetricsRegistry()
+    reg.counter("swtpu_kind_a", "")
+    with pytest.raises(TypeError):
+        reg.gauge("swtpu_kind_a")
+    reg.gauge("swtpu_kind_b", "")
+    with pytest.raises(TypeError):
+        reg.counter("swtpu_kind_b")
+    with pytest.raises(TypeError):
+        reg.histogram("swtpu_kind_a")

@@ -411,6 +411,52 @@ def test_roles_system_and_state_search(api):
     assert status == 200 and len(resp) == 1
 
 
+def test_trace_endpoints(api):
+    """Flight recorder REST surface (PR 3): a batch id returned by ingest
+    resolves to a complete lifecycle record via /api/instance/trace/<id>,
+    and /recent lists it."""
+    call, inst, loop = api
+    rows = [
+        {"deviceToken": f"tr-{i % 2}", "type": "DeviceMeasurement",
+         "request": {"name": "t", "value": float(i)}}
+        for i in range(6)
+    ]
+    status, res = call("POST", "/api/events/batch", rows)
+    assert status == 201
+    tid = res["trace_id"]
+    assert tid
+    status, trace = call("GET", f"/api/instance/trace/{tid}")
+    assert status == 200 and trace["traceId"] == tid
+    stages = trace["records"][0]["stagesUs"]
+    for name in ("decode", "commit", "dispatch", "device_ready",
+                 "readback"):
+        assert name in stages, stages
+    status, recent = call("GET", "/api/instance/trace/recent")
+    assert status == 200
+    assert any(r["traceId"] == tid for r in recent)
+    status, _ = call("GET", "/api/instance/trace/" + "0" * 32)
+    assert status == 404
+    status, _ = call("GET", "/api/instance/trace/recent",
+                     params={"limit": "nope"})
+    assert status == 400
+
+
+def test_prometheus_exposition_lints_over_rest(api):
+    """The full /api/instance/metrics/prometheus payload passes the
+    promtool-style structural lint (PR 3 satellite)."""
+    from tests.test_metrics_exposition import lint_prometheus
+
+    call, inst, loop = api
+    rows = [{"deviceToken": "px-1", "type": "DeviceMeasurement",
+             "request": {"name": "t", "value": 1.0}}]
+    status, _ = call("POST", "/api/events/batch", rows)
+    assert status == 201
+    status, body = call("GET", "/api/instance/metrics/prometheus",
+                        raw=True)
+    assert status == 200
+    lint_prometheus(body.decode())
+
+
 def test_batch_ingest_and_openapi(api):
     call, inst, loop = api
     rows = [
