@@ -112,7 +112,6 @@ def main() -> None:
     n_cores = _os.cpu_count() or 1
     workers_eps = None
     workers_note = None
-    n_ingest_workers = 1
     if smoke:
         workers_note = "skipped: smoke mode"
         log("multi-worker ingest skipped: smoke mode")
@@ -121,7 +120,7 @@ def main() -> None:
 
         weng = Engine(EngineConfig(**HEADLINE_CFG))
         with DecodeWorkerPool(weng, max_msgs=16384) as _pool:
-            n_ingest_workers = _pool.n_workers
+            n_pool_workers = _pool.n_workers
             wpre = []
             rng_w = np.random.default_rng(2)
             toks_w = [f"lg-{i}" for i in range(10_000)]
@@ -145,7 +144,7 @@ def main() -> None:
                 weng.flush_async()
             weng.barrier()
             workers_eps = 44 * 16384 / (time.perf_counter() - t1)
-        log(f"host e2e multi-worker ingest ({n_ingest_workers} workers on "
+        log(f"host e2e multi-worker ingest ({n_pool_workers} workers on "
             f"{n_cores} cores): {workers_eps:,.0f} ev/s")
     else:
         workers_note = (
@@ -179,12 +178,13 @@ def main() -> None:
                  for k, t in (("rtype", np.int32), ("token", np.int32),
                               ("ts", np.int64), ("values", np.float32),
                               ("chmask", np.uint8), ("aux0", np.int32),
-                              ("level", np.int32))}
+                              ("aux1", np.int32), ("level", np.int32))}
 
             def run():
                 return dec.decode_packed(
                     buf, off, _N, o["rtype"], o["token"], o["ts"],
-                    o["values"], o["chmask"], o["aux0"], o["level"])[0]
+                    o["values"], o["chmask"], o["aux0"], o["aux1"],
+                    o["level"])[0]
 
             assert run() == _N
             best = 0.0
@@ -217,6 +217,49 @@ def main() -> None:
         log(f"raw JSON batch decode, 4-measurement payloads: "
             f"{raw_decode_multi_eps:,.0f} ev/s/core "
             f"({4 * raw_decode_multi_eps:,.0f} measurements/s)")
+
+    # sharded arena decode (ISSUE 4 tentpole): the SAME wire batch split
+    # across N threads by payload bytes into one staging arena, vs the
+    # single-threaded scanner. Pure host CPU (no device) — phase-1 safe.
+    # This is the decode-scaling headline a multicore driver host buys.
+    sharded_eps = {}
+    if native_available():
+        from sitewhere_tpu.ingest.arena import StagingArena
+        from sitewhere_tpu.ingest.fast_decode import NativeBatchDecoder
+        from sitewhere_tpu.ingest.workers import ShardedArenaDecoder
+        from sitewhere_tpu.native.binding import NativeInterner
+
+        _SN = 2048 if smoke else 16384
+        _SREPS, _SLOOPS = (3, 2) if smoke else (5, 4)
+        sh_payloads = [generate_measurements_message(f"sh-{i % 512}", i)
+                       for i in range(_SN)]
+        sh_dec = NativeBatchDecoder(NativeInterner(1 << 14), 8)
+        if sh_dec.has_shard:
+            sh_arena = StagingArena(_SN, 8)
+            for w in [1] + sorted({2, n_cores} - {1}):
+                if w > 1:
+                    sharder = ShardedArenaDecoder(sh_dec, w)
+                    sharder.min_shard_payloads = 64
+                    fn = sharder.decode_into
+                else:
+                    fn = sh_dec.decode_into
+                assert fn(sh_payloads, sh_arena, 0)[0] == _SN
+                best = 0.0
+                for _ in range(_SREPS):
+                    t1 = time.perf_counter()
+                    for _ in range(_SLOOPS):
+                        fn(sh_payloads, sh_arena, 0)
+                    best = max(best,
+                               _SLOOPS * _SN / (time.perf_counter() - t1))
+                sharded_eps[w] = best
+            base = sharded_eps.get(1)
+            for w, eps_w in sorted(sharded_eps.items()):
+                log(f"sharded arena decode, {w} worker(s): {eps_w:,.0f} "
+                    f"ev/s" + (f" ({eps_w / base:.2f}x vs 1)"
+                               if base and w > 1 else ""))
+        else:
+            log("sharded arena decode skipped: shard entry points "
+                "unavailable")
 
     # same config as the headline engine so the compiled step is reused
     beng = Engine(EngineConfig(**HEADLINE_CFG))
@@ -398,6 +441,100 @@ def main() -> None:
     # ------------------------------------------------------------------
     eng.flush()
     m = eng.metrics()
+
+    # per-stage breakdown (ISSUE 4): medians over the headline engine's
+    # flight-recorder lifecycle records — the SAME harvesting rule the
+    # stage-time autotuner steers by (utils/flight.stage_durations), so
+    # the bench reports exactly what the tuner sees
+    import statistics as _sstats
+
+    from sitewhere_tpu.utils.flight import stage_durations
+
+    stage_meds = {}
+    _durs = [stage_durations(r.get("stagesUs", {}))
+             for r in eng.flight.recent(512) if r.get("kind") == "ingest"]
+    for key in ("decode_ms", "wal_ms", "dispatch_wait_ms", "device_ms"):
+        vals = [d[key] for d in _durs if d[key] is not None]
+        stage_meds[key] = round(_sstats.median(vals), 3) if vals else None
+    log(f"per-stage medians over {len(_durs)} ingest batches: {stage_meds}")
+
+    # ------------------------------------------------------------------
+    # SMOKE-ONLY correctness/regression gates (ISSUE 4 satellites):
+    #  * workers=2 sharded decode must produce byte-identical stores
+    #  * group-commit WAL must not regress host e2e by > 3%
+    # ------------------------------------------------------------------
+    shard_equal = None
+    shard_w2_vs_w1_pct = None
+    gc_regression_pct = None
+    if smoke:
+        import dataclasses as _dc
+        import tempfile as _tmp
+
+        SM_CFG = dict(device_capacity=1 << 12, token_capacity=1 << 13,
+                      assignment_capacity=1 << 13, store_capacity=1 << 14,
+                      batch_capacity=1024)
+        sp = [generate_measurements_message(f"sm-{i % 200}", i)
+              for i in range(4096)]
+
+        def run_workers(w):
+            e = Engine(EngineConfig(**SM_CFG, ingest_workers=w))
+            e.epoch.base_unix_s = 1700000000.0
+            e.epoch.now_ms = lambda: 54321
+            if e._sharder is not None:
+                e._sharder.min_shard_payloads = 64
+            for lo in range(0, len(sp), 1024):   # warm: program compile
+                e.ingest_json_batch(sp[lo:lo + 1024])
+            e.barrier()
+            t1 = time.perf_counter()
+            for lo in range(0, len(sp), 1024):
+                e.ingest_json_batch(sp[lo:lo + 1024])
+            e.barrier()
+            dt = time.perf_counter() - t1
+            e.flush()
+            return e, len(sp) / dt
+
+        e1, eps1 = run_workers(1)
+        e2, eps2 = run_workers(2)
+        if e2._sharder is None:
+            log("smoke workers=2 variant skipped: sharding unavailable")
+        else:
+            sa = jax.device_get(e1.state.store)
+            sb = jax.device_get(e2.state.store)
+            shard_equal = all(
+                np.array_equal(np.asarray(getattr(sa, f.name)),
+                               np.asarray(getattr(sb, f.name)))
+                for f in _dc.fields(sa))
+            shard_w2_vs_w1_pct = round((eps2 / eps1 - 1) * 100, 1)
+            log(f"smoke sharded e2e: w1={eps1:,.0f} w2={eps2:,.0f} ev/s "
+                f"({shard_w2_vs_w1_pct:+.1f}%), stores equal={shard_equal}")
+
+        def wal_run(group):
+            # steady-state shape: several ingest batches per arena
+            # dispatch, so group commit gets to amortize its fsyncs
+            # across appends (one gate per dispatch, not per batch)
+            with _tmp.TemporaryDirectory() as wd:
+                e = Engine(EngineConfig(**SM_CFG, wal_dir=wd,
+                                        wal_group_commit=group))
+                for lo in range(0, len(sp), 256):   # warm
+                    e.ingest_json_batch(sp[lo:lo + 256])
+                e.barrier()
+                t1 = time.perf_counter()
+                for lo in range(0, len(sp), 256):
+                    e.ingest_json_batch(sp[lo:lo + 256])
+                e.barrier()
+                dt = time.perf_counter() - t1
+                e.wal.close()
+                return len(sp) / dt
+
+        # interleaved best-of-3 per mode: shared-host drift must not
+        # masquerade as group-commit cost
+        g_best = i_best = 0.0
+        for _ in range(3):
+            i_best = max(i_best, wal_run(False))
+            g_best = max(g_best, wal_run(True))
+        gc_regression_pct = round((1 - g_best / i_best) * 100, 1)
+        log(f"smoke group-commit e2e: inline={i_best:,.0f} "
+            f"group={g_best:,.0f} ev/s (regression {gc_regression_pct}%)")
     n_load_batches = (len(runs) * N_BATCH + WARM_BATCH
                       + (1 if len(runs) > 1 else 0))
     expected = n_load_batches * SZ_BATCH
@@ -471,7 +608,20 @@ def main() -> None:
                 **({"raw_json_decode_multi_meas_events_per_s":
                     round(raw_decode_multi_eps)}
                    if raw_decode_multi_eps is not None else {}),
-                "ingest_workers": n_ingest_workers,
+                # per-stage medians (flight-recorder harvest); a stage a
+                # config never visits reports null
+                **stage_meds,
+                # sharded decode fan-out actually used by the headline
+                # engine (0 = sharding unavailable on this build/host)
+                "ingest_workers": (eng._sharder.active_workers
+                                   if eng._sharder is not None else 0),
+                **{f"sharded_decode_events_per_s_w{w}": round(v)
+                   for w, v in sorted(sharded_eps.items())},
+                **({"shard_smoke_stores_equal": shard_equal,
+                    "shard_smoke_e2e_delta_pct": shard_w2_vs_w1_pct}
+                   if shard_equal is not None else {}),
+                **({"groupcommit_smoke_regression_pct": gc_regression_pct}
+                   if gc_regression_pct is not None else {}),
                 **({"workers_events_per_s": round(workers_eps)}
                    if workers_eps is not None else {}),
                 **({"workers_note": workers_note}
@@ -483,6 +633,14 @@ def main() -> None:
     if smoke and trace_overhead_pct > 3.0:
         log(f"FAIL: flight recorder overhead {trace_overhead_pct:.2f}% "
             "> 3% of host e2e throughput")
+        sys.exit(1)
+    if smoke and shard_equal is False:
+        log("FAIL: sharded-decode (workers=2) results diverge from the "
+            "single-worker run")
+        sys.exit(1)
+    if smoke and gc_regression_pct is not None and gc_regression_pct > 3.0:
+        log(f"FAIL: group commit regresses smoke host e2e by "
+            f"{gc_regression_pct}% > 3%")
         sys.exit(1)
 
 

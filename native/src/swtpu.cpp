@@ -33,7 +33,10 @@ struct Interner {
     // string's length + 8-byte prefix: a probe for a short string
     // (tokens like "lg-1234") resolves WITHOUT dereferencing the heap
     // std::string — one cache line instead of two dependent misses —
-    // and longer strings memcmp only after the prefix matches.
+    // and longer strings memcmp only after the prefix matches. The slot
+    // table starts small and doubles at 50% load: interners sized for
+    // millions of entries (event-id/alternate-id tables) cost a few KB
+    // until strings actually arrive.
     std::vector<Slot> slots;
     std::vector<std::string> strings;
     uint64_t mask;
@@ -76,16 +79,37 @@ static uint64_t hash_bytes(const char* s, int n) {
 
 Interner* swtpu_interner_create(int32_t max_entries) {
     uint64_t cap = 64;
-    while (cap < (uint64_t)max_entries * 2) cap <<= 1;
+    uint64_t full = 64;
+    while (full < (uint64_t)max_entries * 2) full <<= 1;
+    if (full < cap) full = cap;
+    if (cap > full) cap = full;
+    // lazy table: start at <=1024 slots, grow toward the full capacity
+    while (cap < full && cap < 1024) cap <<= 1;
     auto* in = new Interner();
     in->slots.assign(cap, Slot{-1, 0, 0});
     in->mask = cap - 1;
     in->max_entries = max_entries;
-    in->strings.reserve(1024);
+    in->strings.reserve(64);
     return in;
 }
 
 void swtpu_interner_destroy(Interner* in) { delete in; }
+
+// double the slot table and rehash (insertion order — the ids — is
+// untouched; the hash is only an in-memory placement)
+static void interner_grow(Interner* in) {
+    std::vector<Slot> ns(in->slots.size() * 2, Slot{-1, 0, 0});
+    uint64_t nm = ns.size() - 1;
+    for (const Slot& sl : in->slots) {
+        if (sl.id < 0) continue;
+        const std::string& t = in->strings[sl.id];
+        uint64_t h = hash_bytes(t.data(), (int)t.size()) & nm;
+        while (ns[h].id >= 0) h = (h + 1) & nm;
+        ns[h] = sl;
+    }
+    in->slots.swap(ns);
+    in->mask = nm;
+}
 
 int32_t swtpu_intern(Interner* in, const char* s, int32_t n) {
     uint64_t h = hash_bytes(s, n) & in->mask;
@@ -94,6 +118,11 @@ int32_t swtpu_intern(Interner* in, const char* s, int32_t n) {
         Slot& sl = in->slots[h];
         if (sl.id < 0) {
             if ((int32_t)in->strings.size() >= in->max_entries) return -1;
+            if ((uint64_t)(in->strings.size() + 1) * 2
+                > (uint64_t)in->slots.size()) {
+                interner_grow(in);
+                return swtpu_intern(in, s, n);   // re-probe the new table
+            }
             int32_t id = (int32_t)in->strings.size();
             in->strings.emplace_back(s, n);
             sl = Slot{id, n, pfx};
@@ -461,22 +490,27 @@ struct Decoder {
     Interner* tokens;       // device tokens (shared with engine)
     Interner* names;        // measurement names
     Interner* alert_types;  // alert types
+    Interner* event_ids;    // alternate/correlation ids (aux1 lane)
 };
 
-Decoder* swtpu_decoder_create(Interner* tokens, int32_t name_cap, int32_t alert_cap) {
+Decoder* swtpu_decoder_create(Interner* tokens, int32_t name_cap,
+                              int32_t alert_cap, int32_t event_cap) {
     auto* d = new Decoder();
     d->tokens = tokens;
     d->names = swtpu_interner_create(name_cap);
     d->alert_types = swtpu_interner_create(alert_cap);
+    d->event_ids = swtpu_interner_create(event_cap);
     return d;
 }
 
 Interner* swtpu_decoder_names(Decoder* d) { return d->names; }
 Interner* swtpu_decoder_alert_types(Decoder* d) { return d->alert_types; }
+Interner* swtpu_decoder_event_ids(Decoder* d) { return d->event_ids; }
 
 void swtpu_decoder_destroy(Decoder* d) {
     swtpu_interner_destroy(d->names);
     swtpu_interner_destroy(d->alert_types);
+    swtpu_interner_destroy(d->event_ids);
     delete d;
 }
 
@@ -496,17 +530,137 @@ void swtpu_decoder_destroy(Decoder* d) {
    // entry points and the Python-list entry points — swtpu_py.cpp —
    // share ONE loop body with zero indirection cost)
 
-// ``aux0_stride`` lets the caller aim out_aux0 at a strided column of a
-// wider staging arena (row i lands at out_aux0[i * aux0_stride]); the
-// plain batch entry points pass 1.
-template <class GetMsg>
+// ---------------------------------------------------------------- sinks
+// The decode loops are additionally templated over an interning SINK so
+// the single-threaded path (DirectSink: intern straight into the shared
+// tables, today's behavior) and the sharded path (ShardSink below:
+// read-only lookups against the shared tables + per-shard overlay for
+// first-seen strings, merged deterministically afterwards) share the
+// exact same scanner.
+
+struct DirectSink {
+    Decoder* d;
+    int32_t token(int32_t row, const char* s, int32_t n) {
+        (void)row;
+        return swtpu_intern(d->tokens, s, n);
+    }
+    void meas(int32_t row, const char* s, int32_t n, double v,
+              float* vrow, uint8_t* mrow, int32_t channels,
+              int32_t* collisions) {
+        (void)row;
+        int32_t nid = swtpu_intern(d->names, s, n);
+        if (nid >= 0) {
+            if (nid >= channels) (*collisions)++;
+            int ch = nid % channels;
+            vrow[ch] = (float)v;
+            mrow[ch] = 1;
+        }
+    }
+    int32_t alert_type(int32_t row, const char* s, int32_t n) {
+        (void)row;
+        return swtpu_intern(d->alert_types, s, n);
+    }
+    int32_t alternate(int32_t row, const char* s, int32_t n) {
+        (void)row;
+        return swtpu_intern(d->event_ids, s, n);
+    }
+};
+
+// Sharded decode: one wire batch splits into contiguous payload ranges,
+// each decoded by one worker into a disjoint row range of the same
+// arena. The shared interners are READ-ONLY during the scan (the engine
+// lock serializes all mutation); strings not yet interned go into a
+// per-shard OVERLAY table and their uses are recorded as patches. The
+// serial merge then interns overlay tails in shard order — which IS
+// first-occurrence row order, because shards are ordered row ranges and
+// each overlay assigns local ids in first-occurrence order — so the
+// final id assignment is byte-identical to a single-threaded scan.
+// Provisional ids are encoded as (-2 - overlay_idx): distinguishable
+// from both real ids (>= 0) and "absent" (-1), and patch application
+// only overwrites cells still holding the matching provisional value
+// (a later occurrence of the same key may have replaced it).
+
+struct Patch {
+    int32_t row;   // shard-relative row
+    int32_t idx;   // overlay id
+    float val;     // measurement value (SK_NAME only)
+};
+
+enum { SK_TOKEN = 0, SK_NAME = 1, SK_ALERT = 2, SK_ALTID = 3 };
+
+struct ShardCtx {
+    Decoder* d;
+    Interner* ov[4];
+    std::vector<Patch> patch[4];
+    // row currently in "deferred" mode: once a row records ONE overlay
+    // (first-seen) measurement name, its remaining name writes defer
+    // too — patch replay then preserves the row's key order even when
+    // a new and a known name alias the same lane (direct ids ride the
+    // patch list bit-inverted: idx < 0 means final id ~idx)
+    int32_t deferred_row;
+};
+
+struct ShardSink {
+    ShardCtx* c;
+    int32_t shared_or_patch(int kind, Interner* base, int32_t row,
+                            const char* s, int32_t n) {
+        int32_t id = swtpu_interner_lookup(base, s, n);
+        if (id >= 0) return id;
+        int32_t idx = swtpu_intern(c->ov[kind], s, n);
+        if (idx < 0) return -1;   // overlay full: same as interner-full
+        c->patch[kind].push_back(Patch{row, idx, 0.f});
+        return -2 - idx;
+    }
+    int32_t token(int32_t row, const char* s, int32_t n) {
+        return shared_or_patch(SK_TOKEN, c->d->tokens, row, s, n);
+    }
+    void meas(int32_t row, const char* s, int32_t n, double v,
+              float* vrow, uint8_t* mrow, int32_t channels,
+              int32_t* collisions) {
+        int32_t nid = swtpu_interner_lookup(c->d->names, s, n);
+        if (nid >= 0) {
+            if (nid >= channels) (*collisions)++;
+            if (c->deferred_row == row) {
+                // this row already deferred a first-seen name: keep its
+                // remaining writes in key order on the patch list too
+                // (direct final id rides bit-inverted), so replay
+                // matches the single-threaded last-write-wins per lane
+                c->patch[SK_NAME].push_back(Patch{row, ~nid, (float)v});
+                return;
+            }
+            int ch = nid % channels;
+            vrow[ch] = (float)v;
+            mrow[ch] = 1;
+            return;
+        }
+        // first-seen name: its lane is unknown until the merge assigns
+        // the final id — defer the lane write entirely (collision
+        // accounting happens at patch time, against the final id)
+        int32_t idx = swtpu_intern(c->ov[SK_NAME], s, n);
+        if (idx < 0) return;
+        c->deferred_row = row;
+        c->patch[SK_NAME].push_back(Patch{row, idx, (float)v});
+    }
+    int32_t alert_type(int32_t row, const char* s, int32_t n) {
+        return shared_or_patch(SK_ALERT, c->d->alert_types, row, s, n);
+    }
+    int32_t alternate(int32_t row, const char* s, int32_t n) {
+        return shared_or_patch(SK_ALTID, c->d->event_ids, row, s, n);
+    }
+};
+
+// ``aux0_stride``/``aux1_stride`` let the caller aim out_aux0/out_aux1
+// at strided columns of a wider staging arena (row i lands at
+// out_aux[i * stride]); the plain batch entry points pass 1.
+template <class Sink, class GetMsg>
 static int32_t decode_json_impl(
-    Decoder* d, int32_t n_msgs, int32_t channels,
+    int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
     int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_aux1, int64_t aux1_stride,
     int32_t* out_level, int32_t* out_collisions,
-    GetMsg get_msg) {
+    Sink& sink, GetMsg get_msg) {
     int32_t ok_count = 0;
     int32_t collisions = 0;
     char sbuf[512];
@@ -516,6 +670,7 @@ static int32_t decode_json_impl(
         out_token[i] = -1;
         out_ts[i] = -1;
         out_aux0[(size_t)i * aux0_stride] = -1;
+        out_aux1[(size_t)i * aux1_stride] = -1;
         out_level[i] = 0;
         memset(out_values + (size_t)i * channels, 0, sizeof(float) * channels);
         memset(out_chmask + (size_t)i * channels, 0, channels);
@@ -526,7 +681,8 @@ static int32_t decode_json_impl(
         int rtype = RT_UNKNOWN;
         // deviceToken takes precedence over hardwareId (route_json_impl
         // and the Python partitioner agree); within one key the last
-        // occurrence wins (json.loads dict semantics)
+        // occurrence wins (json.loads dict semantics). -1 = missing;
+        // sharded decode may hand back provisional ids <= -2.
         int32_t token_dt = -1;
         int32_t token_hw = -1;
         bool in_request_done = false;
@@ -547,7 +703,7 @@ static int32_t decode_json_impl(
                 const char* vp;
                 int n = parse_string_view(sc, &vp, sbuf, sizeof(sbuf));
                 if (n < 0) { failed = true; break; }
-                int32_t tid = swtpu_intern(d->tokens, vp, n);
+                int32_t tid = sink.token(i, vp, n);
                 if (k_dt) token_dt = tid;
                 else token_hw = tid;
             } else if (klen == 4 && !memcmp(kp, "type", 4)) {
@@ -614,13 +770,10 @@ static int32_t decode_json_impl(
                                 if (nn < 0 || !expect(sc, ':')) { failed = true; break; }
                                 double v = parse_number_or_literal(sc);
                                 if (std::isnan(v)) continue;
-                                int32_t nid = swtpu_intern(d->names, np, nn);
-                                if (nid >= 0) {
-                                    if (nid >= channels) collisions++;
-                                    int ch = nid % channels;
-                                    out_values[(size_t)i * channels + ch] = (float)v;
-                                    out_chmask[(size_t)i * channels + ch] = 1;
-                                }
+                                sink.meas(i, np, nn, v,
+                                          out_values + (size_t)i * channels,
+                                          out_chmask + (size_t)i * channels,
+                                          channels, &collisions);
                             }
                         } else skip_value(sc);
                         break;
@@ -666,7 +819,21 @@ static int32_t decode_json_impl(
                         int n = parse_string_view(sc, &vp, sbuf, sizeof(sbuf));
                         if (n >= 0)
                             out_aux0[(size_t)i * aux0_stride] =
-                                swtpu_intern(d->alert_types, vp, n);
+                                sink.alert_type(i, vp, n);
+                        break;
+                    }
+                    case (11 << 8) | 'a': { // alternateId -> aux1 lane
+                        if (memcmp(rkp, "alternateId", 11)) { handled = false; break; }
+                        skip_ws(sc);
+                        if (sc.p >= sc.end || *sc.p != '"') {
+                            skip_value(sc);   // non-string id: absent
+                            break;
+                        }
+                        const char* vp;
+                        int n = parse_string_view(sc, &vp, sbuf, sizeof(sbuf));
+                        if (n >= 0)
+                            out_aux1[(size_t)i * aux1_stride] =
+                                sink.alternate(i, vp, n);
                         break;
                     }
                     default:
@@ -676,13 +843,10 @@ static int32_t decode_json_impl(
                     if (!handled) skip_value(sc);
                 }
                 if (mname_len >= 0 && have_mval) {
-                    int32_t nid = swtpu_intern(d->names, mname_p, mname_len);
-                    if (nid >= 0) {
-                        if (nid >= channels) collisions++;
-                        int ch = nid % channels;
-                        out_values[(size_t)i * channels + ch] = (float)mval;
-                        out_chmask[(size_t)i * channels + ch] = 1;
-                    }
+                    sink.meas(i, mname_p, mname_len, mval,
+                              out_values + (size_t)i * channels,
+                              out_chmask + (size_t)i * channels,
+                              channels, &collisions);
                 }
                 if (have_loc) {
                     out_values[(size_t)i * channels + 0] = lat;
@@ -698,8 +862,10 @@ static int32_t decode_json_impl(
             }
         }
 
-        int32_t token = token_dt >= 0 ? token_dt : token_hw;
-        if (!failed && sc.ok && rtype != RT_UNKNOWN && token >= 0) {
+        // -1 = missing; real ids (>= 0) AND provisional shard ids
+        // (<= -2) both count as present
+        int32_t token = token_dt != -1 ? token_dt : token_hw;
+        if (!failed && sc.ok && rtype != RT_UNKNOWN && token != -1) {
             out_rtype[i] = rtype;
             out_token[i] = token;
             ok_count++;
@@ -718,14 +884,15 @@ static int32_t decode_json_impl(
 //   type 3 alert:       u16le tlen type  u8 level  u16le mlen message
 //   type 4 register / 5 ack: header only
 // Outputs use the same contract as swtpu_decode_batch.
-template <class GetMsg>
+template <class Sink, class GetMsg>
 static int32_t decode_binary_impl(
-    Decoder* d, int32_t n_msgs, int32_t channels,
+    int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
     int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_aux1, int64_t aux1_stride,
     int32_t* out_level, int32_t* out_collisions,
-    GetMsg get_msg) {
+    Sink& sink, GetMsg get_msg) {
     // wire type id -> ReqType (ingest/decoders.py _BIN_TYPES)
     static const int32_t WIRE2RT[6] = {RT_UNKNOWN, RT_MEASUREMENT,
                                        RT_LOCATION, RT_ALERT, RT_REGISTER,
@@ -737,6 +904,9 @@ static int32_t decode_binary_impl(
         out_token[i] = -1;
         out_ts[i] = -1;
         out_aux0[(size_t)i * aux0_stride] = -1;
+        // the binary wire format carries no alternate id (see
+        // ingest/decoders.py encode_binary_request): aux1 stays absent
+        out_aux1[(size_t)i * aux1_stride] = -1;
         out_level[i] = 0;
         memset(out_values + (size_t)i * channels, 0,
                sizeof(float) * channels);
@@ -754,7 +924,7 @@ static int32_t decode_binary_impl(
         if (ver != 1 || wire_type == 0 || wire_type > 5) continue;
         uint16_t tlen = u16();
         if (!need((size_t)tlen + 8)) continue;
-        int32_t token = swtpu_intern(d->tokens, (const char*)p, tlen);
+        int32_t token = sink.token(i, (const char*)p, tlen);
         p += tlen;
         int64_t ts;
         memcpy(&ts, p, 8);
@@ -769,17 +939,15 @@ static int32_t decode_binary_impl(
                 if (!need(2)) { failed = true; break; }
                 uint16_t nlen = u16();
                 if (!need((size_t)nlen + 8)) { failed = true; break; }
-                int32_t nid = swtpu_intern(d->names, (const char*)p, nlen);
+                const char* np = (const char*)p;
                 p += nlen;
                 double v;
                 memcpy(&v, p, 8);
                 p += 8;
-                if (nid >= 0) {
-                    if (nid >= channels) collisions++;
-                    int ch = nid % channels;
-                    out_values[(size_t)i * channels + ch] = (float)v;
-                    out_chmask[(size_t)i * channels + ch] = 1;
-                }
+                sink.meas(i, np, nlen, v,
+                          out_values + (size_t)i * channels,
+                          out_chmask + (size_t)i * channels,
+                          channels, &collisions);
             }
         } else if (rtype == RT_LOCATION) {
             if (!need(24)) continue;
@@ -802,11 +970,11 @@ static int32_t decode_binary_impl(
             uint16_t tl = u16();
             if (!need((size_t)tl + 1)) continue;
             out_aux0[(size_t)i * aux0_stride] =
-                swtpu_intern(d->alert_types, (const char*)p, tl);
+                sink.alert_type(i, (const char*)p, tl);
             p += tl;
             out_level[i] = *p++;
         }
-        if (failed || token < 0) continue;   // interner-full = decode failure
+        if (failed || token == -1) continue;  // interner-full = decode failure
         out_ts[i] = ts;
         out_rtype[i] = rtype;
         out_token[i] = token;
@@ -950,11 +1118,13 @@ int32_t swtpu_decode_batch(
     const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
-    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
-    return decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
+    int32_t* out_aux0, int32_t* out_aux1,
+    int32_t* out_level, int32_t* out_collisions) {
+    DirectSink sink{d};
+    return decode_json_impl(n_msgs, channels, out_rtype, out_token,
                             out_ts, out_values, out_chmask, out_aux0, 1,
-                            out_level, out_collisions,
-                            PackedMsgs{buf, offsets});
+                            out_aux1, 1, out_level, out_collisions,
+                            sink, PackedMsgs{buf, offsets});
 }
 
 int32_t swtpu_decode_binary_batch(
@@ -962,36 +1132,121 @@ int32_t swtpu_decode_binary_batch(
     const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
-    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions) {
-    return decode_binary_impl(d, n_msgs, channels, out_rtype, out_token,
+    int32_t* out_aux0, int32_t* out_aux1,
+    int32_t* out_level, int32_t* out_collisions) {
+    DirectSink sink{d};
+    return decode_binary_impl(n_msgs, channels, out_rtype, out_token,
                               out_ts, out_values, out_chmask, out_aux0, 1,
-                              out_level, out_collisions,
-                              PackedMsgs{buf, offsets});
+                              out_aux1, 1, out_level, out_collisions,
+                              sink, PackedMsgs{buf, offsets});
 }
 
-// Arena-fill entry point: identical decode contract, but out_aux0 is a
-// STRIDED column (row i at out_aux0[i * aux0_stride]) so the scanner
-// writes straight into the aux[:, 0] lane of a preallocated SoA staging
-// arena — the engine's zero-copy batch ingest path points every output
-// at arena column slices and no intermediate decode buffer ever exists.
-// ``binary`` selects the flat-binary wire decoder over the JSON scanner.
+// Arena-fill entry point: identical decode contract, but out_aux0 and
+// out_aux1 are STRIDED columns (row i at out_aux[i * stride]) so the
+// scanner writes straight into the aux[:, 0] / aux[:, 1] lanes of a
+// preallocated SoA staging arena — the engine's zero-copy batch ingest
+// path points every output at arena column slices and no intermediate
+// decode buffer ever exists. ``binary`` selects the flat-binary wire
+// decoder over the JSON scanner.
 int32_t swtpu_decode_arena_batch(
     Decoder* d,
     const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
     int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_aux1, int64_t aux1_stride,
     int32_t* out_level, int32_t* out_collisions, int32_t binary) {
+    DirectSink sink{d};
     return binary
-               ? decode_binary_impl(d, n_msgs, channels, out_rtype,
+               ? decode_binary_impl(n_msgs, channels, out_rtype,
                                     out_token, out_ts, out_values,
                                     out_chmask, out_aux0, aux0_stride,
+                                    out_aux1, aux1_stride,
                                     out_level, out_collisions,
-                                    PackedMsgs{buf, offsets})
-               : decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
+                                    sink, PackedMsgs{buf, offsets})
+               : decode_json_impl(n_msgs, channels, out_rtype, out_token,
                                   out_ts, out_values, out_chmask, out_aux0,
-                                  aux0_stride, out_level, out_collisions,
-                                  PackedMsgs{buf, offsets});
+                                  aux0_stride, out_aux1, aux1_stride,
+                                  out_level, out_collisions,
+                                  sink, PackedMsgs{buf, offsets});
+}
+
+// ------------------------------------------------------------ shard ABI
+// Per-shard decode context for the multi-worker arena path: overlay
+// interners for first-seen strings + patch records of their uses. One
+// ShardCtx belongs to one worker slot; the engine serializes
+// reset -> decode -> (new_*/patch_* queries + merge) per batch.
+
+ShardCtx* swtpu_shard_create(Decoder* d) {
+    auto* c = new ShardCtx();
+    c->d = d;
+    c->deferred_row = -1;
+    for (int k = 0; k < 4; k++) c->ov[k] = swtpu_interner_create(1 << 22);
+    return c;
+}
+
+void swtpu_shard_destroy(ShardCtx* c) {
+    for (int k = 0; k < 4; k++) swtpu_interner_destroy(c->ov[k]);
+    delete c;
+}
+
+void swtpu_shard_reset(ShardCtx* c) {
+    for (int k = 0; k < 4; k++) {
+        if (swtpu_interner_size(c->ov[k]) > 0)
+            swtpu_interner_truncate(c->ov[k], 0);
+        c->patch[k].clear();
+    }
+    c->deferred_row = -1;
+}
+
+// Ranged arena decode through a shard context: same contract as
+// swtpu_decode_arena_batch but interning goes through the shard overlay
+// (shared interners are READ-ONLY). Patch rows are shard-relative.
+int32_t swtpu_shard_decode_arena_batch(
+    ShardCtx* c,
+    const char* buf, const int64_t* offsets, int32_t n_msgs, int32_t channels,
+    int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
+    float* out_values, uint8_t* out_chmask,
+    int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_aux1, int64_t aux1_stride,
+    int32_t* out_level, int32_t* out_collisions, int32_t binary) {
+    swtpu_shard_reset(c);
+    ShardSink sink{c};
+    return binary
+               ? decode_binary_impl(n_msgs, channels, out_rtype,
+                                    out_token, out_ts, out_values,
+                                    out_chmask, out_aux0, aux0_stride,
+                                    out_aux1, aux1_stride,
+                                    out_level, out_collisions,
+                                    sink, PackedMsgs{buf, offsets})
+               : decode_json_impl(n_msgs, channels, out_rtype, out_token,
+                                  out_ts, out_values, out_chmask, out_aux0,
+                                  aux0_stride, out_aux1, aux1_stride,
+                                  out_level, out_collisions,
+                                  sink, PackedMsgs{buf, offsets});
+}
+
+int32_t swtpu_shard_new_count(ShardCtx* c, int32_t kind) {
+    return swtpu_interner_size(c->ov[kind]);
+}
+
+int32_t swtpu_shard_new_string(ShardCtx* c, int32_t kind, int32_t idx,
+                               char* out, int32_t cap) {
+    return swtpu_interner_get(c->ov[kind], idx, out, cap);
+}
+
+int32_t swtpu_shard_patch_count(ShardCtx* c, int32_t kind) {
+    return (int32_t)c->patch[kind].size();
+}
+
+void swtpu_shard_patch_fetch(ShardCtx* c, int32_t kind,
+                             int32_t* rows, int32_t* idxs, float* vals) {
+    const auto& v = c->patch[kind];
+    for (size_t i = 0; i < v.size(); i++) {
+        rows[i] = v[i].row;
+        idxs[i] = v[i].idx;
+        vals[i] = v[i].val;
+    }
 }
 
 }  // extern "C"

@@ -46,7 +46,8 @@ int32_t swtpu_decode_pylist(
     Decoder* d, void* pylist, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
-    int32_t* out_aux0, int32_t* out_level, int32_t* out_collisions,
+    int32_t* out_aux0, int32_t* out_aux1,
+    int32_t* out_level, int32_t* out_collisions,
     int32_t binary) {
     PyObject* list = (PyObject*)pylist;
     if (!PyList_CheckExact(list) || PyList_GET_SIZE(list) < n_msgs)
@@ -69,29 +70,33 @@ int32_t swtpu_decode_pylist(
         t_lens[i] = (int64_t)PyBytes_GET_SIZE(o);
     }
     SpanMsgs get{t_ptrs.data(), t_lens.data()};
+    DirectSink sink{d};
     int32_t ok;
     Py_BEGIN_ALLOW_THREADS
     ok = binary
-             ? decode_binary_impl(d, n_msgs, channels, out_rtype, out_token,
+             ? decode_binary_impl(n_msgs, channels, out_rtype, out_token,
                                   out_ts, out_values, out_chmask, out_aux0,
-                                  1, out_level, out_collisions, get)
-             : decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
+                                  1, out_aux1, 1, out_level, out_collisions,
+                                  sink, get)
+             : decode_json_impl(n_msgs, channels, out_rtype, out_token,
                                 out_ts, out_values, out_chmask, out_aux0,
-                                1, out_level, out_collisions, get);
+                                1, out_aux1, 1, out_level, out_collisions,
+                                sink, get);
     Py_END_ALLOW_THREADS
     for (int32_t i = 0; i < n_msgs; i++) Py_DECREF(t_objs[i]);
     return ok;
 }
 
-// Arena-fill variant of swtpu_decode_pylist: out_aux0 is a strided
-// column (row i at out_aux0[i * aux0_stride]) aimed at the aux[:, 0]
-// lane of a SoA staging arena; every other output points at arena
-// column slices. Same GIL contract as swtpu_decode_pylist.
+// Arena-fill variant of swtpu_decode_pylist: out_aux0/out_aux1 are
+// strided columns (row i at out_aux[i * stride]) aimed at the aux lanes
+// of a SoA staging arena; every other output points at arena column
+// slices. Same GIL contract as swtpu_decode_pylist.
 int32_t swtpu_decode_arena_pylist(
     Decoder* d, void* pylist, int32_t n_msgs, int32_t channels,
     int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
     float* out_values, uint8_t* out_chmask,
     int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_aux1, int64_t aux1_stride,
     int32_t* out_level, int32_t* out_collisions,
     int32_t binary) {
     PyObject* list = (PyObject*)pylist;
@@ -112,17 +117,71 @@ int32_t swtpu_decode_arena_pylist(
         t_lens[i] = (int64_t)PyBytes_GET_SIZE(o);
     }
     SpanMsgs get{t_ptrs.data(), t_lens.data()};
+    DirectSink sink{d};
     int32_t ok;
     Py_BEGIN_ALLOW_THREADS
     ok = binary
-             ? decode_binary_impl(d, n_msgs, channels, out_rtype, out_token,
+             ? decode_binary_impl(n_msgs, channels, out_rtype, out_token,
                                   out_ts, out_values, out_chmask, out_aux0,
-                                  aux0_stride, out_level, out_collisions,
-                                  get)
-             : decode_json_impl(d, n_msgs, channels, out_rtype, out_token,
+                                  aux0_stride, out_aux1, aux1_stride,
+                                  out_level, out_collisions, sink, get)
+             : decode_json_impl(n_msgs, channels, out_rtype, out_token,
                                 out_ts, out_values, out_chmask, out_aux0,
-                                aux0_stride, out_level, out_collisions,
-                                get);
+                                aux0_stride, out_aux1, aux1_stride,
+                                out_level, out_collisions, sink, get);
+    Py_END_ALLOW_THREADS
+    for (int32_t i = 0; i < n_msgs; i++) Py_DECREF(t_objs[i]);
+    return ok;
+}
+
+// Sharded (ranged) arena decode over a list[bytes] SLICE: payloads
+// [start, start + n_msgs) decode through the shard context's overlay
+// interners into output pointers already aimed at the shard's disjoint
+// arena row range. Called concurrently from N Python threads — each
+// extracts its slice under the GIL, then scans with the GIL released,
+// so the scans genuinely parallelize across cores. The shared decoder
+// interners are read-only for the whole sharded call (engine lock).
+int32_t swtpu_shard_decode_arena_pylist(
+    ShardCtx* c, void* pylist, int32_t start, int32_t n_msgs,
+    int32_t channels,
+    int32_t* out_rtype, int32_t* out_token, int64_t* out_ts,
+    float* out_values, uint8_t* out_chmask,
+    int32_t* out_aux0, int64_t aux0_stride,
+    int32_t* out_aux1, int64_t aux1_stride,
+    int32_t* out_level, int32_t* out_collisions,
+    int32_t binary) {
+    PyObject* list = (PyObject*)pylist;
+    if (!PyList_CheckExact(list)
+        || PyList_GET_SIZE(list) < (Py_ssize_t)start + n_msgs)
+        return -1;
+    t_ptrs.resize(n_msgs);
+    t_lens.resize(n_msgs);
+    t_objs.resize(n_msgs);
+    for (int32_t i = 0; i < n_msgs; i++) {
+        PyObject* o = PyList_GET_ITEM(list, start + i);
+        if (!PyBytes_CheckExact(o)) {
+            for (int32_t j = 0; j < i; j++) Py_DECREF(t_objs[j]);
+            return -1;
+        }
+        Py_INCREF(o);
+        t_objs[i] = o;
+        t_ptrs[i] = PyBytes_AS_STRING(o);
+        t_lens[i] = (int64_t)PyBytes_GET_SIZE(o);
+    }
+    SpanMsgs get{t_ptrs.data(), t_lens.data()};
+    int32_t ok;
+    Py_BEGIN_ALLOW_THREADS
+    swtpu_shard_reset(c);
+    ShardSink sink{c};
+    ok = binary
+             ? decode_binary_impl(n_msgs, channels, out_rtype, out_token,
+                                  out_ts, out_values, out_chmask, out_aux0,
+                                  aux0_stride, out_aux1, aux1_stride,
+                                  out_level, out_collisions, sink, get)
+             : decode_json_impl(n_msgs, channels, out_rtype, out_token,
+                                out_ts, out_values, out_chmask, out_aux0,
+                                aux0_stride, out_aux1, aux1_stride,
+                                out_level, out_collisions, sink, get);
     Py_END_ALLOW_THREADS
     for (int32_t i = 0; i < n_msgs; i++) Py_DECREF(t_objs[i]);
     return ok;

@@ -162,20 +162,46 @@ class IngestHostMixin:
         """Log accepted payloads. MUST be called under the engine lock so a
         concurrent snapshot's watermark can never cover a record whose
         events were not yet staged. No-op while replaying or while an outer
-        ingest path on this thread already logged the raw batch."""
+        ingest path on this thread already logged the raw batch.
+
+        Group-commit mode (the default): the append BUFFERS and returns a
+        sequence ticket — the commit thread writes + fsyncs off the driver
+        thread, and :meth:`_wal_gate` holds every dispatch until its
+        batch's ticket is durable (WAL-before-dispatch preserved, fsync
+        latency overlapped with next-batch decode). Non-group mode keeps
+        the inline write+flush."""
         if self.wal is None or getattr(self._wal_local, "depth", 0):
             return
         head = tag + tenant.encode() + b"\x00"
-        # ONE buffered write for the whole group, then one flush: an
-        # accepted event must survive a process crash (fsync cadence
-        # stays the operator's sync() call), and a write() per record
-        # was a measurable slice of the batch staging budget
         rec = self.flight.current()
         t0 = time.perf_counter()
-        self.wal.append_many(payloads, head)
-        self.wal.flush()
+        self._wal_last_seq = self.wal.append_many(payloads, head)
+        if not self.wal.group_commit:
+            # ONE buffered write for the whole group, then one flush: an
+            # accepted event must survive a process crash (fsync cadence
+            # stays the operator's sync() call)
+            self.wal.flush()
         rec.mark("wal_append")
         rec.add("wal_flush_ms", round((time.perf_counter() - t0) * 1000, 3))
+
+    def _wal_gate(self, traces=()) -> None:
+        """Block until every WAL record appended so far is DURABLE (group
+        commit's fsync watermark) — called immediately before a device
+        dispatch, under the engine lock. The append of the dispatching
+        batch happened earlier on this same thread, so gating on the
+        newest ticket covers it. No-op without a WAL (and inside
+        wait_durable, when group commit is off)."""
+        if self.wal is None or not self.wal.group_commit:
+            # non-group mode flushes inline at append and never fsyncs at
+            # dispatch — stamping wal_durable here would claim a
+            # durability guarantee that mode does not provide
+            return
+        t0 = time.perf_counter()
+        self.wal.wait_durable(self._wal_last_seq)
+        dt = time.perf_counter() - t0
+        for rec in traces:
+            rec.mark("wal_durable")
+            rec.add("wal_gate_ms", round(dt * 1000, 3))
 
     # ------------------------------------------------------- flight recorder
     def get_trace(self, trace_id: str) -> dict:
@@ -491,6 +517,29 @@ class EngineConfig:
                                        # (DeviceManagementTriggers analog)
     wal_dir: str | None = None         # write-ahead log directory; None
                                        # disables the durability log
+    wal_group_commit: bool = True      # group-commit WAL: appends buffer,
+                                       # a commit thread fsyncs once per
+                                       # quiescent window, and dispatch
+                                       # gates on the durability watermark
+                                       # (fsync overlaps next-batch decode
+                                       # instead of serializing the driver)
+    wal_group_window_s: float = 0.002  # commit-thread quiescent window
+    ingest_workers: int = 0            # sharded arena decode fan-out:
+                                       # one wire batch splits across N
+                                       # threads by payload bytes into
+                                       # disjoint rows of one arena,
+                                       # byte-identical to single-thread.
+                                       # 0 = auto (os.cpu_count()),
+                                       # 1 = single-threaded decode
+    autotune: bool = False             # stage-time autotuner: adapt
+                                       # dispatch_depth / decode fan-out
+                                       # (and optionally scan_chunk)
+                                       # toward the flight recorder's
+                                       # measured bottleneck
+    autotune_interval: int = 64        # dispatches between evaluations
+    autotune_scan_chunk: bool = False  # allow the tuner to change
+                                       # scan_chunk (recompiles the arena
+                                       # scan program mid-run)
     archive_dir: str | None = None     # long-term retention tier: spill
                                        # ring segments to disk before
                                        # overwrite; query_events merges
@@ -814,7 +863,14 @@ class Engine(IngestHostMixin):
         self.device_types.intern(c.default_device_type)
         self.areas = TokenInterner(1 << 16)
         self.customers = TokenInterner(1 << 16)
-        self.event_ids = TokenInterner(1 << 22)  # alternate/correlation ids
+        # alternate/correlation ids (the aux1 lane). With a native
+        # decoder the engine ADOPTS the decoder's event-id interner so
+        # the batch decode path and the per-request process() path hand
+        # out the same ids (alternate-id queries and the device-side
+        # dedup counter agree across paths).
+        self.event_ids = (self._native_decoder.event_ids
+                          if self._native_decoder is not None
+                          else TokenInterner(1 << 22))
 
         self.state = PipelineState.create(
             c.device_capacity, c.token_capacity, c.assignment_capacity,
@@ -847,19 +903,21 @@ class Engine(IngestHostMixin):
         self._arena_dispatches = 0
         if (self._native_decoder is not None and c.ingest_arenas >= 0
                 and self._native_decoder.has_arena):
-            from sitewhere_tpu.ingest.arena import ArenaPool
+            self._build_arena_machinery(max(1, c.scan_chunk))
+        # sharded multi-core decode: wire batches split across N threads
+        # into disjoint rows of the fill arena, byte-identical to the
+        # single-threaded path (tests/test_shard_decode.py). Degrades to
+        # the plain decoder on 1 core / missing native entry points.
+        self._sharder = None
+        if self._arena_pool is not None:
+            import os as _os
 
-            k = max(1, c.scan_chunk)
-            n_arenas = c.ingest_arenas or max(1, c.dispatch_depth) + 2
-            self._arena_pool = ArenaPool(
-                n_arenas, c.batch_capacity * k, c.channels, lanes=k)
-            if k > 1:
-                from sitewhere_tpu.pipeline import make_arena_scan_step
+            n_workers = c.ingest_workers or (_os.cpu_count() or 1)
+            if n_workers > 1 and self._native_decoder.has_shard:
+                from sitewhere_tpu.ingest.workers import ShardedArenaDecoder
 
-                self._arena_step = make_arena_scan_step(
-                    PipelineConfig(auto_register=c.auto_register,
-                                   default_device_type=0),
-                    c.batch_capacity, c.channels, k)
+                self._sharder = ShardedArenaDecoder(self._native_decoder,
+                                                    n_workers)
         self._last_flush = time.monotonic()
         # host mirrors
         self.devices: dict[int, DeviceInfo] = {}      # device_id -> info
@@ -890,10 +948,13 @@ class Engine(IngestHostMixin):
         # decoder (utils/checkpoint.recover_engine)
         self.wal = None
         self._wal_local = threading.local()   # re-entrancy guard per thread
+        self._wal_last_seq = 0   # newest append ticket; dispatch gates on it
         if c.wal_dir:
             from sitewhere_tpu.utils.ingestlog import IngestLog
 
-            self.wal = IngestLog(c.wal_dir)
+            self.wal = IngestLog(c.wal_dir,
+                                 group_commit=c.wal_group_commit,
+                                 group_window_s=c.wal_group_window_s)
         # long-term retention tier: rows spill to disk before the ring can
         # overwrite them (the external-DB history of the reference)
         self.archive = None
@@ -926,6 +987,72 @@ class Engine(IngestHostMixin):
                     "capacity is %d — ring may wrap before spooling; "
                     "raise store_capacity or lower scan_chunk/batch_capacity",
                     worst, acap)
+        # stage-time autotuner (opt-in): adapts dispatch_depth / decode
+        # fan-out (and optionally scan_chunk) toward the flight
+        # recorder's measured bottleneck, one knob per evaluation
+        self._autotuner = None
+        if c.autotune:
+            from sitewhere_tpu.utils.autotune import StageTimeAutotuner
+
+            self._autotuner = StageTimeAutotuner(
+                self, interval=c.autotune_interval,
+                adapt_scan_chunk=c.autotune_scan_chunk)
+
+    def _build_arena_machinery(self, k: int) -> None:
+        """(Re)build the staging-arena pool and, for k > 1, the K-lane
+        arena scan step — the ONE constructor shared by __init__ and
+        runtime scan_chunk retuning, so the sizing heuristics can never
+        diverge between a fresh and a retuned engine."""
+        from sitewhere_tpu.ingest.arena import ArenaPool
+
+        c = self.config
+        n_arenas = c.ingest_arenas or max(1, c.dispatch_depth) + 2
+        self._arena_pool = ArenaPool(
+            n_arenas, c.batch_capacity * k, c.channels, lanes=k)
+        self._arena_step = None
+        if k > 1:
+            from sitewhere_tpu.pipeline import make_arena_scan_step
+
+            self._arena_step = make_arena_scan_step(
+                PipelineConfig(auto_register=c.auto_register,
+                               default_device_type=0),
+                c.batch_capacity, c.channels, k)
+
+    def set_ingest_tuning(self, *, scan_chunk: int | None = None,
+                          dispatch_depth: int | None = None,
+                          ingest_workers: int | None = None) -> dict:
+        """Apply ingest-tuning knobs at runtime — the single choke point
+        the autotuner (and operators, via REST/config reload) go through,
+        because each knob invalidates different machinery:
+
+          dispatch_depth   takes effect at the next dispatch, free
+          ingest_workers   clamps the sharded-decode fan-out, free
+          scan_chunk       REBUILDS the arena pool + scan step (drains
+                           in-flight dispatches first; the new program
+                           compiles on next dispatch)
+
+        Returns the applied values."""
+        with self.lock:
+            c = self.config
+            if dispatch_depth is not None:
+                c.dispatch_depth = max(1, int(dispatch_depth))
+            if ingest_workers is not None and self._sharder is not None:
+                self._sharder.set_active_workers(ingest_workers)
+            if scan_chunk is not None:
+                k = max(1, int(scan_chunk))
+                if k != max(1, c.scan_chunk) and self._arena_pool is not None:
+                    # quiesce: dispatch the fill arena and staged batches,
+                    # then wait out in-flight programs so no arena of the
+                    # old shape is still feeding a transfer
+                    self._dispatch_arena()
+                    self._dispatch_staged(all_batches=True)
+                    self._arena_pool.drain()
+                    self._build_arena_machinery(k)
+                    c.scan_chunk = k
+            return {"scan_chunk": c.scan_chunk,
+                    "dispatch_depth": c.dispatch_depth,
+                    "ingest_workers": (self._sharder.active_workers
+                                       if self._sharder else 1)}
 
     @property
     def staged_count(self) -> int:
@@ -1094,10 +1221,13 @@ class Engine(IngestHostMixin):
                 chunk = (payloads if take == n
                          else payloads[pos:pos + take])
                 lo = arena.cursor
-                n_ok, collisions = self._native_decoder.decode_into(
+                dec = self._sharder or self._native_decoder
+                n_ok, collisions = dec.decode_into(
                     chunk, arena, lo, binary=binary)
                 rec.mark("decode")
                 rec.mark("arena_fill")
+                if self._sharder is not None:
+                    rec.add("ingest_workers", self._sharder.last_workers)
                 self._wal_append(tag, chunk, tenant)
                 self._arena_commit(arena, lo, take, chunk, tenant,
                                    reg_decoder, now, base_ms, summary)
@@ -1140,6 +1270,7 @@ class Engine(IngestHostMixin):
                 arena.values[lo:hi] = res.values[sl]
                 arena.vmask[lo:hi] = res.chmask[sl]
                 arena.aux[lo:hi, 0] = res.aux0[sl]
+                arena.aux[lo:hi, 1] = res.aux1[sl]
                 arena.level[lo:hi] = res.level[sl]
                 rec.mark("arena_fill")
                 self._arena_commit(arena, lo, take,
@@ -1204,7 +1335,8 @@ class Engine(IngestHostMixin):
         arena.ts_ms[lo:hi] = np.where(ts64 >= 0, rel, now)
         arena.received_ms[lo:hi] = now
         arena.tenant_id[lo:hi] = self.tenants.intern(tenant)
-        arena.aux[lo:hi, 1] = NULL_ID   # aux0 was written by the decoder
+        # aux0 (alert type) AND aux1 (alternate id) were written by the
+        # native decoder — the device-side dedup counter sees batch rows
         alert_rows = ok & (etype == int(EventType.ALERT))
         if alert_rows.any():
             # alert rows carry their level in values[:, 0]
@@ -1229,6 +1361,10 @@ class Engine(IngestHostMixin):
             return
         arena.valid[arena.cursor:] = False
         traces, arena.traces = arena.traces, []
+        # durability watermark: every WAL record of this arena's batches
+        # must be fsync'd before the device program runs (group commit
+        # moved the fsync off-thread; the ORDER guarantee stays here)
+        self._wal_gate(traces)
         for rec in traces:
             rec.mark("dispatch")
         step = self._arena_step or self._step
@@ -1244,6 +1380,8 @@ class Engine(IngestHostMixin):
         # dispatch configs is a tested parity property
         self._arena_dispatches += 1
         self._last_flush = time.monotonic()
+        if self._autotuner is not None:
+            self._autotuner.note_dispatch()
 
     def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
         """Stage a natively decoded SoA batch (shared by the JSON and binary
@@ -1276,7 +1414,7 @@ class Engine(IngestHostMixin):
                         values=values[idxs],
                         vmask=res.chmask[idxs],
                         aux0=res.aux0[idxs],
-                        aux1=np.full(len(idxs), NULL_ID, np.int32),
+                        aux1=res.aux1[idxs],
                     ))
                 self.channel_map.collisions += res.collisions
                 return {"decoded": int(np.sum(ok)) + n_reg_ok, "failed": failed,
@@ -1307,6 +1445,7 @@ class Engine(IngestHostMixin):
                 b.values[lo:hi] = values[chunk]
                 b.vmask[lo:hi] = res.chmask[chunk]
                 b.aux[lo:hi, 0] = res.aux0[chunk]
+                b.aux[lo:hi, 1] = res.aux1[chunk]
                 b._n = hi
                 staged += n_chunk
                 pos += room
@@ -1385,6 +1524,7 @@ class Engine(IngestHostMixin):
                 self._dispatch_staged(all_batches=False)
             else:
                 traces, self._staged_traces = self._staged_traces, []
+                self._wal_gate(traces)
                 for rec in traces:
                     rec.mark("dispatch")
                 self.state, out = self._step(self.state, batch)
@@ -1418,6 +1558,7 @@ class Engine(IngestHostMixin):
             # records for every batch in the chunk (K-batch granularity:
             # the chunk IS the dispatch unit)
             traces, self._staged_traces = self._staged_traces, []
+            self._wal_gate(traces)
             for rec in traces:
                 rec.mark("dispatch")
             self.state, outs = self._scan_step(self.state,
@@ -2333,6 +2474,12 @@ class Engine(IngestHostMixin):
             **({"arena_pool_waits": self._arena_pool.waits,
                 "arena_pool_size": self._arena_pool.n_arenas}
                if self._arena_pool is not None else {}),
+            **({"ingest_workers": self._sharder.active_workers,
+                "sharded_batches": self._sharder.sharded_batches}
+               if self._sharder is not None else {}),
+            **({"wal_fsyncs": self.wal.fsyncs,
+                "wal_commit_groups": self.wal.commit_groups}
+               if self.wal is not None and self.wal.group_commit else {}),
             **({"archived_rows": self.archive.total_rows(),
                 "archive_lost_rows": self.archive.lost_rows}
                if self.archive is not None else {}),

@@ -44,29 +44,39 @@ class DecodedArrays(NamedTuple):
     values: np.ndarray     # float32[N, C]
     chmask: np.ndarray     # bool[N, C]
     aux0: np.ndarray       # int32[N] alert-type id
+    aux1: np.ndarray       # int32[N] alternate-id (event-id interner; -1 none)
     level: np.ndarray      # int32[N] alert level
     collisions: int
 
 
 class NativeBatchDecoder:
     """Holds the C++ decoder + its interners. The token interner is shared
-    with the engine (ids must be the engine's ids)."""
+    with the engine (ids must be the engine's ids); the event-id interner
+    (alternate/correlation ids, the aux1 lane) is decoder-owned and the
+    engine ADOPTS it as ``event_ids`` so the batch path and the
+    per-request path assign the same ids."""
 
     def __init__(self, token_interner: NativeInterner, channels: int,
-                 name_capacity: int = 1 << 20, alert_capacity: int = 1 << 16):
+                 name_capacity: int = 1 << 20, alert_capacity: int = 1 << 16,
+                 event_capacity: int = 1 << 22):
         self.lib = load_library()
         if self.lib is None:
             raise RuntimeError("native library unavailable")
         self.tokens = token_interner
         self.channels = channels
         self.handle = self.lib.swtpu_decoder_create(
-            token_interner.handle, name_capacity, alert_capacity
+            token_interner.handle, name_capacity, alert_capacity,
+            event_capacity
         )
         self.names = NativeInterner(
             name_capacity, self.lib, self.lib.swtpu_decoder_names(self.handle)
         )
         self.alert_types = NativeInterner(
             alert_capacity, self.lib, self.lib.swtpu_decoder_alert_types(self.handle)
+        )
+        self.event_ids = NativeInterner(
+            event_capacity, self.lib,
+            self.lib.swtpu_decoder_event_ids(self.handle)
         )
         # zero-copy list[bytes] entry point (libswtpu_py.so): skips the
         # b"".join + per-payload length scan + offsets cumsum the packed
@@ -99,6 +109,7 @@ class NativeBatchDecoder:
         values = np.empty((n, c), np.float32)
         chmask = np.empty((n, c), np.uint8)
         aux0 = np.empty(n, np.int32)
+        aux1 = np.empty(n, np.int32)
         level = np.empty(n, np.int32)
         collisions = ctypes.c_int32(0)
 
@@ -110,19 +121,20 @@ class NativeBatchDecoder:
             ptr(rtype, ctypes.c_int32), ptr(token, ctypes.c_int32),
             ptr(ts, ctypes.c_int64), ptr(values, ctypes.c_float),
             ptr(chmask, ctypes.c_uint8), ptr(aux0, ctypes.c_int32),
+            ptr(aux1, ctypes.c_int32),
             ptr(level, ctypes.c_int32), ctypes.byref(collisions),
             np.int32(1 if binary else 0)))
         if n_ok < 0:
             return None   # non-bytes item: packed path handles/raises
         return DecodedArrays(
             n_ok=n_ok, rtype=rtype, token_id=token, ts_ms64=ts,
-            values=values, chmask=chmask.view(bool), aux0=aux0,
+            values=values, chmask=chmask.view(bool), aux0=aux0, aux1=aux1,
             level=level, collisions=int(collisions.value))
 
     def decode_packed(self, buf, offsets: np.ndarray, n: int,
                       rtype: np.ndarray, token: np.ndarray, ts: np.ndarray,
                       values: np.ndarray, chmask: np.ndarray,
-                      aux0: np.ndarray, level: np.ndarray,
+                      aux0: np.ndarray, aux1: np.ndarray, level: np.ndarray,
                       *, binary: bool = False) -> tuple[int, int]:
         """One scanner call over an already-concatenated wire batch
         (``offsets`` int64[>=n+1]; output arrays sized >= n rows). THE
@@ -143,7 +155,8 @@ class NativeBatchDecoder:
             ptr(rtype, ctypes.c_int32), ptr(token, ctypes.c_int32),
             ptr(ts, ctypes.c_int64),
             ptr(values, ctypes.c_float), ptr(chmask, ctypes.c_uint8),
-            ptr(aux0, ctypes.c_int32), ptr(level, ctypes.c_int32),
+            ptr(aux0, ctypes.c_int32), ptr(aux1, ctypes.c_int32),
+            ptr(level, ctypes.c_int32),
             ctypes.byref(collisions),
         ))
         return n_ok, int(collisions.value)
@@ -153,13 +166,45 @@ class NativeBatchDecoder:
         """Arena-fill entry points present in the loaded libraries."""
         return bool(getattr(self.lib, "_swtpu_has_arena", False))
 
+    @property
+    def has_shard(self) -> bool:
+        """Sharded (multi-worker) arena-decode entry points present in
+        BOTH libraries (the ShardCtx ABI lives in libswtpu.so, the
+        ranged list decode in libswtpu_py.so)."""
+        return bool(getattr(self.lib, "_swtpu_has_shard", False)
+                    and self.py_lib is not None
+                    and getattr(self.py_lib, "_swtpu_has_shard", False))
+
+    @staticmethod
+    def arena_out_args(arena, lo: int, hi: int, collisions):
+        """The output-pointer argument tail shared by the arena and
+        shard decode entry points: every output aims at the arena's own
+        column slices for rows [lo, hi), with the aux lanes strided."""
+        c = ctypes
+
+        def ptr(a, t):
+            return a.ctypes.data_as(c.POINTER(t))
+
+        stride = c.c_int64(arena.aux.shape[1])
+        return (
+            ptr(arena.rtype[lo:hi], c.c_int32),
+            ptr(arena.token_id[lo:hi], c.c_int32),
+            ptr(arena.ts64[lo:hi], c.c_int64),
+            ptr(arena.values[lo:hi], c.c_float),
+            ptr(arena.vmask[lo:hi], c.c_uint8),
+            ptr(arena.aux[lo:hi], c.c_int32), stride,
+            ptr(arena.aux[lo:hi, 1:], c.c_int32), stride,
+            ptr(arena.level[lo:hi], c.c_int32),
+            c.byref(collisions),
+        )
+
     def decode_into(self, payloads: list[bytes], arena, lo: int,
                     *, binary: bool = False) -> tuple[int, int]:
         """Decode ``payloads`` straight into ``arena`` rows
         [lo, lo + len(payloads)): the scanner's outputs are the arena's
-        own column slices (zero-copy staging; the aux[:, 0] lane is
-        written strided in place). Same no-concurrent-mutation contract
-        as :meth:`decode`. Returns (n_ok, channel_collisions)."""
+        own column slices (zero-copy staging; the aux[:, 0] / aux[:, 1]
+        lanes are written strided in place). Same no-concurrent-mutation
+        contract as :meth:`decode`. Returns (n_ok, channel_collisions)."""
         n = len(payloads)
         hi = lo + n
         if hi > arena.rows:
@@ -171,16 +216,8 @@ class NativeBatchDecoder:
         def ptr(a, t):
             return a.ctypes.data_as(c.POINTER(t))
 
-        args = (
-            ptr(arena.rtype[lo:hi], c.c_int32),
-            ptr(arena.token_id[lo:hi], c.c_int32),
-            ptr(arena.ts64[lo:hi], c.c_int64),
-            ptr(arena.values[lo:hi], c.c_float),
-            ptr(arena.vmask[lo:hi], c.c_uint8),
-            ptr(arena.aux[lo:hi], c.c_int32), c.c_int64(arena.aux.shape[1]),
-            ptr(arena.level[lo:hi], c.c_int32),
-            c.byref(collisions), np.int32(1 if binary else 0),
-        )
+        args = self.arena_out_args(arena, lo, hi, collisions) \
+            + (np.int32(1 if binary else 0),)
         if (self.py_lib is not None and type(payloads) is list
                 and getattr(self.py_lib, "_swtpu_has_arena", False)):
             n_ok = int(self.py_lib.swtpu_decode_arena_pylist(
@@ -219,14 +256,15 @@ class NativeBatchDecoder:
         values = np.empty((n, c), np.float32)
         chmask = np.empty((n, c), np.uint8)
         aux0 = np.empty(n, np.int32)
+        aux1 = np.empty(n, np.int32)
         level = np.empty(n, np.int32)
         n_ok, collisions = self.decode_packed(
-            buf, offsets, n, rtype, token, ts, values, chmask, aux0, level,
-            binary=binary)
+            buf, offsets, n, rtype, token, ts, values, chmask, aux0, aux1,
+            level, binary=binary)
         return DecodedArrays(
             n_ok=n_ok, rtype=rtype, token_id=token, ts_ms64=ts,
-            values=values, chmask=chmask.view(bool), aux0=aux0, level=level,
-            collisions=collisions,
+            values=values, chmask=chmask.view(bool), aux0=aux0, aux1=aux1,
+            level=level, collisions=collisions,
         )
 
 

@@ -46,12 +46,15 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.swtpu_interner_get.argtypes = [c.c_void_p, c.c_int32, c.c_char_p, c.c_int32]
     lib.swtpu_interner_truncate.argtypes = [c.c_void_p, c.c_int32]
     lib.swtpu_decoder_create.restype = c.c_void_p
-    lib.swtpu_decoder_create.argtypes = [c.c_void_p, c.c_int32, c.c_int32]
+    lib.swtpu_decoder_create.argtypes = [c.c_void_p, c.c_int32, c.c_int32,
+                                         c.c_int32]
     lib.swtpu_decoder_destroy.argtypes = [c.c_void_p]
     lib.swtpu_decoder_names.restype = c.c_void_p
     lib.swtpu_decoder_names.argtypes = [c.c_void_p]
     lib.swtpu_decoder_alert_types.restype = c.c_void_p
     lib.swtpu_decoder_alert_types.argtypes = [c.c_void_p]
+    lib.swtpu_decoder_event_ids.restype = c.c_void_p
+    lib.swtpu_decoder_event_ids.argtypes = [c.c_void_p]
     lib.swtpu_decode_batch.restype = c.c_int32
     lib.swtpu_decode_batch.argtypes = [
         c.c_void_p,                      # decoder
@@ -64,13 +67,14 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
         c.POINTER(c.c_float),            # out_values
         c.POINTER(c.c_uint8),            # out_chmask
         c.POINTER(c.c_int32),            # out_aux0
+        c.POINTER(c.c_int32),            # out_aux1
         c.POINTER(c.c_int32),            # out_level
         c.POINTER(c.c_int32),            # out_collisions
     ]
     lib.swtpu_decode_binary_batch.restype = c.c_int32
     lib.swtpu_decode_binary_batch.argtypes = lib.swtpu_decode_batch.argtypes
     try:
-        # arena-fill entry point (strided aux0 column + json/binary flag);
+        # arena-fill entry point (strided aux columns + json/binary flag);
         # absent only in a stale prebuilt library — the arena ingest path
         # then stays off while everything else keeps working
         lib.swtpu_decode_arena_batch.restype = c.c_int32
@@ -79,12 +83,33 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
             c.c_int32, c.c_int32,
             c.POINTER(c.c_int32), c.POINTER(c.c_int32),
             c.POINTER(c.c_int64), c.POINTER(c.c_float),
-            c.POINTER(c.c_uint8), c.POINTER(c.c_int32), c.c_int64,
+            c.POINTER(c.c_uint8),
+            c.POINTER(c.c_int32), c.c_int64,     # aux0 + stride
+            c.POINTER(c.c_int32), c.c_int64,     # aux1 + stride
             c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32,
         ]
         lib._swtpu_has_arena = True
     except AttributeError:
         lib._swtpu_has_arena = False
+    try:
+        # sharded-decode context ABI (multi-worker arena decode)
+        lib.swtpu_shard_create.restype = c.c_void_p
+        lib.swtpu_shard_create.argtypes = [c.c_void_p]
+        lib.swtpu_shard_destroy.argtypes = [c.c_void_p]
+        lib.swtpu_shard_reset.argtypes = [c.c_void_p]
+        lib.swtpu_shard_new_count.restype = c.c_int32
+        lib.swtpu_shard_new_count.argtypes = [c.c_void_p, c.c_int32]
+        lib.swtpu_shard_new_string.restype = c.c_int32
+        lib.swtpu_shard_new_string.argtypes = [
+            c.c_void_p, c.c_int32, c.c_int32, c.c_char_p, c.c_int32]
+        lib.swtpu_shard_patch_count.restype = c.c_int32
+        lib.swtpu_shard_patch_count.argtypes = [c.c_void_p, c.c_int32]
+        lib.swtpu_shard_patch_fetch.argtypes = [
+            c.c_void_p, c.c_int32, c.POINTER(c.c_int32),
+            c.POINTER(c.c_int32), c.POINTER(c.c_float)]
+        lib._swtpu_has_shard = True
+    except AttributeError:
+        lib._swtpu_has_shard = False
     return lib
 
 
@@ -179,6 +204,7 @@ def load_py_library() -> "ctypes.PyDLL | None":
                 c.POINTER(c.c_int32), c.POINTER(c.c_int32),
                 c.POINTER(c.c_int64), c.POINTER(c.c_float),
                 c.POINTER(c.c_uint8), c.POINTER(c.c_int32),
+                c.POINTER(c.c_int32),
                 c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32]
             lib.swtpu_route_pylist.restype = c.c_int32
             lib.swtpu_route_pylist.argtypes = [
@@ -190,11 +216,31 @@ def load_py_library() -> "ctypes.PyDLL | None":
                     c.c_void_p, c.py_object, c.c_int32, c.c_int32,
                     c.POINTER(c.c_int32), c.POINTER(c.c_int32),
                     c.POINTER(c.c_int64), c.POINTER(c.c_float),
-                    c.POINTER(c.c_uint8), c.POINTER(c.c_int32), c.c_int64,
+                    c.POINTER(c.c_uint8),
+                    c.POINTER(c.c_int32), c.c_int64,   # aux0 + stride
+                    c.POINTER(c.c_int32), c.c_int64,   # aux1 + stride
                     c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32]
                 lib._swtpu_has_arena = True
             except AttributeError:
                 lib._swtpu_has_arena = False
+            try:
+                # ranged shard decode: list slice [start, start+n) into a
+                # disjoint arena row range through a ShardCtx (created by
+                # the CDLL handle — pointers are shared across the libs,
+                # the established Decoder*-passing pattern)
+                lib.swtpu_shard_decode_arena_pylist.restype = c.c_int32
+                lib.swtpu_shard_decode_arena_pylist.argtypes = [
+                    c.c_void_p, c.py_object, c.c_int32, c.c_int32,
+                    c.c_int32,
+                    c.POINTER(c.c_int32), c.POINTER(c.c_int32),
+                    c.POINTER(c.c_int64), c.POINTER(c.c_float),
+                    c.POINTER(c.c_uint8),
+                    c.POINTER(c.c_int32), c.c_int64,
+                    c.POINTER(c.c_int32), c.c_int64,
+                    c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32]
+                lib._swtpu_has_shard = True
+            except AttributeError:
+                lib._swtpu_has_shard = False
             _py_lib = lib
         except OSError as e:
             logger.info("py-bridge load failed (%s); packed path only", e)

@@ -345,7 +345,11 @@ class DistributedEngine(IngestHostMixin):
         self.areas = TokenInterner(1 << 16)
         self.customers = TokenInterner(1 << 16)
         self.assets = TokenInterner(1 << 16)
-        self.event_ids = TokenInterner(1 << 22)
+        # adopt the native decoder's event-id interner (alternate ids,
+        # aux1) so batch-decoded and per-request rows share one id space
+        self.event_ids = (self._native_decoder.event_ids
+                          if self._native_decoder is not None
+                          else TokenInterner(1 << 22))
 
         self._buf = _StackedBuffer(self.n_shards, c.batch_capacity_per_shard,
                                    c.channels)

@@ -160,10 +160,12 @@ def _tenant_counter_delta(batch: EventBatch, accepted: jax.Array,
                      aux1 correlation id appearing more than once — the
                      AlternateIdDeduplicator's redelivery signature,
                      detected with one stable sort instead of a host
-                     LRU). Only rows whose staging path populates aux1
-                     can count: the per-request process() path does; the
-                     native batch decoders do not yet extract
-                     alternateId, so batch-staged rows read 0 here.
+                     LRU). Both staging paths populate aux1: the
+                     per-request process() path interns the request's
+                     alternate id, and the native batch/arena decoders
+                     extract ``alternateId`` into the aux1 lane through
+                     the same event-id interner (parity pinned by
+                     tests/test_flight.py).
       geofence_hit   location rows inside any configured zone polygon
       invalid        rows still unmatched after auto-registration
 

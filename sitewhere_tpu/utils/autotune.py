@@ -1,0 +1,183 @@
+"""Stage-time autotuner: steer ingest knobs toward the measured bottleneck.
+
+The PR-3 flight recorder already timestamps every batch's lifecycle
+(decode -> WAL -> commit -> dispatch -> device-ready) at near-zero cost;
+this controller closes the loop. Every ``interval`` dispatches it takes
+the MEDIAN per-stage durations over the recent record window
+(utils/flight.stage_durations — the same harvesting rule bench.py
+reports) and nudges ONE knob toward the dominant stage:
+
+  decode dominates      -> widen the sharded-decode worker fan-out
+  device dominates      -> deepen ``dispatch_depth`` (host/device overlap)
+  dispatch overhead     -> double ``scan_chunk`` (amortize per-dispatch
+     dominates             cost; opt-in — a chunk change recompiles the
+                           arena scan program and rebuilds the pool)
+
+with hysteresis (raise thresholds ~4x above the lower thresholds) so a
+noisy window cannot ping-pong a knob. One change per evaluation keeps
+every adjustment attributable. Decisions are kept on the controller
+(``decisions``) and exported as gauges so an operator can see WHAT the
+tuner believes and WHY without attaching a debugger:
+
+  swtpu_autotune_ingest_workers / _dispatch_depth / _scan_chunk
+  swtpu_autotune_adjustments (counter, labeled by knob + direction)
+
+Every series carries a per-controller ``engine`` label (process-wide
+creation index): several autotuned engines in one process must not
+clobber each other's telemetry.
+"""
+
+from __future__ import annotations
+
+import itertools
+import statistics
+
+from sitewhere_tpu.utils.flight import stage_durations
+from sitewhere_tpu.utils.metrics import REGISTRY
+
+_ENGINE_IDS = itertools.count()
+
+G_WORKERS = REGISTRY.gauge(
+    "swtpu_autotune_ingest_workers",
+    "Sharded-decode worker fan-out chosen by the stage-time autotuner")
+G_DEPTH = REGISTRY.gauge(
+    "swtpu_autotune_dispatch_depth",
+    "dispatch_depth chosen by the stage-time autotuner")
+G_CHUNK = REGISTRY.gauge(
+    "swtpu_autotune_scan_chunk",
+    "scan_chunk chosen by the stage-time autotuner")
+C_ADJUST = REGISTRY.counter(
+    "swtpu_autotune_adjustments",
+    "Autotuner knob adjustments, labeled by knob and direction")
+
+
+def decide(stats: dict, current: dict, bounds: dict) -> list[tuple]:
+    """Pure decision rule: (median stage durations, current knob values,
+    knob bounds) -> ordered [(knob, new_value, reason)] proposals. Pure
+    so tests can pin the policy without fabricating an engine. The
+    caller applies at most the first proposal."""
+    decode = stats.get("decode_ms") or 0.0
+    wal = stats.get("wal_ms") or 0.0
+    wait = stats.get("dispatch_wait_ms") or 0.0
+    device = stats.get("device_ms") or 0.0
+    host = decode + wal
+    out = []
+    workers = current["ingest_workers"]
+    depth = current["dispatch_depth"]
+    chunk = current["scan_chunk"]
+    if (decode > device and decode > wal + wait
+            and workers < bounds["max_workers"]):
+        out.append(("ingest_workers", workers + 1,
+                    f"decode {decode:.2f}ms dominates device "
+                    f"{device:.2f}ms"))
+    if workers > 1 and decode < 0.25 * device:
+        out.append(("ingest_workers", workers - 1,
+                    f"decode {decode:.2f}ms << device {device:.2f}ms; "
+                    "shed shard overhead"))
+    if device > 1.5 * max(host, 1e-9) and depth < bounds["max_depth"]:
+        out.append(("dispatch_depth", depth + 1,
+                    f"device {device:.2f}ms > host {host:.2f}ms; "
+                    "overlap more programs"))
+    if depth > 1 and device < 0.25 * max(host, 1e-9):
+        out.append(("dispatch_depth", depth - 1,
+                    f"device {device:.2f}ms << host {host:.2f}ms; "
+                    "shed queue latency"))
+    if wait > 2.0 * max(device, 1e-9) and chunk < bounds["max_chunk"]:
+        out.append(("scan_chunk", chunk * 2,
+                    f"dispatch wait {wait:.2f}ms > 2x device "
+                    f"{device:.2f}ms; amortize dispatch"))
+    if chunk > 1 and wait < 0.25 * max(device, 1e-9):
+        out.append(("scan_chunk", max(1, chunk // 2),
+                    f"dispatch wait {wait:.2f}ms << device "
+                    f"{device:.2f}ms; shed chunk latency"))
+    return out
+
+
+class StageTimeAutotuner:
+    """Periodic controller over one engine's ingest knobs.
+
+    ``note_dispatch()`` is the engine's per-dispatch hook (called under
+    the engine lock — applying a knob re-enters the same RLock). Knob
+    application goes through ``engine.set_ingest_tuning``, the single
+    choke point that knows how to rebuild what each knob invalidates.
+    ``adapt_scan_chunk`` stays opt-in: a chunk change recompiles the
+    arena scan program, which costs seconds on real chips — only a
+    deployment that can afford mid-run recompiles should allow it."""
+
+    MIN_SAMPLES = 8
+
+    def __init__(self, engine, interval: int = 64, window: int = 128,
+                 max_workers: int | None = None, max_depth: int = 4,
+                 max_chunk: int = 8, adapt_scan_chunk: bool = False):
+        self.engine = engine
+        self.interval = max(1, interval)
+        self.window = window
+        sharder = getattr(engine, "_sharder", None)
+        self.max_workers = (max_workers if max_workers is not None
+                            else (sharder.n_workers if sharder else 1))
+        self.max_depth = max_depth
+        self.max_chunk = max_chunk
+        self.adapt_scan_chunk = adapt_scan_chunk
+        self.decisions: list[dict] = []
+        self._since = 0
+        self.evaluations = 0
+        self.label = f"e{next(_ENGINE_IDS)}"
+
+    def current(self) -> dict:
+        eng = self.engine
+        sharder = getattr(eng, "_sharder", None)
+        return {
+            "ingest_workers": (sharder.active_workers if sharder else 1),
+            "dispatch_depth": max(1, eng.config.dispatch_depth),
+            "scan_chunk": max(1, eng.config.scan_chunk),
+        }
+
+    def note_dispatch(self) -> None:
+        self._since += 1
+        if self._since < self.interval:
+            return
+        self._since = 0
+        self.evaluate()
+
+    def window_stats(self) -> dict | None:
+        """Median per-stage durations over recent ingest records; None
+        until the window holds enough samples to trust."""
+        durs = [stage_durations(r.get("stagesUs", {}))
+                for r in self.engine.flight.recent(self.window)
+                if r.get("kind") == "ingest"]
+        if len(durs) < self.MIN_SAMPLES:
+            return None
+        out = {}
+        for key in ("decode_ms", "wal_ms", "dispatch_wait_ms", "device_ms"):
+            vals = [d[key] for d in durs if d[key] is not None]
+            out[key] = statistics.median(vals) if vals else None
+        return out
+
+    def evaluate(self) -> dict | None:
+        """One control step: measure, decide, apply at most one change,
+        export gauges. Returns the applied decision (or None)."""
+        self.evaluations += 1
+        stats = self.window_stats()
+        applied = None
+        if stats is not None:
+            cur = self.current()
+            bounds = {"max_workers": self.max_workers,
+                      "max_depth": self.max_depth,
+                      "max_chunk": self.max_chunk}
+            for knob, value, reason in decide(stats, cur, bounds):
+                if knob == "scan_chunk" and not self.adapt_scan_chunk:
+                    continue
+                self.engine.set_ingest_tuning(**{knob: value})
+                applied = {"knob": knob, "from": cur[knob], "to": value,
+                           "reason": reason, "stats": stats}
+                self.decisions.append(applied)
+                del self.decisions[:-64]
+                C_ADJUST.inc(engine=self.label, knob=knob,
+                             direction="up" if value > cur[knob]
+                             else "down")
+                break
+        cur = self.current()
+        G_WORKERS.set(cur["ingest_workers"], engine=self.label)
+        G_DEPTH.set(cur["dispatch_depth"], engine=self.label)
+        G_CHUNK.set(cur["scan_chunk"], engine=self.label)
+        return applied

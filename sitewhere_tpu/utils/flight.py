@@ -33,9 +33,42 @@ from sitewhere_tpu.utils.tracing import (current_traceparent, new_trace_id,
                                          trace_id_of)
 
 # canonical stage ordering for rendering (records carry only the stages
-# their path actually visited)
-STAGE_ORDER = ("decode", "arena_fill", "wal_append", "commit", "dispatch",
-               "device_ready", "readback")
+# their path actually visited). ``wal_durable`` is the group-commit
+# durability watermark: the moment the dispatch gate observed the
+# batch's WAL records fsync'd.
+STAGE_ORDER = ("decode", "arena_fill", "wal_append", "commit",
+               "wal_durable", "dispatch", "device_ready", "readback")
+
+
+def stage_durations(stages_us: dict) -> dict:
+    """Per-stage DURATIONS (ms) from one record's cumulative ``stagesUs``
+    offsets — the shared harvesting rule behind bench.py's per-stage
+    breakdown and the stage-time autotuner, so both always agree on what
+    "decode time" means:
+
+      decode_ms        start -> decode mark (the native scan)
+      wal_ms           decode/arena_fill -> wal_append (framing + buffer
+                       or inline flush)
+      dispatch_wait_ms commit -> dispatch (arena fill residency, the
+                       durability gate, and any dispatch-depth wait)
+      device_ms        dispatch -> device_ready (transfer + step)
+
+    Stages a record never visited yield None."""
+    def delta(a, b):
+        if a is None or b is None:
+            return None
+        return max(0.0, (b - a) / 1000.0)
+
+    decode = stages_us.get("decode")
+    wal_from = stages_us.get("arena_fill", decode)
+    return {
+        "decode_ms": delta(0.0, decode),
+        "wal_ms": delta(wal_from, stages_us.get("wal_append")),
+        "dispatch_wait_ms": delta(stages_us.get("commit"),
+                                  stages_us.get("dispatch")),
+        "device_ms": delta(stages_us.get("dispatch"),
+                           stages_us.get("device_ready")),
+    }
 
 
 class FlightRecord:

@@ -153,6 +153,14 @@ class Histogram:
 
         return _Timer()
 
+    def count(self, **labels) -> int:
+        """Total observations for one series — lets tests and controllers
+        assert on event COUNTS (e.g. "fewer WAL fsyncs than batches")
+        without parsing the exposition text."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._totals.get(key, 0)
+
     def quantile(self, q: float, **labels) -> float | None:
         key = tuple(sorted(labels.items()))
         with self._lock:

@@ -148,6 +148,57 @@ def test_device_side_tenant_counters_accepted_and_dedup():
     assert counters["acme"]["invalid"] == 0
 
 
+def alt_payload(token, alt, value=1.0, i=0):
+    return json.dumps({
+        "deviceToken": token, "type": "DeviceMeasurements",
+        "request": {"measurements": {"t": value}, "alternateId": alt,
+                    "eventDate": 1700000000000 + i}}).encode()
+
+
+def test_batch_path_extracts_alternate_id_for_dedup():
+    """ISSUE 4 satellite: the native batch/arena decoders extract
+    ``alternateId`` into the aux1 lane, so the device-side dedup counter
+    works on the batch path — with the SAME counts as the per-request
+    process() path over the same traffic (parity)."""
+    def drive_batch(eng):
+        eng.register_device("dc-b", tenant="acme")
+        eng.ingest_json_batch(
+            [alt_payload("dc-b", "alt-1", i=1),
+             alt_payload("dc-b", "alt-1", i=2),     # in-batch redelivery
+             alt_payload("dc-b", "alt-2", i=3)],
+            tenant="acme")
+        eng.flush()
+        return eng.tenant_pipeline_counters()
+
+    def drive_requests(eng):
+        eng.register_device("dc-r", tenant="acme")
+        for alt in ("alt-1", "alt-1", "alt-2"):
+            eng.process(DecodedRequest(
+                type=RequestType.DEVICE_MEASUREMENT, device_token="dc-r",
+                tenant="acme", measurements={"t": 1.0}, alternate_id=alt))
+        eng.flush()
+        return eng.tenant_pipeline_counters()
+
+    batch = drive_batch(Engine(_cfg()))
+    req = drive_requests(Engine(_cfg()))
+    assert batch["acme"]["dedup_dropped"] == 1
+    assert batch["acme"] == req["acme"], (batch, req)
+
+
+def test_alternate_id_query_spans_batch_rows():
+    """Rows staged by the batch decoder resolve through the alternate-id
+    query surface — engine.event_ids and the decoder's aux1 interner are
+    the SAME table."""
+    eng = Engine(_cfg())
+    eng.ingest_json_batch([alt_payload(f"aq-{i}", f"alt-q{i}", i=i)
+                           for i in range(4)])
+    eng.flush()
+    res = eng.query_events(alternate_id="alt-q2")
+    assert res["total"] == 1
+    assert res["events"][0]["deviceToken"] == "aq-2"
+    assert eng.query_events(alternate_id="alt-missing")["total"] == 0
+
+
 def test_device_side_counters_invalid_lane():
     eng = Engine(_cfg(auto_register=False))
     eng.ingest_json_batch([meas_payload("ghost-1")])

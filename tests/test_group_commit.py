@@ -1,0 +1,245 @@
+"""Group-commit WAL (ISSUE 4 tentpole, pillar 2).
+
+Durability semantics under the commit thread: appends buffer in user
+space and a dedicated thread writes + fsyncs once per quiescent window,
+so a crash loses exactly the un-fsynced tail. The engine gates every
+device dispatch on its batch's durability watermark, which is the whole
+guarantee: a DISPATCHED batch's payloads can never be absent from a
+replayed log, no matter where the crash lands between buffered append
+and fsync. And the fsyncs must actually amortize — fewer fsyncs than
+append groups at steady state — or the design bought nothing.
+"""
+
+import pathlib
+import threading
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.loadgen import generate_measurements_message
+from sitewhere_tpu.utils.ingestlog import _FSYNC_HIST, IngestLog
+
+SMALL = dict(device_capacity=1 << 10, token_capacity=1 << 11,
+             assignment_capacity=1 << 11, store_capacity=1 << 12,
+             batch_capacity=256)
+
+
+def _payload_batch(b, n=32):
+    return [generate_measurements_message(f"gc-{i % 20}", b * 1000 + i)
+            for i in range(n)]
+
+
+# ------------------------------------------------------------ amortization
+def test_group_commit_fewer_fsyncs_than_batches(tmp_path):
+    """Steady state: several ingest batches land between dispatches, so
+    one commit fsync covers several append groups — asserted both on the
+    log's own counters and on the swtpu_wal_fsync_seconds histogram
+    (the operator-visible amortization proof)."""
+    eng = Engine(EngineConfig(**SMALL, wal_dir=str(tmp_path / "wal")))
+    assert eng.wal.group_commit
+    hist_before = _FSYNC_HIST.count()
+    n_batches = 16
+    for b in range(n_batches):
+        eng.ingest_json_batch(_payload_batch(b))
+    eng.flush()
+    assert eng.wal.commit_groups == n_batches
+    assert eng.wal.fsyncs < n_batches, \
+        (eng.wal.fsyncs, "no amortization happened")
+    assert _FSYNC_HIST.count() - hist_before == eng.wal.fsyncs
+    # durability covered everything that was appended
+    assert eng.wal.durable_seq == n_batches
+    records = list(IngestLog(tmp_path / "wal", readonly=True).replay())
+    assert len(records) == n_batches * 32
+    eng.wal.close()
+
+
+# ------------------------------------------------------------ crash safety
+def test_crash_between_append_and_fsync_never_loses_dispatched(tmp_path):
+    """At every dispatch, snapshot what a MACHINE crash would leave
+    behind (files truncated to the fsync'd watermark — the user-space
+    buffer and un-fsynced tail are gone) and replay it: every payload of
+    every batch dispatched so far must be present."""
+    wal_dir = tmp_path / "wal"
+    eng = Engine(EngineConfig(**SMALL, wal_dir=str(wal_dir)))
+    if eng._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    real_step = eng._step
+    dispatched_rows = []
+    snapshots = []
+
+    def checking_step(state, batch):
+        n_valid = int(np.sum(np.asarray(batch.valid)))
+        dispatched_rows.append(n_valid)
+        snapshots.append((sum(dispatched_rows), eng.wal.durable_view()))
+        return real_step(state, batch)
+
+    eng._step = checking_step
+    for b in range(10):
+        eng.ingest_json_batch(_payload_batch(b, n=96))
+    eng.flush()
+    assert sum(dispatched_rows) == 960
+    assert len(snapshots) >= 3
+    for rows_so_far, view in snapshots:
+        crash_dir = tmp_path / f"crash-{rows_so_far}"
+        crash_dir.mkdir()
+        for name, nbytes in view.items():
+            data = (wal_dir / name).read_bytes()[:nbytes]
+            pathlib.Path(crash_dir / name).write_bytes(data)
+        survived = list(IngestLog(crash_dir, readonly=True).replay())
+        assert len(survived) >= rows_so_far, \
+            f"crash after {rows_so_far} dispatched rows lost records " \
+            f"({len(survived)} survived)"
+    eng.wal.close()
+
+
+def test_fsync_failure_blocks_dispatch_fail_stop(tmp_path):
+    """Fail injection between buffered append and fsync: the dispatch
+    gate must refuse (loudly) rather than dispatch an un-durable batch,
+    and the log stays poisoned (fail-stop — a later commit must never
+    retroactively claim durability for lost frames)."""
+    eng = Engine(EngineConfig(**SMALL, wal_dir=str(tmp_path / "wal")))
+
+    def boom():
+        raise OSError("injected fsync failure")
+
+    eng.wal._commit_hook = boom
+    dispatches_before = eng._arena_dispatches
+    with pytest.raises(Exception) as ei:
+        for b in range(8):
+            eng.ingest_json_batch(_payload_batch(b, n=96))
+        eng.flush()
+    assert "WAL" in str(ei.value) or "fsync" in str(ei.value)
+    assert eng._arena_dispatches == dispatches_before, \
+        "a batch was dispatched without durability"
+    # poisoned: further appends refuse too
+    with pytest.raises(RuntimeError):
+        eng.wal.append_many([b"x"], b"\x01t\x00")
+    eng.wal.close()
+
+
+# ----------------------------------------------------- watermark semantics
+def test_watermark_rides_group_commit_in_order(tmp_path):
+    """A watermark buffered between two groups must land between them on
+    disk: replay with a snapshot cursor at the watermark skips exactly
+    the records before it."""
+    log = IngestLog(tmp_path / "wal", group_commit=True)
+    log.append_many([b"a1", b"a2"])
+    log.append_watermark(50)
+    log.append_many([b"b1", b"b2"])
+    log.sync()
+    log.close()
+    replayed = list(IngestLog(tmp_path / "wal", readonly=True).replay())
+    assert replayed == [b"a1", b"a2", b"b1", b"b2"]
+    # snapshot covers cursor 50: records before the watermark are skipped
+    after = list(IngestLog(tmp_path / "wal",
+                           readonly=True).replay(after_cursor=60))
+    assert after == [b"b1", b"b2"]
+    # snapshot older than the watermark: everything replays
+    before = list(IngestLog(tmp_path / "wal",
+                            readonly=True).replay(after_cursor=10))
+    assert before == [b"a1", b"a2", b"b1", b"b2"]
+
+
+def test_watermark_wrap_across_segment_rotation(tmp_path):
+    """Segment rotation under group commit: the watermark and its
+    surrounding records stay ordered across the segment boundary, the
+    sealed segment is fsync'd before the new one opens, and replay
+    honors the watermark exactly as in the single-segment case."""
+    log = IngestLog(tmp_path / "wal", segment_bytes=256, group_commit=True)
+    first = [f"pre-{i}".encode() * 8 for i in range(8)]
+    for p in first:
+        log.append(p)
+        log.flush()             # force commits so rotation interleaves
+    log.append_watermark(100)
+    tail = [f"post-{i}".encode() * 8 for i in range(8)]
+    for p in tail:
+        log.append(p)
+    log.sync()
+    segs = sorted((tmp_path / "wal").glob("segment-*.log"))
+    assert len(segs) >= 2, "rotation never happened"
+    view = log.durable_view()
+    for s in segs:
+        assert view[s.name] == s.stat().st_size   # everything durable
+    log.close()
+    assert list(IngestLog(tmp_path / "wal", readonly=True).replay()) == \
+        first + tail
+    assert list(IngestLog(tmp_path / "wal",
+                          readonly=True).replay(after_cursor=150)) == tail
+
+
+# ------------------------------------------------------------- concurrency
+def test_concurrent_appenders_one_commit_each_group(tmp_path):
+    """Several threads appending concurrently: every group becomes
+    durable, replay sees every record exactly once, and the commit count
+    stays below the group count (they share fsyncs)."""
+    log = IngestLog(tmp_path / "wal", group_commit=True,
+                    group_window_s=0.005)
+    n_threads, n_groups = 4, 12
+
+    def appender(t):
+        for g in range(n_groups):
+            log.append_many([f"t{t}-g{g}-r{r}".encode() for r in range(5)])
+
+    threads = [threading.Thread(target=appender, args=(t,))
+               for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    log.sync()
+    assert log.fsyncs < n_threads * n_groups
+    log.close()
+    replayed = list(IngestLog(tmp_path / "wal", readonly=True).replay())
+    assert sorted(replayed) == sorted(
+        f"t{t}-g{g}-r{r}".encode()
+        for t in range(n_threads) for g in range(n_groups)
+        for r in range(5))
+
+
+def test_wait_durable_seq_zero_is_immediate(tmp_path):
+    log = IngestLog(tmp_path / "wal", group_commit=True)
+    log.wait_durable(0)          # nothing appended: no block, no error
+    seq = log.append_many([b"only"])
+    log.wait_durable(seq)
+    assert log.durable_seq >= seq
+    log.close()
+
+
+def test_empty_append_group_does_not_hang(tmp_path):
+    """append_many([]) adds no records, so its ticket must be the PRIOR
+    group's — a fresh sequence here would never wake the commit thread
+    and the gate would time out."""
+    log = IngestLog(tmp_path / "wal", group_commit=True)
+    seq0 = log.append_many([b"a"])
+    log.wait_durable(seq0)
+    seq = log.append_many([])
+    assert seq == seq0
+    log.wait_durable(seq, timeout=5)    # must return immediately
+    log.flush()                         # ditto
+    log.close()
+
+
+def test_durable_view_reports_nothing_before_first_commit(tmp_path):
+    """Before any commit, nothing is fsync'd — not even the segment
+    magic header, which sits in the user-space write buffer. A crash
+    'now' leaves a 0-byte file and durable_view must say so."""
+    log = IngestLog(tmp_path / "wal", group_commit=True,
+                    group_window_s=5.0)
+    assert all(v == 0 for v in log.durable_view().values())
+    log.close()
+
+
+def test_group_commit_off_preserves_inline_contract(tmp_path):
+    """wal_group_commit=False keeps the PR-2 behavior: appends write +
+    flush inline, the gate is a no-op, and no commit thread exists."""
+    eng = Engine(EngineConfig(**SMALL, wal_dir=str(tmp_path / "wal"),
+                              wal_group_commit=False))
+    assert not eng.wal.group_commit
+    for b in range(4):
+        eng.ingest_json_batch(_payload_batch(b))
+    eng.flush()
+    records = list(IngestLog(tmp_path / "wal", readonly=True).replay())
+    assert len(records) == 4 * 32
+    assert eng.wal.fsyncs == 0    # fsync stays the operator's sync() call
+    eng.wal.close()

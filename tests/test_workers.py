@@ -228,6 +228,27 @@ def test_pool_falls_back_on_lane_conflict():
     assert m["worker_fallback_batches"] == stats["fallback_batches"]
 
 
+def test_pool_translates_alternate_ids():
+    """Worker-local event-id interner ids translate to engine ids like
+    tokens/alert types do: alternate-id queries resolve rows staged
+    through the shared-memory pool."""
+    from sitewhere_tpu.ingest.workers import DecodeWorkerPool
+
+    eng = mini_engine()
+    base = int(eng.epoch.base_unix_s * 1000)
+    payloads = [json.dumps({
+        "deviceToken": f"wp-{i}", "type": "DeviceMeasurements",
+        "request": {"measurements": {"t": 1.0}, "alternateId": f"alt-w{i}",
+                    "eventDate": base + i}}).encode() for i in range(8)]
+    with DecodeWorkerPool(eng, n_workers=2, max_msgs=64) as pool:
+        pool.submit(payloads)
+        pool.flush()
+    eng.flush()
+    res = eng.query_events(alternate_id="alt-w3")
+    assert res["total"] == 1
+    assert res["events"][0]["deviceToken"] == "wp-3"
+
+
 def test_pool_rejects_strict_channel_engines():
     from sitewhere_tpu.ingest.workers import DecodeWorkerPool
 
