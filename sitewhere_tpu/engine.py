@@ -583,6 +583,11 @@ class EngineConfig:
                                        # few dict writes per BATCH — bench
                                        # gates it at <= 3% of host e2e
     flight_capacity: int = 1024        # lifecycle records retained
+    query_coalesce: int = 16           # max concurrent event queries fused
+                                       # into ONE device program by the
+                                       # shared-scan query batcher (1
+                                       # effectively disables coalescing;
+                                       # queries still run off the lock)
 
 
 @dataclasses.dataclass
@@ -825,6 +830,175 @@ def _admin_set_assignment_status(state: PipelineState, assignment_id, status, ac
     return dataclasses.replace(state, registry=reg)
 
 
+def _fetch_query_result(tree):
+    """Materialize a launched query program's outputs on the host. A
+    module-level seam (not inlined at the call site) so tests can pin
+    that the wait + readback happen WITHOUT the engine lock held."""
+    return jax.device_get(tree)
+
+
+class QueryBatcher:
+    """Shared-scan micro-batcher for ``Engine.query_events``.
+
+    Concurrent queries coalesce continuous-batching style (Orca): the
+    first submitter becomes the leader and drains the queue in rounds;
+    queries arriving while a round executes form the next round. Each
+    round groups entries by their power-of-two ``limit`` bucket and runs
+    ONE fused multi-predicate program per group (ops/query.
+    query_store_batch) — Q queries share a single pass over the ring.
+
+    Lock discipline: the leader takes the ENGINE lock only to snapshot
+    ``state.store`` and enqueue the (async) device programs — the state is
+    donated through every ingest step, so the program must capture the
+    buffers before a later dispatch can recycle them. The device wait,
+    result readback, and all host-side formatting happen outside the
+    lock, so reads no longer block ingest dispatch or each other.
+    Snapshot semantics: a query sees every row its caller's mirror sync
+    dispatched, plus whatever concurrent ingest dispatched before the
+    snapshot — one consistent store version, which may trail in-flight
+    dispatches by at most ``dispatch_depth`` batches."""
+
+    def __init__(self, engine, max_batch: int = 16):
+        from sitewhere_tpu.utils.metrics import query_metrics
+
+        self.engine = engine
+        self.max_batch = max(1, int(max_batch))
+        self._mu = threading.Lock()
+        self._queue: list[dict] = []
+        self._running = False
+        self.programs = 0        # device programs launched
+        self.coalesced = 0       # queries served through them
+        self.max_coalesced = 0   # largest micro-batch observed
+        self._metrics = query_metrics()
+        # AOT-compiled executables per (Q bucket, limit bucket): compiling
+        # from ShapeDtypeStructs needs no live buffers, so first-shape
+        # compilation happens OUTSIDE the engine lock — a cold query must
+        # not stall ingest dispatch for a compile. Store shapes are fixed
+        # for the engine's lifetime (PipelineState.create).
+        self._programs: dict[tuple[int, int], Any] = {}
+        self._store_struct = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            engine.state.store)
+
+    def _compiled_for(self, qpad: int, limit: int):
+        from sitewhere_tpu.ops.query import QueryParams, query_store_batch
+
+        key = (qpad, limit)
+        fn = self._programs.get(key)
+        if fn is None:
+            pstruct = QueryParams(*(
+                jax.ShapeDtypeStruct((qpad,), jnp.int32)
+                for _ in QueryParams._fields))
+            fn = query_store_batch.lower(self._store_struct, pstruct,
+                                         limit=limit).compile()
+            self._programs[key] = fn
+        return fn
+
+    def observe_latency(self, seconds: float) -> None:
+        self._metrics["latency"].observe(seconds)
+        self._metrics["queries"].inc()
+
+    def run(self, params: tuple, limit: int):
+        """Submit one predicate set (``ops.query.QueryParams`` field order,
+        plain ints) at a bucketed ``limit``. Returns ``(row, cursors, q)``:
+        the query's numpy ``QueryResult`` row, the snapshot's archive
+        cursor capture (``(epoch, cursor, arena_capacity)`` or None), and
+        the micro-batch size it rode in."""
+        entry = {"params": params, "limit": int(limit),
+                 "event": threading.Event(), "result": None,
+                 "cursors": None, "q": 0, "error": None}
+        if self.engine.lock._is_owned():
+            # a caller already INSIDE the engine lock (RLock re-entrancy
+            # was always legal on this path) must not park as a follower:
+            # the leader would block acquiring the lock this thread holds.
+            # Run its own single-query round re-entrantly instead.
+            self._execute([entry])
+            return entry["result"], entry["cursors"], entry["q"]
+        with self._mu:
+            self._queue.append(entry)
+            lead = not self._running
+            if lead:
+                self._running = True
+        if lead:
+            self._drain()
+        else:
+            entry["event"].wait()
+        if entry["error"] is not None:
+            raise entry["error"]
+        return entry["result"], entry["cursors"], entry["q"]
+
+    def _drain(self) -> None:
+        """Leader loop: execute rounds until the queue is empty. The empty
+        check and the ``_running`` handoff are atomic, so a submitter that
+        saw ``_running`` either lands in a round this leader takes or
+        becomes the next leader itself — no entry can strand."""
+        while True:
+            with self._mu:
+                batch = self._queue[: self.max_batch]
+                del self._queue[: len(batch)]
+                if not batch:
+                    self._running = False
+                    return
+            try:
+                self._execute(batch)
+            except Exception as e:   # fail every entry of the round loudly
+                for entry in batch:
+                    if not entry["event"].is_set():
+                        entry["error"] = e
+                        entry["event"].set()
+
+    def _execute(self, batch: list[dict]) -> None:
+        from sitewhere_tpu.ops.query import QueryParams, bucket_limit
+
+        eng = self.engine
+        groups: dict[int, list[dict]] = {}
+        for entry in batch:
+            groups.setdefault(entry["limit"], []).append(entry)
+        # per group: pad Q to a power of two (repeating the last
+        # predicate) so program shapes stay bounded — one compile per
+        # (Q bucket, limit bucket), not per concurrency level — and
+        # resolve/compile the executable BEFORE taking the engine lock
+        staged = []
+        for limit, entries in groups.items():
+            qn = len(entries)
+            qpad = bucket_limit(qn)
+            cols = []
+            for j in range(len(QueryParams._fields)):
+                col = [e["params"][j] for e in entries]
+                col.extend(col[-1:] * (qpad - qn))
+                cols.append(jnp.asarray(np.asarray(col, np.int32)))
+            staged.append((entries, self._compiled_for(qpad, limit),
+                           QueryParams(*cols)))
+        launched = []
+        with eng.lock:
+            store = eng.state.store
+            cursors = None
+            if eng.archive is not None:
+                # fresh buffers (eager add): the snapshot's own arrays are
+                # donated away by the next ingest dispatch, so the archive
+                # merge must not touch them after the lock is released
+                cursors = (store.epoch + 0, store.cursor + 0,
+                           store.arena_capacity)
+            for entries, compiled, params in staged:
+                # async enqueue only — the device executes (and is
+                # awaited) after the lock is released
+                res = compiled(store, params)
+                launched.append((entries, res))
+                qn = len(entries)
+                self.programs += 1
+                self.coalesced += qn
+                self.max_coalesced = max(self.max_coalesced, qn)
+                self._metrics["batch"].observe(float(qn))
+                self._metrics["programs"].inc()
+        for entries, res in launched:
+            host = _fetch_query_result(res)
+            for q, entry in enumerate(entries):
+                entry["result"] = type(host)(*(col[q] for col in host))
+                entry["cursors"] = cursors
+                entry["q"] = len(entries)
+                entry["event"].set()
+
+
 class Engine(IngestHostMixin):
     """Single-node engine instance."""
 
@@ -943,6 +1117,11 @@ class Engine(IngestHostMixin):
                                      enabled=c.flight_recorder)
         self._staged_traces: list = []
         self._pending_traces: list[list] = []
+        # shared-scan batched query engine: concurrent query_events calls
+        # coalesce into one fused multi-predicate device program; string
+        # lookups and the store snapshot happen under the lock, the device
+        # wait and row formatting outside it
+        self._query_batcher = QueryBatcher(self, max_batch=c.query_coalesce)
         # durability: accepted payloads append to the WAL BEFORE staging,
         # tagged by wire format so recovery replays each through the right
         # decoder (utils/checkpoint.recover_engine)
@@ -2139,12 +2318,22 @@ class Engine(IngestHostMixin):
                         sel[d] = True
                 mask &= sel
             if area is not None or device_type is not None:
-                for d in np.nonzero(mask)[0]:
-                    info = self.devices.get(int(d))
-                    if info is None or (area is not None and info.area != area) or (
-                        device_type is not None and info.device_type != device_type
-                    ):
-                        mask[d] = False
+                # the hot area/type columns live on device (admin writes
+                # mirror them): one id-array fetch + vectorized compare
+                # replaces the per-device dict-lookup loop
+                reg = self.state.registry
+                if area is not None:
+                    aid = self.areas.lookup(area)
+                    if aid == NULL_ID:   # unknown area matches nothing
+                        mask[:] = False
+                    else:
+                        mask &= np.asarray(reg.device_area[:n]) == aid
+                if device_type is not None:
+                    ty = self.device_types.lookup(device_type)
+                    if ty == NULL_ID:
+                        mask[:] = False
+                    else:
+                        mask &= np.asarray(reg.device_type[:n]) == ty
             out = []
             for d in np.nonzero(mask)[0][:limit]:
                 info = self.devices.get(int(d))
@@ -2179,75 +2368,98 @@ class Engine(IngestHostMixin):
         so the limit applies after filtering; ``area``/``customer`` cover
         the reference's per-area/per-customer event rollups
         (Areas.java /{token}/measurements..., Customers.java ditto) and
-        ``alternate_id`` the /events/alternate/{id} lookup."""
-        from sitewhere_tpu.ops.query import query_store
+        ``alternate_id`` the /events/alternate/{id} lookup.
 
+        Read path (shared-scan batched): only the mirror sync and the
+        string->id resolution run under the engine lock. The device
+        program — coalesced with any concurrent queries into one fused
+        multi-predicate pass — and all row formatting run OUTSIDE it, so
+        queries block neither ingest dispatch nor each other. ``limit``
+        buckets to the next power of two for the compile cache; the
+        result slices back to the exact page."""
+        from sitewhere_tpu.ops.query import bucket_limit
+
+        t_q0 = time.perf_counter()
+        limit = max(1, int(limit))
+        rec = self.flight.begin("query", tenant=tenant or "all")
+        miss = False   # any unknown string filter matches NOTHING — an
+                       # unknown tenant must never widen to all tenants
         with self.lock:
             self._sync_mirrors()
             dev = NULL_ID
             if device_token is not None:
                 tid = self.tokens.lookup(device_token)
                 dev = self.token_device.get(tid, NULL_ID)
-                if dev == NULL_ID:
-                    return {"total": 0, "events": []}
+                miss |= dev == NULL_ID
             ten = NULL_ID
-            if tenant is not None:
+            if not miss and tenant is not None:
                 ten = self.tenants.lookup(tenant)
-                if ten == NULL_ID:   # unknown tenant matches NOTHING —
-                    return {"total": 0, "events": []}   # never all tenants
+                miss |= ten == NULL_ID
             area_id = customer_id = aux1 = None
-            if area is not None:
+            if not miss and area is not None:
                 area_id = self.areas.lookup(area)
-                if area_id == NULL_ID:
-                    return {"total": 0, "events": []}
-            if customer is not None:
+                miss |= area_id == NULL_ID
+            if not miss and customer is not None:
                 customer_id = self.customers.lookup(customer)
-                if customer_id == NULL_ID:
-                    return {"total": 0, "events": []}
-            if alternate_id is not None:
+                miss |= customer_id == NULL_ID
+            if not miss and alternate_id is not None:
                 aux1 = self.event_ids.lookup(alternate_id)
-                if aux1 == NULL_ID:
-                    return {"total": 0, "events": []}
-            imin, imax = -(2**31), 2**31 - 1
-            res = query_store(
-                self.state.store,
-                jnp.int32(dev),
-                jnp.int32(int(etype) if etype is not None else NULL_ID),
-                jnp.int32(ten),
-                jnp.int32(since_ms if since_ms is not None else imin),
-                jnp.int32(until_ms if until_ms is not None else imax),
-                limit=limit,
-                assignment=(jnp.int32(assignment_id)
-                            if assignment_id is not None else None),
-                aux0=jnp.int32(aux0) if aux0 is not None else None,
-                aux1=jnp.int32(aux1) if aux1 is not None else None,
-                area=jnp.int32(area_id) if area_id is not None else None,
-                customer=(jnp.int32(customer_id)
-                          if customer_id is not None else None),
-            )
-            n = int(res.n)
-            lane_names = self._lane_names()
-            events = []
-            vmask = np.asarray(res.vmask[:n])
-            values = np.asarray(res.values[:n])
-            aux = np.asarray(res.aux[:n])
-            for i in range(n):
-                events.append(self._format_event(
-                    int(res.etype[i]), int(res.device[i]),
-                    int(res.assignment[i]), int(res.ts_ms[i]),
-                    int(res.received_ms[i]), values[i], vmask[i], aux[i],
-                    lane_names))
-            total = int(res.total)
-            if self.archive is not None and self.archive.segments:
+                miss |= aux1 == NULL_ID
+            lane_names = None if miss else self._lane_names()
+        rec.mark("lookup")
+        if miss:
+            # still a served query: count it and close its record so
+            # high miss-rate polling shows up in the read metrics
+            self._query_batcher.observe_latency(time.perf_counter() - t_q0)
+            return {"total": 0, "events": []}
+        imin, imax = -(2**31), 2**31 - 1
+        params = (  # ops.query.QueryParams field order
+            dev,
+            int(etype) if etype is not None else NULL_ID,
+            ten,
+            int(since_ms) if since_ms is not None else imin,
+            int(until_ms) if until_ms is not None else imax,
+            int(assignment_id) if assignment_id is not None else NULL_ID,
+            int(aux0) if aux0 is not None else NULL_ID,
+            int(aux1) if aux1 is not None else NULL_ID,
+            int(area_id) if area_id is not None else NULL_ID,
+            int(customer_id) if customer_id is not None else NULL_ID,
+        )
+        row, cursors, coalesced = self._query_batcher.run(
+            params, bucket_limit(limit))
+        rec.mark("device")
+        rec.add("coalesced", coalesced)
+        # every result column is already ONE host numpy array (the
+        # batcher's single readback) — per-row formatting never touches
+        # the device again
+        total = int(row.total)
+        n = min(total, limit)
+        events = [
+            self._format_event(
+                int(row.etype[i]), int(row.device[i]),
+                int(row.assignment[i]), int(row.ts_ms[i]),
+                int(row.received_ms[i]), row.values[i], row.vmask[i],
+                row.aux[i], lane_names)
+            for i in range(n)
+        ]
+        rec.mark("format")
+        if self.archive is not None and self.archive.segments:
+            # two-tier merge: archive files are mutated by _spool/compact
+            # under the engine lock, so the disk scan re-takes it; the
+            # eviction cap comes from the SNAPSHOT's cursors, keeping the
+            # tiers non-overlapping even if the ring advanced meanwhile
+            with self.lock:
                 total, events = self._merge_archive(
-                    total, events, limit,
+                    total, events, limit, cursors=cursors,
                     device=dev if device_token is not None else None,
                     etype=int(etype) if etype is not None else None,
                     tenant=ten if tenant is not None else None,
                     since_ms=since_ms, until_ms=until_ms,
                     assignment=assignment_id, aux0=aux0, aux1=aux1,
                     area=area_id, customer=customer_id)
-            return {"total": total, "events": events}
+            rec.mark("archive")
+        self._query_batcher.observe_latency(time.perf_counter() - t_q0)
+        return {"total": total, "events": events}
 
     def _lane_names(self) -> dict[int, str]:
         lane_names: dict[int, str] = {}
@@ -2302,18 +2514,28 @@ class Engine(IngestHostMixin):
         return ev
 
     def _merge_archive(self, total: int, events: list[dict], limit: int,
-                       **filters) -> tuple[int, list[dict]]:
+                       cursors=None, **filters) -> tuple[int, list[dict]]:
         """Fold archived history into a ring query result. The archive scan
         is capped at rows already EVICTED from each arena (absolute pos <
         head - capacity) so the two tiers never overlap; the reference's
         unbounded date-range search (InfluxDbDeviceEventManagement.java:
-        63-161) falls out of ring + archive union. Caller holds the lock."""
+        63-161) falls out of ring + archive union. Caller holds the lock.
+        ``cursors`` — the ``(epoch, cursor, arena_capacity)`` capture the
+        query batcher took with its store snapshot — pins the eviction cap
+        to the SAME store version the ring scan saw; without it the cap
+        reads the live store (the pre-snapshot behavior)."""
         from sitewhere_tpu.ops.readback import arena_cursor
 
-        store = self.state.store
-        acap = store.arena_capacity
-        max_pos = {a: arena_cursor(store, a) - acap
-                   for a in range(store.arenas)}
+        if cursors is not None:
+            ep, cu, acap = cursors
+            ep, cu = np.asarray(ep), np.asarray(cu)
+            max_pos = {a: int(ep[a]) * acap + int(cu[a]) - acap
+                       for a in range(len(cu))}
+        else:
+            store = self.state.store
+            acap = store.arena_capacity
+            max_pos = {a: arena_cursor(store, a) - acap
+                       for a in range(store.arenas)}
         if all(v <= 0 for v in max_pos.values()):
             return total, events
         a_total, rows = self.archive.query(max_pos=max_pos, limit=limit,

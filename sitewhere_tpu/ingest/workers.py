@@ -246,6 +246,11 @@ class ShardedArenaDecoder:
         """Drop-in for ``NativeBatchDecoder.decode_into`` — same outputs,
         same contract, decoded by up to ``active_workers`` shards."""
         n = len(payloads)
+        if lo + n > arena.rows:
+            # same guard as the single-threaded contract: short column
+            # slices would hand the native scanner pointers it writes past
+            raise ValueError(f"{n} payloads exceed arena room "
+                             f"{arena.rows - lo}")
         k = min(self.active_workers, n // self.min_shard_payloads)
         if k <= 1 or type(payloads) is not list:
             self.last_workers = 1

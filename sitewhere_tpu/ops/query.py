@@ -4,6 +4,14 @@ The reference's event queries (listDeviceEvents / searchDeviceEvents REST
 paths backed by InfluxDB/Cassandra per-tenant queries) become a masked scan
 over the HBM ring with an on-device sort — the whole store is filtered in
 one XLA program and only the top-``limit`` rows travel to the host.
+
+:func:`query_store_batch` is the shared-scan variant (Crescando/SharedDB
+scan sharing): Q predicate sets evaluate in ONE pass over the store. The
+ordering sort is query-independent — newest-first with index tie-break —
+so the batch runs it once and each query reduces to an O(N) masked scan
+plus an O(N) stable-partition top-k (ops/segment.stable_partition_topk)
+instead of Q independent O(N log N) sorts. Results are byte-identical to
+Q sequential :func:`query_store` calls, tie-breaking included.
 """
 
 from __future__ import annotations
@@ -16,7 +24,7 @@ import jax.numpy as jnp
 
 from sitewhere_tpu.core.store import EventStore
 from sitewhere_tpu.core.types import NULL_ID
-from sitewhere_tpu.ops.segment import lex_argsort
+from sitewhere_tpu.ops.segment import lex_argsort, stable_partition_topk
 
 
 class QueryResult(NamedTuple):
@@ -33,6 +41,93 @@ class QueryResult(NamedTuple):
     values: jax.Array   # float32[limit, C]
     vmask: jax.Array
     aux: jax.Array
+
+
+class QueryParams(NamedTuple):
+    """One predicate set per lane (int32[Q] each; ``NULL_ID`` = any).
+    ``t0``/``t1`` are the inclusive event-time bounds — callers pass the
+    full int32 range for an unbounded side."""
+
+    device: jax.Array
+    etype: jax.Array
+    tenant: jax.Array
+    t0: jax.Array
+    t1: jax.Array
+    assignment: jax.Array
+    aux0: jax.Array
+    aux1: jax.Array
+    area: jax.Array
+    customer: jax.Array
+
+
+N_QUERY_PARAMS = len(QueryParams._fields)
+
+
+def bucket_limit(limit: int) -> int:
+    """Power-of-two bucket for the static ``limit`` argument — bounds the
+    compile cache at one program per bucket instead of one per distinct
+    ``pageSize`` (callers slice the result back to the exact page)."""
+    return 1 << max(0, int(limit) - 1).bit_length()
+
+
+MAX_PAGE_SIZE = 1000
+
+
+def clamp_page_size(value, default: int = 100) -> int:
+    """THE pageSize clamp ([1, MAX_PAGE_SIZE]) shared by every external
+    surface (REST gateway, RPC server) — it caps :func:`bucket_limit` at
+    1024, so a wire-supplied page size can never mint an unbounded set of
+    compiled query programs. Lives next to the bucketing it protects so
+    the surfaces can't drift apart."""
+    if value is None:
+        value = default
+    return max(1, min(int(value), MAX_PAGE_SIZE))
+
+
+@functools.partial(jax.jit, static_argnames=("limit",))
+def query_store_batch(store: EventStore, params: QueryParams,
+                      limit: int = 100) -> QueryResult:
+    """Evaluate Q predicate sets in one pass over the ring (leading Q dim
+    on every result field). One shared newest-first ordering sort; per
+    query only the O(N) mask + stable-partition top-k. Byte-identical to
+    Q sequential :func:`query_store` calls at the same ``limit``."""
+    limit = min(limit, store.capacity)   # match query_store's perm[:limit]
+    neg_ts = -jnp.maximum(store.ts_ms, jnp.iinfo(jnp.int32).min + 1)
+    # ONE ordering sort shared by every query: stable ascending on -ts
+    # keeps index-ascending ties, so a stable partition by each query's
+    # match mask reproduces lex_argsort([~match, -ts]) exactly
+    _, perm = lex_argsort([neg_ts])
+
+    def one(p: QueryParams) -> QueryResult:
+        m = store.valid
+        m &= (p.device == NULL_ID) | (store.device == p.device)
+        m &= (p.etype == NULL_ID) | (store.etype == p.etype)
+        m &= (p.tenant == NULL_ID) | (store.tenant == p.tenant)
+        m &= (p.assignment == NULL_ID) | (store.assignment == p.assignment)
+        m &= (p.aux0 == NULL_ID) | (store.aux[:, 0] == p.aux0)
+        m &= (p.aux1 == NULL_ID) | (store.aux[:, 1] == p.aux1)
+        m &= (p.area == NULL_ID) | (store.area == p.area)
+        m &= (p.customer == NULL_ID) | (store.customer == p.customer)
+        m &= (store.ts_ms >= p.t0) & (store.ts_ms <= p.t1)
+        total = jnp.sum(m.astype(jnp.int32))
+        top = stable_partition_topk(perm, m[perm], total, limit)
+        return QueryResult(
+            n=jnp.minimum(total, limit),
+            total=total,
+            etype=store.etype[top],
+            device=store.device[top],
+            assignment=store.assignment[top],
+            tenant=store.tenant[top],
+            area=store.area[top],
+            customer=store.customer[top],
+            ts_ms=store.ts_ms[top],
+            received_ms=store.received_ms[top],
+            values=store.values[top],
+            vmask=store.vmask[top],
+            aux=store.aux[top],
+        )
+
+    return jax.vmap(one)(params)
 
 
 @functools.partial(jax.jit, static_argnames=("limit",))

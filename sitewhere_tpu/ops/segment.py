@@ -72,6 +72,26 @@ def scatter_argmax_mask(
     return winner
 
 
+def stable_partition_topk(perm: jax.Array, match_sorted: jax.Array,
+                          total: jax.Array, limit: int) -> jax.Array:
+    """First ``limit`` entries of the stable partition of ``perm`` by
+    ``match_sorted``: matching entries keep their ``perm`` order and come
+    first, non-matching entries (in ``perm`` order) fill the remainder.
+
+    This is the O(N) per-query half of the shared-scan batched query: when
+    ``perm`` is one ordering sort shared by every query in a batch, the
+    result equals ``lex_argsort([~match, order_key])[:limit]`` — the
+    stable lexicographic sort the single-query path runs — without paying
+    a per-query O(N log N) sort. ``total`` must equal ``sum(match_sorted)``
+    (the caller already needs it for result counting). Two cumulative sums
+    and one no-conflict scatter; destinations past ``limit`` drop."""
+    m = match_sorted
+    match_rank = jnp.cumsum(m.astype(jnp.int32)) - 1
+    non_rank = jnp.cumsum((~m).astype(jnp.int32)) - 1
+    dest = jnp.where(m, match_rank, total + non_rank)
+    return jnp.zeros((limit,), perm.dtype).at[dest].set(perm, mode="drop")
+
+
 def compact_valid_front(valid: jax.Array) -> tuple[jax.Array, jax.Array]:
     """Stable permutation moving ``valid`` rows to the front.
 

@@ -410,10 +410,15 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
     def list_device_events(token: str = None, type: str = None,
                            sinceMs: int = None, untilMs: int = None,
                            pageSize: int = 100, tenant: str = None):
+        from sitewhere_tpu.ops.query import clamp_page_size
+
         et = EventType[type.upper()] if type else None
+        # same clamp as the REST gateway: a peer-sent pageSize feeds the
+        # limit-bucketed query compile cache
         return inst.engine.query_events(
             device_token=token, etype=et, tenant=tenant,
-            since_ms=sinceMs, until_ms=untilMs, limit=pageSize)
+            since_ms=sinceMs, until_ms=untilMs,
+            limit=clamp_page_size(pageSize))
 
     def add_device_event(envelope: dict, tenant: str = "default"):
         from sitewhere_tpu.ingest.decoders import request_from_envelope

@@ -143,8 +143,8 @@ class StageTimeAutotuner:
         """Median per-stage durations over recent ingest records; None
         until the window holds enough samples to trust."""
         durs = [stage_durations(r.get("stagesUs", {}))
-                for r in self.engine.flight.recent(self.window)
-                if r.get("kind") == "ingest"]
+                for r in self.engine.flight.recent(self.window,
+                                                   kind="ingest")]
         if len(durs) < self.MIN_SAMPLES:
             return None
         out = {}

@@ -39,6 +39,37 @@ from sitewhere_tpu.utils.tracing import (current_traceparent, new_trace_id,
 STAGE_ORDER = ("decode", "arena_fill", "wal_append", "commit",
                "wal_durable", "dispatch", "device_ready", "readback")
 
+# read-path lifecycle (kind="query" records): id resolution under the
+# engine lock, the coalesced device program (including any wait to join a
+# micro-batch), then host-side row formatting — all outside the lock
+QUERY_STAGE_ORDER = ("lookup", "device", "format", "archive")
+
+
+def query_stage_durations(stages_us: dict) -> dict:
+    """Per-stage DURATIONS (ms) for one query record — the read-path
+    sibling of :func:`stage_durations`, shared by bench.py's query
+    breakdown so "device time" always means the same interval:
+
+      lookup_ms   start -> lookup (mirror sync + string->id resolution,
+                  the only part that holds the engine lock)
+      device_ms   lookup -> device (coalesce wait + fused program +
+                  result readback)
+      format_ms   device -> format (host row formatting)
+
+    Stages a record never visited yield None."""
+    def delta(a, b):
+        if a is None or b is None:
+            return None
+        return max(0.0, (b - a) / 1000.0)
+
+    return {
+        "lookup_ms": delta(0.0, stages_us.get("lookup")),
+        "device_ms": delta(stages_us.get("lookup"),
+                           stages_us.get("device")),
+        "format_ms": delta(stages_us.get("device"),
+                           stages_us.get("format")),
+    }
+
 
 def stage_durations(stages_us: dict) -> dict:
     """Per-stage DURATIONS (ms) from one record's cumulative ``stagesUs``
@@ -217,15 +248,21 @@ class FlightRecorder:
             recs = list(self._by_id.get(trace_id, ()))
         return [r.to_dict() for r in recs]
 
-    def recent(self, limit: int = 50) -> list[dict]:
-        """Newest-first records (bounded by ``limit``)."""
+    def recent(self, limit: int = 50, kind: str | None = None) -> list[dict]:
+        """Newest-first records (bounded by ``limit``). ``kind`` filters
+        ("ingest", "query", ...) while scanning the WHOLE ring for
+        matches — a burst of query records must not dilute an ingest-
+        stage consumer's window (the autotuner steers by these) down to
+        nothing before the limit is reached."""
         out = []
         with self._lock:
             i = (self._head - 1) % self.capacity
-            for _ in range(min(limit, self.capacity)):
+            for _ in range(self.capacity):
                 rec = self._ring[i]
-                if rec is not None:
+                if rec is not None and (kind is None or rec.kind == kind):
                     out.append(rec)
+                    if len(out) >= limit:
+                        break
                 i = (i - 1) % self.capacity
         return [r.to_dict() for r in out]
 

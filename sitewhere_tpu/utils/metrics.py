@@ -234,6 +234,42 @@ class MetricsRegistry:
 REGISTRY = MetricsRegistry()
 
 
+# batch-size buckets for the shared-scan query coalescer (counts, not
+# seconds — the default latency buckets would squash every batch into the
+# first bucket)
+QUERY_BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+
+def query_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """The ``swtpu_query_*`` instruments for the batched read path — one
+    definition so the engine's QueryBatcher, bench.py, and tests always
+    agree on names and bucket layouts:
+
+      swtpu_query_latency_seconds   end-to-end query_events latency
+                                    (lookup + coalesce wait + device +
+                                    formatting + archive merge)
+      swtpu_query_batch_size        predicates fused per device program
+      swtpu_queries_total           query_events calls served
+      swtpu_query_programs_total    device programs launched (the
+                                    amortization ratio vs queries_total)
+    """
+    reg = registry or REGISTRY
+    return {
+        "latency": reg.histogram(
+            "swtpu_query_latency_seconds",
+            "end-to-end engine query latency in seconds"),
+        "batch": reg.histogram(
+            "swtpu_query_batch_size",
+            "event queries coalesced into one device program",
+            buckets=QUERY_BATCH_BUCKETS),
+        "queries": reg.counter(
+            "swtpu_queries_total", "event queries served"),
+        "programs": reg.counter(
+            "swtpu_query_programs_total",
+            "batched query device programs launched"),
+    }
+
+
 def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
                           tenant: str = "all") -> None:
     """Push the engine's device-side counters into the registry (scrape-time

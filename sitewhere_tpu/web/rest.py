@@ -16,6 +16,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import dataclasses
+import functools
 import json
 from typing import Any
 
@@ -59,6 +60,18 @@ def _sync(fn):
         return fn(request)
 
     return handler
+
+
+def _page_size(src, default: int = 100) -> int:
+    """Mapping adapter over the shared clamp (ops/query.clamp_page_size,
+    [1, 1000]) used by every paged surface here — it feeds the engine's
+    power-of-two-bucketed query compile cache, so an unclamped raw
+    pageSize can never mint an unbounded set of compiled programs.
+    ``src`` is any Mapping with a ``pageSize`` key (query string or JSON
+    body)."""
+    from sitewhere_tpu.ops.query import clamp_page_size
+
+    return clamp_page_size(src.get("pageSize"), default)
 
 
 
@@ -448,7 +461,7 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     async def list_devices(request: web.Request):
         q = request.query
         res = inst.device_management.list_devices(
-            page=int(q.get("page", 1)), page_size=int(q.get("pageSize", 100)),
+            page=int(q.get("page", 1)), page_size=_page_size(q),
             device_type=q.get("deviceType"), tenant=q.get("tenant"),
         )
         return json_response({
@@ -477,7 +490,7 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         [dataclasses.asdict(
             inst.device_management.get_device_summary(i.token))
          for i in _it.islice(inst.engine.devices.values(),
-                             int(req.query.get("pageSize", 100)))])))
+                             _page_size(req.query))])))
     r.add_get("/api/devices/{token}", get_device)
     r.add_delete("/api/devices/{token}", delete_device)
 
@@ -491,27 +504,33 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         inst.engine.flush()
         return json_response({"accepted": True}, status=201)
 
+    # event queries run OFF the gateway loop (asyncio.to_thread): the
+    # engine's shared-scan batcher coalesces whatever queries overlap in
+    # flight into one device program, which only helps if concurrent REST
+    # reads actually reach it concurrently
     async def get_device_events(request: web.Request):
         q = request.query
         et = EventType[q["type"].upper()] if "type" in q else None
-        res = inst.engine.query_events(
+        res = await asyncio.to_thread(
+            inst.engine.query_events,
             device_token=request.match_info.get("token"),
             etype=et,
             since_ms=int(q["sinceMs"]) if "sinceMs" in q else None,
             until_ms=int(q["untilMs"]) if "untilMs" in q else None,
-            limit=int(q.get("pageSize", 100)),
+            limit=_page_size(q),
         )
         return json_response(res)
 
     async def query_all_events(request: web.Request):
         q = request.query
         et = EventType[q["type"].upper()] if "type" in q else None
-        res = inst.engine.query_events(
+        res = await asyncio.to_thread(
+            inst.engine.query_events,
             device_token=q.get("deviceToken"), etype=et,
             tenant=request.get("tenant"),
             since_ms=int(q["sinceMs"]) if "sinceMs" in q else None,
             until_ms=int(q["untilMs"]) if "untilMs" in q else None,
-            limit=int(q.get("pageSize", 100)),
+            limit=_page_size(q),
         )
         return json_response(res)
 
@@ -690,9 +709,10 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
             raise EntityNotFound("assignment")
         q = request.query
         et = EventType[q["type"].upper()] if "type" in q else None
-        res = inst.engine.query_events(
+        res = await asyncio.to_thread(
+            inst.engine.query_events,
             device_token=a.device_token, etype=et, assignment_id=a.id,
-            limit=int(q.get("pageSize", 100)),
+            limit=_page_size(q),
         )
         return json_response(res)
 
@@ -893,7 +913,7 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         if "status" in q:
             els = [e for e in els if e.status.name == q["status"].upper()]
         page = max(1, int(q.get("page", 1)))
-        size = int(q.get("pageSize", 100))
+        size = _page_size(q)
         lo = (page - 1) * size
         return json_response({
             "numResults": len(els), "page": page, "pageSize": size,
@@ -948,7 +968,7 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     r.add_get("/api/batch", _sync(lambda req: json_response(_paged(
         inst.batch.operations.list(
             page=int(req.query.get("page", 1)),
-            page_size=int(req.query.get("pageSize", 100)))))))
+            page_size=_page_size(req.query))))))
     r.add_get("/api/batch/{token}", _sync(lambda req: json_response((lambda op: {
         "token": op.meta.token, "status": op.status,
         "operationType": op.operation_type, "counts": op.counts(),
@@ -1007,7 +1027,7 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         # index itself is lock-protected for cross-thread search)
         docs = await asyncio.to_thread(
             provider.search, request.query.get("q", "*:*"),
-            int(request.query.get("pageSize", 100)))
+            _page_size(request.query))
         return json_response({"numResults": len(docs), "results": docs})
 
     r.add_get("/api/search/events", search_events)
@@ -1311,13 +1331,14 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     # --- device-state search (reference: DeviceStates.java POST search) ---
     async def device_state_search(request: web.Request):
         body = await request.json() if request.can_read_body else {}
-        states = inst.engine.search_device_states(
+        states = await asyncio.to_thread(
+            inst.engine.search_device_states,
             last_interaction_before_ms=body.get("lastInteractionDateBeforeMs"),
             presence=body.get("presence"),
             device_tokens=body.get("deviceTokens"),
             area=body.get("areaToken"),
             device_type=body.get("deviceTypeToken"),
-            limit=int(body.get("pageSize", 100)),
+            limit=_page_size(body),
         )
         return json_response({"numResults": len(states), "results": states})
 
@@ -1532,7 +1553,8 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(ev)
 
     async def get_event_by_alternate(request: web.Request):
-        res = inst.engine.query_events(
+        res = await asyncio.to_thread(
+            inst.engine.query_events,
             alternate_id=request.match_info["alternateId"], limit=1,
             tenant=_event_lookup_tenant(request))
         if not res["events"]:
@@ -1558,9 +1580,11 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
             et = _ROLLUPS.get(request.match_info["etype"])
             if et is None:
                 raise EntityNotFound("unknown event rollup")
-            res = inst.engine.query_events(
-                **{kind: request.match_info["token"]}, etype=et,
-                limit=int(request.query.get("pageSize", 100)))
+            res = await asyncio.to_thread(
+                functools.partial(
+                    inst.engine.query_events,
+                    **{kind: request.match_info["token"]}, etype=et,
+                    limit=_page_size(request.query)))
             return json_response({"numResults": res["total"],
                                   "results": res["events"]})
 

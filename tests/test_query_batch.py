@@ -1,0 +1,289 @@
+"""Shared-scan batched query engine (ISSUE 5).
+
+Pins the three contracts of the read path:
+  * the fused multi-predicate kernel is BYTE-identical to sequential
+    ``query_store`` calls (ordering and newest-first tie-breaks included),
+  * ``query_events`` holds the engine lock only for mirror sync + id
+    resolution — never during device execution or row formatting,
+  * ``limit`` buckets to a power of two for the compile cache but the
+    caller still gets exactly its requested page.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import sitewhere_tpu.engine as engine_mod
+from sitewhere_tpu.core.types import NULL_ID, EventType
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.ops.query import (QueryParams, bucket_limit, query_store,
+                                     query_store_batch)
+
+IMIN, IMAX = -(2**31), 2**31 - 1
+
+
+def _engine(**kw):
+    cfg = dict(device_capacity=256, token_capacity=512,
+               assignment_capacity=512, store_capacity=1 << 12,
+               batch_capacity=64, channels=4)
+    cfg.update(kw)
+    return Engine(EngineConfig(**cfg))
+
+
+def _fill(eng, n=200, n_dev=10, ties=4):
+    """Ingest n measurements across n_dev devices with ``ties``-way event-
+    time ties (every run of ``ties`` consecutive events shares one ts)."""
+    base = int(eng.epoch.base_unix_s * 1000)
+    pays = [json.dumps({
+        "deviceToken": f"qb-{i % n_dev}", "type": "DeviceMeasurements",
+        "request": {"measurements": {"t": float(i)},
+                    "eventDate": base + (i // ties)}}).encode()
+        for i in range(n)]
+    eng.ingest_json_batch(pays)
+    eng.flush()
+
+
+def test_batched_matches_sequential_bytes():
+    """Every field of the batched result equals the sequential
+    ``query_store`` result bit for bit — including rows past ``n`` (the
+    sort-order padding) and ts-tie ordering."""
+    import jax
+    import jax.numpy as jnp
+
+    eng = _engine()
+    _fill(eng)
+    store = eng.state.store
+    dev3 = eng.token_device[eng.tokens.lookup("qb-3")]
+    base = int(eng.epoch.base_unix_s * 1000)
+    t_mid = (0 + 200 // 4) // 2  # falls on a tie boundary
+    N = NULL_ID
+    preds = [
+        # (device, etype, tenant, t0, t1, assignment, aux0, aux1, area, cust)
+        (N, N, N, IMIN, IMAX, N, N, N, N, N),                  # everything
+        (dev3, N, N, IMIN, IMAX, N, N, N, N, N),               # one device
+        (N, int(EventType.MEASUREMENT), 0, IMIN, IMAX, N, N, N, N, N),
+        (N, N, N, t_mid, t_mid + 10, N, N, N, N, N),           # tie window
+        (dev3, N, N, t_mid, IMAX, N, N, N, N, N),              # combined
+        (9999, N, N, IMIN, IMAX, N, N, N, N, N),               # no matches
+    ]
+    for limit in (1, 7, 64):
+        seq = [jax.device_get(query_store(
+            store, jnp.int32(d), jnp.int32(e), jnp.int32(t),
+            jnp.int32(t0), jnp.int32(t1), limit=limit,
+            assignment=jnp.int32(a), aux0=jnp.int32(x0),
+            aux1=jnp.int32(x1), area=jnp.int32(ar), customer=jnp.int32(c)))
+            for (d, e, t, t0, t1, a, x0, x1, ar, c) in preds]
+        cols = list(zip(*preds))
+        params = QueryParams(*(jnp.asarray(np.asarray(c, np.int32))
+                               for c in cols))
+        bat = jax.device_get(query_store_batch(store, params, limit=limit))
+        for i, s in enumerate(seq):
+            for f in s._fields:
+                a = np.asarray(getattr(s, f))
+                b = np.asarray(getattr(bat, f)[i])
+                assert a.shape == b.shape and np.array_equal(a, b), \
+                    (limit, i, f)
+
+
+def test_limit_bucket_slices_exact_page():
+    """pageSize stays exact through the power-of-two compile bucket, and
+    two limits in one bucket share one compiled program."""
+    eng = _engine()
+    _fill(eng, n=50)
+    assert bucket_limit(5) == bucket_limit(7) == 8
+    assert bucket_limit(8) == 8 and bucket_limit(9) == 16
+    r = eng.query_events(limit=7)
+    assert r["total"] == 50 and len(r["events"]) == 7
+    assert set(eng._query_batcher._programs) == {(1, 8)}
+    r = eng.query_events(limit=5)          # same bucket: no new program
+    assert len(r["events"]) == 5
+    assert set(eng._query_batcher._programs) == {(1, 8)}
+    r = eng.query_events(limit=9)          # next bucket: one new program
+    assert len(r["events"]) == 9
+    assert set(eng._query_batcher._programs) == {(1, 8), (1, 16)}
+    r = eng.query_events(limit=12)         # same bucket as 9: no growth
+    assert len(r["events"]) == 12
+    assert set(eng._query_batcher._programs) == {(1, 8), (1, 16)}
+    r = eng.query_events(limit=200)        # more than matches: all rows
+    assert len(r["events"]) == 50
+
+
+def test_query_runs_off_the_engine_lock(monkeypatch):
+    """The device wait/readback and every _format_event call happen with
+    the engine lock RELEASED (ingest can dispatch meanwhile)."""
+    eng = _engine()
+    _fill(eng, n=40)
+    seen = {"fetch": 0, "format": 0}
+    orig_fetch = engine_mod._fetch_query_result
+
+    def fetch(tree):
+        assert not eng.lock._is_owned(), \
+            "engine lock held during query device wait/readback"
+        seen["fetch"] += 1
+        return orig_fetch(tree)
+
+    orig_fmt = Engine._format_event
+
+    def fmt(self, *a, **k):
+        assert not self.lock._is_owned(), \
+            "engine lock held during query row formatting"
+        seen["format"] += 1
+        return orig_fmt(self, *a, **k)
+
+    monkeypatch.setattr(engine_mod, "_fetch_query_result", fetch)
+    monkeypatch.setattr(Engine, "_format_event", fmt)
+    res = eng.query_events(limit=10)
+    assert res["total"] == 40 and len(res["events"]) == 10
+    assert seen["fetch"] >= 1 and seen["format"] == 10
+    # the query left a flight record with the read-path stages
+    recs = [r for r in eng.flight.recent(10) if r.get("kind") == "query"]
+    assert recs and {"lookup", "device", "format"} <= set(
+        recs[0]["stagesUs"])
+
+
+def test_concurrent_queries_coalesce(monkeypatch):
+    """Queries issued while a round executes ride the NEXT fused program
+    (continuous batching) — and every caller still gets its own result."""
+    eng = _engine()
+    _fill(eng, n=200, n_dev=8)
+    orig_fetch = engine_mod._fetch_query_result
+    gate = threading.Event()
+
+    def slow_fetch(tree):
+        gate.wait(5.0)   # hold round 1 open so followers can queue up
+        return orig_fetch(tree)
+
+    monkeypatch.setattr(engine_mod, "_fetch_query_result", slow_fetch)
+    results: dict[int, dict] = {}
+    errors: list[Exception] = []
+
+    def query(i):
+        try:
+            results[i] = eng.query_events(device_token=f"qb-{i}", limit=50)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=query, args=(i,)) for i in range(8)]
+    threads[0].start()
+    while eng._query_batcher.programs == 0 and threads[0].is_alive():
+        threading.Event().wait(0.005)   # leader reaches its slow fetch
+    for t in threads[1:]:
+        t.start()
+    # all followers enqueued before the leader's fetch completes
+    deadline = 300
+    while len(eng._query_batcher._queue) < 7 and deadline:
+        threading.Event().wait(0.01)
+        deadline -= 1
+    gate.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert all(results[i]["total"] == 25 for i in range(8))
+    assert all(e["deviceToken"] == f"qb-{i}"
+               for i in range(8) for e in results[i]["events"])
+    assert eng._query_batcher.max_coalesced >= 2
+    assert eng._query_batcher.programs < 8   # fewer programs than queries
+
+
+def test_miss_queries_still_counted():
+    """Unknown-string-filter queries (the early-return path) still count
+    in swtpu_queries_total and the latency histogram — a high miss-rate
+    poller must not read as zero traffic."""
+    from sitewhere_tpu.utils.metrics import query_metrics
+
+    eng = _engine()
+    _fill(eng, n=10)
+    qm = query_metrics()
+    before = qm["queries"].value()
+    assert eng.query_events(device_token="ghost") == {"total": 0,
+                                                      "events": []}
+    assert eng.query_events(tenant="ghost")["total"] == 0
+    assert eng.query_events(alternate_id="ghost")["total"] == 0
+    assert qm["queries"].value() == before + 3
+
+
+def test_query_reentrant_under_engine_lock():
+    """A caller already inside the engine lock (legal with the RLock
+    before the batcher existed) must not deadlock — it runs its own
+    single-query round re-entrantly."""
+    eng = _engine()
+    _fill(eng, n=30)
+    with eng.lock:
+        res = eng.query_events(limit=10)
+    assert res["total"] == 30 and len(res["events"]) == 10
+
+
+def test_search_device_states_vectorized_filters():
+    """area/device_type filtering reads the on-device id columns — results
+    match the host metadata exactly, unknown tokens match nothing."""
+    eng = _engine()
+    eng.register_device("sv-1", device_type="sensor", area="north")
+    eng.register_device("sv-2", device_type="gateway", area="north")
+    eng.register_device("sv-3", device_type="sensor", area="south")
+    eng.register_device("sv-4")   # no area; default type
+    got = {d["device"] for d in eng.search_device_states(area="north")}
+    assert got == {"sv-1", "sv-2"}
+    got = {d["device"] for d in eng.search_device_states(
+        device_type="sensor")}
+    assert got == {"sv-1", "sv-3"}
+    got = {d["device"] for d in eng.search_device_states(
+        area="north", device_type="sensor")}
+    assert got == {"sv-1"}
+    assert eng.search_device_states(area="atlantis") == []
+    assert eng.search_device_states(device_type="nope") == []
+
+
+@pytest.mark.slow
+def test_concurrent_query_ingest_stress():
+    """Writers and readers hammer the engine together: queries (which no
+    longer serialize against ingest dispatch) stay consistent, totals
+    balance exactly at the end."""
+    eng = _engine(store_capacity=1 << 14)
+    base = int(eng.epoch.base_unix_s * 1000)
+    N_WRITERS, PER_WRITER, BATCH = 4, 40, 32
+    errors: list[Exception] = []
+    done = threading.Event()
+
+    def writer(w):
+        try:
+            for b in range(PER_WRITER):
+                eng.ingest_json_batch([json.dumps({
+                    "deviceToken": f"st-{w}-{i % 8}",
+                    "type": "DeviceMeasurements",
+                    "request": {"measurements": {"t": float(i)},
+                                "eventDate": base + b * BATCH + i}}).encode()
+                    for i in range(BATCH)])
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reader(r):
+        try:
+            while not done.is_set():
+                res = eng.query_events(limit=20)
+                assert len(res["events"]) <= 20
+                res = eng.query_events(device_token=f"st-{r % 4}-0",
+                                       limit=10)
+                assert all(e["deviceToken"] == f"st-{r % 4}-0"
+                           for e in res["events"]
+                           if e["deviceToken"] is not None)
+                eng.query_events(since_ms=0, until_ms=10_000, limit=20)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(N_WRITERS)]
+    threads += [threading.Thread(target=reader, args=(r,)) for r in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads[:N_WRITERS]:
+        t.join()
+    eng.flush()
+    done.set()
+    for t in threads[N_WRITERS:]:
+        t.join()
+    assert not errors, errors
+    total = N_WRITERS * PER_WRITER * BATCH
+    assert eng.metrics()["persisted"] == total
+    assert eng.query_events(limit=1)["total"] == total
