@@ -564,6 +564,132 @@ def main() -> None:
                 "dependent on shared infra, not gated")
 
     # ------------------------------------------------------------------
+    # Event-plane replication smoke (ISSUE 6): 2 in-process ranks, RF=2.
+    # HARD gates: after killing the owner, failover reads return within
+    # the detection budget, snapshot-consistent, with an explicit
+    # stale_ms watermark, and EVERY acked event is served (zero loss).
+    # Replication overhead on ingest e2e is REPORTED (this container's
+    # run-to-run noise is ±30%; a hard gate would flap), not gated.
+    # ------------------------------------------------------------------
+    replication_failover_ok = replication_no_loss = None
+    replication_failover_ms = replication_overhead_pct = None
+    if smoke:
+        import asyncio as _aio
+        import socket as _socket
+        import tempfile as _rtmp
+        import threading as _rthr
+
+        from sitewhere_tpu.parallel.cluster import (ClusterConfig,
+                                                    ClusterEngine,
+                                                    build_cluster_rpc,
+                                                    owner_rank)
+        from sitewhere_tpu.parallel.distributed import DistributedConfig
+        from sitewhere_tpu.parallel.replication import (
+            ReplicaApplier, ReplicaFeed, register_replication_rpc)
+
+        _socks = [_socket.socket() for _ in range(2)]
+        for _s in _socks:
+            _s.bind(("127.0.0.1", 0))
+        _rports = [_s.getsockname()[1] for _s in _socks]
+        for _s in _socks:
+            _s.close()
+        _rloop = _aio.new_event_loop()
+        _rthread = _rthr.Thread(target=_rloop.run_forever, daemon=True)
+        _rthread.start()
+        _rdir = _rtmp.mkdtemp(prefix="bench-replication-")
+        _rpeers = [f"127.0.0.1:{p}" for p in _rports]
+        _rbase = float(int(time.time()))
+        rclusters, rfeeds, rappliers, rservers = [], [], [], []
+        for r in range(2):
+            cc = ClusterConfig(
+                rank=r, n_ranks=2, peers=_rpeers, secret="bench-rep",
+                epoch_base_unix_s=_rbase, connect_timeout_s=1.0,
+                engine=DistributedConfig(
+                    n_shards=2, device_capacity_per_shard=1 << 10,
+                    token_capacity_per_shard=1 << 11,
+                    assignment_capacity_per_shard=1 << 11,
+                    store_capacity_per_shard=1 << 14, channels=4,
+                    batch_capacity_per_shard=256,
+                    wal_dir=f"{_rdir}/wal-r{r}"))
+            c = ClusterEngine(cc)
+            feed = ReplicaFeed(c, f"{_rdir}/replica-r{r}", rf=2,
+                               heartbeat_s=0.2)
+            applier = ReplicaApplier(c, rf=2, detect_s=2.0)
+            c.attach_replication(feed, applier)
+            srv = build_cluster_rpc(c.local, "bench-rep")
+            register_replication_rpc(srv, applier)
+            _aio.run_coroutine_threadsafe(
+                srv.start(port=_rports[r]), _rloop).result(10)
+            rclusters.append(c)
+            rfeeds.append(feed)
+            rappliers.append(applier)
+            rservers.append(srv)
+        rc0, rc1 = rclusters
+        for f in rfeeds:
+            f.start()
+        rtoks, _i = [], 0
+        while len(rtoks) < 32:
+            t = f"rep-{_i}"
+            if owner_rank(t, 2) == 0:
+                rtoks.append(t)
+            _i += 1
+        R_BATCH, R_SZ = 16, 128
+
+        def _rbatches(tag):
+            return [[generate_measurements_message(
+                rtoks[(lo + j) % len(rtoks)], tag * 100_000 + lo + j)
+                for j in range(R_SZ)] for lo in range(R_BATCH)]
+
+        for b in _rbatches(0):     # warm: compile + interners
+            rc0.ingest_json_batch(b)
+        rc0.flush()
+        t1 = time.perf_counter()
+        for b in _rbatches(1):
+            rc0.ingest_json_batch(b)
+        rc0.flush()
+        rate_on = R_BATCH * R_SZ / (time.perf_counter() - t1)
+        _deadline = time.monotonic() + 30
+        while not rfeeds[0].drained() and time.monotonic() < _deadline:
+            time.sleep(0.05)
+        acked_total = rc0.local.query_events(
+            device_token=rtoks[0])["total"]
+
+        # ---- kill the owner mid-run: failover gate -------------------
+        _aio.run_coroutine_threadsafe(rservers[0].stop(),
+                                      _rloop).result(10)
+        rfeeds[0].stop()
+        t0 = time.monotonic()
+        fq = rc1.query_events(device_token=rtoks[0], limit=200)
+        replication_failover_ms = round(
+            (time.monotonic() - t0) * 1000, 1)
+        replication_no_loss = fq["total"] == acked_total
+        replication_failover_ok = ("stale_ms" in fq
+                                   and replication_failover_ms < 10_000)
+        log(f"smoke replication: failover read {replication_failover_ms}"
+            f"ms, stale_ms={fq.get('stale_ms')}, events "
+            f"{fq['total']}/{acked_total} (no_loss={replication_no_loss})")
+
+        # ---- overhead on ingest e2e: REPORTED, not gated -------------
+        rc0.local.replica_feed = None   # detach: same engine, no feed
+        t1 = time.perf_counter()
+        for b in _rbatches(2):
+            rc0.ingest_json_batch(b)
+        rc0.flush()
+        rate_off = R_BATCH * R_SZ / (time.perf_counter() - t1)
+        replication_overhead_pct = round((rate_off / rate_on - 1) * 100, 1)
+        log(f"smoke replication ingest e2e: feed-on "
+            f"{rate_on:,.0f} ev/s vs feed-off {rate_off:,.0f} ev/s "
+            f"({replication_overhead_pct:+.1f}% — reported, not gated)")
+        for f in rfeeds:
+            f.stop()
+        for c in rclusters:
+            c.close()
+        _aio.run_coroutine_threadsafe(rservers[1].stop(),
+                                      _rloop).result(10)
+        _rloop.call_soon_threadsafe(_rloop.stop)
+        _rthread.join(timeout=5)
+
+    # ------------------------------------------------------------------
     # Query path (ISSUE 5): shared-scan batched query engine.
     #  * kernel level: ONE fused multi-predicate program vs Q sequential
     #    query_store programs over the SAME store — parity is a smoke
@@ -827,6 +953,15 @@ def main() -> None:
                    if gc_amortized is not None else {}),
                 **({"groupcommit_smoke_regression_pct": gc_regression_pct}
                    if gc_regression_pct is not None else {}),
+                # event-plane replication (ISSUE 6): failover reads must
+                # land in-budget with zero acked loss (hard gates below);
+                # the feed's ingest overhead is reported, not gated
+                **({"replication_smoke_failover_ok":
+                        replication_failover_ok,
+                    "replication_smoke_no_loss": replication_no_loss,
+                    "replication_failover_ms": replication_failover_ms,
+                    "replication_overhead_pct": replication_overhead_pct}
+                   if replication_failover_ok is not None else {}),
                 **({"workers_events_per_s": round(workers_eps)}
                    if workers_eps is not None else {}),
                 **({"workers_note": workers_note}
@@ -857,6 +992,14 @@ def main() -> None:
     if smoke and batched_qps < seq_qps:
         log(f"FAIL: batched query QPS {batched_qps:,.0f} < sequential "
             f"{seq_qps:,.0f} on the smoke workload")
+        sys.exit(1)
+    if smoke and replication_failover_ok is False:
+        log("FAIL: failover read did not land within the detection "
+            "budget with a stale_ms watermark")
+        sys.exit(1)
+    if smoke and replication_no_loss is False:
+        log("FAIL: follower served fewer events than the owner acked "
+            "(acknowledged-event loss)")
         sys.exit(1)
 
 

@@ -157,6 +157,28 @@ class IngestHostMixin:
     ``config.strict_channels``, ``process()``, ``_ingest_decoded()``,
     ``flight`` (utils/flight.FlightRecorder), ``_staged_traces``."""
 
+    # staging-clock pin (event-plane replication): a replica feed ships
+    # each WAL append's staging timestamp so the follower's standby
+    # stages byte-identical rows; the follower's applier sets this
+    # around its apply call, the leader sets it at publish time. The pin
+    # is shared engine state: it is SET and CLEARED only under the
+    # engine lock, within the same critical section that staged the
+    # batch — an unlocked clear could null a concurrent batch's pin
+    # between its publish and its staging.
+    _now_override: int | None = None
+
+    def _staging_now(self) -> int:
+        ov = self._now_override
+        return int(ov) if ov is not None else self.epoch.now_ms()
+
+    def _clear_now_pin(self) -> None:
+        """Drop the staging-clock pin (engine lock held). Nested
+        process() calls (batch fallback, register/ack re-entry) keep the
+        OUTER batch's pin — the whole batch must stage on one clock on
+        both the leader and the follower."""
+        if not getattr(self._wal_local, "depth", 0):
+            self._now_override = None
+
     def _wal_append(self, tag: bytes, payloads: list[bytes],
                     tenant: str) -> None:
         """Log accepted payloads. MUST be called under the engine lock so a
@@ -183,6 +205,16 @@ class IngestHostMixin:
             self.wal.flush()
         rec.mark("wal_append")
         rec.add("wal_flush_ms", round((time.perf_counter() - t0) * 1000, 3))
+        feed = getattr(self, "replica_feed", None)
+        if feed is not None:
+            # same critical section as the append: feed order == WAL
+            # order. Pin the staging clock here and ship it, so leader
+            # staging and follower replay stamp identical received_ms
+            # (the byte-identity oracle). The sender still gates on
+            # wait_durable(ticket) before the bytes leave this host.
+            now_ms = self.epoch.now_ms()
+            self._now_override = now_ms
+            feed.publish(tag, payloads, tenant, self._wal_last_seq, now_ms)
 
     def _wal_gate(self, traces=()) -> None:
         """Block until every WAL record appended so far is DURABLE (group
@@ -237,8 +269,9 @@ class IngestHostMixin:
             "ingest", tenant=tenant, n_payloads=len(payloads),
             traceparent=traceparent or current_traceparent())
         with self.flight.bind(rec):
-            summary = self._ingest_batch_inner(payloads, tenant, tag, dec,
-                                               native_fn, binary, rec)
+            summary = self._ingest_batch_inner(payloads, tenant, tag,
+                                               dec, native_fn, binary,
+                                               rec)
         if rec.trace_id is not None:
             rec.add_counts(summary)
             if rec.meta.get("path") != "arena" and summary.get("staged"):
@@ -265,26 +298,33 @@ class IngestHostMixin:
                             binary, rec) -> dict:
         if native_fn is None:
             with self.lock:
-                predecoded = self._strict_predecode(payloads, dec)
-                self._wal_append(tag, payloads, tenant)
-                summary = self._ingest_python_fallback(payloads, tenant,
-                                                       dec, predecoded)
-                rec.mark("decode")
-                rec.mark("commit")
-                return summary
+                try:
+                    predecoded = self._strict_predecode(payloads, dec)
+                    self._wal_append(tag, payloads, tenant)
+                    summary = self._ingest_python_fallback(payloads, tenant,
+                                                           dec, predecoded)
+                    rec.mark("decode")
+                    rec.mark("commit")
+                    return summary
+                finally:
+                    self._clear_now_pin()
         if self.config.strict_channels:
             # strict serializes the native decode under the lock so a
             # rejected batch can roll back the names it interned without
             # clobbering a concurrent batch's newly-interned names
             with self.lock:
-                names_before = len(self.channel_map.names)
-                res = native_fn(payloads)
-                rec.mark("decode")
-                self._check_strict_native(res, names_before)
-                self._wal_append(tag, payloads, tenant)
-                summary = self._ingest_decoded(res, payloads, tenant, dec)
-                rec.mark("commit")
-                return summary
+                try:
+                    names_before = len(self.channel_map.names)
+                    res = native_fn(payloads)
+                    rec.mark("decode")
+                    self._check_strict_native(res, names_before)
+                    self._wal_append(tag, payloads, tenant)
+                    summary = self._ingest_decoded(res, payloads, tenant,
+                                                   dec)
+                    rec.mark("commit")
+                    return summary
+                finally:
+                    self._clear_now_pin()
         if getattr(self, "_arena_pool", None) is not None \
                 and not self.config.fair_tenancy:
             # zero-copy path: the native scanner fills the staging arena
@@ -298,10 +338,13 @@ class IngestHostMixin:
         res = native_fn(payloads)
         rec.mark("decode")
         with self.lock:
-            self._wal_append(tag, payloads, tenant)
-            summary = self._ingest_decoded(res, payloads, tenant, dec)
-            rec.mark("commit")
-            return summary
+            try:
+                self._wal_append(tag, payloads, tenant)
+                summary = self._ingest_decoded(res, payloads, tenant, dec)
+                rec.mark("commit")
+                return summary
+            finally:
+                self._clear_now_pin()
 
     def _strict_predecode(self, payloads, dec):
         """Strict pre-pass for the Python-fallback path: decode ONCE and
@@ -395,17 +438,20 @@ class IngestHostMixin:
                     area=req.extras.get("areaToken"),
                     customer=req.extras.get("customerToken"),
                 )
+                self._clear_now_pin()
                 return
             if req.type is RequestType.MAP_DEVICE:
                 parent = (req.extras.get("parentToken")
                           or req.extras.get("parentHardwareId"))
                 if parent:
                     self.map_device(req.device_token, parent)
+                self._clear_now_pin()
                 return
             et = req.event_type
             if et is None:
+                self._clear_now_pin()
                 return
-            now = self.epoch.now_ms()
+            now = self._staging_now()
             # wire timestamps are absolute unix ms; device arrays carry int32
             # ms relative to the engine epoch base
             if req.event_ts_ms is not None:
@@ -448,6 +494,10 @@ class IngestHostMixin:
                     if req.alternate_id is not None else NULL_ID)
             self._stage_row(int(et), token_id, tenant_id, ts, now,
                             values, mask, aux0, aux1)
+            # top-level per-request call: the pin set by _wal_append
+            # (replica feed) covered exactly this request; nested calls
+            # keep the outer batch's pin (_clear_now_pin checks depth)
+            self._clear_now_pin()
 
     def _decode_prologue(self, res, payloads, tenant, reg_decoder,
                          now: int, base_ms: int):

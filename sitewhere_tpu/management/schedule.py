@@ -127,8 +127,21 @@ class ScheduleManager:
         # cluster fire policy: with replicated schedules on every rank,
         # exactly ONE rank may run each schedule's jobs (the replicator
         # installs an owner-rank predicate; None = fire everything, the
-        # single-node behavior)
+        # single-node behavior). With event-plane replication the
+        # predicate is failure-aware: a dead owner's schedules fire at
+        # its first live follower (parallel/replication.install_fireover)
         self.fire_filter: Callable[[str], bool] | None = None
+        # catch-up policy: when this predicate admits a schedule token,
+        # a Cron job also fires when a matching minute passed SINCE its
+        # last fire (not just when now is inside one) — the fire-over
+        # path uses it so windows missed during failure detection still
+        # run exactly once on the follower
+        self.catchup_filter: Callable[[str], bool] | None = None
+        # post-fire hook (job just updated fired_count/last_fired_ms):
+        # the entity replicator ships the job's new state so a recovered
+        # owner sees which windows its follower already covered — the
+        # no-double-fire half of scheduler fire-over
+        self.on_fired: Callable[[ScheduledJob], None] | None = None
 
     # CRUD ----------------------------------------------------------------
     def create_schedule(self, token: str, name: str, trigger_type: str,
@@ -181,10 +194,21 @@ class ScheduleManager:
         # Cron: fire when entering a matching minute
         expr = CronExpression.parse(sched.cron)
         dt = datetime.datetime.fromtimestamp(now_ms / 1000)
-        if not expr.matches(dt):
-            return False
         last = job.last_fired_ms
-        return last is None or (now_ms - last) >= 60_000
+        if expr.matches(dt):
+            return last is None or (now_ms - last) >= 60_000
+        if (last is not None and self.catchup_filter is not None
+                and self.catchup_filter(job.schedule_token)):
+            # missed-window catch-up: a matching minute elapsed between
+            # the last fire and now (e.g. while the owner was dead and
+            # detection ran) — fire once, late, rather than never
+            try:
+                nxt = expr.next_fire(
+                    datetime.datetime.fromtimestamp(last / 1000))
+            except ValueError:
+                return False
+            return nxt.timestamp() * 1000 <= now_ms
+        return False
 
     async def fire_due(self, now_ms: float | None = None) -> int:
         """Fire all due jobs once; returns count fired. Exposed separately
@@ -209,6 +233,11 @@ class ScheduleManager:
                 job.last_error = None
             except Exception as e:
                 job.last_error = str(e)
+            if self.on_fired is not None:
+                try:
+                    self.on_fired(job)
+                except Exception:
+                    pass   # replication of fired state is best-effort
             fired += 1
         return fired
 

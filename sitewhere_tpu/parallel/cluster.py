@@ -117,9 +117,14 @@ class _SyncPeer:
     the connection; ``call()`` blocks the calling thread only (the engine
     surface is synchronous, like the reference's blocking gRPC stubs)."""
 
-    def __init__(self, addr: str, token_factory, timeout_s: float = 30.0):
+    def __init__(self, addr: str, token_factory, timeout_s: float = 30.0,
+                 src_rank: int = -1, dst_rank: int = -1):
         host, _, port = addr.rpartition(":")
         self.host, self.port = host or "127.0.0.1", int(port)
+        # (src, dst) identify this link for the chaos fault seam
+        # (utils/faults.py) — a no-op attribute read unless a plan is
+        # installed
+        self.src_rank, self.dst_rank = src_rank, dst_rank
         # a FACTORY, not a token: JwtService.validate enforces exp, so a
         # token minted once at engine construction would turn every
         # reconnect after its 24h expiry into a permanent 401 — mint
@@ -201,6 +206,9 @@ class _SyncPeer:
             "indeterminate — not auto-retried)") from None
 
     def call(self, method: str, **params: Any) -> Any:
+        from sitewhere_tpu.utils import faults
+
+        faults.check(self.src_rank, self.dst_rank, method)
         # capture the CALLING thread's traceparent here: the coroutine
         # runs on the background loop, whose context never sees it —
         # this one line threads trace context through every cluster and
@@ -396,6 +404,15 @@ class ClusterEngine:
         self.search_index = None          # see attach_search_index
         self.command_service = None       # see attach_command_service
         self.forward_queue = None         # see attach_forwarding
+        self.replica_feed = None          # see attach_replication
+        self.replica_applier = None       # see attach_replication
+        self.replication_factor = 1
+        # peer health (up/suspect/down + probe backoff) fed by every
+        # transport outcome — the failover read path and fire-over
+        # detection both key on it
+        from sitewhere_tpu.parallel.replication import PeerHealth
+
+        self.health = PeerHealth()
         self._peers: dict[int, _SyncPeer] = {}
         self._peers_lock = threading.Lock()
         self._fid_seq = 0
@@ -418,8 +435,21 @@ class ClusterEngine:
             if peer is None:
                 peer = self._peers[rank] = _SyncPeer(
                     self.cluster_config.peers[rank], self._token_factory,
-                    self.cluster_config.connect_timeout_s)
+                    self.cluster_config.connect_timeout_s,
+                    src_rank=self.rank, dst_rank=rank)
             return peer
+
+    def _peer_call(self, rank: int, method: str, **params):
+        """Peer call that feeds the health tracker: transport failures
+        (refusal/timeout — result unknown either way) count against the
+        rank, successes reset it."""
+        try:
+            res = self._peer(rank).call(method, **params)
+        except (ConnectionError, TimeoutError):
+            self.health.record_failure(rank)
+            raise
+        self.health.record_success(rank)
+        return res
 
     def owner(self, token: str) -> int:
         return owner_rank(token, self.n_ranks)
@@ -474,6 +504,61 @@ class ClusterEngine:
         self.forward_queue = queue
         self.local.forward_queue = queue     # rank metrics see the queue
         self.local.spill_registry = registry
+
+    def attach_replication(self, feed, applier) -> None:
+        """Event-plane replication (parallel/replication.py): the FEED is
+        this rank's leader role (streams WAL-durable batches to its
+        followers — placed on the local engine so _wal_append publishes),
+        the APPLIER its follower role (standby stores + failover reads).
+        Either may be None on asymmetric topologies."""
+        self.replica_feed = feed
+        self.replica_applier = applier
+        self.local.replica_feed = feed
+        self.local.replica_applier = applier
+        rf = max(getattr(feed, "rf", 1), getattr(applier, "rf", 1))
+        self.replication_factor = max(self.replication_factor, rf)
+
+    # ------------------------------------------------- failover read plumbing
+    def _try_peer(self, rank: int) -> bool:
+        """Spend a real attempt on this rank? Always, until replication
+        gives the read path somewhere else to go; with replicas attached
+        a DOWN rank is skipped between probe windows so failover reads
+        don't pay a connect timeout each."""
+        if self.replica_applier is None and self.replica_feed is None:
+            return True
+        return (not self.health.is_down(rank)
+                or self.health.should_probe(rank))
+
+    def _replica_read(self, owner: int, method: str, local_attr: str,
+                      **params):
+        """Serve a dead owner's partition from its most-caught-up
+        follower: the local standby when this rank follows the owner
+        (no RPC), else the owner's followers in ring order (ring order
+        is also fire-over order, so the first live follower is the one
+        already acting for the owner). Returns None when nobody can
+        serve."""
+        from sitewhere_tpu.parallel.replication import replica_ring
+
+        ring = replica_ring(owner, self.n_ranks, self.replication_factor)
+        for f in ring:
+            if f == self.rank:
+                applier = self.replica_applier
+                if applier is None:
+                    continue
+                res = getattr(applier, local_attr)(owner, **params)
+                if res is not None:
+                    return res
+                continue
+            if self.health.is_down(f) and not self.health.should_probe(f):
+                continue
+            try:
+                res = self._peer_call(f, method, leader=owner, **params)
+            except (ConnectionError, TimeoutError):
+                continue
+            if res is not None and not (isinstance(res, dict)
+                                        and res.get("unknown")):
+                return res
+        return None
 
     def _next_fid(self) -> str:
         """Unique forward id: rank + wall-clock ns + in-process seq —
@@ -705,9 +790,27 @@ class ClusterEngine:
                 for part in parts for a in part]
 
     def get_device_state(self, token: str) -> dict | None:
-        return self._route(
-            token, lambda: self.local.get_device_state(token),
-            "Cluster.getDeviceState", token=token)
+        """Owner-routed read with failover: when the owner rank is
+        unreachable, the most-caught-up follower serves its standby copy
+        with an explicit ``stale_ms`` watermark."""
+        r = self.owner(token)
+        if r == self.rank:
+            return self.local.get_device_state(token)
+        err: Exception | None = None
+        if self._try_peer(r):
+            try:
+                return self._peer_call(r, "Cluster.getDeviceState",
+                                       token=token)
+            except (ConnectionError, TimeoutError) as e:
+                err = e
+        res = self._replica_read(r, "Cluster.replicaDeviceState",
+                                 "device_state", token=token)
+        if res is None:
+            raise err if err is not None else ConnectionError(
+                f"rank {r} down and no replica holds its partition")
+        if res.get("missing"):
+            return None
+        return res
 
     # ----------------------------------------------------- assignments
     # Assignments live at their DEVICE's owner rank (they expand on its
@@ -812,9 +915,28 @@ class ClusterEngine:
         return self._peer(r).call("Cluster.deleteAssignment", token=token)
 
     def search_device_states(self, **kw) -> list[dict]:
-        out = [s for part in self._fanout(
-            self.local.search_device_states(**kw),
-            "Cluster.searchDeviceStates", **kw) for s in part]
+        out = list(self.local.search_device_states(**kw))
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            part, err = None, None
+            if self._try_peer(r):
+                try:
+                    part = self._peer_call(r, "Cluster.searchDeviceStates",
+                                           **kw)
+                except (ConnectionError, TimeoutError) as e:
+                    err = e
+            if part is None:
+                # a dead rank's slice comes from its follower's standby
+                # (rows carry stale_ms); queries stay loud only when
+                # NOBODY can serve the partition
+                part = self._replica_read(r, "Cluster.replicaSearchStates",
+                                          "search_states", **kw)
+                if part is None:
+                    raise err if err is not None else ConnectionError(
+                        f"rank {r} down and no replica holds its "
+                        "partition")
+            out.extend(part)
         limit = kw.get("limit")
         if limit is not None:
             out = out[:limit]
@@ -830,13 +952,41 @@ class ClusterEngine:
                 "aux0/aux1 are rank-local interner ids and mean different "
                 "strings on other ranks — use command_responses() or "
                 "alternate_id instead")
-        results = self._fanout(self.local.query_events(**kw),
-                               "Cluster.queryEvents", **kw)
+        results = [self.local.query_events(**kw)]
+        stale_ms = None
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            res, err = None, None
+            if self._try_peer(r):
+                try:
+                    res = self._peer_call(r, "Cluster.queryEvents", **kw)
+                except (ConnectionError, TimeoutError) as e:
+                    err = e
+            if res is None:
+                # owner unreachable: its partition serves from the most-
+                # caught-up follower's standby, and the merged response
+                # carries the replica's staleness watermark
+                res = self._replica_read(r, "Cluster.replicaQueryEvents",
+                                         "query_events", **kw)
+                if res is None:
+                    raise err if err is not None else ConnectionError(
+                        f"rank {r} down and no replica holds its "
+                        "partition")
+                stale_ms = max(stale_ms or 0.0,
+                               float(res.get("stale_ms", 0.0)))
+            results.append(res)
         events = [e for res in results for e in res["events"]]
         events.sort(key=event_order_key)
         limit = kw.get("limit", 100)
-        return {"total": sum(res["total"] for res in results),
-                "events": events[:limit]}
+        out = {"total": sum(res["total"] for res in results),
+               "events": events[:limit]}
+        if stale_ms is not None:
+            # explicit degradation marker: part of this result is a
+            # follower's standby view, at most stale_ms behind the acked
+            # history of the dead owner
+            out["stale_ms"] = stale_ms
+        return out
 
     def get_event(self, event_id: int,
                   tenant: str | None = None) -> dict | None:
@@ -1008,7 +1158,8 @@ class ClusterEngine:
 
     # metric keys that merge as MAX, not sum (ages/watermarks: a summed
     # "oldest" is an age no spill has)
-    _MAX_MERGED = ("forward_queue_oldest_ms",)
+    _MAX_MERGED = ("forward_queue_oldest_ms", "replica_max_stale_ms",
+                   "forward_dedup_horizon_age_ms")
 
     def metrics(self) -> dict:
         """Cluster-merged counters PLUS per-rank attribution: the summed
@@ -1076,6 +1227,14 @@ class ClusterEngine:
         rep = getattr(self, "entity_replicator", None)
         if rep is not None:
             out["entities"] = rep.metrics()
+        # explicit health states (up/suspect/down) + replication posture:
+        # the operator's first stop during a partition event
+        out["health"] = self.health.snapshot()
+        out["replicationFactor"] = self.replication_factor
+        if self.replica_feed is not None:
+            out["replicaFeed"] = self.replica_feed.metrics()
+        if self.replica_applier is not None:
+            out["replicaStandbys"] = self.replica_applier.standbys_status()
         return out
 
     @property
@@ -1149,9 +1308,18 @@ def local_rank_metrics(engine) -> dict:
     fq = getattr(engine, "forward_queue", None)
     if fq is not None:
         m.update(fq.metrics())
+    reg = getattr(engine, "spill_registry", None)
+    if reg is not None:
+        m.update(reg.metrics())
     rep = getattr(engine, "entity_replicator", None)
     if rep is not None:
         m.update(rep.metrics())
+    feed = getattr(engine, "replica_feed", None)
+    if feed is not None:
+        m.update(feed.metrics())
+    applier = getattr(engine, "replica_applier", None)
+    if applier is not None:
+        m.update(applier.metrics())
     return m
 
 
@@ -1241,10 +1409,21 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         """Tagged forward: the id registry suppresses redeliveries (a
         retry after a lost response or a sender/owner restart must not
         double-ingest). Record AFTER ingest: a crash in between costs a
-        duplicate (at-least-once), never a loss."""
+        duplicate (at-least-once), never a loss. A fid OLDER than the
+        registry's eviction watermark can no longer be proven un-applied
+        — it dead-letters (preserved, counted) instead of re-applying."""
         reg = getattr(engine, "spill_registry", None)
-        if reg is not None and reg.seen(fid):
-            return {"duplicate_forward": 1}
+        if reg is not None:
+            verdict = reg.check(fid)
+            if verdict == "duplicate":
+                return {"duplicate_forward": 1}
+            if verdict == "stale":
+                plist = _wire_payloads(payloads, lens, _attachment)
+                reg.deadletter(fid, {
+                    "fid": fid, "tenant": tenant, "encoding": encoding,
+                    "payloads": [base64.b64encode(p).decode()
+                                 for p in plist]})
+                return {"stale_forward": len(plist)}
         plist = _wire_payloads(payloads, lens, _attachment)
         if encoding == "binary":
             summary = engine.ingest_binary_batch(plist, tenant)
@@ -1265,8 +1444,14 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def forward_envelope(fid: str, envelope: dict,
                          tenant: str = "default"):
         reg = getattr(engine, "spill_registry", None)
-        if reg is not None and reg.seen(fid):
-            return {"duplicate_forward": 1}
+        if reg is not None:
+            verdict = reg.check(fid)
+            if verdict == "duplicate":
+                return {"duplicate_forward": 1}
+            if verdict == "stale":
+                reg.deadletter(fid, {"fid": fid, "tenant": tenant,
+                                     "envelope": envelope})
+                return {"stale_forward": 1}
         res = process_envelope(envelope, tenant)
         if reg is not None:
             reg.record(fid)

@@ -486,7 +486,7 @@ class DistributedEngine(IngestHostMixin):
         """Stage a natively decoded SoA batch, grouped by owning shard with
         one argsort (the vectorized Kafka-partitioner hop)."""
         with self.lock:
-            now = self.epoch.now_ms()
+            now = self._staging_now()
             base_ms = int(self.epoch.base_unix_s * 1000)
             etype, ok, ts_rel, values, failed, n_reg_ok = \
                 self._decode_prologue(res, payloads, tenant, reg_decoder,

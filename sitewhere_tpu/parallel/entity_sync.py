@@ -157,6 +157,17 @@ class EntityReplicator:
         # Deleted entities keep their entry as a TOMBSTONE — state
         # transfer ships it so a late joiner deletes too.
         self._last: dict[tuple[str, str], tuple[float, int]] = {}
+        # tombstone index: (kind, token) -> (ts, origin, seq). A churny
+        # admin plane must not grow _last (and every state-transfer
+        # payload) forever: gc_tombstones() drops tombstones once every
+        # rank's receipt vector provably covers the delete op — past
+        # that horizon no peer can still ship a pre-delete state that
+        # would need the LWW entry to lose against.
+        self._tombstones: dict[tuple[str, str], tuple[float, int, int]] = {}
+        # peer receipt vectors observed during anti-entropy (the GC
+        # horizon's evidence)
+        self._peer_vectors: dict[int, dict[int, int]] = {}
+        self.tombstone_min_age_ms = 60_000.0
         # memory/disk bound: past compact_threshold indexed ops, the
         # index truncates to the newest compact_keep per origin and the
         # journal rewrites as one state dump + the kept tail. A peer
@@ -186,7 +197,7 @@ class EntityReplicator:
                          "push_failures": 0, "gap_backfills": 0,
                          "sync_pulls": 0, "apply_errors": 0,
                          "compactions": 0, "state_transfers": 0,
-                         "state_pages_served": 0}
+                         "state_pages_served": 0, "tombstones_gcd": 0}
         self._log = None
         self._log_dir = None
         self._compacting = False           # journal snapshot in flight
@@ -271,13 +282,20 @@ class EntityReplicator:
         self.cluster.entity_replicator = self
         self.cluster.local.entity_replicator = self
         # replicated schedules exist on every rank: fire each at exactly
-        # one (its token's owner under the device partitioner)
+        # one (its token's owner under the device partitioner). With
+        # event-plane replication attached, install_fireover replaces
+        # this with the failure-aware predicate (dead owner -> first
+        # live follower fires, with fencing).
         if self.cluster.n_ranks > 1:
             from sitewhere_tpu.parallel.cluster import owner_rank
 
             inst.scheduler.fire_filter = (
                 lambda tok: owner_rank(tok, self.cluster.n_ranks)
                 == self.rank)
+            # replicate fired state (fired_count/last_fired_ms) so a
+            # recovered owner never re-fires a window its follower
+            # already covered
+            inst.scheduler.on_fired = self._on_job_fired
 
     # ------------------------------------------------------ local taps
     def _on_store_change(self, action, kind, token, entity) -> None:
@@ -298,6 +316,13 @@ class EntityReplicator:
     def _on_command_change(self, action, kind, token, cmd) -> None:
         self._emit(action, kind, token,
                    to_state(cmd) if cmd is not None else None)
+
+    def _on_job_fired(self, job) -> None:
+        """Scheduler post-fire hook: ship the job's fired state as a
+        normal replicated upsert — LWW converges every rank (including
+        a recovering owner) onto the newest last_fired_ms."""
+        self._emit("upsert", "scheduled-job", job.meta.token,
+                   to_state(job))
 
     def _remember(self, op: dict) -> None:
         """Index one counted op (lock held)."""
@@ -327,6 +352,8 @@ class EntityReplicator:
                   "kind": kind, "token": token, "state": state}
             self.vector[self.rank] = self._my_seq
             self._last[(kind, token)] = (op["ts"], self.rank)
+            self._note_tombstone(kind, token, action, op["ts"], self.rank,
+                                 self._my_seq)
             self._remember(op)
             self._journal(op)
             self.counters["emitted"] += 1
@@ -439,6 +466,8 @@ class EntityReplicator:
             self.counters["lww_skipped"] += 1
             return
         self._last[(kind, token)] = key
+        self._note_tombstone(kind, token, op["action"], float(op["ts"]),
+                             int(op["origin"]), int(op["seq"]))
         try:
             self._apply_state(kind, token, op["action"], op["state"])
             self.counters["applied"] += 1
@@ -645,6 +674,15 @@ class EntityReplicator:
             if existing is not None and tuple(existing) >= key:
                 continue
             self._last[kt] = key
+            # dump entries carry no per-op seq; bound the delete by the
+            # dump vector's coverage of its origin (conservative: GC
+            # waits at least until every rank covers the whole dump)
+            vec = dump.get("vector", {})
+            bound = int(vec.get(str(e["origin"]), vec.get(e["origin"], 0)))
+            self._note_tombstone(
+                e["kind"], e["token"],
+                "delete" if e["state"] is None else "upsert",
+                key[0], key[1], bound)
             try:
                 self._apply_state(
                     e["kind"], e["token"],
@@ -764,6 +802,59 @@ class EntityReplicator:
         logger.info("rank %d: entity journal compacted to %d ops",
                     self.rank, self._total_ops)
 
+    # ------------------------------------------------------ tombstone GC
+    def _note_tombstone(self, kind: str, token: str, action: str,
+                        ts: float, origin: int, seq: int) -> None:
+        """Track (or clear) the delete op behind an LWW tombstone (lock
+        held) — the evidence gc_tombstones() needs."""
+        if action == "delete":
+            self._tombstones[(kind, token)] = (ts, origin, seq)
+        else:
+            self._tombstones.pop((kind, token), None)
+
+    def gc_tombstones(self, min_age_ms: float | None = None) -> int:
+        """Drop tombstones past the cluster-wide sync horizon: every
+        rank's receipt vector covers the delete op (observed during
+        anti-entropy), so no peer can still hold — or ship — a
+        pre-delete state the LWW entry would need to beat. An age floor
+        keeps very fresh deletes out of the race with in-flight state
+        transfers. Returns tombstones collected.
+
+        Safety argument (pinned by test): after GC, a replayed pre-
+        delete OP is blocked by the receipt vector (seq <= vector), and
+        a pre-delete STATE entry cannot exist on any rank whose vector
+        covered the delete (its own LWW register already resolved the
+        delete as the winner)."""
+        min_age = (self.tombstone_min_age_ms if min_age_ms is None
+                   else min_age_ms)
+        now = time.time() * 1000
+        n = self.cluster.n_ranks
+        removed = 0
+        with self._lock:
+            for key, (ts, origin, seq) in list(self._tombstones.items()):
+                if now - ts < min_age:
+                    continue
+                if self.vector.get(origin, 0) < seq:
+                    continue
+                covered = True
+                for r in range(n):
+                    if r == self.rank:
+                        continue
+                    vec = self._peer_vectors.get(r)
+                    if vec is None or vec.get(origin, 0) < seq:
+                        covered = False
+                        break
+                if not covered:
+                    continue
+                del self._tombstones[key]
+                self._last.pop(key, None)
+                removed += 1
+                self.counters["tombstones_gcd"] += 1
+        if removed:
+            logger.info("rank %d: GC'd %d entity tombstones", self.rank,
+                        removed)
+        return removed
+
     # ---------------------------------------------------- anti-entropy
     def sync_from_peers(self, best_effort: bool = True) -> int:
         """Pull everything we lack from every reachable peer (startup
@@ -785,6 +876,13 @@ class EntityReplicator:
                     total += self._pull_state(r)
                 else:
                     total += self.apply_batch(ops)
+                # the peer's receipt vector is the tombstone-GC horizon
+                # evidence: a delete op covered by EVERY rank's vector
+                # can never be contradicted by a late pre-delete state
+                pv = c._peer(r).call("Cluster.entityVector")
+                with self._lock:
+                    self._peer_vectors[r] = {int(k): int(v)
+                                             for k, v in pv.items()}
             except (ConnectionError, TimeoutError, RpcError):
                 # RpcError too: one peer answering garbage (version skew,
                 # mid-restart handler) must not abort best-effort healing
@@ -826,6 +924,7 @@ class EntityReplicator:
         with self._lock:
             return {"entity_ops_known": sum(
                         len(v) for v in self._ops_by_origin.values()),
+                    "entity_tombstones": len(self._tombstones),
                     "entity_push_queue_depth": self._push_q.qsize(),
                     "entity_vector": {str(k): v
                                       for k, v in sorted(self.vector.items())},

@@ -49,35 +49,111 @@ class SpillRegistry:
     """Owner-side record of applied forward ids. Appends are flushed (OS
     buffer) on every record and fsynced periodically: losing a record
     can only cause a duplicate (which the engine deduplicator absorbs),
-    never a loss, so per-record fsync is not worth the hot-path cost."""
+    never a loss, so per-record fsync is not worth the hot-path cost.
+
+    The in-memory set is CAPPED, so it has an explicit dedup HORIZON:
+    when an entry evicts, the eviction watermark (the evicted fid's
+    spill-time ns, persisted) advances — a redelivery carrying a fid
+    OLDER than the watermark can no longer be distinguished from an
+    already-applied forward, so it is REJECTED (dead-lettered + counted)
+    instead of silently double-applied. The horizon exports as a gauge
+    so an operator sees how much redelivery window the capacity buys."""
 
     def __init__(self, directory, capacity: int = 200_000,
                  fsync_every: int = 256):
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.path = self.dir / "applied-forwards.log"
+        self._horizon_path = self.dir / "horizon"
         self.capacity = capacity
         self.fsync_every = fsync_every
         self._lock = threading.Lock()
         self._seen: OrderedDict[str, None] = OrderedDict()
         self._since_sync = 0
         self._lines = 0
+        self.horizon_ns = 0
+        self.stale_rejects = 0
+        try:
+            self.horizon_ns = int(self._horizon_path.read_text().strip())
+        except (OSError, ValueError):
+            pass
+        self._persisted_horizon_ns = self.horizon_ns
         if self.path.exists():
+            loaded_horizon = self.horizon_ns
             for line in self.path.read_text().splitlines():
                 fid = line.strip()
                 if fid:
                     self._remember(fid)
                     self._lines += 1
+            if self.horizon_ns != loaded_horizon:
+                self._persist_horizon()
         self._fh = open(self.path, "a")
 
+    @staticmethod
+    def fid_time_ns(fid: str) -> "int | None":
+        """Spill-clock component of a forward id (rank-time_ns-seq);
+        None for foreign formats (treated as inside the horizon)."""
+        parts = fid.split("-")
+        if len(parts) >= 3:
+            try:
+                return int(parts[1])
+            except ValueError:
+                return None
+        return None
+
     def _remember(self, fid: str) -> None:
+        """Advances the in-memory horizon on eviction; the caller
+        persists it ONCE per record (at steady-state capacity every
+        record evicts, and a tmp-write+rename per eviction inside the
+        lock would tax the hot dedup path — and the post-restart reload
+        loop worst of all). A crash-stale horizon is safe: the reloaded
+        _seen log still classifies those fids as duplicates."""
         self._seen[fid] = None
         while len(self._seen) > self.capacity:
-            self._seen.popitem(last=False)
+            evicted, _ = self._seen.popitem(last=False)
+            ns = self.fid_time_ns(evicted)
+            if ns is not None and ns > self.horizon_ns:
+                self.horizon_ns = ns
+
+    def _persist_horizon(self) -> None:
+        tmp = self._horizon_path.with_suffix(".tmp")
+        tmp.write_text(str(self.horizon_ns))
+        tmp.rename(self._horizon_path)
+        self._persisted_horizon_ns = self.horizon_ns
 
     def seen(self, fid: str) -> bool:
         with self._lock:
             return fid in self._seen
+
+    def check(self, fid: str) -> str:
+        """Classify a delivery: "new" (apply it), "duplicate" (suppress),
+        or "stale" (older than the eviction watermark — the registry can
+        no longer prove it wasn't applied; the caller must dead-letter,
+        not re-apply)."""
+        with self._lock:
+            if fid in self._seen:
+                return "duplicate"
+            ns = self.fid_time_ns(fid)
+            if ns is not None and self.horizon_ns and ns <= self.horizon_ns:
+                self.stale_rejects += 1
+                return "stale"
+            return "new"
+
+    def deadletter(self, fid: str, record: dict) -> None:
+        """Preserve a rejected (post-horizon) redelivery's payload on
+        disk — rejection must never silently drop data."""
+        dl = self.dir / "deadletter"
+        dl.mkdir(parents=True, exist_ok=True)
+        (dl / f"stale-{fid}.json").write_text(json.dumps(record))
+
+    def metrics(self) -> dict:
+        with self._lock:
+            age_ms = ((time.time_ns() - self.horizon_ns) / 1e6
+                      if self.horizon_ns else -1.0)
+            return {"forward_dedup_entries": len(self._seen),
+                    "forward_dedup_horizon_ns": self.horizon_ns,
+                    "forward_dedup_horizon_age_ms": age_ms,
+                    "forward_stale_rejects": self.stale_rejects}
 
     def record(self, fid: str) -> None:
         with self._lock:
@@ -88,6 +164,13 @@ class SpillRegistry:
             self._lines += 1
             if self._since_sync >= self.fsync_every:
                 os.fsync(self._fh.fileno())
+                # persist the horizon on the same cadence as the fsync:
+                # at steady-state capacity EVERY record evicts, and a
+                # tmp+rename per record would tax the hot dedup path. A
+                # crash-stale horizon only widens the window in which a
+                # redelivery classifies via the reloaded _seen log.
+                if self.horizon_ns != self._persisted_horizon_ns:
+                    self._persist_horizon()
                 self._since_sync = 0
             if self._lines > 2 * self.capacity:
                 self._compact()
@@ -108,6 +191,8 @@ class SpillRegistry:
 
     def close(self) -> None:
         with self._lock:
+            if self.horizon_ns != self._persisted_horizon_ns:
+                self._persist_horizon()
             self._fh.close()
 
 
@@ -115,15 +200,23 @@ class ForwardQueue:
     """Sender-side durable spill queue, one subdirectory per peer rank."""
 
     def __init__(self, cluster, directory, retry_interval_s: float = 0.5,
-                 retry_budget_s: float = 300.0):
+                 retry_budget_s: float = 300.0,
+                 app_reject_attempts: int = 5):
         self.cluster = cluster
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.retry_interval_s = retry_interval_s
         self.retry_budget_s = retry_budget_s
+        # a deterministic owner-side reject (poison batch) dead-letters
+        # after this many delivery attempts instead of wedging the peer
+        # queue for the whole transport retry budget
+        self.app_reject_attempts = app_reject_attempts
+        self._attempts: dict[str, int] = {}
         self.counters = {"spilled_batches": 0, "spilled_payloads": 0,
                          "redelivered_batches": 0, "deadlettered_batches": 0,
-                         "retry_failures": 0}
+                         "retry_failures": 0, "retry_app_rejects": 0,
+                         "retry_transport_failures": 0,
+                         "deadlettered_poison": 0}
         self._seq = 0
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
@@ -212,8 +305,21 @@ class ForwardQueue:
 
     def retry_once(self) -> int:
         """One pass over every peer queue, oldest-first; returns batches
-        redelivered. Stops at the first still-failing file per peer so
-        spill order is preserved within a peer."""
+        redelivered. Failures classify in two kinds with DIFFERENT
+        ordering contracts:
+
+        * TRANSPORT failures (connection refused / timeout — the peer
+          itself is unreachable, every later batch would fail the same
+          way): stop at the first failing file so spill order is
+          preserved across the outage, dead-letter past the time budget.
+        * APPLICATION rejects (``RpcError`` — the peer is UP and
+          deterministically refused THIS batch): count the attempt,
+          dead-letter the poison file after ``app_reject_attempts``, and
+          CONTINUE to the next file — one poison batch must not
+          head-of-line-block every batch behind it for the whole
+          transport budget (up to 5 minutes before this fix)."""
+        from sitewhere_tpu.rpc.protocol import RpcError
+
         redelivered = 0
         for peer_dir in sorted(self.dir.glob("rank-*")):
             rank = int(peer_dir.name.split("-")[1])
@@ -227,12 +333,22 @@ class ForwardQueue:
                 try:
                     self._deliver(rank, rec)
                     self.reset(rank)
-                except Exception as e:
-                    # transport errors AND owner-side application errors
-                    # (RpcError from a poison batch) take the same path:
-                    # count, dead-letter past the budget, and never let
-                    # one bad record wedge the pump for every peer
+                except RpcError as e:
                     self.counters["retry_failures"] += 1
+                    self.counters["retry_app_rejects"] += 1
+                    n = self._attempts.get(path.name, 0) + 1
+                    self._attempts[path.name] = n
+                    if n >= self.app_reject_attempts:
+                        logger.error(
+                            "forward to rank %d rejected %d times (%s) "
+                            "-> deadletter poison %s", rank, n, e,
+                            path.name)
+                        self._deadletter(path)
+                        self.counters["deadlettered_poison"] += 1
+                    continue   # the peer is up: later batches deliver
+                except Exception as e:
+                    self.counters["retry_failures"] += 1
+                    self.counters["retry_transport_failures"] += 1
                     if age_s > self.retry_budget_s:
                         logger.error(
                             "forward to rank %d undeliverable after "
@@ -240,7 +356,8 @@ class ForwardQueue:
                             e, path.name)
                         self._deadletter(path)
                         continue
-                    break   # keep order: don't skip ahead of a failure
+                    break   # keep order: don't skip ahead of an outage
+                self._attempts.pop(path.name, None)
                 path.unlink()
                 redelivered += 1
                 self.counters["redelivered_batches"] += 1
@@ -250,6 +367,7 @@ class ForwardQueue:
         dl = self.dir / "deadletter"
         dl.mkdir(parents=True, exist_ok=True)
         path.rename(dl / path.name)
+        self._attempts.pop(path.name, None)
         self.counters["deadlettered_batches"] += 1
 
     # ------------------------------------------------------- lifecycle

@@ -71,6 +71,15 @@ class RankConfig:
                                         # derives "<wal_dir>-forward"
     forward_retry_interval_s: float = 0.5
     forward_retry_budget_s: float = 300.0
+    # event-plane replication (RF>=2): each rank streams its WAL-durable
+    # ingest to rf-1 followers; their standbys serve reads + schedule
+    # fire-over while this rank is dead. 1 disables.
+    replication_factor: int = 2
+    replica_dir: str | None = None      # feed state (epoch); None derives
+                                        # "<wal_dir>-replica"
+    replica_heartbeat_s: float = 0.5
+    replica_detect_s: float = 5.0       # feed-silence budget before a
+                                        # follower declares the owner dead
 
 
 class _LoopThread:
@@ -160,6 +169,12 @@ class RankRuntime:
             # the entity-replication surface rides the same
             # authenticated cluster RPC server
             self.replicator.register_rpc(self._cluster_srv)
+        if self.cluster.replica_applier is not None:
+            from sitewhere_tpu.parallel.replication import (
+                register_replication_rpc)
+
+            register_replication_rpc(self._cluster_srv,
+                                     self.cluster.replica_applier)
         self._rpc_loop.run(
             self._cluster_srv.start(host=cfg.rpc_host, port=rpc_port))
 
@@ -199,6 +214,10 @@ class RankRuntime:
                         try:
                             await asyncio.to_thread(rep.sync_from_peers,
                                                     True)
+                            # the pull refreshed every peer's receipt
+                            # vector — the safe horizon tombstone GC
+                            # needs (never resurrects: see gc_tombstones)
+                            await asyncio.to_thread(rep.gc_tombstones)
                         except Exception:
                             logger.exception("entity anti-entropy failed")
                         await asyncio.sleep(cfg.entity_sync_interval_s)
@@ -211,6 +230,8 @@ class RankRuntime:
         self.rest_port = self._server_handle.port
         if self.cluster.forward_queue is not None:
             self.cluster.forward_queue.start()   # background redelivery
+        if self.cluster.replica_feed is not None:
+            self.cluster.replica_feed.start()    # follower streaming
         # readiness surfaces on the public health route
         self.instance.health_extra = {
             "rank": self.rank,
@@ -229,6 +250,29 @@ class RankRuntime:
 
     def run_on_serving_loop(self, coro, timeout_s: float = 60.0):
         return self._main_loop.run(coro, timeout_s)
+
+    def hard_kill(self) -> None:
+        """Simulated SIGKILL for chaos tests: sever every serving socket
+        and background thread WITHOUT flushing, saving, or closing the
+        engine — on-disk state is left exactly as a real kill would
+        (whatever the WAL fsync'd). The process-local python objects are
+        abandoned; recovery is ``run_rank`` over the same dirs."""
+        self._stopped = True
+        if self.cluster.replica_feed is not None:
+            self.cluster.replica_feed.stop()
+        if self.cluster.forward_queue is not None:
+            self.cluster.forward_queue.stop()
+        if self._rpc_loop is not None:
+            for srv in (self._instance_srv, self._cluster_srv):
+                if srv is not None:
+                    try:
+                        self._rpc_loop.run(srv.stop(), 10.0)
+                    except Exception:
+                        pass
+            self._rpc_loop.close()
+        if self._main_loop is not None:
+            self._main_loop.close()
+        self.cluster.close()
 
     def stop(self, timeout_s: float = 30.0) -> None:
         if self._stopped:
@@ -261,6 +305,10 @@ class RankRuntime:
                 self._rpc_loop.close()
         if self.replicator is not None:
             self.replicator.close()
+        if self.cluster.replica_feed is not None:
+            self.cluster.replica_feed.stop()
+        if self.cluster.replica_applier is not None:
+            self.cluster.replica_applier.close()
         if self.cluster.forward_queue is not None:
             self.cluster.forward_queue.stop()
         reg = getattr(self.cluster.local, "spill_registry", None)
@@ -328,6 +376,34 @@ def run_rank(cfg: RankConfig) -> RankRuntime:
                         retry_interval_s=cfg.forward_retry_interval_s,
                         retry_budget_s=cfg.forward_retry_budget_s),
                     SpillRegistry(pathlib.Path(fdir) / "registry"))
+        if cfg.cluster.n_ranks > 1 and cfg.replication_factor > 1:
+            rdir = cfg.replica_dir
+            if rdir is None and cfg.cluster.engine.wal_dir:
+                wd = pathlib.Path(cfg.cluster.engine.wal_dir)
+                rdir = str(wd.with_name(wd.name + "-replica"))
+            if rdir is None:
+                logger.warning(
+                    "rank %d: replication_factor=%d requested but no WAL/"
+                    "replica dir — event-plane replication disabled "
+                    "(the feed ships WAL-durable batches; a WAL-less "
+                    "rank has nothing durable to ship)",
+                    cfg.cluster.rank, cfg.replication_factor)
+            else:
+                from sitewhere_tpu.parallel.replication import (
+                    ReplicaApplier, ReplicaFeed, install_fireover)
+
+                feed = ReplicaFeed(cluster, rdir,
+                                   rf=cfg.replication_factor,
+                                   heartbeat_s=cfg.replica_heartbeat_s)
+                applier = ReplicaApplier(cluster,
+                                         rf=cfg.replication_factor,
+                                         detect_s=cfg.replica_detect_s)
+                cluster.attach_replication(feed, applier)
+                # a fenced leader pulls entity state (follower-updated
+                # schedule fired marks) before resuming its own firing
+                rep = replicator
+                feed.on_fenced = lambda: rep.sync_from_peers(True)
+                install_fireover(inst.scheduler, cluster)
     except Exception:
         # fail-fast must not leak the constructed engine or journals: a
         # supervisor retrying run_rank in-process would otherwise

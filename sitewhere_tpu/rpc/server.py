@@ -582,6 +582,13 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         u = inst.users.users.get(username)
         return inst.users.authorities_for(u) if u is not None else None
 
+    # --- cluster health/replication posture (rank-local, no fan-out) ------
+    def cluster_health():
+        from sitewhere_tpu.parallel.replication import (
+            cluster_health_payload)
+
+        return cluster_health_payload(inst.engine)
+
     families: dict[str, Handler] = {
         "DeviceManagement.getDeviceByToken": get_device_by_token,
         "DeviceManagement.createDevice": create_device,
@@ -632,6 +639,7 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         "ScheduleManagement.listScheduledJobs": list_scheduled_jobs,
         "LabelGeneration.getLabel": get_label,
         "LabelGeneration.listGenerators": list_label_generators,
+        "Instance.clusterHealth": cluster_health,
     }
     tenant_admin: dict[str, Handler] = {
         "TenantManagement.createTenant": create_tenant,

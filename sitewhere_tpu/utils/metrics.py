@@ -270,6 +270,42 @@ def query_metrics(registry: MetricsRegistry | None = None) -> dict:
     }
 
 
+def replication_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """The ``swtpu_replication_*`` instruments for the event-plane
+    replica feed (ISSUE 6). Registered here — NOT in engine.metrics(),
+    whose dict is pinned equal across dispatch shapes — exactly like the
+    query instruments:
+
+      swtpu_replication_published_total   WAL appends published to the feed
+      swtpu_replication_applied_total     feed batches applied into standbys
+      swtpu_replication_failover_reads_total  reads served from a standby
+      swtpu_replication_fireovers_total   schedule fire-over takeovers
+      swtpu_replication_lag_batches       publish-to-apply lag (gauge)
+      swtpu_replication_stale_ms          standby staleness watermark (gauge)
+    """
+    reg = registry or REGISTRY
+    return {
+        "published": reg.counter(
+            "swtpu_replication_published_total",
+            "ingest batches published to the replica feed"),
+        "applied": reg.counter(
+            "swtpu_replication_applied_total",
+            "replica feed batches applied into standby stores"),
+        "failover_reads": reg.counter(
+            "swtpu_replication_failover_reads_total",
+            "reads served from a follower standby during owner outage"),
+        "fireovers": reg.counter(
+            "swtpu_replication_fireovers_total",
+            "schedule fire-over takeovers for dead owners"),
+        "lag": reg.gauge(
+            "swtpu_replication_lag_batches",
+            "replica feed publish-to-ack lag in batches"),
+        "stale": reg.gauge(
+            "swtpu_replication_stale_ms",
+            "standby staleness watermark in milliseconds"),
+    }
+
+
 def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
                           tenant: str = "all") -> None:
     """Push the engine's device-side counters into the registry (scrape-time
@@ -372,6 +408,28 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
         if oldest is not None:
             reg.gauge("swtpu_spill_queue_oldest_ms",
                       "age of the oldest spilled forward").set(oldest)
+
+    sreg = getattr(engine, "spill_registry", None)
+    if sreg is not None:
+        sm = sreg.metrics()
+        reg.gauge("swtpu_forward_dedup_horizon_age_ms",
+                  "age of the forward dedup eviction watermark (-1 = "
+                  "nothing evicted yet)").set(
+                      sm["forward_dedup_horizon_age_ms"])
+        reg.gauge("swtpu_forward_dedup_entries",
+                  "forward ids the dedup registry currently holds").set(
+                      sm["forward_dedup_entries"])
+
+    feed = getattr(engine, "replica_feed", None)
+    applier = getattr(engine, "replica_applier", None)
+    if feed is not None or applier is not None:
+        inst = replication_metrics(reg)
+        if feed is not None:
+            fm = feed.metrics()
+            inst["lag"].set(fm.get("replica_feed_max_lag_batches", 0))
+        if applier is not None:
+            am = applier.metrics()
+            inst["stale"].set(am.get("replica_max_stale_ms", 0.0))
 
     flight = getattr(engine, "flight", None)
     if flight is not None:

@@ -209,6 +209,17 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_get("/api/instance/cluster", cluster_status)
 
+    async def cluster_health(request: web.Request):
+        """Rank-LOCAL replication/health view (no peer fan-out, so it
+        answers instantly even mid-partition) — the surface an operator
+        (or the failover gate in bench.py) polls during an outage."""
+        from sitewhere_tpu.parallel.replication import (
+            cluster_health_payload)
+
+        return json_response(cluster_health_payload(inst.engine))
+
+    r.add_get("/api/instance/cluster/health", cluster_health)
+
     # --- flight recorder (batch-lifecycle tracing; PR 3) -----------------
     async def trace_recent(request: web.Request):
         recent = getattr(inst.engine, "recent_traces", None)

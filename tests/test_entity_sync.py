@@ -531,3 +531,79 @@ def test_concurrent_mutations_with_compaction_storm_converge(tmp_path):
             assert rep._total_ops <= 12 + 2 * 3 + 1
     finally:
         _close_all(clusters, reps, host)
+
+
+def test_tombstone_gc_safe_horizon_never_resurrects(tmp_path):
+    """ISSUE 6 satellite: deleted entities leave LWW tombstones that
+    previously lived forever (memory + state-transfer payloads). GC
+    drops a tombstone only once EVERY rank's receipt vector covers the
+    delete op — past that horizon no peer can still ship a pre-delete
+    state, so GC can never resurrect (pinned below)."""
+    clusters, insts, reps, host = _mk_replicated(tmp_path)
+    r0, r1 = reps
+    try:
+        insts[0].device_management.create_device_type("gone-type", "Gone")
+        store0 = insts[0].device_management.device_types
+        store1 = insts[1].device_management.device_types
+        r0.drain_pushes()
+        assert store1.try_get("gone-type") is not None
+        create_seq = r0.vector[0]
+        store0.delete("gone-type")
+        r0.drain_pushes()
+        assert store1.try_get("gone-type") is None
+        assert ("device-type", "gone-type") in r0._tombstones
+        assert ("device-type", "gone-type") in r1._tombstones
+
+        # horizon evidence: each rank must have SEEN the other's vector
+        r0.sync_from_peers()
+        r1.sync_from_peers()
+        # too fresh: the age floor refuses (no race with in-flight
+        # transfers)
+        assert r1.gc_tombstones() == 0
+        assert r0.gc_tombstones(min_age_ms=0) == 1
+        assert r1.gc_tombstones(min_age_ms=0) == 1
+        assert ("device-type", "gone-type") not in r0._last
+        assert r0.metrics()["entity_tombstones"] == 0
+
+        # --- never resurrects -----------------------------------------
+        # (1) a full LWW state transfer after GC ships no trace of it
+        assert r1._pull_state(0) == 0
+        assert store1.try_get("gone-type") is None
+        # (2) a replayed PRE-DELETE op (origin 0, the create's seq) is
+        # blocked by the receipt vector, not re-applied
+        res = r1.apply_op({"origin": 0, "seq": create_seq,
+                           "ts": time.time() * 1000 + 10_000,
+                           "action": "upsert", "kind": "device-type",
+                           "token": "gone-type",
+                           "state": {"meta": {"token": "gone-type",
+                                              "id": 999},
+                                     "name": "Zombie"}})
+        assert res.get("duplicate")
+        assert store1.try_get("gone-type") is None
+    finally:
+        _close_all(clusters, reps, host)
+
+
+def test_tombstone_gc_waits_for_lagging_peer(tmp_path):
+    """The safe half of the horizon: while ANY rank's vector does not
+    cover the delete, the tombstone stays (a state transfer from the
+    laggard could still carry pre-delete state)."""
+    clusters, insts, reps, host = _mk_replicated(tmp_path)
+    r0, r1 = reps
+    try:
+        insts[0].device_management.create_device_type("lag-type", "Lag")
+        store0 = insts[0].device_management.device_types
+        store0.delete("lag-type")
+        r0.drain_pushes()
+        # rank 0 has NEVER pulled rank 1's vector: no evidence -> no GC
+        assert r0.gc_tombstones(min_age_ms=0) == 0
+        # stale evidence: pretend rank 1 is far behind the delete
+        with r0._lock:
+            r0._peer_vectors[1] = {0: 0}
+        assert r0.gc_tombstones(min_age_ms=0) == 0
+        assert ("device-type", "lag-type") in r0._tombstones
+        # real evidence unblocks it
+        r0.sync_from_peers()
+        assert r0.gc_tombstones(min_age_ms=0) == 1
+    finally:
+        _close_all(clusters, reps, host)

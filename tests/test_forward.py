@@ -251,3 +251,114 @@ def test_cluster_status_reports_down_peer_via_circuit(tmp_path):
         assert m["forward_queue_oldest_ms"] >= 0     # max-merged age
     finally:
         _close(clusters, regs, host)
+
+
+def test_poison_batch_does_not_block_the_queue(tmp_path):
+    """ISSUE 6 satellite: a deterministic owner-side reject (RpcError)
+    must NOT head-of-line-block the batches spilled behind it — they
+    deliver on the same pass, and the poison file dead-letters after K
+    attempts instead of wedging the pump for the transport budget."""
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        q = queues[0]
+        q.app_reject_attempts = 3
+        remote = tokens_owned_by(1, 1, prefix="poison")[0]
+        # poison first (envelope the owner deterministically rejects),
+        # a GOOD batch queued behind it
+        q.spill(1, "envelope", "default", c0._next_fid(),
+                envelope={"garbage": True})
+        q.spill(1, "json", "default", c0._next_fid(),
+                payloads=[meas(remote, "t", 1.0, 100)])
+        # one pass: the good batch delivers DESPITE the poison ahead
+        assert q.retry_once() == 1
+        m = q.metrics()
+        assert m["forward_retry_app_rejects"] == 1
+        assert m["forward_retry_transport_failures"] == 0
+        assert m["forward_queue_depth"] == 1   # only the poison remains
+        c1.flush()
+        assert c1.query_events(device_token=remote)["total"] == 1
+        # after K=3 total attempts the poison dead-letters (preserved)
+        assert q.retry_once() == 0
+        assert q.retry_once() == 0
+        m = q.metrics()
+        assert m["forward_deadlettered_poison"] == 1
+        assert m["forward_queue_depth"] == 0
+        assert len(list((tmp_path / "fwd-r0" / "deadletter")
+                        .glob("spill-*.json"))) == 1
+    finally:
+        _close(clusters, regs, host)
+
+
+def test_transport_failures_still_preserve_order(tmp_path):
+    """The poison fix must not weaken the transport contract: while the
+    peer is DOWN, retry stops at the first file (order preserved), and
+    both failure classes count separately."""
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path, connect_timeout_s=1.0)
+    c0 = clusters[0]
+    try:
+        host.stop(servers[1])
+        remote = tokens_owned_by(1, 2, prefix="ord")
+        for i, t in enumerate(remote):
+            c0.ingest_json_batch([meas(t, "t", float(i), 100 + i)])
+        q = queues[0]
+        assert q.metrics()["forward_queue_depth"] == 2
+        assert q.retry_once() == 0          # down: nothing skips ahead
+        m = q.metrics()
+        assert m["forward_retry_transport_failures"] >= 1
+        assert m["forward_retry_app_rejects"] == 0
+        assert m["forward_queue_depth"] == 2
+    finally:
+        _close(clusters, regs, host)
+
+
+def test_post_horizon_redelivery_rejected_not_reapplied(tmp_path):
+    """ISSUE 6 satellite: the dedup registry's capacity is an explicit
+    HORIZON — a redelivery older than the eviction watermark can no
+    longer be proven un-applied, so it dead-letters (+counter) instead
+    of silently double-applying; the watermark survives a restart."""
+    import base64
+
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        regs[1].close()
+        small = SpillRegistry(tmp_path / "small-reg", capacity=4)
+        c1.attach_forwarding(queues[1], small)
+        regs[1] = small
+        remote = tokens_owned_by(1, 1, prefix="hz")[0]
+
+        def fwd(fid, ts_rel):
+            p = base64.b64encode(meas(remote, "t", 1.0, ts_rel)).decode()
+            return c0._peer(1).call("Cluster.ingestForward", fid=fid,
+                                    payloads=[p], tenant="default",
+                                    encoding="json")
+
+        fids = [f"0-{1000 + i}-{i}" for i in range(7)]
+        for i, fid in enumerate(fids):
+            assert fwd(fid, 100 + i)["staged"] == 1
+        # capacity 4 of 7: three evictions -> watermark at the newest
+        # evicted fid's clock
+        assert small.horizon_ns == 1002
+        # post-horizon redelivery: REJECTED + preserved, never re-applied
+        s = fwd(fids[0], 100)
+        assert s == {"stale_forward": 1}
+        assert small.metrics()["forward_stale_rejects"] == 1
+        assert len(list((tmp_path / "small-reg" / "deadletter")
+                        .glob("stale-*.json"))) == 1
+        # an in-horizon redelivery still suppresses as a duplicate
+        assert fwd(fids[-1], 106) == {"duplicate_forward": 1}
+        c1.flush()
+        assert c1.query_events(device_token=remote)["total"] == 7
+        # the watermark is persistent state
+        small.close()
+        reopened = SpillRegistry(tmp_path / "small-reg", capacity=4)
+        assert reopened.horizon_ns == 1002
+        assert reopened.check(fids[0]) == "stale"
+        regs[1] = reopened
+        c1.attach_forwarding(queues[1], reopened)
+    finally:
+        _close(clusters, regs, host)

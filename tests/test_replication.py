@@ -1,0 +1,484 @@
+"""Event-plane replication RF>=2 (ROADMAP open item #1 / ISSUE 6).
+
+The reference survives any single replica dying because storage is a
+shared DB; here each rank's event partition was RF=1 — one SIGKILL'd
+rank meant unreadable history and silently dead schedules. These tests
+pin the replication contract: follower standby stores are BYTE-equal to
+the owner's after a replicated stream, failover reads serve a dead
+owner's partition with an explicit stale_ms watermark, schedules pinned
+to a dead owner fire on its first follower exactly once (fencing epoch +
+replicated fired state — no double-fire on recovery), and no WAL-durable
+(acked) event is ever lost.
+"""
+
+import asyncio
+import dataclasses
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from sitewhere_tpu.parallel.cluster import (ClusterConfig, ClusterEngine,
+                                            build_cluster_rpc, owner_rank)
+from sitewhere_tpu.parallel.replication import (DOWN, PeerHealth,
+                                                ReplicaApplier, ReplicaFeed,
+                                                install_fireover,
+                                                register_replication_rpc,
+                                                replica_ring)
+from tests.test_cluster import (BASE_MS, BASE_S, _engine_cfg, _free_ports,
+                                _ServerHost, meas, tokens_owned_by)
+
+
+def _mk_replicated_cluster(tmp_path, rf=2, n_ranks=2, detect_s=1.0,
+                           heartbeat_s=0.2, connect_timeout_s=5.0,
+                           start_feeds=True):
+    """n ranks with full engines + replica feeds/appliers over live RPC."""
+    ports = _free_ports(n_ranks)
+    peers = [f"127.0.0.1:{p}" for p in ports]
+    host = _ServerHost()
+    clusters, feeds, appliers, servers = [], [], [], []
+    for r in range(n_ranks):
+        cc = ClusterConfig(rank=r, n_ranks=n_ranks, peers=peers,
+                           secret="rep-secret", epoch_base_unix_s=BASE_S,
+                           engine=_engine_cfg(tmp_path, r),
+                           connect_timeout_s=connect_timeout_s)
+        c = ClusterEngine(cc)
+        feed = ReplicaFeed(c, tmp_path / f"replica-r{r}", rf=rf,
+                           heartbeat_s=heartbeat_s)
+        applier = ReplicaApplier(c, rf=rf, detect_s=detect_s)
+        c.attach_replication(feed, applier)
+        srv = build_cluster_rpc(c.local, "rep-secret")
+        register_replication_rpc(srv, applier)
+        host.start(srv, ports[r])
+        clusters.append(c)
+        feeds.append(feed)
+        appliers.append(applier)
+        servers.append(srv)
+    if start_feeds:
+        for f in feeds:
+            f.start()
+    return clusters, feeds, appliers, servers, host, ports
+
+
+def _wait(cond, timeout_s=15.0, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _close(clusters, feeds, host):
+    for f in feeds:
+        f.stop()
+    for c in clusters:
+        c.close()
+    host.close()
+
+
+def test_replica_ring_is_deterministic_and_disjoint():
+    assert replica_ring(0, 4, 2) == [1]
+    assert replica_ring(3, 4, 3) == [0, 1]
+    assert replica_ring(0, 1, 2) == []   # rf clamps to n_ranks
+    # every rank's follower set excludes itself and covers the ring
+    for n in (2, 3, 5):
+        for r in range(n):
+            ring = replica_ring(r, n, 2)
+            assert r not in ring and len(ring) == 1
+
+
+def test_follower_store_byte_equal_after_replicated_stream(tmp_path):
+    """THE replication oracle (shard-decode style): after streaming the
+    owner's WAL-order batches — json batch, binary per-request, a second
+    json round — the follower's standby store is BYTE-identical to the
+    owner's live store, interner contents included. The feed ships the
+    owner's pinned staging clock per batch, so even received_ms agrees."""
+    from sitewhere_tpu.ingest.decoders import request_from_envelope
+
+    clusters, feeds, appliers, servers, host, ports = \
+        _mk_replicated_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        toks = tokens_owned_by(0, 3, prefix="rep")
+        c0.ingest_json_batch([meas(t, "temp", 1.0 + i, 100 + i)
+                              for i, t in enumerate(toks)])
+        # per-request path (WAL_BINARY single-record publish)
+        env = {"deviceToken": toks[0], "type": "DeviceMeasurements",
+               "request": {"measurements": {"temp": 7.5},
+                           "eventDate": BASE_MS + 400}}
+        req = request_from_envelope(env)
+        req.tenant = "default"
+        c0.process(req)
+        c0.ingest_json_batch([meas(toks[1], "hum", 40.0, 500)])
+        c0.flush()
+        _wait(feeds[0].drained, what="feed drain")
+
+        st = appliers[1]._standby(0)
+        assert st is not None and st.applied_seq == 3
+        st.engine.flush()
+        owner = jax.device_get(c0.local.state.store)
+        standby = jax.device_get(st.engine.state.store)
+        for f in dataclasses.fields(owner):
+            a = np.asarray(getattr(owner, f.name))
+            b = np.asarray(getattr(standby, f.name))
+            assert np.array_equal(a, b), \
+                f"standby store field {f.name} diverged"
+        for name in ("tokens", "tenants", "event_ids"):
+            own = getattr(c0.local, name)
+            rep = getattr(st.engine, name)
+            assert [own.token(i) for i in range(len(own))] == \
+                [rep.token(i) for i in range(len(rep))], name
+        # device-state parity through the standby's own read path
+        assert st.engine.get_device_state(toks[0])["measurements"] == \
+            c0.local.get_device_state(toks[0])["measurements"]
+    finally:
+        _close(clusters, feeds, host)
+
+
+def test_wal_resync_rebuilds_standby_from_full_history(tmp_path):
+    """A follower that joins LATE (or gapped) converges by WAL resync:
+    everything the owner ever acked — including batches ingested before
+    the feed even started — serves from the standby."""
+    clusters, feeds, appliers, servers, host, ports = \
+        _mk_replicated_cluster(tmp_path, start_feeds=False)
+    c0, c1 = clusters
+    try:
+        toks = tokens_owned_by(0, 2, prefix="hist")
+        for i in range(3):
+            c0.ingest_json_batch([meas(t, "t", float(i), 100 + 10 * i + j)
+                                  for j, t in enumerate(toks)])
+        c0.flush()
+        # feed starts AFTER the history exists: initial resync must ship
+        # the whole WAL, then the live stream takes over
+        for f in feeds:
+            f.start()
+        _wait(feeds[0].drained, what="resync + drain")
+        c0.ingest_json_batch([meas(toks[0], "t", 9.0, 900)])
+        c0.flush()
+        _wait(feeds[0].drained, what="live drain")
+        res = appliers[1].query_events(0, device_token=toks[0])
+        assert res["total"] == 4
+        assert res["stale_ms"] >= 0
+        assert feeds[0].counters["resyncs"] >= 1
+    finally:
+        _close(clusters, feeds, host)
+
+
+def test_failover_reads_served_by_follower_with_stale_ms(tmp_path):
+    """Owner dies -> queries over its partition serve from the follower
+    standby with snapshot-consistent results and an explicit staleness
+    bound; once marked DOWN, repeated reads skip the dead owner's
+    connect timeout (probe backoff)."""
+    clusters, feeds, appliers, servers, host, ports = \
+        _mk_replicated_cluster(tmp_path, connect_timeout_s=1.0)
+    c0, c1 = clusters
+    try:
+        toks = tokens_owned_by(0, 2, prefix="fo")
+        c0.ingest_json_batch([meas(t, "temp", 1.0 + i, 100 + i)
+                              for i, t in enumerate(toks)])
+        c0.flush()
+        _wait(feeds[0].drained, what="feed drain")
+        host.stop(servers[0])
+        feeds[0].stop()
+
+        q = c1.query_events(device_token=toks[0])
+        assert q["total"] == 1 and q["stale_ms"] >= 0
+        assert q["events"][0]["eventDateMs"] == 100
+        ds = c1.get_device_state(toks[1])
+        assert ds["measurements"]["temp"]["value"] == 2.0
+        assert ds["stale_ms"] >= 0 and ds["served_by_replica"] == 1
+        rows = c1.search_device_states()
+        assert any(r.get("served_by_replica") == 1 for r in rows)
+        _wait(lambda: c1.health.is_down(0), what="health DOWN")
+        # down rank skips the connect attempt between probe windows
+        t0 = time.monotonic()
+        q2 = c1.query_events(device_token=toks[0])
+        assert q2["total"] == 1 and q2["stale_ms"] >= 0
+        assert time.monotonic() - t0 < 0.8, "DOWN owner must not cost a " \
+            "connect timeout per read"
+        # an unknown device on the dead partition reads as absent, not 500
+        assert c1.get_device_state(
+            tokens_owned_by(0, 3, prefix="fo")[2]) is None
+    finally:
+        _close(clusters, feeds, host)
+
+
+def test_no_acked_event_lost_on_owner_kill_and_recovery(tmp_path):
+    """The chaos invariant: SIGKILL the owner mid-ingest. Every event
+    acked (WAL-durable) before the kill is served by the follower during
+    the outage; ingest accepted at the survivor during the outage spills
+    durably; after the owner replays its WAL everything is back and the
+    spilled share redelivers — zero acknowledged loss, no duplicates."""
+    from sitewhere_tpu.parallel.distributed import (DistributedConfig,
+                                                    DistributedEngine)
+    from sitewhere_tpu.parallel.forward import ForwardQueue, SpillRegistry
+    from sitewhere_tpu.utils.checkpoint import replay_records
+    from sitewhere_tpu.utils.ingestlog import IngestLog
+
+    clusters, feeds, appliers, servers, host, ports = \
+        _mk_replicated_cluster(tmp_path, connect_timeout_s=1.0)
+    c0, c1 = clusters
+    q1 = ForwardQueue(c1, tmp_path / "fwd-r1", retry_budget_s=300.0)
+    reg1 = SpillRegistry(tmp_path / "fwd-r1" / "registry")
+    c1.attach_forwarding(q1, reg1)
+    try:
+        toks = tokens_owned_by(0, 2, prefix="loss")
+        acked = 0
+        for i in range(4):
+            s = c0.ingest_json_batch([meas(t, "t", float(i), 100 + 10 * i
+                                           + j) for j, t in enumerate(toks)])
+            assert s["staged"] == 2
+            acked += 2
+        c0.flush()
+        _wait(feeds[0].drained, what="feed drain")
+
+        # ---- SIGKILL the owner: servers severed, engine abandoned ----
+        host.stop(servers[0])
+        feeds[0].stop()
+        wal0 = c0.local.wal
+        wal0.flush()
+
+        # follower serves every acked event during the outage
+        for t in toks:
+            r = c1.query_events(device_token=t)
+            assert r["total"] == 4, (t, r)
+            assert r["stale_ms"] >= 0
+        # ingest continues at the survivor; the dead owner's share spills
+        s = c1.ingest_json_batch([meas(toks[0], "t", 99.0, 990)])
+        assert s["spilled"] == 1
+
+        # ---- owner restarts: WAL replay IS the acked history ---------
+        wal0.close()
+        cfg = dataclasses.asdict(c0.local.config)
+        cfg["wal_dir"] = None
+        rec = DistributedEngine(DistributedConfig(**cfg))
+        rec.epoch = c0.epoch
+        ro = IngestLog(tmp_path / "wal-r0", readonly=True)
+        replayed = replay_records(ro, rec.ingest_json_batch,
+                                  rec.ingest_binary_batch)
+        ro.close()
+        rec.flush()
+        assert replayed == acked
+        for t in toks:
+            assert rec.query_events(device_token=t)["total"] == 4
+        # serve the recovered engine on the old port: the spilled batch
+        # redelivers exactly once
+        srv0b = build_cluster_rpc(rec, "rep-secret")
+        reg0b = SpillRegistry(tmp_path / "reg-r0b")
+        rec.spill_registry = reg0b
+        host.start(srv0b, ports[0])
+        assert q1.retry_once() == 1
+        rec.flush()
+        assert rec.query_events(device_token=toks[0])["total"] == 5
+        reg0b.close()
+    finally:
+        reg1.close()
+        q1.stop()
+        _close(clusters, feeds, host)
+
+
+def test_scheduler_fireover_fencing_and_no_double_fire(tmp_path):
+    """Schedules pinned to a dead owner fire on its first follower
+    within the detection budget; the takeover bumps the fencing epoch;
+    on recovery the owner syncs the follower-updated fired state before
+    resuming — the covered window never fires twice."""
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+    from sitewhere_tpu.parallel.entity_sync import EntityReplicator
+
+    clusters, feeds, appliers, servers, host, ports = \
+        _mk_replicated_cluster(tmp_path, detect_s=0.8, heartbeat_s=0.15,
+                               connect_timeout_s=1.0)
+    c0, c1 = clusters
+    insts, reps = [], []
+    fires = {0: [], 1: []}
+    for i, c in enumerate(clusters):
+        inst = SiteWhereTpuInstance(
+            InstanceConfig(engine=EngineConfig()), engine=c)
+        rep = EntityReplicator(c, inst,
+                               log_dir=str(tmp_path / f"elog-r{i}"))
+        rep.attach()
+        rep.register_rpc(host.servers[i])
+        inst.scheduler.register_executor(
+            "probe", lambda job, _r=i: fires[_r].append(job.meta.token))
+        install_fireover(inst.scheduler, c)
+        insts.append(inst)
+        reps.append(rep)
+    feeds[0].on_fenced = lambda: reps[0].sync_from_peers(True)
+
+    def fire(rank, now_ms):
+        return asyncio.run(insts[rank].scheduler.fire_due(now_ms))
+
+    try:
+        tok = tokens_owned_by(0, 1, prefix="fsch")[0]
+        insts[0].scheduler.create_schedule(tok, "interval", "Simple",
+                                           interval_s=60)
+        insts[0].scheduler.create_job("job-f", tok, "probe", {})
+        reps[0].drain_pushes()
+        _wait(feeds[0].drained, what="initial feed round-trip")
+        _wait(feeds[0].can_fire, what="fence grace clear")
+
+        t = time.time() * 1000
+        # owner alive: only the owner fires
+        assert fire(0, t) == 1 and fires[0] == ["job-f"]
+        assert fire(1, t) == 0 and fires[1] == []
+        reps[0].drain_pushes()   # replicate the fired mark
+
+        # ---- owner dies: feed silence past the detection budget ------
+        host.stop(servers[0])
+        feeds[0].stop()
+        _wait(lambda: not appliers[1].leader_alive(0),
+              what="feed-silence detection")
+        # next window fires at the follower (takeover + fence bump)
+        assert fire(1, t + 61_000) == 1 and fires[1] == ["job-f"]
+        assert appliers[1].counters["fireovers"] == 1
+        st = appliers[1]._standby(0)
+        assert st.fence_epoch > feeds[0].epoch
+        # the dead owner's window never fires twice at the follower
+        assert fire(1, t + 62_000) == 0
+
+        # ---- owner recovers ------------------------------------------
+        srv0b = build_cluster_rpc(c0.local, "rep-secret")
+        register_replication_rpc(srv0b, appliers[0])
+        host.start(srv0b, ports[0])
+        old_epoch = feeds[0].epoch
+        feeds[0].start()
+        _wait(lambda: feeds[0].epoch > old_epoch, what="fence adoption")
+        # fencing pulled the follower's fired state: the window the
+        # follower covered does NOT re-fire at the owner...
+        assert insts[0].scheduler.jobs.get("job-f").last_fired_ms \
+            == pytest.approx(t + 61_000)
+        assert fire(0, t + 62_000) == 0
+        # ...and the follower has handed firing back
+        _wait(lambda: appliers[1].leader_alive(0), what="leader alive")
+        assert fire(1, t + 121_500) == 0
+        assert fire(0, t + 121_500) == 1
+        assert fires[0] == ["job-f"] * 2 and fires[1] == ["job-f"]
+    finally:
+        for rep in reps:
+            rep.close()
+        _close(clusters, feeds, host)
+
+
+def test_cron_catchup_fires_missed_window_once():
+    """The catch-up predicate: a cron window that passed while the owner
+    was dead fires once, late, on the follower — and only when the
+    catch-up filter admits the schedule."""
+    import datetime
+
+    from sitewhere_tpu.management.schedule import ScheduleManager
+
+    sm = ScheduleManager()
+    fired = []
+    sm.register_executor("probe", lambda job: fired.append(job.meta.token))
+    now = datetime.datetime(2026, 8, 3, 12, 30, 30)
+    now_ms = now.timestamp() * 1000
+    # fires only at minute 7 of each hour; last fired two hours ago
+    sm.create_schedule("cr", "cron-7", "Cron", cron="7 * * * *")
+    sm.create_job("cj", "cr", "probe", {})
+    sm.jobs.get("cj").last_fired_ms = now_ms - 2 * 3600_000
+    # without catch-up: 12:30 is not minute 7 -> nothing fires
+    assert asyncio.run(sm.fire_due(now_ms)) == 0
+    # with catch-up admitted: the missed 12:07 window fires once
+    sm.catchup_filter = lambda tok: True
+    assert asyncio.run(sm.fire_due(now_ms)) == 1
+    assert asyncio.run(sm.fire_due(now_ms + 1000)) == 0   # once only
+    assert fired == ["cj"]
+
+
+def test_fault_injector_is_deterministic_and_kills(tmp_path):
+    from sitewhere_tpu.utils import faults
+
+    plan = faults.FaultPlan(seed=42).drop(src=0, dst=1, prob=0.5)
+    a = faults.FaultInjector(plan)
+    b = faults.FaultInjector(faults.FaultPlan(seed=42).drop(src=0, dst=1,
+                                                           prob=0.5))
+
+    def outcomes(inj):
+        out = []
+        for _ in range(32):
+            try:
+                inj.before_call(0, 1, "Cluster.queryEvents")
+                out.append("ok")
+            except ConnectionError:
+                out.append("drop")
+        return out
+
+    seq_a, seq_b = outcomes(a), outcomes(b)
+    assert seq_a == seq_b and "drop" in seq_a and "ok" in seq_a
+
+    # the kill rule refuses instantly through the real peer path
+    clusters, feeds, appliers, servers, host, ports = \
+        _mk_replicated_cluster(tmp_path, start_feeds=False)
+    try:
+        faults.install(faults.FaultPlan(seed=1).kill(1))
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            clusters[0]._peer(1).call("Cluster.deviceCount")
+        assert time.monotonic() - t0 < 0.2
+        faults.clear()
+        assert clusters[0]._peer(1).call("Cluster.deviceCount") == 0
+    finally:
+        faults.clear()
+        _close(clusters, feeds, host)
+
+
+def test_peer_health_state_machine():
+    h = PeerHealth(down_after=2, probe_base_s=0.05)
+    assert h.state(3) == "up"
+    h.record_failure(3)
+    assert h.state(3) == "suspect"
+    h.record_failure(3)
+    assert h.state(3) == DOWN and h.is_down(3)
+    # backoff (2nd failure doubles it to 0.1s): an immediate probe is
+    # denied; once the window passes one probe is granted and re-arms
+    assert not h.should_probe(3)
+    time.sleep(0.13)
+    assert h.should_probe(3)
+    assert not h.should_probe(3)   # re-armed by the granted probe
+    h.record_success(3)
+    assert h.state(3) == "up" and h.should_probe(3)
+
+
+@pytest.mark.slow
+def test_chaos_kill_recover_loop(tmp_path):
+    """Heavy kill/recover loop under a seeded fault plan: repeated owner
+    death and recovery with ingest running never loses an acked event
+    and always restores failover reads within the detection budget."""
+    clusters, feeds, appliers, servers, host, ports = \
+        _mk_replicated_cluster(tmp_path, connect_timeout_s=1.0,
+                               detect_s=0.8)
+    c0, c1 = clusters
+    try:
+        toks = tokens_owned_by(0, 2, prefix="chaos")
+        total = 0
+        for round_ in range(3):
+            for i in range(3):
+                s = c0.ingest_json_batch(
+                    [meas(t, "t", float(i), 1000 * round_ + 10 * i + j)
+                     for j, t in enumerate(toks)])
+                assert s["staged"] == 2
+                total += 1
+            c0.flush()
+            _wait(feeds[0].drained, what=f"drain round {round_}")
+            host.stop(servers[0])
+            t0 = time.monotonic()
+            r = c1.query_events(device_token=toks[0])
+            assert r["total"] == total and r["stale_ms"] >= 0
+            assert time.monotonic() - t0 < 5.0, "failover read must land " \
+                "within the detection budget"
+            # recover: same engine, new server (WAL state untouched)
+            srv = build_cluster_rpc(c0.local, "rep-secret")
+            register_replication_rpc(srv, appliers[0])
+            host.start(srv, ports[0])
+            servers[0] = srv
+            _wait(lambda: not c1.health.is_down(0) or c1.health.
+                  should_probe(0), what="probe window")
+            c1.health.record_success(0)   # next read re-probes the owner
+        q = c0.query_events(device_token=toks[0])
+        assert q["total"] == total and "stale_ms" not in q
+    finally:
+        _close(clusters, feeds, host)
