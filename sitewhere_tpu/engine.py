@@ -245,6 +245,16 @@ class IngestHostMixin:
     def recent_traces(self, limit: int = 50) -> list[dict]:
         return self.flight.recent(limit)
 
+    def slo_harvest(self) -> list:
+        """Completed ingest lifecycles not yet exported to the SLO plane.
+        Drained (exactly once each) by the Prometheus exporter at SCRAPE
+        time: the per-tenant ``swtpu_ingest_e2e_seconds`` histograms are
+        built entirely from flight records, so the ingest hot path pays
+        ZERO extra device syncs for SLO latency — the same harvest rule
+        bench.py's cluster leg and the autotuner's stage medians ride."""
+        return self.flight.harvest_completed("ingest",
+                                             terminal="device_ready")
+
     @contextlib.contextmanager
     def _wal_suppress(self):
         """Suppress WAL logging for nested process() calls on THIS thread

@@ -13,7 +13,16 @@ ingest→device-state latency percentiles — the BASELINE.md north-star metrics
 Modes:
   * engine — payload bytes → C++ batch decode → staging → fused TPU step →
     state merged. Latency is measured per batch from first submit to the
-    flush return that made the batch's events visible in device state.
+    flush return that made the batch's events visible in device state
+    (CLOSED loop: the next batch waits for the previous one).
+  * open loop — a seeded, deterministic schedule of per-tenant Poisson
+    arrivals carrying a MIXED ingest/query/entity-mutation workload
+    (``build_open_loop_schedule`` + ``run_open_loop``). The generator
+    fires on the schedule's clock, never the engine's: when the engine
+    falls behind, events queue and their measured latency GROWS — the
+    queueing delay a closed-loop driver structurally hides, and exactly
+    what per-tenant SLO measurement must see. Per-event wire→state
+    latencies sample into log-bucketed histograms (p50/p99/p99.9).
   * rest — HTTP POSTs against a running gateway (wire-level e2e).
 
 CLI: ``python -m sitewhere_tpu.loadgen --batches 50 --batch-size 4096``.
@@ -22,6 +31,7 @@ CLI: ``python -m sitewhere_tpu.loadgen --batches 50 --batch-size 4096``.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
 import time
 
@@ -140,6 +150,254 @@ def run_engine_load(engine, n_batches: int = 50, batch_size: int = 4096,
     return LoadStats(sent, decoded, failed, wall, sent / wall, p50, p99, mx)
 
 
+# ---------------------------------------------------------------------------
+# Open-loop mixed-workload harness (ISSUE 7).
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass(frozen=True)
+class TenantLoad:
+    """One tenant's arrival process and workload mix."""
+
+    tenant: str
+    rate_eps: float                    # mean event arrival rate (Poisson)
+    n_devices: int = 64
+    device_prefix: str | None = None   # default "<tenant>-dev"
+    query_every: int = 0               # one query per N ingest frames
+    mutate_every: int = 0              # one entity mutation per N frames
+
+
+@dataclasses.dataclass(frozen=True)
+class OpenLoopSpec:
+    """A complete, seed-determined load description: same spec + same
+    seed => byte-identical payload stream and identical arrival
+    schedule (pinned by tests/test_loadgen.py)."""
+
+    tenants: tuple
+    duration_s: float = 1.0
+    frame_size: int = 64               # events per ingest submission
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class ScheduledOp:
+    """One scheduled action. ``t_s`` is the arrival offset from schedule
+    start; ingest frames also carry each event's OWN arrival offset so
+    latency is measured per event, from the moment it notionally hit
+    the wire — not from whenever the backlogged driver got to it."""
+
+    t_s: float
+    kind: str                          # "ingest" | "query" | "mutate"
+    tenant: str
+    payloads: list | None = None
+    arrivals: tuple | None = None
+    query: dict | None = None
+    mutate: tuple | None = None        # (op, token, metadata)
+
+
+_KIND_ORDER = {"ingest": 0, "query": 1, "mutate": 2}
+
+
+def build_open_loop_schedule(spec: OpenLoopSpec) -> list[ScheduledOp]:
+    """Deterministic open-loop schedule: per-tenant Poisson arrivals
+    (seeded per tenant index), events grouped into frames of
+    ``frame_size`` (a frame departs when its LAST event has arrived),
+    with query and entity-mutation ops interleaved at each tenant's
+    configured cadence. Pure function of the spec — no wall clock, no
+    global RNG."""
+    ops: list[ScheduledOp] = []
+    for ti, tl in enumerate(spec.tenants):
+        rng = np.random.default_rng([spec.seed, ti])
+        prefix = tl.device_prefix or f"{tl.tenant}-dev"
+        if tl.rate_eps <= 0:
+            continue
+        # draw inter-arrival gaps in chunks until past the horizon
+        gaps: list[np.ndarray] = []
+        total = 0.0
+        while total < spec.duration_s:
+            g = rng.exponential(1.0 / tl.rate_eps,
+                                size=max(64, int(tl.rate_eps * 0.25) or 64))
+            gaps.append(g)
+            total += float(g.sum())
+        arr = np.cumsum(np.concatenate(gaps))
+        arr = arr[arr < spec.duration_s]
+        picks = rng.integers(0, tl.n_devices, len(arr))
+        mut_registered: set[str] = set()
+        n_frames = 0
+        for lo in range(0, len(arr), spec.frame_size):
+            hi = min(lo + spec.frame_size, len(arr))
+            payloads = [generate_measurements_message(
+                f"{prefix}-{int(picks[k])}", ti * 10_000_000 + k)
+                for k in range(lo, hi)]
+            frame_t = float(arr[hi - 1])
+            ops.append(ScheduledOp(
+                t_s=frame_t, kind="ingest", tenant=tl.tenant,
+                payloads=payloads,
+                arrivals=tuple(float(a) for a in arr[lo:hi])))
+            n_frames += 1
+            if tl.query_every and n_frames % tl.query_every == 0:
+                variant = (n_frames // tl.query_every) % 3
+                if variant == 0:
+                    q = {"limit": 20}
+                elif variant == 1:
+                    q = {"device_token":
+                         f"{prefix}-{int(picks[lo])}", "limit": 20}
+                else:
+                    q = {"since_ms": 0, "limit": 20}
+                ops.append(ScheduledOp(t_s=frame_t, kind="query",
+                                       tenant=tl.tenant, query=q))
+            if tl.mutate_every and n_frames % tl.mutate_every == 0:
+                j = n_frames // tl.mutate_every
+                token = f"{prefix}-m{j % 8}"
+                if token not in mut_registered:
+                    mut_registered.add(token)
+                    mut = ("register", token, None)
+                else:
+                    mut = ("update", token, {"rev": str(j)})
+                ops.append(ScheduledOp(t_s=frame_t, kind="mutate",
+                                       tenant=tl.tenant, mutate=mut))
+    ops.sort(key=lambda op: (op.t_s, op.tenant, _KIND_ORDER[op.kind]))
+    return ops
+
+
+def schedule_fingerprint(schedule: list[ScheduledOp]) -> str:
+    """SHA-256 over the canonical byte form of a schedule — the
+    determinism pin (same seed => same fingerprint) and the provenance
+    field the bench records next to its measured numbers."""
+    h = hashlib.sha256()
+    for op in schedule:
+        h.update(f"{op.kind}|{op.tenant}|{op.t_s!r}\n".encode())
+        for p in op.payloads or ():
+            h.update(p)
+        for a in op.arrivals or ():
+            h.update(repr(a).encode())
+        if op.query is not None:
+            h.update(json.dumps(op.query, sort_keys=True).encode())
+        if op.mutate is not None:
+            h.update(repr(op.mutate).encode())
+    return h.hexdigest()
+
+
+def _pcts(lat_ms: list[float]) -> dict:
+    if not lat_ms:
+        return {"p50_ms": None, "p99_ms": None, "p999_ms": None,
+                "max_ms": None}
+    a = np.asarray(lat_ms)
+    return {"p50_ms": round(float(np.percentile(a, 50)), 3),
+            "p99_ms": round(float(np.percentile(a, 99)), 3),
+            "p999_ms": round(float(np.percentile(a, 99.9)), 3),
+            "max_ms": round(float(a.max()), 3)}
+
+
+@dataclasses.dataclass
+class OpenLoopResult:
+    """Per-tenant SLO view of one open-loop run. For each tenant,
+    ``per_tenant[t]`` carries two latency families:
+
+      e2e_*      scheduled arrival -> visible in device state. THE SLO
+                 number: includes queueing delay whenever the engine
+                 (or the driver) fell behind the arrival process.
+      service_*  submit -> visible. The engine-side span comparable to
+                 the flight-recorder-harvested swtpu_ingest_e2e_seconds
+                 histogram (same start edge as the batch's flight
+                 record). e2e == service when the run kept pace.
+    """
+
+    wall_s: float
+    events: int
+    events_per_s: float
+    offered_eps: float
+    queries: int
+    query_p99_ms: float | None
+    mutations: int
+    max_lateness_s: float
+    per_tenant: dict
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def run_open_loop(engine, schedule: list[ScheduledOp], *,
+                  checkpoint_frames: int = 4,
+                  time_scale: float = 1.0) -> OpenLoopResult:
+    """Replay a schedule against a live engine (Engine, DistributedEngine
+    or ClusterEngine — anything with ingest_json_batch / query_events /
+    flush). Ops fire at their scheduled time; a late driver fires
+    immediately and the lateness lands in the measured latency (open
+    loop). Completion checkpoints every ``checkpoint_frames`` ingest
+    frames call ``engine.flush()`` — on a cluster facade that fans out,
+    so forwarded events count only once visible at their OWNER."""
+    pending: list[tuple[str, list[float], float]] = []
+    per: dict[str, tuple[list, list]] = {}
+    qlat: list[float] = []
+    mutations = 0
+    max_late = 0.0
+    frames = 0
+    events = 0
+    t0 = time.perf_counter()
+
+    def checkpoint():
+        nonlocal frames
+        frames = 0
+        if not pending:
+            return
+        engine.flush()
+        t_done = time.perf_counter()
+        for tenant, arrivals, submit in pending:
+            e2e, svc = per.setdefault(tenant, ([], []))
+            e2e.extend((t_done - a) * 1e3 for a in arrivals)
+            svc.extend([(t_done - submit) * 1e3] * len(arrivals))
+        pending.clear()
+
+    for op in schedule:
+        target = t0 + op.t_s * time_scale
+        now = time.perf_counter()
+        if now < target:
+            time.sleep(target - now)
+        else:
+            max_late = max(max_late, now - target)
+        if op.kind == "ingest":
+            submit = time.perf_counter()
+            engine.ingest_json_batch(op.payloads, op.tenant)
+            pending.append((op.tenant,
+                            [t0 + a * time_scale for a in op.arrivals],
+                            submit))
+            events += len(op.payloads)
+            frames += 1
+            if frames >= checkpoint_frames:
+                checkpoint()
+        elif op.kind == "query":
+            t1 = time.perf_counter()
+            engine.query_events(**op.query)
+            qlat.append((time.perf_counter() - t1) * 1e3)
+        else:
+            kind, token, md = op.mutate
+            if kind == "register":
+                engine.register_device(token, tenant=op.tenant)
+            else:
+                try:
+                    engine.update_device(token, metadata=md)
+                except KeyError:
+                    engine.register_device(token, tenant=op.tenant)
+            mutations += 1
+    checkpoint()
+    wall = time.perf_counter() - t0
+    horizon = max((op.t_s for op in schedule), default=0.0) * time_scale
+    per_tenant = {}
+    for tenant, (e2e, svc) in sorted(per.items()):
+        per_tenant[tenant] = {
+            "events": len(e2e),
+            **{f"e2e_{k}": v for k, v in _pcts(e2e).items()},
+            **{f"service_{k}": v for k, v in _pcts(svc).items()},
+        }
+    qp = _pcts(qlat)
+    return OpenLoopResult(
+        wall_s=round(wall, 3), events=events,
+        events_per_s=round(events / wall, 1) if wall else 0.0,
+        offered_eps=round(events / horizon, 1) if horizon else 0.0,
+        queries=len(qlat), query_p99_ms=qp["p99_ms"],
+        mutations=mutations, max_lateness_s=round(max_late, 4),
+        per_tenant=per_tenant)
+
+
 async def run_rest_load(base_url: str, jwt: str, n_workers: int = 5,
                         msgs_per_worker: int = 100,
                         device_prefix: str = "rest-lg") -> LoadStats:
@@ -186,6 +444,13 @@ def main() -> None:
     ap.add_argument("--batches", type=int, default=50)
     ap.add_argument("--batch-size", type=int, default=4096)
     ap.add_argument("--devices", type=int, default=10_000)
+    ap.add_argument("--open-loop", action="store_true",
+                    help="seeded open-loop mixed workload instead of the "
+                         "closed-loop batch driver")
+    ap.add_argument("--rate", type=float, default=5000.0,
+                    help="open-loop arrival rate (events/s)")
+    ap.add_argument("--duration", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
     engine = Engine(EngineConfig(
@@ -193,6 +458,25 @@ def main() -> None:
         token_capacity=1 << 17, assignment_capacity=1 << 17,
         store_capacity=1 << 18, batch_capacity=args.batch_size,
     ))
+    if args.open_loop:
+        # warm OUTSIDE the measured schedule: the first flush pays the
+        # fused-step jit compile (seconds), which would otherwise land
+        # in — and, open-loop, cascade through — every reported latency
+        run_engine_load(engine, n_batches=1, batch_size=args.batch_size,
+                        n_devices=min(args.devices, 4096),
+                        warmup_batches=1)
+        spec = OpenLoopSpec(
+            tenants=(TenantLoad("default", args.rate,
+                                n_devices=min(args.devices, 4096),
+                                query_every=8, mutate_every=16),),
+            duration_s=args.duration,
+            frame_size=min(args.batch_size, 512), seed=args.seed)
+        schedule = build_open_loop_schedule(spec)
+        res = run_open_loop(engine, schedule)
+        print(json.dumps({
+            "schedule_fingerprint": schedule_fingerprint(schedule),
+            **res.to_dict()}))
+        return
     stats = run_engine_load(engine, args.batches, args.batch_size, args.devices)
     print(json.dumps(stats.to_dict()))
 

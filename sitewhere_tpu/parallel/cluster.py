@@ -87,6 +87,20 @@ _FNV_PRIME = 0x100000001b3
 _MASK64 = (1 << 64) - 1
 
 
+# resolved once, like replication's instruments: the forward-hop
+# histogram sits on the per-sub-batch forward path
+_CLUSTER_INSTRUMENTS: dict | None = None
+
+
+def _cluster_instruments() -> dict:
+    global _CLUSTER_INSTRUMENTS
+    if _CLUSTER_INSTRUMENTS is None:
+        from sitewhere_tpu.utils.metrics import cluster_metrics_instruments
+
+        _CLUSTER_INSTRUMENTS = cluster_metrics_instruments()
+    return _CLUSTER_INSTRUMENTS
+
+
 def owner_rank(token: str, n_ranks: int) -> int:
     """Owning rank of a device token: FNV-1a over the token STRING —
     stable across processes, restarts, and interner orders (the process-
@@ -584,11 +598,15 @@ class ClusterEngine:
             return _merge_counts([
                 self._forward_batch(r, kind, plist[:mid], tenant),
                 self._forward_batch(r, kind, plist[mid:], tenant)])
+        hop = _cluster_instruments()["forward_hop"]
         if self.forward_queue is None:
             method = ("Cluster.ingestJson" if kind == "json"
                       else "Cluster.ingestBinary")
-            return self._peer(r).call(method, lens=lens, tenant=tenant,
-                                      _attachment=b"".join(plist))
+            t0 = time.perf_counter()
+            res = self._peer(r).call(method, lens=lens, tenant=tenant,
+                                     _attachment=b"".join(plist))
+            hop.observe(time.perf_counter() - t0, dst=str(r))
+            return res
         fid = self._next_fid()
         if self.forward_queue.circuit_open(r):
             # a known-down peer: spill without paying the connect
@@ -598,10 +616,13 @@ class ClusterEngine:
                                      payloads=plist)
             return {"spilled": len(plist)}
         try:
-            return self._peer(r).call(
+            t0 = time.perf_counter()
+            res = self._peer(r).call(
                 "Cluster.ingestForward", fid=fid, lens=lens,
                 tenant=tenant, encoding=kind,
                 _attachment=b"".join(plist))
+            hop.observe(time.perf_counter() - t0, dst=str(r))
+            return res
         except (ConnectionError, TimeoutError):
             self.forward_queue.trip(r)
             self.forward_queue.spill(r, kind, tenant, fid,
@@ -1203,6 +1224,36 @@ class ClusterEngine:
                     slot[etype] = slot.get(etype, 0) + n
         return merged
 
+    def cluster_metrics(self) -> str:
+        """ONE federated Prometheus exposition for the whole cluster,
+        served from any rank (ISSUE 7): every live rank exports its own
+        engine into its registry and ships the text; samples re-export
+        under a ``rank`` label with HELP/TYPE deduped across ranks, and
+        histogram bucket lines keep their trace-id exemplars. A DOWN
+        rank degrades to ``swtpu_cluster_rank_up{rank=...} 0`` instead
+        of failing the scrape — the operator needs this surface most
+        exactly when a rank is missing."""
+        from sitewhere_tpu.utils.metrics import (REGISTRY, _escape_label,
+                                                 export_engine_metrics,
+                                                 federate_expositions)
+
+        export_engine_metrics(self.local)
+        local_text = REGISTRY.expose_text(exemplars=True)
+        keyed = self._fanout_keyed(local_text, "Cluster.metricsText",
+                                   tolerant=True)
+        parts = {r: t for r, t in keyed.items()
+                 if not isinstance(t, PeerDown)}
+        lines = [federate_expositions(parts).rstrip("\n"),
+                 "# HELP swtpu_cluster_rank_up 1 if the rank answered "
+                 "the federated scrape",
+                 "# TYPE swtpu_cluster_rank_up gauge"]
+        for r in sorted(keyed):
+            up = 0 if isinstance(keyed[r], PeerDown) else 1
+            lines.append(
+                f'swtpu_cluster_rank_up{{rank="{_escape_label(r)}"}} {up}')
+        _cluster_instruments()["scrapes"].inc()
+        return "\n".join(lines) + "\n"
+
     def cluster_status(self) -> dict:
         """The operator's cluster page: this rank's identity, every
         rank's reachability + device count, and the durability gauges.
@@ -1228,8 +1279,16 @@ class ClusterEngine:
         if rep is not None:
             out["entities"] = rep.metrics()
         # explicit health states (up/suspect/down) + replication posture:
-        # the operator's first stop during a partition event
-        out["health"] = self.health.snapshot()
+        # the operator's first stop during a partition event. The
+        # per-LEADER staleness watermarks ride the health block so a
+        # single lagging follower is visible here before a failover
+        # read ever hits it (same series as
+        # swtpu_replication_stale_ms{leader=...}).
+        out["health"] = {"peers": self.health.snapshot()}
+        if self.replica_applier is not None:
+            out["health"]["replicationStaleMs"] = {
+                str(r): ms
+                for r, ms in self.replica_applier.stale_by_leader().items()}
         out["replicationFactor"] = self.replication_factor
         if self.replica_feed is not None:
             out["replicaFeed"] = self.replica_feed.metrics()
@@ -1528,6 +1587,17 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def metrics():
         return local_rank_metrics(engine)
 
+    def metrics_text():
+        """This rank's registry exposition (exemplars kept — the caller
+        is the federated scrape, which re-labels by rank). The export
+        runs HERE, against the local engine, so each rank's text
+        reflects its own partition."""
+        from sitewhere_tpu.utils.metrics import (REGISTRY,
+                                                 export_engine_metrics)
+
+        export_engine_metrics(engine)
+        return REGISTRY.expose_text(exemplars=True)
+
     def tenant_metrics():
         return engine.tenant_metrics()
 
@@ -1598,6 +1668,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.listDeviceInfos": list_device_infos,
         "Cluster.deviceCount": device_count,
         "Cluster.metrics": metrics,
+        "Cluster.metricsText": metrics_text,
         "Cluster.tenantMetrics": tenant_metrics,
         "Cluster.presenceSweep": presence_sweep,
         "Cluster.invokeCommand": invoke_command,

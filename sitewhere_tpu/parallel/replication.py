@@ -863,6 +863,16 @@ class ReplicaApplier:
             leaders = list(self._standbys)
         return {str(r): self.status(r) for r in leaders}
 
+    def stale_by_leader(self) -> dict[int, float]:
+        """Staleness watermark PER LEADER this rank follows — the
+        per-peer series behind ``swtpu_replication_stale_ms{leader=...}``
+        and the cluster_status health block (a single lagging follower
+        must be visible before a failover read hits it, not averaged
+        into a max)."""
+        with self._lock:
+            leaders = list(self._standbys)
+        return {r: round(self.stale_ms(r), 3) for r in leaders}
+
     def metrics(self) -> dict:
         with self._lock:
             leaders = dict(self._standbys)

@@ -589,6 +589,19 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
 
         return cluster_health_payload(inst.engine)
 
+    async def cluster_metrics():
+        """The federated exposition over the instance control plane —
+        the same rank-labeled payload REST serves at
+        /api/instance/cluster/metrics. OFF-LOOP: on a clustered engine
+        this fans out over blocking peer RPC, and run_rank serves the
+        instance RPC on the SAME loop as the rank's cluster RPC server
+        — a synchronous handler here would block that loop exactly like
+        deployment rule 1 (parallel/cluster.py) warns, deadlocking two
+        ranks that scrape each other."""
+        from sitewhere_tpu.utils.metrics import federated_exposition
+
+        return await asyncio.to_thread(federated_exposition, inst.engine)
+
     families: dict[str, Handler] = {
         "DeviceManagement.getDeviceByToken": get_device_by_token,
         "DeviceManagement.createDevice": create_device,
@@ -640,6 +653,7 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         "LabelGeneration.getLabel": get_label,
         "LabelGeneration.listGenerators": list_label_generators,
         "Instance.clusterHealth": cluster_health,
+        "Instance.clusterMetrics": cluster_metrics,
     }
     tenant_admin: dict[str, Handler] = {
         "TenantManagement.createTenant": create_tenant,

@@ -108,7 +108,7 @@ class FlightRecord:
     carries counts and path annotations."""
 
     __slots__ = ("trace_id", "kind", "tenant", "rank", "n_payloads",
-                 "t0_unix_ms", "t0_ns", "stages", "meta")
+                 "t0_unix_ms", "t0_ns", "stages", "meta", "harvested")
 
     def __init__(self, trace_id: str | None, kind: str, tenant: str,
                  rank: int, n_payloads: int):
@@ -121,6 +121,9 @@ class FlightRecord:
         self.t0_ns = time.perf_counter_ns()
         self.stages: dict[str, int] = {}
         self.meta: dict[str, object] = {}
+        # consumed-once marker for the scrape-time SLO harvest (never
+        # serialized; a record stays readable via recent()/records_of)
+        self.harvested = False
 
     def mark(self, stage: str) -> None:
         self.stages[stage] = time.perf_counter_ns()
@@ -265,6 +268,28 @@ class FlightRecorder:
                         break
                 i = (i - 1) % self.capacity
         return [r.to_dict() for r in out]
+
+    def harvest_completed(self, kind: str = "ingest",
+                          terminal: str = "device_ready") -> list:
+        """Records of ``kind`` whose ``terminal`` stage has been marked
+        and that were never harvested before — marked-and-returned
+        atomically under the ring lock, so the scrape-time SLO exporter
+        observes every completed lifecycle EXACTLY once regardless of
+        which scrape surface (local, federated, RPC) gets there first.
+        Returns the live FlightRecord objects (the caller reads stage
+        nanos directly; to_dict would round them to microseconds).
+
+        The ring is the retention window: a record evicted between two
+        scrapes is lost to the histogram — the SLO plane SAMPLES at
+        scrape cadence, it is not an exact event count."""
+        out = []
+        with self._lock:
+            for rec in self._ring:
+                if (rec is not None and rec.kind == kind
+                        and not rec.harvested and terminal in rec.stages):
+                    rec.harvested = True
+                    out.append(rec)
+        return out
 
     def __len__(self) -> int:
         with self._lock:

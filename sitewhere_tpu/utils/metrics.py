@@ -21,6 +21,14 @@ _DEFAULT_BUCKETS = (
     2.5, 5.0, 10.0,
 )
 
+# log-bucketed ladder for end-to-end SLO latency (seconds): a 1-2.5-5
+# decade scale from 1ms to 30s, wide enough that open-loop queueing
+# delay under overload still lands in a finite bucket
+E2E_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+    5.0, 10.0, 30.0,
+)
+
 
 # Prometheus text-format label escaping: backslash first (escaping the
 # escapes), then quote and newline — a label value containing any of the
@@ -62,7 +70,7 @@ class _LabeledSeries:
         with self._lock:
             return self._values.get(key, 0.0)
 
-    def expose(self) -> Iterator[str]:
+    def expose(self, exemplars: bool = False) -> Iterator[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} {self.kind}"
         with self._lock:       # snapshot: a concurrent write mid-iteration
@@ -124,8 +132,23 @@ class Histogram:
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        # last exemplar per (series, bucket index): OpenMetrics-style
+        # trace links on the bucket lines (bucket len(buckets) = +Inf)
+        self._exemplars: dict[tuple, dict[int, tuple[str, float]]] = {}
 
     def observe(self, value: float, **labels) -> None:
+        self.observe_n(value, 1, **labels)
+
+    def observe_n(self, value: float, count: int = 1,
+                  exemplar: str | None = None, **labels) -> None:
+        """Record ``count`` observations of ``value`` in one update — the
+        scrape-time harvest path observes one flight record per BATCH,
+        weighted by its payload count, so per-tenant quantiles weight
+        events, not batches, without 10^3 bisects per record. ``exemplar``
+        (a trace id) sticks to the bucket the value fell in and is served
+        on exemplar-aware expositions."""
+        if count <= 0:
+            return
         key = tuple(sorted(labels.items()))
         with self._lock:
             if key not in self._counts:
@@ -134,9 +157,11 @@ class Histogram:
                 self._totals[key] = 0
             idx = bisect.bisect_left(self.buckets, value)
             if idx < len(self.buckets):
-                self._counts[key][idx] += 1
-            self._sums[key] += value
-            self._totals[key] += 1
+                self._counts[key][idx] += count
+            self._sums[key] += value * count
+            self._totals[key] += count
+            if exemplar is not None:
+                self._exemplars.setdefault(key, {})[idx] = (exemplar, value)
 
     def time(self, **labels):
         """Context manager measuring a stage duration — the per-stage latency
@@ -162,6 +187,13 @@ class Histogram:
             return self._totals.get(key, 0)
 
     def quantile(self, q: float, **labels) -> float | None:
+        """Bucket-quantile estimate: locate the bounding bucket, then
+        linearly interpolate within it — the standard
+        ``histogram_quantile`` rule, so SLO summaries and the autotuner
+        can read a p99 straight from the exposition buckets without any
+        raw-sample retention. Values beyond the last finite bucket clamp
+        to that bound (the +Inf bucket has no width to interpolate
+        into); None until a series observes."""
         key = tuple(sorted(labels.items()))
         with self._lock:
             counts = self._counts.get(key)
@@ -171,12 +203,19 @@ class Histogram:
             target = q * total
             acc = 0
             for i, c in enumerate(counts):
+                if c and acc + c >= target:
+                    lo = self.buckets[i - 1] if i else 0.0
+                    hi = self.buckets[i]
+                    frac = min(1.0, max(0.0, (target - acc) / c))
+                    return lo + (hi - lo) * frac
                 acc += c
-                if acc >= target:
-                    return self.buckets[i]
             return self.buckets[-1]
 
-    def expose(self) -> Iterator[str]:
+    def expose(self, exemplars: bool = False) -> Iterator[str]:
+        """Prometheus text exposition. ``exemplars`` appends OpenMetrics
+        trace-id exemplars to the bucket lines — only the federated
+        cluster scrape asks for them; the plain text-format endpoint
+        stays strictly 0.0.4-parseable."""
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
         with self._lock:       # snapshot: observe() mutates these in place
@@ -184,15 +223,27 @@ class Histogram:
             counts = {k: list(self._counts[k]) for k in keys}
             sums = dict(self._sums)
             totals = dict(self._totals)
+            exm = ({k: dict(v) for k, v in self._exemplars.items()}
+                   if exemplars else {})
+
+        def _ex(key, idx) -> str:
+            ex = exm.get(key, {}).get(idx)
+            if ex is None:
+                return ""
+            tid, val = ex
+            return f' # {{trace_id="{_escape_label(tid)}"}} {val:.9g}'
+
         for key in keys:
             labels = dict(key)
             acc = 0
-            for bound, c in zip(self.buckets, counts[key]):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts[key])):
                 acc += c
                 le = dict(labels, le=repr(bound))
-                yield f"{self.name}_bucket{_fmt_labels(le)} {acc}"
+                yield (f"{self.name}_bucket{_fmt_labels(le)} {acc}"
+                       f"{_ex(key, i)}")
             inf = dict(labels, le="+Inf")
-            yield f"{self.name}_bucket{_fmt_labels(inf)} {totals[key]}"
+            yield (f"{self.name}_bucket{_fmt_labels(inf)} {totals[key]}"
+                   f"{_ex(key, len(self.buckets))}")
             yield f"{self.name}_sum{_fmt_labels(labels)} {sums[key]}"
             yield f"{self.name}_count{_fmt_labels(labels)} {totals[key]}"
 
@@ -222,12 +273,12 @@ class MetricsRegistry:
                 raise TypeError(f"metric {name!r} already registered as {type(m).__name__}")
             return m
 
-    def expose_text(self) -> str:
+    def expose_text(self, exemplars: bool = False) -> str:
         with self._lock:       # snapshot the registry: a concurrent
             metrics = list(self._metrics.values())   # register() mid-scrape
         lines: list[str] = []
         for m in metrics:
-            lines.extend(m.expose())
+            lines.extend(m.expose(exemplars=exemplars))
         return "\n".join(lines) + "\n"
 
 
@@ -281,7 +332,12 @@ def replication_metrics(registry: MetricsRegistry | None = None) -> dict:
       swtpu_replication_failover_reads_total  reads served from a standby
       swtpu_replication_fireovers_total   schedule fire-over takeovers
       swtpu_replication_lag_batches       publish-to-apply lag (gauge)
-      swtpu_replication_stale_ms          standby staleness watermark (gauge)
+      swtpu_replication_stale_ms          standby staleness watermark,
+                                          labeled per LEADER rank (one
+                                          series per peer this rank
+                                          stands by for — a single
+                                          lagging follower must be
+                                          visible, not averaged away)
     """
     reg = registry or REGISTRY
     return {
@@ -302,7 +358,52 @@ def replication_metrics(registry: MetricsRegistry | None = None) -> dict:
             "replica feed publish-to-ack lag in batches"),
         "stale": reg.gauge(
             "swtpu_replication_stale_ms",
-            "standby staleness watermark in milliseconds"),
+            "standby staleness watermark in milliseconds, per leader "
+            "rank this rank follows"),
+    }
+
+
+def slo_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """The SLO latency plane (ISSUE 7): per-tenant end-to-end ingest
+    latency harvested from flight-recorder lifecycle records at SCRAPE
+    time — the ingest hot path never pays an extra device sync for it.
+    Kept OUT of engine.metrics() (dispatch-shape equality) like the
+    query and replication instruments.
+
+      swtpu_ingest_e2e_seconds   wire->state latency per tenant
+                                 (log-bucketed; slowest-decile
+                                 observations carry trace-id exemplars
+                                 resolving via /api/instance/trace/<id>)
+    """
+    reg = registry or REGISTRY
+    return {
+        "ingest_e2e": reg.histogram(
+            "swtpu_ingest_e2e_seconds",
+            "per-tenant ingest wire->state latency harvested from "
+            "flight records at scrape time",
+            buckets=E2E_LATENCY_BUCKETS),
+    }
+
+
+def cluster_metrics_instruments(registry: MetricsRegistry | None
+                                = None) -> dict:
+    """Cluster data-plane instruments (ISSUE 7):
+
+      swtpu_forward_hop_seconds    sender-observed cross-rank forward
+                                   RPC latency, labeled by destination
+                                   rank (the forwarded-hop p99 the bench
+                                   cluster leg reports)
+      swtpu_cluster_scrapes_total  federated metric scrapes served
+    """
+    reg = registry or REGISTRY
+    return {
+        "forward_hop": reg.histogram(
+            "swtpu_forward_hop_seconds",
+            "cross-rank ingest forward RPC latency (sender-observed)",
+            buckets=E2E_LATENCY_BUCKETS),
+        "scrapes": reg.counter(
+            "swtpu_cluster_scrapes_total",
+            "federated cluster metric scrapes served"),
     }
 
 
@@ -428,11 +529,171 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
             fm = feed.metrics()
             inst["lag"].set(fm.get("replica_feed_max_lag_batches", 0))
         if applier is not None:
-            am = applier.metrics()
-            inst["stale"].set(am.get("replica_max_stale_ms", 0.0))
+            # one series PER LEADER this rank stands by for (not a
+            # global max): a single lagging follower must show up on
+            # the scrape before a failover read hits it
+            written: set[tuple] = set()
+            for leader, ms in applier.stale_by_leader().items():
+                labels = {"leader": str(leader)}
+                inst["stale"].set(ms, **labels)
+                written.add(tuple(sorted(labels.items())))
+            inst["stale"].retain(written)
 
     flight = getattr(engine, "flight", None)
     if flight is not None:
         reg.gauge("swtpu_flight_records",
                   "batch lifecycle records held by the flight "
                   "recorder").set(len(flight))
+
+    # SLO latency plane (ISSUE 7): drain completed ingest lifecycles the
+    # recorder accumulated since the last scrape into the per-tenant e2e
+    # histogram — each record observed exactly once, weighted by its
+    # payload count, with a trace-id exemplar when the batch landed in
+    # the slowest decile of its tenant's series (a p99 spike on the
+    # scrape then links straight to /api/instance/trace/<id>)
+    harvest = getattr(engine, "slo_harvest", None)
+    if callable(harvest):
+        hist = slo_metrics(reg)["ingest_e2e"]
+        for rec in harvest():
+            end = rec.stages.get("device_ready")
+            if end is None:
+                continue
+            secs = max(0.0, (end - rec.t0_ns) / 1e9)
+            ex = None
+            if rec.trace_id is not None:
+                q90 = hist.quantile(0.9, tenant=rec.tenant)
+                if q90 is None or secs >= q90:
+                    ex = rec.trace_id
+            hist.observe_n(secs, max(1, int(rec.n_payloads)),
+                           exemplar=ex, tenant=rec.tenant)
+
+
+# --------------------------------------------------------------------------
+# Federated cluster exposition (ISSUE 7): every rank's registry merged
+# into ONE rank-labeled payload served from any rank.
+# --------------------------------------------------------------------------
+def _inject_rank_label(line: str, rank) -> str:
+    """Prepend ``rank="<rank>"`` to one sample line's label set without
+    reparsing the rest of the line: the existing label body may contain
+    escaped quotes and the tail may carry an OpenMetrics exemplar, both
+    of which survive verbatim. The closing-brace scan honors quoted
+    strings so a ``}`` inside a label VALUE never truncates the set."""
+    i, n = 0, len(line)
+    while i < n and line[i] not in "{ ":
+        i += 1
+    name = line[:i]
+    rl = f'rank="{_escape_label(rank)}"'
+    if i < n and line[i] == "{":
+        j, in_str, esc = i + 1, False, False
+        while j < n:
+            ch = line[j]
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = not in_str
+            elif ch == "}" and not in_str:
+                break
+            j += 1
+        if j >= n:
+            raise ValueError(f"unterminated label set: {line!r}")
+        body = line[i + 1:j]
+        sep = "," if body else ""
+        return f"{name}{{{rl}{sep}{body}}}{line[j + 1:]}"
+    return f"{name}{{{rl}}}{line[i:]}"
+
+
+def federate_expositions(parts: dict) -> str:
+    """Merge per-rank Prometheus expositions into ONE lint-clean payload:
+    every sample gains a ``rank`` label, HELP/TYPE comments are deduped
+    across ranks (first rank's text wins; a TYPE that genuinely differs
+    between ranks is a code bug and fails loudly), and families stay
+    contiguous. ``parts`` maps rank -> that rank's exposition text."""
+    families: dict[str, dict] = {}
+    order: list[str] = []
+    for rank in sorted(parts, key=str):
+        current: str | None = None
+        for line in parts[rank].splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                name = line.split(maxsplit=3)[2]
+                fam = families.get(name)
+                if fam is None:
+                    fam = families[name] = {"help": line, "type": None,
+                                            "samples": []}
+                    order.append(name)
+                current = name
+                continue
+            if line.startswith("# TYPE "):
+                p = line.split()
+                name = p[2]
+                fam = families.get(name)
+                if fam is None:
+                    fam = families[name] = {"help": f"# HELP {name} ",
+                                            "type": None, "samples": []}
+                    order.append(name)
+                if fam["type"] is None:
+                    fam["type"] = line
+                elif fam["type"] != line:
+                    raise ValueError(
+                        f"metric {name!r} exposed with conflicting types "
+                        f"across ranks: {fam['type']!r} vs {line!r}")
+                current = name
+                continue
+            if line.startswith("#"):
+                continue           # other comments don't federate
+            if current is None:
+                raise ValueError(
+                    f"rank {rank!r} sample before any HELP/TYPE: {line!r}")
+            families[current]["samples"].append(
+                _inject_rank_label(line, rank))
+    lines: list[str] = []
+    for name in order:
+        fam = families[name]
+        lines.append(fam["help"])
+        if fam["type"] is not None:
+            lines.append(fam["type"])
+        lines.extend(fam["samples"])
+    return "\n".join(lines) + "\n"
+
+
+def federated_exposition(engine) -> str:
+    """THE payload behind ``GET /api/instance/cluster/metrics`` and the
+    ``Instance.clusterMetrics`` RPC: a clustered engine fans out to every
+    rank (ClusterEngine.cluster_metrics); a single-node engine degrades
+    to its own registry under ``rank="0"`` — including the
+    ``swtpu_cluster_rank_up`` availability series, so alerts written
+    against the clustered payload hold on any topology."""
+    fn = getattr(engine, "cluster_metrics", None)
+    if fn is not None:
+        return fn()
+    export_engine_metrics(engine)
+    rank = getattr(engine, "rank", 0)
+    text = federate_expositions({rank: REGISTRY.expose_text(exemplars=True)})
+    return (text
+            + "# HELP swtpu_cluster_rank_up 1 if the rank answered the "
+              "federated scrape\n"
+              "# TYPE swtpu_cluster_rank_up gauge\n"
+            + f'swtpu_cluster_rank_up{{rank="{_escape_label(rank)}"}} 1\n')
+
+
+# an exemplar suffix as THIS module emits it: labels then a float value,
+# anchored at end of line — anchoring (rather than splitting on " # {")
+# keeps a label VALUE that happens to contain '# {' intact
+_EXEMPLAR_SUFFIX_RE = None
+
+
+def strip_exemplars(text: str) -> str:
+    """Drop OpenMetrics exemplar suffixes from an exposition — the
+    Prometheus 0.0.4 text parser rejects a trailing ``# {...}`` on a
+    sample line, so surfaces serving ``text/plain`` must shed them."""
+    global _EXEMPLAR_SUFFIX_RE
+    if _EXEMPLAR_SUFFIX_RE is None:
+        import re
+
+        _EXEMPLAR_SUFFIX_RE = re.compile(
+            r' # \{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"\} [^ ]+$')
+    return "\n".join(_EXEMPLAR_SUFFIX_RE.sub("", line)
+                     for line in text.splitlines()) + "\n"

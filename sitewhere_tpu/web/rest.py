@@ -220,6 +220,32 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_get("/api/instance/cluster/health", cluster_health)
 
+    async def cluster_metrics_text(request: web.Request):
+        """Federated metrics plane (ISSUE 7): ONE rank-labeled Prometheus
+        exposition covering every live rank. Off-loop: a clustered
+        engine fans out to peers inside cluster_metrics; single-node
+        engines degrade to their own registry under rank=\"0\".
+
+        Content negotiation: a scraper that Accepts openmetrics-text
+        gets the exemplar-bearing payload (trace-id exemplars on the
+        SLO histogram buckets) terminated with the mandatory ``# EOF``;
+        everyone else gets strict text-format 0.0.4 — the 0.0.4 parser
+        rejects exemplar suffixes, and a failed parse takes EVERY
+        rank's metrics down with it."""
+        from sitewhere_tpu.utils.metrics import (federated_exposition,
+                                                 strip_exemplars)
+
+        text = await asyncio.to_thread(federated_exposition, inst.engine)
+        accept = request.headers.get("Accept", "")
+        if "application/openmetrics-text" in accept:
+            return web.Response(
+                text=text + "# EOF\n",
+                content_type="application/openmetrics-text")
+        return web.Response(text=strip_exemplars(text),
+                            content_type="text/plain")
+
+    r.add_get("/api/instance/cluster/metrics", cluster_metrics_text)
+
     # --- flight recorder (batch-lifecycle tracing; PR 3) -----------------
     async def trace_recent(request: web.Request):
         recent = getattr(inst.engine, "recent_traces", None)

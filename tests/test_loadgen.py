@@ -110,3 +110,175 @@ def test_flush_async_drain_semantics():
     assert eng.get_device("as-9") is not None
     summaries = eng.drain()                   # nothing pending -> zero summary
     assert summaries[-1]["registered"] == 0
+
+
+# ---------------------------------------------------------------------
+# Open-loop mixed-workload harness (ISSUE 7)
+# ---------------------------------------------------------------------
+def _open_loop_imports():
+    from sitewhere_tpu.loadgen import (OpenLoopSpec, TenantLoad,
+                                       build_open_loop_schedule,
+                                       run_open_loop,
+                                       schedule_fingerprint)
+    return (OpenLoopSpec, TenantLoad, build_open_loop_schedule,
+            run_open_loop, schedule_fingerprint)
+
+
+def test_open_loop_schedule_is_byte_for_byte_deterministic():
+    """Same seed => identical payload STREAM (byte-equal) and identical
+    arrival schedule; a different seed diverges."""
+    import dataclasses
+
+    (OpenLoopSpec, TenantLoad, build, _run, fingerprint) = \
+        _open_loop_imports()
+    spec = OpenLoopSpec(
+        tenants=(TenantLoad("a", 2000.0, n_devices=8, query_every=3,
+                            mutate_every=5),
+                 TenantLoad("b", 1000.0, n_devices=8)),
+        duration_s=0.4, frame_size=32, seed=7)
+    s1, s2 = build(spec), build(spec)
+    assert fingerprint(s1) == fingerprint(s2)
+    assert len(s1) == len(s2) and len(s1) > 0
+    for a, b in zip(s1, s2):
+        assert (a.kind, a.tenant, a.t_s) == (b.kind, b.tenant, b.t_s)
+        assert a.payloads == b.payloads          # byte-for-byte
+        assert a.arrivals == b.arrivals
+        assert a.query == b.query and a.mutate == b.mutate
+    s3 = build(dataclasses.replace(spec, seed=8))
+    assert fingerprint(s3) != fingerprint(s1)
+
+
+def test_open_loop_schedule_shape():
+    """Arrival offsets are per event and monotone within a frame; query
+    and mutation ops ride the configured cadence."""
+    (OpenLoopSpec, TenantLoad, build, _run, _fp) = _open_loop_imports()
+    spec = OpenLoopSpec(
+        tenants=(TenantLoad("a", 3000.0, n_devices=4, query_every=2,
+                            mutate_every=3),),
+        duration_s=0.3, frame_size=16, seed=1)
+    sched = build(spec)
+    kinds = [op.kind for op in sched]
+    assert "query" in kinds and "mutate" in kinds
+    times = [op.t_s for op in sched]
+    assert times == sorted(times)
+    for op in sched:
+        if op.kind != "ingest":
+            continue
+        assert len(op.payloads) == len(op.arrivals) <= 16
+        assert list(op.arrivals) == sorted(op.arrivals)
+        assert op.t_s == op.arrivals[-1]   # frame departs with its last event
+    # the first mutation registers before any update of the same token
+    muts = [op.mutate for op in sched if op.kind == "mutate"]
+    first_seen = {}
+    for kind, token, _md in muts:
+        if token not in first_seen:
+            first_seen[token] = kind
+    assert all(k == "register" for k in first_seen.values())
+
+
+def test_open_loop_mixed_ops_end_to_end():
+    (OpenLoopSpec, TenantLoad, build, run, _fp) = _open_loop_imports()
+    eng = _engine()
+    # warm: the first flush pays the jit compile, which must not land in
+    # the measured run
+    run_engine_load(eng, n_batches=1, batch_size=32, n_devices=8,
+                    warmup_batches=1)
+    spec = OpenLoopSpec(
+        tenants=(TenantLoad("alpha", 2500.0, n_devices=8, query_every=3,
+                            mutate_every=4),
+                 TenantLoad("bravo", 1000.0, n_devices=8)),
+        duration_s=0.4, frame_size=32, seed=5)
+    sched = build(spec)
+    expected = sum(len(op.payloads) for op in sched if op.kind == "ingest")
+    res = run(eng, sched, checkpoint_frames=2)
+    assert res.events == expected
+    assert res.queries > 0 and res.query_p99_ms is not None
+    assert res.mutations > 0
+    for t in ("alpha", "bravo"):
+        d = res.per_tenant[t]
+        assert d["events"] > 0
+        assert d["e2e_p50_ms"] <= d["e2e_p99_ms"] <= d["e2e_p999_ms"]
+        # on-pace run: e2e (arrival-based) ~ service (submit-based)
+        assert d["e2e_p50_ms"] >= d["service_p50_ms"] - 1e-6
+    eng.flush()
+    assert eng.metrics()["persisted"] >= expected
+
+
+def test_open_loop_backlog_latency_includes_queueing_delay():
+    """THE open-loop property: when the engine is artificially slowed
+    below the offered rate, recorded wire->state latency GROWS with the
+    backlog (scheduled arrival -> visible), far beyond the per-frame
+    service time a closed-loop driver would report."""
+    import time as _time
+
+    (OpenLoopSpec, TenantLoad, build, run, _fp) = _open_loop_imports()
+
+    class SlowEngine:
+        """Every ingest stalls: service time >> scheduled inter-frame
+        gap, so arrivals pile up behind the driver."""
+
+        def __init__(self, inner, stall_s):
+            self._inner = inner
+            self._stall = stall_s
+
+        def ingest_json_batch(self, payloads, tenant="default"):
+            _time.sleep(self._stall)
+            return self._inner.ingest_json_batch(payloads, tenant)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    eng = _engine()
+    run_engine_load(eng, n_batches=1, batch_size=32, n_devices=8,
+                    warmup_batches=1)                      # warm compile
+    stall = 0.03
+    # offered: one 16-event frame every ~4ms; served: >= 30ms per frame
+    spec = OpenLoopSpec(
+        tenants=(TenantLoad("bl", 4000.0, n_devices=8),),
+        duration_s=0.25, frame_size=16, seed=11)
+    sched = build(spec)
+    res = run(SlowEngine(eng, stall), sched, checkpoint_frames=1)
+    d = res.per_tenant["bl"]
+    n_frames = sum(1 for op in sched if op.kind == "ingest")
+    assert n_frames >= 10
+    # the LAST frames waited behind the whole backlog: max e2e latency
+    # must exceed several service times, and the p99 must sit well above
+    # the single-frame stall
+    assert res.max_lateness_s > 3 * stall
+    assert d["e2e_max_ms"] > 5 * stall * 1e3
+    assert d["e2e_p99_ms"] > 2 * stall * 1e3
+
+
+def test_open_loop_slo_histogram_matches_loadgen_p99():
+    """Acceptance pin (ISSUE 7): per-tenant swtpu_ingest_e2e_seconds p99
+    computed via Histogram.quantile from the scrape-time flight-record
+    harvest matches the loadgen-measured p99 within one bucket width.
+    The comparable loadgen family is service_* (submit -> visible): the
+    flight record's clock starts at ingest entry."""
+    import bisect
+
+    from sitewhere_tpu.utils.metrics import (E2E_LATENCY_BUCKETS,
+                                             MetricsRegistry,
+                                             export_engine_metrics)
+
+    (OpenLoopSpec, TenantLoad, build, run, _fp) = _open_loop_imports()
+    eng = _engine()
+    run_engine_load(eng, n_batches=1, batch_size=64, n_devices=16,
+                    warmup_batches=1)                      # warm compile
+    spec = OpenLoopSpec(
+        tenants=(TenantLoad("slo", 4000.0, n_devices=16),),
+        duration_s=0.5, frame_size=64, seed=3)
+    sched = build(spec)
+    res = run(eng, sched, checkpoint_frames=1)
+    reg = MetricsRegistry()
+    export_engine_metrics(eng, reg)                        # harvests SLO
+    hist = reg.histogram("swtpu_ingest_e2e_seconds")
+    assert hist.count(tenant="slo") == res.per_tenant["slo"]["events"]
+    slo_p99 = hist.quantile(0.99, tenant="slo")
+    load_p99 = res.per_tenant["slo"]["service_p99_ms"] / 1e3
+    i = bisect.bisect_left(E2E_LATENCY_BUCKETS, load_p99)
+    i = min(i, len(E2E_LATENCY_BUCKETS) - 1)
+    width = E2E_LATENCY_BUCKETS[i] - (E2E_LATENCY_BUCKETS[i - 1] if i
+                                      else 0.0)
+    assert abs(slo_p99 - load_p99) <= width + 1e-9, \
+        (slo_p99, load_p99, width)

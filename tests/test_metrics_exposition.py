@@ -16,8 +16,11 @@ from sitewhere_tpu.utils.metrics import (Counter, Gauge, MetricsRegistry,
 
 _SAMPLE_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
-    r'(?P<labels>\{[^{}]*\})? (?P<value>[^ ]+)$')
+    r'(?P<labels>\{[^{}]*\})? (?P<value>[^ ]+)'
+    r'(?P<exemplar> # \{[^{}]*\} [^ ]+( [^ ]+)?)?$')
 _LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_EXEMPLAR_RE = re.compile(r'^ # (?P<labels>\{[^{}]*\}) (?P<value>[^ ]+)'
+                          r'( (?P<ts>[^ ]+))?$')
 
 
 def _parse_labels(text):
@@ -59,6 +62,15 @@ def lint_prometheus(text: str) -> None:
         m = _SAMPLE_RE.match(line)
         assert m, f"unparseable sample line: {line!r}"
         name = m.group("name")
+        if m.group("exemplar"):
+            # OpenMetrics exemplars are legal only on histogram bucket
+            # lines; labels must be well-formed and the value must parse
+            assert name.endswith("_bucket"), (
+                f"exemplar on non-bucket line: {line!r}")
+            em = _EXEMPLAR_RE.match(m.group("exemplar"))
+            assert em, f"malformed exemplar: {line!r}"
+            _parse_labels(em.group("labels"))
+            float(em.group("value"))
         base = re.sub(r"_(bucket|sum|count|total)$", "", name)
         fam = name if name in families else base
         assert fam in families, f"sample {name} has no HELP/TYPE"
@@ -195,3 +207,154 @@ def test_registry_kind_mismatch_both_directions():
         reg.counter("swtpu_kind_b")
     with pytest.raises(TypeError):
         reg.histogram("swtpu_kind_a")
+
+
+# -------------------------------------------- quantile estimator (ISSUE 7)
+def test_quantile_interpolates_within_bounding_bucket():
+    from sitewhere_tpu.utils.metrics import Histogram
+
+    h = Histogram("swtpu_q_seconds", "", buckets=(1.0, 2.0, 4.0))
+    for _ in range(100):
+        h.observe(1.5)                 # every sample in the (1, 2] bucket
+    # uniform-within-bucket rule: p50 = lo + 0.5 * width
+    assert abs(h.quantile(0.5) - 1.5) < 1e-9
+    assert h.quantile(1.0) == 2.0      # upper edge of the bounding bucket
+    # first bucket interpolates down from 0
+    h2 = Histogram("swtpu_q2_seconds", "", buckets=(1.0, 2.0))
+    for _ in range(10):
+        h2.observe(0.2)
+    assert abs(h2.quantile(0.5) - 0.5) < 1e-9
+
+
+def test_quantile_matches_numpy_percentiles_within_bucket_width():
+    """The satellite's contract: bucket-quantile vs exact numpy
+    percentiles on known distributions, within one bucket width."""
+    import bisect
+
+    import numpy as np
+
+    from sitewhere_tpu.utils.metrics import Histogram
+
+    rng = np.random.default_rng(0)
+    for dist in (rng.uniform(0.0, 1.0, 5000),
+                 rng.exponential(0.05, 5000),
+                 rng.lognormal(-4.0, 1.0, 5000)):
+        h = Histogram("swtpu_qn_seconds", "")
+        for v in dist:
+            h.observe(float(v))
+        for q in (0.5, 0.9, 0.99):
+            exact = float(np.percentile(dist, q * 100))
+            est = h.quantile(q)
+            i = bisect.bisect_left(h.buckets, exact)
+            if i >= len(h.buckets):      # beyond the last finite bucket
+                assert est == h.buckets[-1]
+                continue
+            lo = h.buckets[i - 1] if i else 0.0
+            assert abs(est - exact) <= (h.buckets[i] - lo) + 1e-12, \
+                (q, est, exact)
+
+
+def test_quantile_overflow_clamps_to_last_finite_bound():
+    from sitewhere_tpu.utils.metrics import Histogram
+
+    h = Histogram("swtpu_qo_seconds", "", buckets=(0.1, 1.0))
+    h.observe(50.0)
+    h.observe(60.0)
+    assert h.quantile(0.5) == 1.0
+    assert h.quantile(0.99) == 1.0
+    assert Histogram("swtpu_qe_seconds", "").quantile(0.5) is None
+
+
+# ------------------------------------------------- exemplars (ISSUE 7)
+def test_histogram_exemplars_only_on_request():
+    """Exemplars ride ONLY exemplar-aware expositions: the plain
+    text-format payload stays strictly Prometheus-0.0.4 parseable."""
+    from sitewhere_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    h = reg.histogram("swtpu_ex_seconds", "exemplars")
+    h.observe_n(0.2, 3, exemplar="00-abcdef-01", tenant="t")
+    plain = reg.expose_text()
+    assert "# {" not in plain
+    lint_prometheus(plain)
+    rich = reg.expose_text(exemplars=True)
+    assert '# {trace_id="00-abcdef-01"} 0.2' in rich
+    lint_prometheus(rich)
+
+
+def test_observe_n_weights_event_counts():
+    from sitewhere_tpu.utils.metrics import Histogram
+
+    h = Histogram("swtpu_w_seconds", "")
+    h.observe_n(0.003, 10, tenant="a")
+    h.observe_n(0.03, 90, tenant="a")
+    assert h.count(tenant="a") == 100
+    q = h.quantile(0.5, tenant="a")    # p50 weighted by EVENTS
+    assert 0.025 <= q <= 0.05
+
+
+# ------------------------------------- federated exposition (ISSUE 7)
+def _mk_rank_text(val: float) -> str:
+    from sitewhere_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    c = reg.counter("swtpu_fed_total", "events")
+    c.inc(val, tenant="a")
+    g = reg.gauge("swtpu_fed_depth", "queue depth")
+    g.set(val)
+    h = reg.histogram("swtpu_fed_seconds", "latency")
+    h.observe(0.01 * val)
+    return reg.expose_text()
+
+
+def test_federate_dedups_help_type_and_labels_every_sample():
+    from sitewhere_tpu.utils.metrics import federate_expositions
+
+    fed = federate_expositions({0: _mk_rank_text(1), 1: _mk_rank_text(2)})
+    lint_prometheus(fed)
+    # ONE HELP/TYPE per family across ranks
+    assert fed.count("# HELP swtpu_fed_total") == 1
+    assert fed.count("# TYPE swtpu_fed_seconds histogram") == 1
+    # every sample rank-labeled, existing labels preserved
+    assert 'swtpu_fed_total{rank="0",tenant="a"} 1.0' in fed
+    assert 'swtpu_fed_total{rank="1",tenant="a"} 2.0' in fed
+    assert 'swtpu_fed_depth{rank="0"} 1' in fed
+    assert 'swtpu_fed_depth{rank="1"} 2' in fed
+
+
+def test_federate_escapes_rank_and_survives_hostile_label_values():
+    from sitewhere_tpu.utils.metrics import (MetricsRegistry,
+                                             federate_expositions)
+
+    reg = MetricsRegistry()
+    g = reg.gauge("swtpu_fed_esc", "escaping")
+    g.set(1, tenant='a"b\\c\nd')        # hostile VALUE inside the rank text
+    fed = federate_expositions({'r"0\\x': reg.expose_text()})
+    lint_prometheus(fed)
+    assert 'rank="r\\"0\\\\x"' in fed   # hostile RANK key escaped
+    assert '\\"b' in fed and "\\\\c" in fed and "\\nd" in fed
+
+
+def test_federate_preserves_exemplars():
+    from sitewhere_tpu.utils.metrics import (MetricsRegistry,
+                                             federate_expositions)
+
+    reg = MetricsRegistry()
+    h = reg.histogram("swtpu_fed_ex_seconds", "latency")
+    h.observe_n(0.02, 1, exemplar="tid-1")
+    fed = federate_expositions({3: reg.expose_text(exemplars=True)})
+    lint_prometheus(fed)
+    assert '# {trace_id="tid-1"}' in fed
+    assert 'rank="3"' in fed
+
+
+def test_federate_cross_rank_type_conflict_is_loud():
+    from sitewhere_tpu.utils.metrics import (MetricsRegistry,
+                                             federate_expositions)
+
+    ra = MetricsRegistry()
+    ra.counter("swtpu_fed_kind", "k").inc()
+    rb = MetricsRegistry()
+    rb.gauge("swtpu_fed_kind", "k").set(1)
+    with pytest.raises(ValueError):
+        federate_expositions({0: ra.expose_text(), 1: rb.expose_text()})
