@@ -1200,6 +1200,170 @@ def main() -> None:
     log(f"mixed read/write: {mixed_rw_events_per_s:,.0f} ev/s ingested "
         f"with {mixed_read_qps:,.0f} concurrent q/s over {mixed_elapsed:.2f}s")
 
+    # ------------------------------------------------------------------
+    # Historical tier (ISSUE 8): columnar archive pushdown + batched
+    # tiered queries over a >= 10x-ring-capacity archive.
+    #  * parity: planner-driven EventArchive.query must be BYTE-identical
+    #    to query_unpruned (the retained full scan) across a filter
+    #    matrix AND at the engine's merged query_events level — smoke gate
+    #  * pruning: a selective predicate must decode strictly fewer
+    #    segments than exist (zone maps/blooms actually fire) — smoke gate
+    #  * bounded latency: historical-query p99 while ingest runs
+    #    concurrently — smoke gate (<= ARCHIVE_P99_BUDGET_MS)
+    # ------------------------------------------------------------------
+    import tempfile as _tempfile
+
+    A_RING = 4096 if smoke else 32768
+    A_BATCH = 512 if smoke else 2048
+    A_DEVS = 64
+    A_MULT = 11                       # primes archive to ~11x the ring
+    ARCHIVE_P99_BUDGET_MS = 1000.0 if smoke else 250.0
+    arch_dir = _tempfile.mkdtemp(prefix="swtpu-bench-arch-")
+    aeng = Engine(EngineConfig(
+        device_capacity=1 << 10, token_capacity=1 << 12,
+        assignment_capacity=1 << 12, store_capacity=A_RING,
+        batch_capacity=A_BATCH, channels=8,
+        archive_dir=arch_dir, archive_segment_rows=A_RING // 8))
+    _abase = int(aeng.epoch.base_unix_s * 1000)
+    A_N = A_MULT * A_RING
+    _aper = A_N // A_DEVS             # devices cluster in time -> the
+                                      # per-segment blooms/zones can prune
+
+    def _apay(i: int) -> bytes:
+        return json.dumps({
+            "deviceToken": f"ab-{min(i // _aper, A_DEVS - 1)}",
+            "type": "DeviceMeasurements",
+            "request": {"measurements": {"temp": float(i % 97)},
+                        "eventDate": _abase + 1000 + i // 2}}).encode()
+
+    t1 = time.perf_counter()
+    for lo in range(0, A_N, A_BATCH):
+        aeng.ingest_json_batch([_apay(i) for i in range(lo, lo + A_BATCH)])
+        if aeng.staged_count:
+            aeng.flush_async()
+    aeng.flush()
+    arch = aeng.archive
+    archive_rows = arch.total_rows()
+    archive_segments = len(arch.segments)
+    archive_ring_multiple = archive_rows / A_RING
+    log(f"archive leg: primed {A_N} events in "
+        f"{time.perf_counter() - t1:.1f}s -> {archive_rows} archived rows "
+        f"in {len(arch.segments)} segments "
+        f"({archive_ring_multiple:.1f}x ring, lost={arch.lost_rows})")
+
+    # (a) kernel-level parity: pushdown vs the unpruned oracle, byte-exact
+    _adevs = sorted(aeng.token_device.values())
+
+    def _rows_eq(ra, rb):
+        if len(ra) != len(rb):
+            return False
+        for x, y in zip(ra, rb):
+            if x.keys() != y.keys():
+                return False
+            for k in x:
+                if isinstance(x[k], np.ndarray) or isinstance(y[k], np.ndarray):
+                    if not np.array_equal(np.asarray(x[k]), np.asarray(y[k])):
+                        return False
+                elif x[k] != y[k]:
+                    return False
+        return True
+
+    _afilters = [
+        {"limit": 50},
+        {"limit": 5},
+        {"device": int(_adevs[7])},
+        {"device": int(_adevs[7]), "limit": 3},
+        {"since_ms": 1000, "until_ms": 1500, "limit": 100},
+        {"since_ms": 1000 + A_N // 4, "limit": 64},
+        {"device": int(_adevs[3]), "since_ms": 1200, "until_ms": 2200},
+        {"etype": int(EventType.MEASUREMENT), "limit": 20},
+        {"device": 999_999_999},
+        {"max_pos": {0: archive_rows // 3}, "limit": 40},
+        {"max_pos": {0: archive_rows // 3}, "device": int(_adevs[1])},
+    ]
+    archive_parity = True
+    for f in _afilters:
+        ta, ra = arch.query(**f)
+        tb, rb = arch.query_unpruned(**f)
+        if ta != tb or not _rows_eq(ra, rb):
+            archive_parity = False
+            log(f"archive PARITY MISMATCH for {f}: {ta} vs {tb}")
+    # ...and at the engine's merged (ring + archive) level: identical
+    # query_events output with the archive side swapped to the oracle
+    _aq = [dict(device_token="ab-7", limit=50),
+           dict(since_ms=1000, until_ms=1500, limit=100),
+           dict(limit=20)]
+    _pushed = [aeng.query_events(**q) for q in _aq]
+    arch.query = arch.query_unpruned
+    try:
+        _legacy = [aeng.query_events(**q) for q in _aq]
+    finally:
+        del arch.query               # restore the class pushdown method
+    archive_parity &= _pushed == _legacy
+    log(f"archive parity (pushdown vs unpruned full scan): {archive_parity}")
+
+    # (b) pruning actually fires: a selective device query decodes
+    # strictly fewer segments than exist (counters prove it)
+    _dec0, _pr0 = arch.plan_decoded, arch.plan_pruned
+    aeng.query_events(device_token="ab-9", limit=50)
+    archive_decoded_segments = arch.plan_decoded - _dec0
+    archive_pruned_segments = arch.plan_pruned - _pr0
+    archive_pruning_fires = (0 < archive_decoded_segments < len(arch.segments)
+                             and archive_pruned_segments > 0)
+    log(f"archive pruning: device query decoded "
+        f"{archive_decoded_segments}/{len(arch.segments)} segments "
+        f"(pruned {archive_pruned_segments}, fires={archive_pruning_fires})")
+
+    # (c) historical-query p99 stays bounded WHILE ingest runs
+    _aqs = [dict(since_ms=1000, until_ms=1500, limit=50),
+            dict(device_token="ab-7", limit=50),
+            dict(device_token="ab-7", since_ms=1200, until_ms=2200,
+                 limit=50),
+            dict(limit=20)]
+    _A_PER = 30 if smoke else 100
+    _alat: list[float] = []
+    _amu = _threading.Lock()
+
+    def _areader(w: int) -> None:
+        out = []
+        for k in range(_A_PER):
+            t2 = time.perf_counter()
+            aeng.query_events(**_aqs[(w + k) % len(_aqs)])
+            out.append((time.perf_counter() - t2) * 1e3)
+        with _amu:
+            _alat.extend(out)
+
+    _aths = [_threading.Thread(target=_areader, args=(w,)) for w in range(2)]
+    t1 = time.perf_counter()
+    for th in _aths:
+        th.start()
+    _ak = 0
+    while any(th.is_alive() for th in _aths):
+        aeng.ingest_json_batch(
+            [_apay(A_N + _ak * A_BATCH + i) for i in range(A_BATCH)])
+        if aeng.staged_count:
+            aeng.flush_async()
+        _ak += 1
+    aeng.barrier()
+    for th in _aths:
+        th.join()
+    _awall = time.perf_counter() - t1
+    _alat.sort()
+    archive_query_p99_ms = _alat[min(len(_alat) - 1,
+                                     int(0.99 * len(_alat)))]
+    archive_query_qps = len(_alat) / _awall
+    archive_prune_ratio = (arch.plan_pruned / arch.plan_considered
+                           if arch.plan_considered else 0.0)
+    log(f"archive tiered reads under ingest: {len(_alat)} historical "
+        f"queries at {archive_query_qps:,.1f} q/s, "
+        f"p50={_alat[len(_alat) // 2]:.1f}ms "
+        f"p99={archive_query_p99_ms:.1f}ms (budget "
+        f"{ARCHIVE_P99_BUDGET_MS:.0f}ms) while ingesting "
+        f"{_ak * A_BATCH} events; cumulative prune ratio "
+        f"{archive_prune_ratio:.2f}, cache hits/loads "
+        f"{arch.cache.hits}/{arch.cache.loads}, "
+        f"count shortcuts {arch.count_shortcuts}")
+
     n_load_batches = (len(runs) * N_BATCH + WARM_BATCH
                       + (1 if len(runs) > 1 else 0))
     expected = n_load_batches * SZ_BATCH
@@ -1275,6 +1439,22 @@ def main() -> None:
                 "query_batched_qps": round(batched_qps),
                 "query_sequential_qps": round(seq_qps),
                 "query_batch_parity": query_parity,
+                # historical tier (ISSUE 8): archive pushdown leg over a
+                # >= 10x-ring archive — parity/pruning/p99 are smoke
+                # gates, the rest reports (BENCH_SCHEMA.md)
+                "archive_parity": archive_parity,
+                "archive_pruning_fires": archive_pruning_fires,
+                "archive_query_p99_ms": round(archive_query_p99_ms, 1),
+                "archive_query_qps": round(archive_query_qps, 1),
+                "archive_rows": archive_rows,
+                "archive_segments": archive_segments,
+                "archive_ring_multiple": round(archive_ring_multiple, 1),
+                "archive_decoded_segments": archive_decoded_segments,
+                "archive_pruned_segments": archive_pruned_segments,
+                "archive_prune_ratio": round(archive_prune_ratio, 3),
+                "archive_cache_hits": arch.cache.hits,
+                "archive_cache_loads": arch.cache.loads,
+                "archive_count_shortcuts": arch.count_shortcuts,
                 **({"smoke": True} if smoke else {}),
                 "binary_wire_events_per_s": round(bin_eps),
                 "device_step_events_per_s": round(eps),
@@ -1344,6 +1524,24 @@ def main() -> None:
     if smoke and batched_qps < seq_qps:
         log(f"FAIL: batched query QPS {batched_qps:,.0f} < sequential "
             f"{seq_qps:,.0f} on the smoke workload")
+        sys.exit(1)
+    if smoke and not archive_parity:
+        log("FAIL: archive pushdown results diverge from the unpruned "
+            "full-scan merge")
+        sys.exit(1)
+    if smoke and not archive_pruning_fires:
+        log("FAIL: archive planner decoded every segment on a selective "
+            "predicate — zone-map/bloom pruning did not fire")
+        sys.exit(1)
+    if smoke and archive_ring_multiple < 10.0:
+        log(f"FAIL: archive leg primed only {archive_ring_multiple:.1f}x "
+            "ring capacity (< 10x)")
+        sys.exit(1)
+    if smoke and archive_query_p99_ms > ARCHIVE_P99_BUDGET_MS:
+        log(f"FAIL: historical-query p99 {archive_query_p99_ms:.1f}ms "
+            f"> {ARCHIVE_P99_BUDGET_MS:.0f}ms budget over a "
+            f"{archive_ring_multiple:.1f}x-ring archive with concurrent "
+            "ingest")
         sys.exit(1)
     if smoke and replication_failover_ok is False:
         log("FAIL: failover read did not land within the detection "

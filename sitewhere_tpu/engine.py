@@ -614,6 +614,11 @@ class EngineConfig:
                                            # segments older than this (vs
                                            # the partition's newest event)
                                            # expire
+    archive_cache_segments: int = 8    # LRU segment-decode cache depth
+                                       # shared by archive queries, by-id
+                                       # lookups, and feed replay (one
+                                       # np.load per segment per working
+                                       # set, not per call)
     scan_chunk: int = 1                # >1: dispatch K emitted batches as
                                        # ONE lax.scan program (amortizes
                                        # dispatch/transfer per chunk; adds
@@ -958,22 +963,32 @@ class QueryBatcher:
         self._metrics["latency"].observe(seconds)
         self._metrics["queries"].inc()
 
-    def run(self, params: tuple, limit: int):
+    def run(self, params: tuple, limit: int, archive: dict | None = None):
         """Submit one predicate set (``ops.query.QueryParams`` field order,
-        plain ints) at a bucketed ``limit``. Returns ``(row, cursors, q)``:
-        the query's numpy ``QueryResult`` row, the snapshot's archive
-        cursor capture (``(epoch, cursor, arena_capacity)`` or None), and
-        the micro-batch size it rode in."""
+        plain ints) at a bucketed ``limit``. ``archive`` — ``{"limit":
+        exact_page, "filters": {...}}`` — asks the round to ALSO scan the
+        retention tier for this query: the leader runs one shared
+        planning/decode pass for every archive request it coalesced (one
+        eviction-cap computation, planner tables reused, segment decodes
+        shared through the archive's LRU cache) instead of each query
+        re-scanning the disk tier behind the engine lock. Returns ``(row,
+        cursors, q, archive_result)``: the query's numpy ``QueryResult``
+        row, the snapshot's archive cursor capture (``(epoch, cursor,
+        arena_capacity)`` or None), the micro-batch size it rode in, and
+        the ``(total, rows)`` archive page (None when the tier is absent,
+        empty, or fully covered by the ring)."""
         entry = {"params": params, "limit": int(limit),
                  "event": threading.Event(), "result": None,
-                 "cursors": None, "q": 0, "error": None}
+                 "cursors": None, "q": 0, "error": None,
+                 "archive": archive, "archive_result": None}
         if self.engine.lock._is_owned():
             # a caller already INSIDE the engine lock (RLock re-entrancy
             # was always legal on this path) must not park as a follower:
             # the leader would block acquiring the lock this thread holds.
             # Run its own single-query round re-entrantly instead.
             self._execute([entry])
-            return entry["result"], entry["cursors"], entry["q"]
+            return (entry["result"], entry["cursors"], entry["q"],
+                    entry["archive_result"])
         with self._mu:
             self._queue.append(entry)
             lead = not self._running
@@ -985,7 +1000,8 @@ class QueryBatcher:
             entry["event"].wait()
         if entry["error"] is not None:
             raise entry["error"]
-        return entry["result"], entry["cursors"], entry["q"]
+        return (entry["result"], entry["cursors"], entry["q"],
+                entry["archive_result"])
 
     def _drain(self) -> None:
         """Leader loop: execute rounds until the queue is empty. The empty
@@ -1050,6 +1066,29 @@ class QueryBatcher:
                 self.max_coalesced = max(self.max_coalesced, qn)
                 self._metrics["batch"].observe(float(qn))
                 self._metrics["programs"].inc()
+        # batched tiered reads: while the fused ring programs execute on
+        # device, the leader serves every archive request of the round in
+        # ONE pass — the eviction cap is computed once from the round's
+        # shared snapshot cursors, the planner's zone-map/bloom tables are
+        # built once, and each surviving segment decodes at most once into
+        # the archive's LRU cache no matter how many queries touch it. The
+        # engine lock is held for the disk scan (archive files are mutated
+        # by _spool/compact under it), exactly like the per-query merge it
+        # replaces — but once per round instead of once per query.
+        archive_entries = [e for e in batch if e["archive"] is not None]
+        if archive_entries and eng.archive is not None and cursors is not None:
+            with eng.lock:
+                if eng.archive.segments:
+                    ep, cu, acap = cursors
+                    ep, cu = np.asarray(ep), np.asarray(cu)
+                    max_pos = {a: int(ep[a]) * acap + int(cu[a]) - acap
+                               for a in range(len(cu))}
+                    if any(v > 0 for v in max_pos.values()):
+                        for e in archive_entries:
+                            req = e["archive"]
+                            e["archive_result"] = eng.archive.query(
+                                max_pos=max_pos, limit=req["limit"],
+                                **req["filters"])
         for entries, res in launched:
             host = _fetch_query_result(res)
             for q, entry in enumerate(entries):
@@ -1208,7 +1247,8 @@ class Engine(IngestHostMixin):
                 segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
                 max_rows_per_part=c.archive_max_rows,
                 topology=single_topology(c.tenant_arenas),
-                max_age_ms=c.archive_max_age_ms)
+                max_age_ms=c.archive_max_age_ms,
+                cache_segments=c.archive_cache_segments)
             # spool whenever any arena could be halfway to overwrite; with
             # the worst case of every staged row landing in one arena this
             # keeps backlog + one batch < arena capacity
@@ -2485,8 +2525,21 @@ class Engine(IngestHostMixin):
             int(area_id) if area_id is not None else NULL_ID,
             int(customer_id) if customer_id is not None else NULL_ID,
         )
-        row, cursors, coalesced = self._query_batcher.run(
-            params, bucket_limit(limit))
+        archive_req = None
+        if self.archive is not None:
+            # predicate pushdown request for the retention tier: the
+            # batcher round scans it ONCE for every coalesced query, with
+            # the same resolved ids the device predicates use and the
+            # caller's EXACT page size (not the bucketed one)
+            archive_req = {"limit": limit, "filters": dict(
+                device=dev if device_token is not None else None,
+                etype=int(etype) if etype is not None else None,
+                tenant=ten if tenant is not None else None,
+                since_ms=since_ms, until_ms=until_ms,
+                assignment=assignment_id, aux0=aux0, aux1=aux1,
+                area=area_id, customer=customer_id)}
+        row, cursors, coalesced, archive_res = self._query_batcher.run(
+            params, bucket_limit(limit), archive=archive_req)
         rec.mark("device")
         rec.add("coalesced", coalesced)
         # every result column is already ONE host numpy array (the
@@ -2503,20 +2556,14 @@ class Engine(IngestHostMixin):
             for i in range(n)
         ]
         rec.mark("format")
-        if self.archive is not None and self.archive.segments:
-            # two-tier merge: archive files are mutated by _spool/compact
-            # under the engine lock, so the disk scan re-takes it; the
-            # eviction cap comes from the SNAPSHOT's cursors, keeping the
-            # tiers non-overlapping even if the ring advanced meanwhile
-            with self.lock:
-                total, events = self._merge_archive(
-                    total, events, limit, cursors=cursors,
-                    device=dev if device_token is not None else None,
-                    etype=int(etype) if etype is not None else None,
-                    tenant=ten if tenant is not None else None,
-                    since_ms=since_ms, until_ms=until_ms,
-                    assignment=assignment_id, aux0=aux0, aux1=aux1,
-                    area=area_id, customer=customer_id)
+        if archive_res is not None:
+            # two-tier merge from the round's shared archive pass: the
+            # disk scan already ran inside the batcher round (capped by
+            # the SAME snapshot cursors the ring scan saw, so the tiers
+            # never overlap); formatting the pre-fetched rows needs no
+            # lock, like the ring-side formatting above
+            total, events = self._merge_archive(total, events, limit,
+                                                archive_res)
             rec.mark("archive")
         self._query_batcher.observe_latency(time.perf_counter() - t_q0)
         return {"total": total, "events": events}
@@ -2574,32 +2621,18 @@ class Engine(IngestHostMixin):
         return ev
 
     def _merge_archive(self, total: int, events: list[dict], limit: int,
-                       cursors=None, **filters) -> tuple[int, list[dict]]:
-        """Fold archived history into a ring query result. The archive scan
-        is capped at rows already EVICTED from each arena (absolute pos <
-        head - capacity) so the two tiers never overlap; the reference's
-        unbounded date-range search (InfluxDbDeviceEventManagement.java:
-        63-161) falls out of ring + archive union. Caller holds the lock.
-        ``cursors`` — the ``(epoch, cursor, arena_capacity)`` capture the
-        query batcher took with its store snapshot — pins the eviction cap
-        to the SAME store version the ring scan saw; without it the cap
-        reads the live store (the pre-snapshot behavior)."""
-        from sitewhere_tpu.ops.readback import arena_cursor
-
-        if cursors is not None:
-            ep, cu, acap = cursors
-            ep, cu = np.asarray(ep), np.asarray(cu)
-            max_pos = {a: int(ep[a]) * acap + int(cu[a]) - acap
-                       for a in range(len(cu))}
-        else:
-            store = self.state.store
-            acap = store.arena_capacity
-            max_pos = {a: arena_cursor(store, a) - acap
-                       for a in range(store.arenas)}
-        if all(v <= 0 for v in max_pos.values()):
-            return total, events
-        a_total, rows = self.archive.query(max_pos=max_pos, limit=limit,
-                                           **filters)
+                       archive_res: tuple[int, list[dict]],
+                       ) -> tuple[int, list[dict]]:
+        """Fold archived history into a ring query result. The archive
+        scan itself ran inside the batcher round (pushdown + shared
+        decode, capped at rows already EVICTED from each arena — absolute
+        pos < head - capacity at the round's snapshot — so the two tiers
+        never overlap); this merge only formats the pre-fetched rows and
+        interleaves them newest-first, byte-identical to the pre-pushdown
+        per-query scan. The reference's unbounded date-range search
+        (InfluxDbDeviceEventManagement.java:63-161) falls out of ring +
+        archive union."""
+        a_total, rows = archive_res
         if not a_total:
             return total, events
         lane_names = self._lane_names()

@@ -163,6 +163,12 @@ class TenantLoad:
     device_prefix: str | None = None   # default "<tenant>-dev"
     query_every: int = 0               # one query per N ingest frames
     mutate_every: int = 0              # one entity mutation per N frames
+    history_every: int = 0             # one HISTORICAL query per N frames:
+                                       # a date range ending history_age_ms
+                                       # in the past, so an archive-primed
+                                       # engine serves it from the tiered
+                                       # (ring + disk) read path
+    history_age_ms: int = 60_000       # how far behind "now" the range ends
 
 
 @dataclasses.dataclass(frozen=True)
@@ -244,6 +250,18 @@ def build_open_loop_schedule(spec: OpenLoopSpec) -> list[ScheduledOp]:
                     q = {"since_ms": 0, "limit": 20}
                 ops.append(ScheduledOp(t_s=frame_t, kind="query",
                                        tenant=tl.tenant, query=q))
+            if tl.history_every and n_frames % tl.history_every == 0:
+                # deterministic MARKER, not a concrete range: the schedule
+                # is a pure function of the spec (no wall clock), so the
+                # driver resolves the range against the engine's epoch at
+                # fire time — "everything up to history_age_ms ago", which
+                # on an archive-primed engine lands beyond the ring
+                hv = (n_frames // tl.history_every) % 2
+                q = {"history_age_ms": tl.history_age_ms, "limit": 20}
+                if hv == 1:
+                    q["device_token"] = f"{prefix}-{int(picks[lo])}"
+                ops.append(ScheduledOp(t_s=frame_t, kind="query",
+                                       tenant=tl.tenant, query=q))
             if tl.mutate_every and n_frames % tl.mutate_every == 0:
                 j = n_frames // tl.mutate_every
                 token = f"{prefix}-m{j % 8}"
@@ -307,6 +325,8 @@ class OpenLoopResult:
     offered_eps: float
     queries: int
     query_p99_ms: float | None
+    history_queries: int
+    history_p99_ms: float | None
     mutations: int
     max_lateness_s: float
     per_tenant: dict
@@ -328,6 +348,8 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
     pending: list[tuple[str, list[float], float]] = []
     per: dict[str, tuple[list, list]] = {}
     qlat: list[float] = []
+    hlat: list[float] = []
+    epoch = getattr(engine, "epoch", None)
     mutations = 0
     max_late = 0.0
     frames = 0
@@ -365,9 +387,21 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
             if frames >= checkpoint_frames:
                 checkpoint()
         elif op.kind == "query":
+            q = dict(op.query)
+            age = q.pop("history_age_ms", None)
+            if age is not None:
+                # resolve the historical marker at fire time: a range from
+                # the beginning of history (unbounded start — backfilled
+                # events can sit at negative epoch-relative ms) to ``age``
+                # before now — older than the ring on any archive-primed
+                # run, so the tiered read path serves it
+                now_rel = (int(epoch.now_ms()) if epoch is not None
+                           else 0)
+                q["until_ms"] = now_rel - int(age)
             t1 = time.perf_counter()
-            engine.query_events(**op.query)
-            qlat.append((time.perf_counter() - t1) * 1e3)
+            engine.query_events(**q)
+            (hlat if age is not None
+             else qlat).append((time.perf_counter() - t1) * 1e3)
         else:
             kind, token, md = op.mutate
             if kind == "register":
@@ -389,11 +423,13 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
             **{f"service_{k}": v for k, v in _pcts(svc).items()},
         }
     qp = _pcts(qlat)
+    hp = _pcts(hlat)
     return OpenLoopResult(
         wall_s=round(wall, 3), events=events,
         events_per_s=round(events / wall, 1) if wall else 0.0,
         offered_eps=round(events / horizon, 1) if horizon else 0.0,
         queries=len(qlat), query_p99_ms=qp["p99_ms"],
+        history_queries=len(hlat), history_p99_ms=hp["p99_ms"],
         mutations=mutations, max_lateness_s=round(max_late, 4),
         per_tenant=per_tenant)
 

@@ -21,6 +21,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from sitewhere_tpu.core.store import EventStore
 from sitewhere_tpu.core.types import NULL_ID
@@ -68,6 +69,44 @@ def bucket_limit(limit: int) -> int:
     compile cache at one program per bucket instead of one per distinct
     ``pageSize`` (callers slice the result back to the exact page)."""
     return 1 << max(0, int(limit) - 1).bit_length()
+
+
+def host_filter_mask(cols: dict, *, device=None, etype=None, tenant=None,
+                     assignment=None, aux0=None, aux1=None, area=None,
+                     customer=None, since_ms=None,
+                     until_ms=None) -> np.ndarray:
+    """Host-side (numpy) evaluation of ONE query predicate set over a
+    columnar row block — the archive-tier mirror of the masks
+    :func:`query_store` builds on device, kept here so the two tiers'
+    predicate semantics can never drift apart. ``cols`` maps ring column
+    names to arrays (``aux`` is the 2-d lane column); ``None`` = any,
+    matching the NULL_ID convention of :class:`QueryParams`. Validity and
+    eviction caps are the CALLER's concern — this is only the predicate
+    conjunction."""
+    n = len(cols["ts_ms"])
+    m = np.ones(n, bool)
+    if device is not None:
+        m &= cols["device"] == device
+    if etype is not None:
+        m &= cols["etype"] == etype
+    if tenant is not None:
+        m &= cols["tenant"] == tenant
+    if assignment is not None:
+        m &= cols["assignment"] == assignment
+    if aux0 is not None:
+        m &= cols["aux"][:, 0] == aux0
+    if aux1 is not None:
+        m &= cols["aux"][:, 1] == aux1
+    if area is not None:
+        m &= cols["area"] == area
+    if customer is not None:
+        m &= cols["customer"] == customer
+    ts = cols["ts_ms"]
+    if since_ms is not None:
+        m &= ts >= since_ms
+    if until_ms is not None:
+        m &= ts <= until_ms
+    return m
 
 
 MAX_PAGE_SIZE = 1000

@@ -86,6 +86,7 @@ class DistributedConfig:
     archive_segment_rows: int = 4096
     archive_max_rows: int | None = None  # per-(shard,arena) retention cap
     archive_max_age_ms: int | None = None  # event-time retention horizon
+    archive_cache_segments: int = 8    # LRU segment-decode cache depth
     flight_recorder: bool = True       # batch-lifecycle flight recorder
     flight_capacity: int = 1024        # lifecycle records retained
 
@@ -399,7 +400,8 @@ class DistributedEngine(IngestHostMixin):
                 segment_rows=max(1, min(c.archive_segment_rows, acap // 4)),
                 max_rows_per_part=c.archive_max_rows,
                 topology=mesh_topology(self.n_shards, arenas),
-                max_age_ms=c.archive_max_age_ms)
+                max_age_ms=c.archive_max_age_ms,
+                cache_segments=c.archive_cache_segments)
             self._spool_trigger = max(self.archive.segment_rows,
                                       acap // 2 - c.batch_capacity_per_shard)
 

@@ -17,23 +17,120 @@ Design (TPU-first):
   partition is the ring's write order, so a partition's segments tile
   absolute positions [0, spilled) contiguously.
 - Segment files are columnar ``.npz`` (structure-of-arrays, like the ring
-  itself); queries prune whole segments by their [ts_min, ts_max] interval
-  before touching rows — the archive analog of time-series index pruning.
+  itself). Every segment carries STATISTICS written at append time —
+  per-column zone maps (min/max over valid rows for the time + id
+  columns) and compact tenant/device/assignment bloom filters — persisted
+  in the manifest and mirrored as small members inside the ``.npz``
+  itself, so index rebuilds never decompress full columns and queries
+  prune whole segments before touching rows (the archive analog of a
+  time-series store's shard index + SSTable bloom filters).
+- Queries PUSH DOWN: a :class:`SegmentPlanner` evaluates each predicate
+  set against the zone maps + blooms and hands back only surviving
+  segments newest-first; decoding stops early once the result page is
+  provably complete, and only the columns the query touches are
+  materialized. Results stay byte-identical to the full scan
+  (:meth:`EventArchive.query_unpruned` keeps the unpruned reference
+  implementation as the parity oracle).
 - Crash safety: segments are written to a temp name and renamed; the
-  manifest is rebuilt from the segment files when missing or stale.
+  manifest is rebuilt from the segment files when missing or stale; a
+  truncated/corrupt segment file is QUARANTINED (renamed ``*.corrupt``)
+  instead of aborting recovery — at index rebuild for files the
+  manifest missed, and at first decode for files an intact manifest
+  vouched for (rot behind the stats fast path), so one bad file never
+  takes the read path down either way.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import pathlib
+import zipfile
 
 import numpy as np
 
 _COLUMNS = ("etype", "device", "assignment", "tenant", "area", "customer",
             "asset", "ts_ms", "received_ms", "values", "vmask", "aux",
             "valid")
+
+# columns with zone maps (min/max over VALID rows). ``aux0``/``aux1`` are
+# the two lanes of the 2-d ``aux`` column (the invocation/alternate-id
+# lanes the query surface filters on).
+_ZONE_COLUMNS = ("ts_ms", "received_ms", "etype", "device", "assignment",
+                 "tenant", "area", "customer")
+# columns that additionally carry a bloom filter: the high-cardinality id
+# lanes where a min/max interval is too loose to prune (a segment touching
+# devices {3, 9000} has a zone map spanning every device in between)
+_BLOOM_COLUMNS = ("tenant", "device", "assignment")
+_BLOOM_BITS = 1024                     # 128 bytes per column per segment
+_BLOOM_WORDS = _BLOOM_BITS // 64
+# everything stats computation needs (all predicate columns + validity) —
+# deliberately NOT the payload columns (values/vmask), so a lazy backfill
+# never decompresses the wide float lanes
+_STATS_COLUMNS = ("valid", "ts_ms", "received_ms", "etype", "device",
+                  "assignment", "tenant", "area", "customer", "aux")
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer (vectorized) — the bloom hash kernel."""
+    with np.errstate(over="ignore"):
+        x = x + np.uint64(0x9E3779B97F4A7C15)
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        return x ^ (x >> np.uint64(31))
+
+
+_BLOOM_SALTS = (np.uint64(0x51_7C_C1_B7_27_22_0A_95),
+                np.uint64(0x2545F4914F6CDD1D))
+
+
+def _bloom_build(vals: np.ndarray) -> np.ndarray:
+    """k=2 bloom bitset (uint64[_BLOOM_WORDS]) over integer column values.
+    No false negatives by construction — the planner may only ever prune a
+    segment the value provably never touched."""
+    bits = np.zeros(_BLOOM_WORDS, np.uint64)
+    if vals.size:
+        v = vals.astype(np.int64).astype(np.uint64)
+        for salt in _BLOOM_SALTS:
+            h = _mix64(v ^ salt) % np.uint64(_BLOOM_BITS)
+            np.bitwise_or.at(bits, (h >> np.uint64(6)).astype(np.int64),
+                             np.uint64(1) << (h & np.uint64(63)))
+    return bits
+
+
+def _bloom_positions(value: int) -> list[tuple[int, np.uint64]]:
+    """(word index, bit mask) pairs a value sets — shared by the scalar
+    membership test and the planner's vectorized matrix test."""
+    v = np.uint64(np.int64(value))
+    out = []
+    for salt in _BLOOM_SALTS:
+        h = int(_mix64(np.asarray([v ^ salt], np.uint64))[0]) % _BLOOM_BITS
+        out.append((h >> 6, np.uint64(1) << np.uint64(h & 63)))
+    return out
+
+
+def _compute_stats(cols: dict) -> dict:
+    """Per-segment statistics over the VALID rows: zone maps for the
+    time/id columns, blooms for the high-cardinality ids, and the valid
+    row count (lets a provably-full-match segment contribute its total
+    without being decoded at all). JSON-serializable (manifest round
+    trip); blooms are hex-encoded little-endian uint64 words."""
+    valid = np.asarray(cols["valid"], bool)
+    idx = np.nonzero(valid)[0]
+    st: dict = {"rows": int(idx.size), "z": {}, "bloom": {}}
+    if not idx.size:
+        return st
+    for c in _ZONE_COLUMNS:
+        v = np.asarray(cols[c])[idx]
+        st["z"][c] = [int(v.min()), int(v.max())]
+    aux = np.asarray(cols["aux"])[idx]
+    st["z"]["aux0"] = [int(aux[:, 0].min()), int(aux[:, 0].max())]
+    st["z"]["aux1"] = [int(aux[:, 1].min()), int(aux[:, 1].max())]
+    for c in _BLOOM_COLUMNS:
+        st["bloom"][c] = _bloom_build(
+            np.asarray(cols[c])[idx]).tobytes().hex()
+    return st
 
 
 def mesh_topology(n_shards: int, arenas: int) -> str:
@@ -56,9 +153,214 @@ class _Segment:
     ts_min: int
     ts_max: int
     path: str
+    stats: dict | None = None   # zone maps + blooms + valid-row count;
+                                # None on manifests written before the
+                                # pushdown tier (back-filled lazily)
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
+
+
+class SegmentCache:
+    """Bounded LRU of decoded segment columns, keyed by segment path.
+
+    Columns load LAZILY: predicate evaluation pulls only the columns the
+    query touches (npz members decompress individually) and the row
+    materialization that follows reuses the same entry. Shared by the
+    planner-driven query path, by-id lookups (``get_row``), chunked replay
+    (``read_rows``), and compaction, so none of them re-``np.load`` a file
+    another caller just decoded. Entries die with their segment (expiry,
+    compaction, retire, quarantine) via :meth:`retain`."""
+
+    def __init__(self, max_segments: int = 8):
+        self.max_segments = max(1, int(max_segments))
+        self._entries: "collections.OrderedDict[str, dict]" = \
+            collections.OrderedDict()
+        self.hits = 0      # calls fully served from cache
+        self.loads = 0     # np.load file opens (misses, counted per open)
+
+    def columns(self, directory: pathlib.Path, path: str,
+                names: tuple) -> dict:
+        entry = self._entries.get(path)
+        if entry is not None:
+            self._entries.move_to_end(path)
+            missing = [c for c in names if c not in entry]
+            if not missing:
+                self.hits += 1
+                return entry
+        else:
+            missing = list(names)
+        with np.load(directory / path) as z:
+            fresh = {c: np.asarray(z[c]) for c in missing}
+        self.loads += 1
+        if entry is None:
+            entry = self._entries[path] = {}
+            self._entries.move_to_end(path)
+            while len(self._entries) > self.max_segments:
+                self._entries.popitem(last=False)
+        entry.update(fresh)
+        return entry
+
+    def retain(self, live_paths: set) -> None:
+        for p in list(self._entries):
+            if p not in live_paths:
+                del self._entries[p]
+
+
+class SegmentPlanner:
+    """Zone-map + bloom pruning over an archive's segment index.
+
+    The planner keeps VECTORIZED per-column tables (one numpy row per
+    segment, rebuilt only when the index generation changes), so Q
+    concurrent queries in a batcher round share one planning pass: each
+    predicate set reduces to a handful of numpy comparisons over the
+    whole index instead of a per-segment Python loop. For every query it
+    returns the surviving segments NEWEST-FIRST (by their valid-rows
+    ts upper bound) together with a provably-full-match flag: a segment
+    whose zone maps prove that EVERY valid row matches (and whose
+    eviction cap covers it) can contribute its stored row count without
+    being decoded at all once the result page is closed.
+
+    Pruning is exact, never lossy: zone maps bound the valid rows, blooms
+    have no false negatives, and a surviving segment still evaluates the
+    full row-level mask — a bloom false positive costs one decode, never
+    a wrong row."""
+
+    _BIG = np.int64(2**62)
+
+    def __init__(self, archive: "EventArchive"):
+        self.archive = archive
+        self._gen = -1
+
+    # ---------------------------------------------------------- tables
+    def _refresh(self) -> None:
+        arch = self.archive
+        if self._gen == arch._generation:
+            return
+        # lazy back-fill: segments adopted from a pre-pushdown manifest
+        # carry no stats; compute them once (predicate columns only) and
+        # persist, so the cost is paid on first plan, not every plan
+        dirty = False
+        # snapshot: back-fill can QUARANTINE an unreadable segment,
+        # which removes it from arch.segments mid-walk
+        for s in list(arch.segments):
+            if s.stats is None:
+                arch._ensure_stats(s)
+                dirty = True
+        if dirty:
+            arch._save_index()
+        segs = arch.segments           # (part, start)-sorted == scan order
+        n = len(segs)
+        self._segs = list(segs)
+        self._part = np.fromiter((s.part for s in segs), np.int64, n)
+        self._start = np.fromiter((s.start for s in segs), np.int64, n)
+        self._count = np.fromiter((s.count for s in segs), np.int64, n)
+        self._rows = np.fromiter(
+            ((s.stats or {}).get("rows", -1) for s in segs), np.int64, n)
+        known = self._rows >= 0
+        self._known = known
+        self._z = {}
+        for c in _ZONE_COLUMNS + ("aux0", "aux1"):
+            zmin = np.full(n, -self._BIG)
+            zmax = np.full(n, self._BIG)
+            for i, s in enumerate(segs):
+                z = (s.stats or {}).get("z", {}).get(c)
+                if z is not None:
+                    zmin[i], zmax[i] = z
+                elif known[i]:
+                    # known stats with no zone entry = zero valid rows:
+                    # an empty interval fails every predicate
+                    zmin[i], zmax[i] = self._BIG, -self._BIG
+            self._z[c] = (zmin, zmax)
+        # newest-first bound on VALID rows' event time; unknown-stats
+        # segments fall back to the all-rows bound (still an upper bound)
+        zts_min, zts_max = self._z["ts_ms"]
+        all_hi = np.fromiter((s.ts_max for s in segs), np.int64, n)
+        all_lo = np.fromiter((s.ts_min for s in segs), np.int64, n)
+        self._ts_hi = np.where(known, np.minimum(zts_max, all_hi), all_hi)
+        self._ts_lo = np.where(known & (self._rows > 0),
+                               np.maximum(zts_min, all_lo), all_lo)
+        self._bloom = {}
+        for c in _BLOOM_COLUMNS:
+            mat = np.full((n, _BLOOM_WORDS), np.uint64(0xFFFFFFFFFFFFFFFF),
+                          np.uint64)     # unknown = all bits = never prunes
+            for i, s in enumerate(segs):
+                h = (s.stats or {}).get("bloom", {}).get(c)
+                if h is not None:
+                    mat[i] = np.frombuffer(bytes.fromhex(h), np.uint64)
+                elif known[i]:
+                    mat[i] = 0           # zero valid rows: nothing matches
+            self._bloom[c] = mat
+        self._gen = arch._generation
+
+    # ------------------------------------------------------------ plan
+    def plan(self, *, max_pos=None, device=None, etype=None, tenant=None,
+             assignment=None, aux0=None, aux1=None, area=None,
+             customer=None, since_ms=None, until_ms=None,
+             device_parts=None, assignment_parts=None):
+        """One predicate set -> ``(rows, considered)`` where ``rows`` is a
+        newest-first list of ``(scan_order, segment, full_match, ts_hi,
+        cap_covers)`` tuples and ``considered`` counts the segments the
+        eviction cap admitted (what an unpruned scan would have opened)."""
+        self._refresh()
+        n = len(self._segs)
+        if not n:
+            return [], 0
+        if max_pos is not None:
+            caps = np.fromiter((max_pos.get(int(p), 0) for p in self._part),
+                               np.int64, n)
+            eligible = self._start < caps
+            cap_covers = caps >= self._start + self._count
+        else:
+            eligible = np.ones(n, bool)
+            cap_covers = np.ones(n, bool)
+        considered = int(eligible.sum())
+        alive = eligible.copy()
+        # a known-empty segment (zero valid rows) contributes nothing
+        alive &= ~self._known | (self._rows > 0)
+        full = alive & self._known & (self._rows > 0) & cap_covers
+
+        def eq(col: str, v) -> None:
+            nonlocal alive, full
+            if v is None:
+                return
+            v = int(v)
+            zmin, zmax = self._z[col]
+            alive &= (zmin <= v) & (v <= zmax)
+            full &= (zmin == v) & (zmax == v)
+            mat = self._bloom.get(col)
+            if mat is not None:
+                hit = np.ones(n, bool)
+                for w, mask in _bloom_positions(v):
+                    hit &= (mat[:, w] & mask) != 0
+                alive &= hit
+
+        eq("device", device)
+        eq("etype", etype)
+        eq("tenant", tenant)
+        eq("assignment", assignment)
+        eq("aux0", aux0)
+        eq("aux1", aux1)
+        eq("area", area)
+        eq("customer", customer)
+        if since_ms is not None:
+            alive &= self._ts_hi >= int(since_ms)
+            full &= self._ts_lo >= int(since_ms)
+        if until_ms is not None:
+            alive &= self._ts_lo <= int(until_ms)
+            full &= self._ts_hi <= int(until_ms)
+        # shard-scoped id namespaces (mesh): a filter bound to one shard's
+        # partitions contributes zero rows everywhere else
+        if device is not None and device_parts is not None:
+            alive &= np.isin(self._part, list(device_parts))
+        if assignment is not None and assignment_parts is not None:
+            alive &= np.isin(self._part, list(assignment_parts))
+        order = np.nonzero(alive)[0]
+        if order.size:
+            order = order[np.lexsort((order, -self._ts_hi[order]))]
+        return ([(int(i), self._segs[i], bool(full[i]),
+                  int(self._ts_hi[i]), bool(cap_covers[i]))
+                 for i in order], considered)
 
 
 class EventArchive:
@@ -72,7 +374,8 @@ class EventArchive:
     def __init__(self, directory: str | pathlib.Path, segment_rows: int = 4096,
                  max_rows_per_part: int | None = None,
                  topology: str | None = None,
-                 max_age_ms: int | None = None):
+                 max_age_ms: int | None = None,
+                 cache_segments: int = 8):
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.segment_rows = int(segment_rows)
@@ -100,11 +403,12 @@ class EventArchive:
         self.expired_rows = 0
         self.segments: list[_Segment] = []
         self.lost_rows = 0   # rows overwritten before they could spill
-        # per-partition segments sorted by start (bisect lookups) + a
-        # one-segment row cache: replay reads a segment in max_batch
-        # chunks and must not re-extract the npz per chunk
+        # per-partition segments sorted by start (bisect lookups) + the
+        # LRU segment-decode cache shared by queries, by-id lookups and
+        # chunked replay (one decode per segment per working set, not per
+        # call)
         self._by_part: dict[int, list[_Segment]] = {}
-        self._row_cache: tuple[str, dict] | None = None
+        self.cache = SegmentCache(max_segments=cache_segments)
         # monotone spill watermark per partition, independent of segment
         # PRESENCE: retention may expire the tail segment (backfilled event
         # times), and a watermark derived from surviving segments would
@@ -115,6 +419,17 @@ class EventArchive:
         # migration pads history up to an arena boundary) — replay must
         # not count them as lost rows
         self._gaps: dict[int, list[list[int]]] = {}
+        # pushdown accounting (exported as swtpu_archive_* gauges at
+        # scrape time; the bench's pruning proof reads them directly)
+        self.queries = 0            # pushdown query() calls
+        self.plan_considered = 0    # segments the eviction cap admitted
+        self.plan_pruned = 0        # ...of which zone maps/blooms pruned
+        self.plan_decoded = 0       # unique segments decoded per query
+        self.count_shortcuts = 0    # full-match segments counted w/o decode
+        self.corrupt_segments = 0   # files quarantined (rebuild or decode)
+        self._generation = 0        # bumped on every index mutation; the
+                                    # planner rebuilds its tables on change
+        self._planner = SegmentPlanner(self)
         self._load_index()
 
     # ------------------------------------------------------------- index
@@ -144,35 +459,120 @@ class EventArchive:
         # adopt any segment file the manifest missed (crash between the
         # segment rename and the manifest rewrite) — but NEVER a file whose
         # own topology stamp disagrees (a manifest-less dir must not smuggle
-        # old-topology partition indices past the retire check)
+        # old-topology partition indices past the retire check). A file
+        # that cannot be read at all (truncated by a crash, bit rot) is
+        # QUARANTINED — renamed aside and counted — so one bad segment
+        # never takes the rest of the archive down with it.
         for f in sorted(self.dir.glob("seg-*.npz")):
             if f.name in known:
                 self.segments.append(known[f.name])
                 continue
-            with np.load(f) as z:
-                # an archive opened with topology=None stamps np.str_("");
-                # treat that like a missing stamp (same semantics as a
-                # null manifest stamp) so such segments are adopted, not
-                # retired, by a later topology-aware open
-                seg_topo = (str(z["topology"]) if "topology" in z.files
-                            else "") or None
-                if (self.topology is not None and seg_topo is not None
-                        and seg_topo != self.topology):
-                    pass  # retired below, outside the np.load handle
-                else:
-                    seg_topo = None
-                    ts = z["ts_ms"]
-                    self.segments.append(_Segment(
-                        part=int(z["part"]), start=int(z["start"]),
-                        count=int(ts.shape[0]),
-                        ts_min=int(ts.min()) if ts.size else 0,
-                        ts_max=int(ts.max()) if ts.size else 0,
-                        path=f.name))
+            try:
+                with np.load(f) as z:
+                    # an archive opened with topology=None stamps
+                    # np.str_(""); treat that like a missing stamp (same
+                    # semantics as a null manifest stamp) so such segments
+                    # are adopted, not retired, by a topology-aware open
+                    seg_topo = (str(z["topology"]) if "topology" in z.files
+                                else "") or None
+                    if (self.topology is not None and seg_topo is not None
+                            and seg_topo != self.topology):
+                        pass  # retired below, outside the np.load handle
+                    else:
+                        seg_topo = None
+                        if "seg_nrows" in z.files:
+                            # stats members written at append time: the
+                            # rebuild touches only scalars + the compact
+                            # stats blob, never a full column
+                            count = int(z["seg_nrows"])
+                            ts_min = int(z["seg_ts_min"])
+                            ts_max = int(z["seg_ts_max"])
+                            stats = json.loads(str(z["stats_json"]))
+                        else:
+                            # pre-pushdown file: full-column fallback and
+                            # the lazy stats back-fill in one read
+                            ts = z["ts_ms"]
+                            count = int(ts.shape[0])
+                            ts_min = int(ts.min()) if ts.size else 0
+                            ts_max = int(ts.max()) if ts.size else 0
+                            stats = _compute_stats(
+                                {c: np.asarray(z[c])
+                                 for c in _STATS_COLUMNS})
+                        self.segments.append(_Segment(
+                            part=int(z["part"]), start=int(z["start"]),
+                            count=count, ts_min=ts_min, ts_max=ts_max,
+                            path=f.name, stats=stats))
+            except (OSError, ValueError, KeyError, EOFError,
+                    zipfile.BadZipFile) as err:
+                self._quarantine(f, err)
+                continue
             if seg_topo is not None:
                 self._retire(seg_topo, files=[f])
         self.segments.sort(key=lambda s: (s.part, s.start))
         self._drop_covered()
         self._reindex()
+
+    def _quarantine(self, f: pathlib.Path, err: Exception) -> None:
+        """Move an unreadable segment file aside (``<name>.corrupt`` —
+        outside the ``seg-*.npz`` recovery glob) so the rest of the
+        archive keeps serving; the loss is counted and logged LOUDLY, and
+        the file is preserved for offline forensics."""
+        import logging
+
+        target = f.with_name(f.name + ".corrupt")
+        n = 0
+        while target.exists():
+            n += 1
+            target = f.with_name(f"{f.name}.corrupt{n}")
+        f.rename(target)
+        self.corrupt_segments += 1
+        logging.getLogger(__name__).warning(
+            "archive: QUARANTINED corrupt segment %s -> %s (%s: %s); "
+            "its rows are unavailable until repaired, the rest of the "
+            "archive keeps serving", f.name, target.name,
+            type(err).__name__, err)
+
+    def _drop_corrupt(self, seg: "_Segment", err: Exception) -> None:
+        """Quarantine a segment that failed to DECODE after adoption — a
+        manifest-listed file is trusted at :meth:`_load_index` without
+        being opened (that's the point of the stats fast path), so
+        truncation/bit rot behind an intact manifest only surfaces at
+        first decode. The file moves aside, the segment leaves the index
+        (generation bump makes planners rebuild), and the caller serves
+        on without its rows instead of failing every query that plans
+        over it."""
+        try:
+            self.segments.remove(seg)
+        except ValueError:
+            return   # already dropped (repeated failure on a stale ref)
+        f = self.dir / seg.path
+        if f.exists():
+            self._quarantine(f, err)
+        else:
+            self.corrupt_segments += 1   # vanished from under us: still
+                                         # counted, nothing to rename
+        self._reindex()
+        self._save_index()
+
+    def _cols_or_drop(self, seg: "_Segment", names: tuple) -> dict | None:
+        """Decode ``names`` columns of ``seg`` via the shared cache;
+        an unreadable file is quarantined (:meth:`_drop_corrupt`) and
+        ``None`` returned so one rotten segment never takes the whole
+        read path down."""
+        try:
+            return self.cache.columns(self.dir, seg.path, names)
+        except (OSError, ValueError, KeyError, EOFError,
+                zipfile.BadZipFile) as err:
+            self._drop_corrupt(seg, err)
+            return None
+
+    def _ensure_stats(self, seg: _Segment) -> None:
+        """Back-fill zone maps + blooms for a segment adopted from a
+        pre-pushdown manifest (predicate columns only, via the shared
+        decode cache). An unreadable segment quarantines instead."""
+        cols = self._cols_or_drop(seg, _STATS_COLUMNS)
+        if cols is not None:
+            seg.stats = _compute_stats(cols)
 
     def _drop_covered(self) -> None:
         """Delete segment files whose row range is fully covered by a
@@ -197,6 +597,10 @@ class EventArchive:
             self._by_part.setdefault(s.part, []).append(s)
         for segs in self._by_part.values():
             segs.sort(key=lambda s: s.start)
+        self._generation += 1
+        # decode-cache entries die with their segment (expiry, compaction,
+        # retire, quarantine, test surgery on .segments)
+        self.cache.retain({s.path for s in self.segments})
 
     def _retire(self, old_topology: str,
                 files: "list[pathlib.Path] | None" = None) -> None:
@@ -257,26 +661,36 @@ class EventArchive:
     def append_segment(self, part: int, start: int, sl) -> None:
         """Persist one contiguous ring slice (a ``StoreSlice`` already on
         host). Idempotent: re-spooling an existing (part, start) range —
-        e.g. after WAL replay — is a no-op."""
+        e.g. after WAL replay — is a no-op. Zone maps + blooms are
+        computed HERE, once, while the columns are already in memory —
+        queries and index rebuilds only ever read them back."""
         name = f"seg-p{part:04d}-o{start:014d}-n{sl.ts_ms.shape[0]}.npz"
         path = self.dir / name
         end = start + int(sl.ts_ms.shape[0])
         self._spilled[part] = max(self._spilled.get(part, 0), end)
         if path.exists():
             return
-        ts = np.asarray(sl.ts_ms)
+        cols = {c: np.asarray(getattr(sl, c)) for c in _COLUMNS}
+        ts = cols["ts_ms"]
+        count = int(ts.shape[0])
+        ts_min = int(ts.min()) if ts.size else 0
+        ts_max = int(ts.max()) if ts.size else 0
+        stats = _compute_stats(cols)
         # temp name must NOT match the seg-*.npz recovery glob (write via a
         # file handle — np.savez would append .npz to a bare path)
         tmp = path.with_name(path.name + ".tmp")
         with open(tmp, "wb") as f:
             np.savez(f, part=np.int64(part), start=np.int64(start),
                      topology=np.str_(self.topology or ""),
-                     **{c: np.asarray(getattr(sl, c)) for c in _COLUMNS})
+                     seg_nrows=np.int64(count),
+                     seg_ts_min=np.int64(ts_min),
+                     seg_ts_max=np.int64(ts_max),
+                     stats_json=np.str_(json.dumps(stats)),
+                     **cols)
         tmp.replace(path)
         self.segments.append(_Segment(
-            part=part, start=start, count=int(ts.shape[0]),
-            ts_min=int(ts.min()) if ts.size else 0,
-            ts_max=int(ts.max()) if ts.size else 0, path=name))
+            part=part, start=start, count=count,
+            ts_min=ts_min, ts_max=ts_max, path=name, stats=stats))
         self.segments.sort(key=lambda s: (s.part, s.start))
         self._reindex()
         self._expire(part)
@@ -310,9 +724,6 @@ class EventArchive:
             self.expired_rows += victim.count
             self.segments.remove(victim)
             (self.dir / victim.path).unlink(missing_ok=True)
-            if self._row_cache is not None \
-                    and self._row_cache[0] == victim.path:
-                self._row_cache = None
         if victims:
             self._reindex()
 
@@ -343,30 +754,42 @@ class EventArchive:
                 if len(run) < 2:
                     i = j
                     continue
-                cols: dict[str, list] = {c: [] for c in _COLUMNS}
+                cols: "dict[str, list] | None" = {c: [] for c in _COLUMNS}
                 for s in run:
                     sc = self._segment_cols(s)
+                    if sc is None:   # quarantined: leave this run alone
+                        cols = None
+                        break
                     for c in _COLUMNS:
                         cols[c].append(sc[c])
+                if cols is None:
+                    i = j
+                    continue
                 merged = {c: np.concatenate(cols[c]) for c in _COLUMNS}
                 start = run[0].start
+                ts = merged["ts_ms"]
+                ts_min = int(ts.min()) if ts.size else 0
+                ts_max = int(ts.max()) if ts.size else 0
+                stats = _compute_stats(merged)
                 name = f"seg-p{part:04d}-o{start:014d}-n{total}.npz"
                 tmp = self.dir / (name + ".tmp")
                 with open(tmp, "wb") as f:
                     np.savez(f, part=np.int64(part), start=np.int64(start),
-                             topology=np.str_(self.topology or ""), **merged)
+                             topology=np.str_(self.topology or ""),
+                             seg_nrows=np.int64(total),
+                             seg_ts_min=np.int64(ts_min),
+                             seg_ts_max=np.int64(ts_max),
+                             stats_json=np.str_(json.dumps(stats)),
+                             **merged)
                 tmp.replace(self.dir / name)
-                ts = merged["ts_ms"]
                 new_seg = _Segment(
                     part=part, start=start, count=total,
-                    ts_min=int(ts.min()) if ts.size else 0,
-                    ts_max=int(ts.max()) if ts.size else 0, path=name)
+                    ts_min=ts_min, ts_max=ts_max, path=name, stats=stats)
                 for s in run:
                     (self.dir / s.path).unlink(missing_ok=True)
                     self.segments.remove(s)
                     files_removed += 1
                 self.segments.append(new_seg)
-                self._row_cache = None
                 merged_segments += 1
                 segs[i:j] = [new_seg]
                 i += 1
@@ -433,6 +856,8 @@ class EventArchive:
         if seg is None:
             return None
         cols = self._segment_cols(seg)
+        if cols is None:
+            return None
         i = pos - seg.start
         if not bool(cols["valid"][i]):
             return None
@@ -460,21 +885,17 @@ class EventArchive:
         i = bisect.bisect_right(segs, pos, key=lambda s: s.start)
         return segs[i].start if i < len(segs) else None
 
-    def _segment_cols(self, seg: "_Segment") -> dict:
-        if self._row_cache is not None and self._row_cache[0] == seg.path:
-            return self._row_cache[1]
-        with np.load(self.dir / seg.path) as z:
-            cols = {c: np.asarray(z[c]) for c in _COLUMNS}
-        self._row_cache = (seg.path, cols)
-        return cols
+    def _segment_cols(self, seg: "_Segment") -> dict | None:
+        return self._cols_or_drop(seg, _COLUMNS)
 
     def read_rows(self, part: int, start: int, count: int):
         """Contiguous archived rows [start, start+n) of a partition as a
         StoreSlice-compatible column namespace (n <= count; one segment per
         call — callers loop). Returns (cols, n); n == 0 means the range is
         not on disk (never spilled, or a recorded-loss gap — see
-        :meth:`next_start`). Bisect lookup + one-segment cache, so chunked
-        replay never rescans the index or re-extracts a segment file."""
+        :meth:`next_start`). Bisect lookup + the shared LRU decode cache,
+        so chunked replay never rescans the index or re-extracts a segment
+        file."""
         import types
 
         seg = self._segment_for(part, start)
@@ -483,6 +904,8 @@ class EventArchive:
         i = start - seg.start
         n = min(count, seg.count - i)
         cols = self._segment_cols(seg)
+        if cols is None:
+            return None, 0
         return types.SimpleNamespace(
             **{c: cols[c][i:i + n] for c in _COLUMNS}), n
 
@@ -496,7 +919,19 @@ class EventArchive:
               device_parts: frozenset[int] | None = None,
               assignment_parts: frozenset[int] | None = None,
               ) -> tuple[int, list[dict]]:
-        """Newest-first filtered scan over archived rows.
+        """Newest-first filtered scan over archived rows, with PUSHDOWN.
+
+        The :class:`SegmentPlanner` evaluates the predicate set against
+        every segment's zone maps + blooms first; only survivors are
+        decoded (newest-first), the scan stops materializing candidates
+        once the page is provably complete, provably-full-match segments
+        contribute their stored row count without being decoded at all,
+        and only the columns the query touches load from disk — the final
+        page winners are the only rows whose payload columns materialize.
+        Results (total AND rows, ts-tie ordering included) are
+        byte-identical to :meth:`query_unpruned`, the retained full-scan
+        reference — pinned by tests/test_archive_pushdown.py and the
+        smoke-bench archive gate.
 
         ``max_pos[part]`` caps the scan at rows already EVICTED from that
         partition's ring (absolute position < max_pos) so ring + archive
@@ -505,6 +940,103 @@ class EventArchive:
         engines — the id namespaces repeat per shard). Returns
         (total_matching, top rows) where each row is a plain dict of
         scalars/arrays in ring column layout plus ``part``/``pos``."""
+        from sitewhere_tpu.ops.query import host_filter_mask
+
+        self.queries += 1
+        # limit <= 0 is a count-only page: (total, []) — matches the
+        # oracle's limit=0 behavior (Engine clamps to >= 1, but the
+        # distributed path forwards the caller's limit verbatim)
+        limit = max(0, limit)
+        plan_rows, considered = self._planner.plan(
+            max_pos=max_pos, device=device, etype=etype, tenant=tenant,
+            assignment=assignment, aux0=aux0, aux1=aux1, area=area,
+            customer=customer, since_ms=since_ms, until_ms=until_ms,
+            device_parts=device_parts, assignment_parts=assignment_parts)
+        self.plan_considered += considered
+        self.plan_pruned += considered - len(plan_rows)
+        pred_cols = ["valid", "ts_ms"]
+        for col, v in (("device", device), ("etype", etype),
+                       ("tenant", tenant), ("assignment", assignment),
+                       ("area", area), ("customer", customer)):
+            if v is not None:
+                pred_cols.append(col)
+        if aux0 is not None or aux1 is not None:
+            pred_cols.append("aux")
+        total = 0
+        # page candidates: (ts, scan_order, rank_in_segment, seg, row).
+        # Sorting by (-ts, scan_order, rank) reproduces the reference
+        # merge exactly: the full scan appends per-segment newest-first
+        # pages in (part, start) order and stable-sorts on -ts, so ties
+        # resolve by scan order then in-segment rank.
+        kept: list[tuple[int, int, int, _Segment, int]] = []
+        kth: int | None = None
+        decoded: set[str] = set()
+        for order_i, seg, full_match, ts_hi, cap_covers in plan_rows:
+            # the page is CLOSED to this segment when it already holds
+            # ``limit`` rows all strictly newer than anything the segment
+            # can contain (strict: an equal-ts row could still win its
+            # tie-break on scan order)
+            page_closed = kth is not None and kth > ts_hi
+            if page_closed and full_match:
+                # zone maps prove every valid row matches and the cap
+                # covers the segment: count it without touching the file
+                total += seg.stats["rows"]
+                self.count_shortcuts += 1
+                continue
+            need = ("valid", "ts_ms") if full_match else tuple(pred_cols)
+            cols = self._cols_or_drop(seg, need)
+            if cols is None:
+                continue   # quarantined mid-query: rows unavailable
+            decoded.add(seg.path)
+            m = cols["valid"].astype(bool)
+            if max_pos is not None and not cap_covers:
+                cap = min(seg.count, max_pos.get(seg.part, 0) - seg.start)
+                m[cap:] = False
+            if not full_match:
+                m &= host_filter_mask(
+                    cols, device=device, etype=etype, tenant=tenant,
+                    assignment=assignment, aux0=aux0, aux1=aux1,
+                    area=area, customer=customer, since_ms=since_ms,
+                    until_ms=until_ms)
+            idx = np.nonzero(m)[0]
+            total += int(idx.size)
+            if page_closed or not idx.size:
+                continue
+            ts = cols["ts_ms"]
+            sel = idx[np.argsort(-ts[idx], kind="stable")][:limit]
+            kept.extend((int(ts[i]), order_i, j, seg, int(i))
+                        for j, i in enumerate(sel))
+            kept.sort(key=lambda t: (-t[0], t[1], t[2]))
+            del kept[limit:]
+            kth = kept[-1][0] if kept and len(kept) == limit else None
+        self.plan_decoded += len(decoded)
+        rows: list[dict] = []
+        for ts_v, order_i, j, seg, i in kept:
+            cols = self._cols_or_drop(seg, _COLUMNS)
+            if cols is None:
+                continue   # payload columns rotted behind good pred cols
+            row = {c: cols[c][i] for c in _COLUMNS}
+            row["part"] = seg.part
+            row["pos"] = seg.start + i
+            rows.append(row)
+        return total, rows
+
+    def query_unpruned(self, *, max_pos: dict[int, int] | None = None,
+                       device: int | None = None, etype: int | None = None,
+                       tenant: int | None = None, since_ms: int | None = None,
+                       until_ms: int | None = None,
+                       assignment: int | None = None,
+                       aux0: int | None = None, aux1: int | None = None,
+                       area: int | None = None, customer: int | None = None,
+                       limit: int = 100,
+                       device_parts: frozenset[int] | None = None,
+                       assignment_parts: frozenset[int] | None = None,
+                       ) -> tuple[int, list[dict]]:
+        """The pre-pushdown full scan, kept VERBATIM as the parity oracle:
+        decodes every eligible segment with its own ``np.load`` and
+        filters row-by-row. :meth:`query` must return byte-identical
+        (total, rows) — the smoke bench hard-gates it and the pushdown
+        tests pin it across tie/bloom/gap edge cases."""
         total = 0
         top: list[tuple[int, dict]] = []
         for seg in self.segments:

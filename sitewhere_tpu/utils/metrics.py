@@ -321,6 +321,86 @@ def query_metrics(registry: MetricsRegistry | None = None) -> dict:
     }
 
 
+def _safe_size(path) -> int:
+    try:
+        return path.stat().st_size
+    except OSError:
+        return 0
+
+
+def archive_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """The ``swtpu_archive_*`` gauges for the historical retention tier
+    (ISSUE 8). Registered here — NOT in engine.metrics(), whose dict is
+    pinned equal across dispatch shapes — exactly like the query and
+    replication instruments. All gauges, synced at scrape time from the
+    archive's own counters (the archive mutates under the engine lock;
+    the scrape must never take it):
+
+      swtpu_archive_segments            live segment files on disk
+      swtpu_archive_rows                rows held by the archive tier
+      swtpu_archive_bytes               bytes in live segment files
+      swtpu_archive_queries_total       pushdown scans served
+      swtpu_archive_segments_considered_total
+                                        segments admitted by the eviction
+                                        cap (what a full scan would open)
+      swtpu_archive_segments_pruned_total
+                                        ...of which zone maps/blooms
+                                        pruned without decoding
+      swtpu_archive_segments_decoded_total
+                                        unique segments actually decoded
+                                        (pruned + decoded + shortcut ==
+                                        considered per round)
+      swtpu_archive_count_shortcut_total
+                                        provably-full-match segments
+                                        counted from stats alone
+      swtpu_archive_cache_hits_total / swtpu_archive_cache_loads_total
+                                        LRU segment-decode cache traffic
+      swtpu_archive_corrupt_segments    files quarantined (rebuild+decode)
+      swtpu_archive_lost_rows / swtpu_archive_expired_rows
+                                        rows wrapped before spool / rows
+                                        expired by retention policy
+    """
+    reg = registry or REGISTRY
+    return {
+        "segments": reg.gauge(
+            "swtpu_archive_segments", "live archived segment files"),
+        "rows": reg.gauge(
+            "swtpu_archive_rows", "rows held by the archive tier"),
+        "bytes": reg.gauge(
+            "swtpu_archive_bytes", "bytes on disk in live segments"),
+        "queries": reg.gauge(
+            "swtpu_archive_queries_total", "archive pushdown scans served"),
+        "considered": reg.gauge(
+            "swtpu_archive_segments_considered_total",
+            "segments admitted by the eviction cap across all scans"),
+        "pruned": reg.gauge(
+            "swtpu_archive_segments_pruned_total",
+            "segments pruned by zone maps/bloom filters without decoding"),
+        "decoded": reg.gauge(
+            "swtpu_archive_segments_decoded_total",
+            "unique segments decoded per scan, summed"),
+        "count_shortcuts": reg.gauge(
+            "swtpu_archive_count_shortcut_total",
+            "provably-full-match segments counted from stats alone"),
+        "cache_hits": reg.gauge(
+            "swtpu_archive_cache_hits_total",
+            "segment-decode cache calls served without touching disk"),
+        "cache_loads": reg.gauge(
+            "swtpu_archive_cache_loads_total",
+            "segment-decode cache np.load file opens"),
+        "corrupt": reg.gauge(
+            "swtpu_archive_corrupt_segments",
+            "segment files quarantined as corrupt (at index rebuild or "
+            "first decode)"),
+        "lost_rows": reg.gauge(
+            "swtpu_archive_lost_rows",
+            "ring rows overwritten before they could spill"),
+        "expired_rows": reg.gauge(
+            "swtpu_archive_expired_rows",
+            "archived rows expired by retention policy"),
+    }
+
+
 def replication_metrics(registry: MetricsRegistry | None = None) -> dict:
     """The ``swtpu_replication_*`` instruments for the event-plane
     replica feed (ISSUE 6). Registered here — NOT in engine.metrics(),
@@ -498,6 +578,24 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
         reg.gauge("swtpu_dispatch_inflight",
                   "device programs dispatched but not yet drained").set(
                       len(pending))
+
+    arch = getattr(engine, "archive", None)
+    if arch is not None:
+        inst = archive_metrics(reg)
+        inst["segments"].set(len(arch.segments))
+        inst["rows"].set(arch.total_rows())
+        inst["bytes"].set(sum(
+            _safe_size(arch.dir / s.path) for s in list(arch.segments)))
+        inst["queries"].set(arch.queries)
+        inst["considered"].set(arch.plan_considered)
+        inst["pruned"].set(arch.plan_pruned)
+        inst["decoded"].set(arch.plan_decoded)
+        inst["count_shortcuts"].set(arch.count_shortcuts)
+        inst["cache_hits"].set(arch.cache.hits)
+        inst["cache_loads"].set(arch.cache.loads)
+        inst["corrupt"].set(arch.corrupt_segments)
+        inst["lost_rows"].set(arch.lost_rows)
+        inst["expired_rows"].set(arch.expired_rows)
 
     fq = getattr(engine, "forward_queue", None)
     if fq is not None:

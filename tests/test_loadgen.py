@@ -204,6 +204,51 @@ def test_open_loop_mixed_ops_end_to_end():
     assert eng.metrics()["persisted"] >= expected
 
 
+def test_open_loop_historical_queries_hit_the_archive_tier(tmp_path):
+    """ISSUE 8 satellite: ``history_every`` emits deterministic historical
+    query markers (a date range ending ``history_age_ms`` before now —
+    resolved against the engine epoch at fire time), and on an
+    archive-primed engine those queries actually traverse the tiered
+    (ring + disk) read path."""
+    import time
+
+    (OpenLoopSpec, TenantLoad, build, run, fingerprint) = \
+        _open_loop_imports()
+    eng = Engine(EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=64, channels=4, batch_capacity=16,
+        archive_segment_rows=16, archive_dir=str(tmp_path / "ha")))
+    # prime >= 4x ring so the history range falls beyond the ring
+    base = int(eng.epoch.base_unix_s * 1000)
+    old = base + int(eng.epoch.now_ms()) - 30_000
+    for i in range(4 * 64):
+        eng.ingest_json_batch([json.dumps({
+            "deviceToken": f"hist-{i % 4}", "type": "DeviceMeasurements",
+            "request": {"measurements": {"t": float(i)},
+                        "eventDate": old + i}}).encode()])
+    eng.flush()
+    assert eng.archive.total_rows() > 0
+    spec = OpenLoopSpec(
+        tenants=(TenantLoad("default", 1500.0, n_devices=4,
+                            device_prefix="hist", history_every=2,
+                            history_age_ms=5_000),),
+        duration_s=0.3, frame_size=32, seed=11)
+    s1, s2 = build(spec), build(spec)
+    assert fingerprint(s1) == fingerprint(s2)   # markers stay deterministic
+    hist_ops = [op for op in s1 if op.kind == "query"
+                and "history_age_ms" in op.query]
+    assert hist_ops and all(op.query["limit"] == 20 for op in hist_ops)
+    assert any("device_token" in op.query for op in hist_ops)
+    before = eng.archive.queries
+    t0 = time.perf_counter()
+    res = run(eng, s1, checkpoint_frames=2)
+    assert res.history_queries == len(hist_ops)
+    assert res.history_p99_ms is not None and res.history_p99_ms > 0
+    # the tiered path was exercised: every history query planned a scan
+    assert eng.archive.queries >= before + len(hist_ops)
+    assert time.perf_counter() - t0 < 60
+
+
 def test_open_loop_backlog_latency_includes_queueing_delay():
     """THE open-loop property: when the engine is artificially slowed
     below the offered rate, recorded wire->state latency GROWS with the
