@@ -157,6 +157,14 @@ class IngestHostMixin:
     ``config.strict_channels``, ``process()``, ``_ingest_decoded()``,
     ``flight`` (utils/flight.FlightRecorder), ``_staged_traces``."""
 
+    # overload discipline (ISSUE 9): hosts that enable config.qos attach
+    # an AdmissionController (consulted at the ingest EDGES, never here)
+    # and a WeightedFairGate ordering the batch-ingest critical section
+    # across tenants; both default off so recovery/standby replay and
+    # non-QoS engines pay nothing
+    qos = None
+    _wfq_gate = None
+
     # staging-clock pin (event-plane replication): a replica feed ships
     # each WAL append's staging timestamp so the follower's standby
     # stages byte-identical rows; the follower's applier sets this
@@ -278,10 +286,25 @@ class IngestHostMixin:
         rec = self.flight.begin(
             "ingest", tenant=tenant, n_payloads=len(payloads),
             traceparent=traceparent or current_traceparent())
+        # weighted-fair turn (ISSUE 9): under multi-tenant contention the
+        # gate orders which tenant's batch enters the ingest critical
+        # section (and therefore acquires the next arena slot / staging
+        # room) by virtual-time deficit, so one tenant's flood cannot
+        # starve the others in lock-arrival order. The turn is ENTERED by
+        # the inner skeleton immediately before its branch's critical
+        # section, so work that deliberately runs outside the engine lock
+        # (the lenient path's native decode) keeps overlapping across
+        # threads with QoS on. Re-entrant callers (admin paths already
+        # inside the engine lock) skip the gate — parking them would
+        # deadlock against their own lock.
+        gate = self._wfq_gate
+        gate_ctx = (gate.turn(tenant, len(payloads))
+                    if gate is not None and not self.lock._is_owned()
+                    else contextlib.nullcontext())
         with self.flight.bind(rec):
             summary = self._ingest_batch_inner(payloads, tenant, tag,
                                                dec, native_fn, binary,
-                                               rec)
+                                               rec, gate_ctx)
         if rec.trace_id is not None:
             rec.add_counts(summary)
             if rec.meta.get("path") != "arena" and summary.get("staged"):
@@ -305,9 +328,13 @@ class IngestHostMixin:
         return summary
 
     def _ingest_batch_inner(self, payloads, tenant, tag, dec, native_fn,
-                            binary, rec) -> dict:
+                            binary, rec,
+                            gate_ctx=contextlib.nullcontext()) -> dict:
+        # gate_ctx is the batch's (single-use) weighted-fair turn; each
+        # branch enters it immediately before its own critical section —
+        # never around work that is designed to run outside the lock
         if native_fn is None:
-            with self.lock:
+            with gate_ctx, self.lock:
                 try:
                     predecoded = self._strict_predecode(payloads, dec)
                     self._wal_append(tag, payloads, tenant)
@@ -322,7 +349,7 @@ class IngestHostMixin:
             # strict serializes the native decode under the lock so a
             # rejected batch can roll back the names it interned without
             # clobbering a concurrent batch's newly-interned names
-            with self.lock:
+            with gate_ctx, self.lock:
                 try:
                     names_before = len(self.channel_map.names)
                     res = native_fn(payloads)
@@ -341,13 +368,15 @@ class IngestHostMixin:
             # directly — no decode output arrays, no staging copy. Decode
             # runs UNDER the lock (the arena is shared mutable state);
             # cross-thread decode parallelism is the worker pool's job.
-            return self._ingest_batch_arena(payloads, tenant, tag, dec,
-                                            binary)
+            with gate_ctx:
+                return self._ingest_batch_arena(payloads, tenant, tag, dec,
+                                                binary)
         # lenient fast path: decode OUTSIDE the lock (concurrent receivers
-        # decode in parallel); log + stage atomically
+        # decode in parallel — and outside the WFQ turn, for the same
+        # reason); log + stage atomically
         res = native_fn(payloads)
         rec.mark("decode")
-        with self.lock:
+        with gate_ctx, self.lock:
             try:
                 self._wal_append(tag, payloads, tenant)
                 summary = self._ingest_decoded(res, payloads, tenant, dec)
@@ -653,6 +682,41 @@ class EngineConfig:
                                        # shared-scan query batcher (1
                                        # effectively disables coalescing;
                                        # queries still run off the lock)
+    qos: bool = False                  # overload discipline (utils/qos.py):
+                                       # per-tenant token-bucket admission
+                                       # + weighted-fair ingest/query
+                                       # scheduling. Admission applies at
+                                       # the EDGES (REST/RPC/cluster
+                                       # forward/loadgen), never inside
+                                       # the engine's own ingest — WAL
+                                       # replay and replica apply must
+                                       # never shed durable events
+    tenant_rates: dict | None = None   # tenant -> admitted events/s
+                                       # (token bucket); unlisted tenants
+                                       # use qos_default_rate_eps
+    qos_default_rate_eps: float = 0.0  # rate for unlisted tenants
+                                       # (0 = no per-tenant rate cap)
+    qos_burst_s: float = 2.0           # token-bucket depth, in seconds
+                                       # of the tenant's rate
+    tenant_weights: dict | None = None # weighted-fair-queuing weights for
+                                       # arena-turn + query-round sharing
+                                       # (default: equal, 1.0 each)
+    shed_threshold: int = 0            # staged-row backlog at which every
+                                       # tenant sheds "saturated" (0 =
+                                       # auto: 4 * batch_capacity *
+                                       # scan_chunk); the SLO autotuner
+                                       # steers this knob
+    qos_min_retry_after_s: float = 0.05  # Retry-After floor on sheds
+    arena_stall_timeout_s: float | None = None  # bound ArenaPool.acquire:
+                                       # a wedged in-flight dispatch
+                                       # raises ArenaStallError (-> shed
+                                       # + counter) instead of hanging
+                                       # the ingest thread silently
+    slo_p99_target_ms: float | None = None  # autotuner SLO objective:
+                                       # steer workers/depth/chunk + the
+                                       # shed threshold toward this
+                                       # per-tenant ingest-e2e p99 target
+                                       # instead of raw throughput
 
 
 @dataclasses.dataclass
@@ -931,6 +995,10 @@ class QueryBatcher:
         self._mu = threading.Lock()
         self._queue: list[dict] = []
         self._running = False
+        self._wfq = None         # weighted-fair round membership (QoS):
+                                 # attach_wfq installs a WFQPicker so an
+                                 # overflowing round's slots follow
+                                 # tenant weights, not arrival order
         self.programs = 0        # device programs launched
         self.coalesced = 0       # queries served through them
         self.max_coalesced = 0   # largest micro-batch observed
@@ -959,11 +1027,20 @@ class QueryBatcher:
             self._programs[key] = fn
         return fn
 
+    def attach_wfq(self, weights: dict | None) -> None:
+        """Enable weighted-fair round membership (ISSUE 9): when more
+        queries are queued than one round holds, slots are granted in
+        per-tenant virtual-time order instead of first-come."""
+        from sitewhere_tpu.utils.qos import WFQPicker
+
+        self._wfq = WFQPicker(weights)
+
     def observe_latency(self, seconds: float) -> None:
         self._metrics["latency"].observe(seconds)
         self._metrics["queries"].inc()
 
-    def run(self, params: tuple, limit: int, archive: dict | None = None):
+    def run(self, params: tuple, limit: int, archive: dict | None = None,
+            tenant: str | None = None):
         """Submit one predicate set (``ops.query.QueryParams`` field order,
         plain ints) at a bucketed ``limit``. ``archive`` — ``{"limit":
         exact_page, "filters": {...}}`` — asks the round to ALSO scan the
@@ -980,7 +1057,8 @@ class QueryBatcher:
         entry = {"params": params, "limit": int(limit),
                  "event": threading.Event(), "result": None,
                  "cursors": None, "q": 0, "error": None,
-                 "archive": archive, "archive_result": None}
+                 "archive": archive, "archive_result": None,
+                 "tenant": tenant or "default"}
         if self.engine.lock._is_owned():
             # a caller already INSIDE the engine lock (RLock re-entrancy
             # was always legal on this path) must not park as a follower:
@@ -1010,8 +1088,18 @@ class QueryBatcher:
         becomes the next leader itself — no entry can strand."""
         while True:
             with self._mu:
-                batch = self._queue[: self.max_batch]
-                del self._queue[: len(batch)]
+                if (self._wfq is not None
+                        and len(self._queue) > self.max_batch):
+                    # overflow round under QoS: membership follows
+                    # tenant weights (virtual-time order, FIFO within a
+                    # tenant) — a one-tenant read flood can no longer
+                    # push every other tenant's queries behind its
+                    # entire backlog
+                    batch, self._queue = self._wfq.pick(
+                        self._queue, self.max_batch)
+                else:
+                    batch = self._queue[: self.max_batch]
+                    del self._queue[: len(batch)]
                 if not batch:
                     self._running = False
                     return
@@ -1276,6 +1364,29 @@ class Engine(IngestHostMixin):
             self._autotuner = StageTimeAutotuner(
                 self, interval=c.autotune_interval,
                 adapt_scan_chunk=c.autotune_scan_chunk)
+        # overload discipline (ISSUE 9): per-tenant token-bucket admission
+        # (consulted by the REST/RPC/cluster/loadgen EDGES — never by the
+        # engine's own ingest, so WAL replay and replica apply can never
+        # shed durable events) + weighted-fair scheduling of the ingest
+        # critical section and query-round membership
+        self._stall_sheds = 0     # arena-stall sheds (plain attribute:
+                                  # NOT a metrics() key — dispatch-shape
+                                  # equality; mirrored in swtpu_qos_*)
+        if c.qos:
+            from sitewhere_tpu.utils.qos import (AdmissionController,
+                                                 WeightedFairGate)
+
+            self.qos = AdmissionController(
+                tenant_rates=c.tenant_rates,
+                default_rate_eps=c.qos_default_rate_eps,
+                burst_s=c.qos_burst_s,
+                shed_threshold=(c.shed_threshold
+                                or 4 * c.batch_capacity
+                                * max(1, c.scan_chunk)),
+                backlog_fn=lambda: self.staged_count,
+                min_retry_after_s=c.qos_min_retry_after_s)
+            self._wfq_gate = WeightedFairGate(c.tenant_weights)
+            self._query_batcher.attach_wfq(c.tenant_weights)
 
     def _build_arena_machinery(self, k: int) -> None:
         """(Re)build the staging-arena pool and, for k > 1, the K-lane
@@ -1299,13 +1410,16 @@ class Engine(IngestHostMixin):
 
     def set_ingest_tuning(self, *, scan_chunk: int | None = None,
                           dispatch_depth: int | None = None,
-                          ingest_workers: int | None = None) -> dict:
+                          ingest_workers: int | None = None,
+                          shed_threshold: int | None = None) -> dict:
         """Apply ingest-tuning knobs at runtime — the single choke point
         the autotuner (and operators, via REST/config reload) go through,
         because each knob invalidates different machinery:
 
           dispatch_depth   takes effect at the next dispatch, free
           ingest_workers   clamps the sharded-decode fan-out, free
+          shed_threshold   moves the QoS saturation valve (no-op with
+                           QoS off), free
           scan_chunk       REBUILDS the arena pool + scan step (drains
                            in-flight dispatches first; the new program
                            compiles on next dispatch)
@@ -1317,6 +1431,9 @@ class Engine(IngestHostMixin):
                 c.dispatch_depth = max(1, int(dispatch_depth))
             if ingest_workers is not None and self._sharder is not None:
                 self._sharder.set_active_workers(ingest_workers)
+            if shed_threshold is not None and self.qos is not None:
+                c.shed_threshold = max(1, int(shed_threshold))
+                self.qos.shed_threshold = c.shed_threshold
             if scan_chunk is not None:
                 k = max(1, int(scan_chunk))
                 if k != max(1, c.scan_chunk) and self._arena_pool is not None:
@@ -1328,10 +1445,13 @@ class Engine(IngestHostMixin):
                     self._arena_pool.drain()
                     self._build_arena_machinery(k)
                     c.scan_chunk = k
-            return {"scan_chunk": c.scan_chunk,
-                    "dispatch_depth": c.dispatch_depth,
-                    "ingest_workers": (self._sharder.active_workers
-                                       if self._sharder else 1)}
+            applied = {"scan_chunk": c.scan_chunk,
+                       "dispatch_depth": c.dispatch_depth,
+                       "ingest_workers": (self._sharder.active_workers
+                                          if self._sharder else 1)}
+            if self.qos is not None:
+                applied["shed_threshold"] = self.qos.shed_threshold
+            return applied
 
     @property
     def staged_count(self) -> int:
@@ -1476,6 +1596,31 @@ class Engine(IngestHostMixin):
             else None, binary=True, traceparent=traceparent)
 
     # ------------------------------------------------------------ arena ingest
+    def _acquire_arena(self, tenant: str, n_remaining: int):
+        """Pool acquire bounded by ``arena_stall_timeout_s``: a wedged
+        in-flight dispatch raises a typed stall instead of hanging the
+        ingest thread under the engine lock forever; the stall translates
+        to an explicit shed (counted in ``swtpu_qos_shed_total`` with
+        reason="stall" when QoS is on) that the edges surface as
+        429/Retry-After. Chunks of the batch staged BEFORE the stall are
+        already WAL-durable and dispatch normally."""
+        from sitewhere_tpu.ingest.arena import ArenaStallError
+
+        try:
+            return self._arena_pool.acquire(
+                timeout_s=self.config.arena_stall_timeout_s)
+        except ArenaStallError as e:
+            self._stall_sheds += 1
+            if self.qos is not None:
+                self.qos.note_shed(tenant, n_remaining, "stall")
+            from sitewhere_tpu.utils.qos import ShedError
+
+            raise ShedError(
+                f"ingest shed: {e}", tenant=tenant,
+                retry_after_s=max(
+                    1.0, self.config.arena_stall_timeout_s or 1.0),
+                reason="stall") from e
+
     def _ingest_batch_arena(self, payloads, tenant, tag, reg_decoder,
                             binary: bool) -> dict:
         """Zero-copy batch ingest: the native scanner decodes straight
@@ -1495,7 +1640,8 @@ class Engine(IngestHostMixin):
             while pos < n:
                 arena = self._arena_fill
                 if arena is None:
-                    arena = self._arena_fill = self._arena_pool.acquire()
+                    arena = self._arena_fill = \
+                        self._acquire_arena(tenant, n - pos)
                 take = min(n - pos, arena.room)
                 chunk = (payloads if take == n
                          else payloads[pos:pos + take])
@@ -1539,7 +1685,8 @@ class Engine(IngestHostMixin):
             while pos < n:
                 arena = self._arena_fill
                 if arena is None:
-                    arena = self._arena_fill = self._arena_pool.acquire()
+                    arena = self._arena_fill = \
+                        self._acquire_arena(tenant, n - pos)
                 take = min(n - pos, arena.room)
                 lo, hi = arena.cursor, arena.cursor + take
                 sl = slice(pos, pos + take)
@@ -2539,7 +2686,8 @@ class Engine(IngestHostMixin):
                 assignment=assignment_id, aux0=aux0, aux1=aux1,
                 area=area_id, customer=customer_id)}
         row, cursors, coalesced, archive_res = self._query_batcher.run(
-            params, bucket_limit(limit), archive=archive_req)
+            params, bucket_limit(limit), archive=archive_req,
+            tenant=tenant)
         rec.mark("device")
         rec.add("coalesced", coalesced)
         # every result column is already ONE host numpy array (the

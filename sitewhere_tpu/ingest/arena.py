@@ -32,11 +32,19 @@ the OLDEST in-flight dispatch (backpressure, counted in
 from __future__ import annotations
 
 import collections
+import time
 
 import numpy as np
 
 from sitewhere_tpu.core.events import EventBatch
 from sitewhere_tpu.core.types import AUX_LANES, NULL_ID
+
+
+class ArenaStallError(RuntimeError):
+    """``ArenaPool.acquire`` gave up waiting on a wedged in-flight
+    dispatch (``timeout_s`` exceeded). Raised LOUDLY instead of hanging
+    the ingest thread under the engine lock forever; the engine
+    translates it to a shed + counter (ISSUE 9)."""
 
 
 class StagingArena:
@@ -140,13 +148,18 @@ class ArenaPool:
     def inflight_count(self) -> int:
         return len(self._inflight)
 
-    def acquire(self) -> StagingArena:
+    def acquire(self, timeout_s: float | None = None) -> StagingArena:
         """A fillable arena; blocks on the oldest in-flight dispatch when
-        every arena is tied up (ingest backpressure)."""
+        every arena is tied up (ingest backpressure). With ``timeout_s``
+        the block is BOUNDED: a dispatch that never completes (wedged
+        device runtime, dead transfer stream) raises a typed
+        :class:`ArenaStallError` instead of hanging the ingest thread
+        silently — the caller sheds the batch and the failure is
+        visible."""
         self._reclaim_ready()
         if not self._free:
             self.waits += 1
-            self._reclaim_oldest()
+            self._reclaim_oldest(timeout_s)
         return self._free.pop()
 
     def retire(self, arena: StagingArena, ticket, traces: list = ()) -> None:
@@ -165,9 +178,24 @@ class ArenaPool:
         for rec in traces:
             rec.mark("device_ready")
 
-    def _reclaim_oldest(self) -> None:
+    def _reclaim_oldest(self, timeout_s: float | None = None) -> None:
         import jax
 
+        if timeout_s is not None:
+            # bounded wait: poll the ticket's readiness (jax has no timed
+            # block) and refuse to pop an arena we may never get back. A
+            # ticket without is_ready (plain numpy in tests) is treated
+            # as ready — block_until_ready returns immediately for it.
+            ticket = self._inflight[0][1]
+            is_ready = getattr(ticket, "is_ready", None)
+            deadline = time.monotonic() + timeout_s
+            while is_ready is not None and not is_ready():
+                if time.monotonic() >= deadline:
+                    raise ArenaStallError(
+                        f"arena recycle stalled: oldest of "
+                        f"{len(self._inflight)} in-flight dispatch(es) "
+                        f"not ready after {timeout_s:.3f}s")
+                time.sleep(min(0.001, timeout_s / 10))
         arena, ticket, traces = self._inflight.popleft()
         jax.block_until_ready(ticket)
         self._mark_ready(traces)

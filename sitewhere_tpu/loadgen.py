@@ -169,6 +169,16 @@ class TenantLoad:
                                        # engine serves it from the tiered
                                        # (ring + disk) read path
     history_age_ms: int = 60_000       # how far behind "now" the range ends
+    abusive_mult: float = 1.0          # noisy-neighbor knob (ISSUE 9):
+                                       # during burst windows the tenant
+                                       # offers rate_eps * abusive_mult.
+                                       # Extra arrivals come from a
+                                       # SEPARATE seeded stream, so a
+                                       # schedule with the knob OFF stays
+                                       # byte-identical to pre-knob runs
+    abusive_period_s: float = 0.0      # burst window period; 0 (with
+                                       # mult > 1) = the whole horizon
+    abusive_burst_s: float = 0.0       # burst length within each period
 
 
 @dataclasses.dataclass(frozen=True)
@@ -225,6 +235,29 @@ def build_open_loop_schedule(spec: OpenLoopSpec) -> list[ScheduledOp]:
             total += float(g.sum())
         arr = np.cumsum(np.concatenate(gaps))
         arr = arr[arr < spec.duration_s]
+        if tl.abusive_mult > 1.0:
+            # noisy-neighbor bursts: superimpose an EXTRA Poisson stream
+            # at rate * (mult - 1), thinned to the burst windows — the
+            # union of Poisson processes is Poisson at the summed rate,
+            # so inside a window the tenant offers rate * mult. The
+            # extra stream draws from its own seeded generator: the base
+            # stream's draws (and every other tenant's schedule) are
+            # untouched, keeping non-abusive fingerprints stable.
+            xrng = np.random.default_rng([spec.seed, ti, 0xAB])
+            xrate = tl.rate_eps * (tl.abusive_mult - 1.0)
+            xgaps: list[np.ndarray] = []
+            xtotal = 0.0
+            while xtotal < spec.duration_s:
+                g = xrng.exponential(
+                    1.0 / xrate, size=max(64, int(xrate * 0.25) or 64))
+                xgaps.append(g)
+                xtotal += float(g.sum())
+            xarr = np.cumsum(np.concatenate(xgaps))
+            xarr = xarr[xarr < spec.duration_s]
+            if tl.abusive_period_s > 0 and tl.abusive_burst_s > 0:
+                xarr = xarr[(xarr % tl.abusive_period_s)
+                            < tl.abusive_burst_s]
+            arr = np.sort(np.concatenate([arr, xarr]), kind="stable")
         picks = rng.integers(0, tl.n_devices, len(arr))
         mut_registered: set[str] = set()
         n_frames = 0
@@ -317,6 +350,12 @@ class OpenLoopResult:
                  the flight-recorder-harvested swtpu_ingest_e2e_seconds
                  histogram (same start edge as the batch's flight
                  record). e2e == service when the run kept pace.
+
+    With QoS enabled on the engine (``engine.qos``), the driver acts as
+    the admission EDGE: shed frames are counted per tenant (``shed`` in
+    ``per_tenant``, ``shed_events`` in total) and never submitted —
+    ``events`` is the ADMITTED count, the denominator of any
+    zero-admitted-loss check.
     """
 
     wall_s: float
@@ -330,6 +369,7 @@ class OpenLoopResult:
     mutations: int
     max_lateness_s: float
     per_tenant: dict
+    shed_events: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -350,6 +390,11 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
     qlat: list[float] = []
     hlat: list[float] = []
     epoch = getattr(engine, "epoch", None)
+    # the driver is an ingest EDGE: with QoS on, every frame faces the
+    # engine's admission controller here — shed frames count per tenant
+    # and are never submitted (the client saw an explicit 429)
+    qos = getattr(engine, "qos", None)
+    shed: dict[str, int] = {}
     mutations = 0
     max_late = 0.0
     frames = 0
@@ -377,6 +422,12 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
         else:
             max_late = max(max_late, now - target)
         if op.kind == "ingest":
+            if qos is not None:
+                d = qos.admit(op.tenant, len(op.payloads))
+                if not d.admitted:
+                    shed[op.tenant] = (shed.get(op.tenant, 0)
+                                       + len(op.payloads))
+                    continue
             submit = time.perf_counter()
             engine.ingest_json_batch(op.payloads, op.tenant)
             pending.append((op.tenant,
@@ -416,9 +467,11 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
     wall = time.perf_counter() - t0
     horizon = max((op.t_s for op in schedule), default=0.0) * time_scale
     per_tenant = {}
-    for tenant, (e2e, svc) in sorted(per.items()):
+    for tenant in sorted(set(per) | set(shed)):
+        e2e, svc = per.get(tenant, ([], []))
         per_tenant[tenant] = {
             "events": len(e2e),
+            "shed": shed.get(tenant, 0),
             **{f"e2e_{k}": v for k, v in _pcts(e2e).items()},
             **{f"service_{k}": v for k, v in _pcts(svc).items()},
         }
@@ -427,11 +480,12 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
     return OpenLoopResult(
         wall_s=round(wall, 3), events=events,
         events_per_s=round(events / wall, 1) if wall else 0.0,
-        offered_eps=round(events / horizon, 1) if horizon else 0.0,
+        offered_eps=round((events + sum(shed.values())) / horizon, 1)
+        if horizon else 0.0,
         queries=len(qlat), query_p99_ms=qp["p99_ms"],
         history_queries=len(hlat), history_p99_ms=hp["p99_ms"],
         mutations=mutations, max_lateness_s=round(max_late, 4),
-        per_tenant=per_tenant)
+        per_tenant=per_tenant, shed_events=sum(shed.values()))
 
 
 async def run_rest_load(base_url: str, jwt: str, n_workers: int = 5,

@@ -628,7 +628,25 @@ class ClusterEngine:
             self.forward_queue.spill(r, kind, tenant, fid,
                                      payloads=plist)
             return {"spilled": len(plist)}
-        except RpcError:
+        except RpcError as e:
+            if getattr(e, "code", None) == 429:
+                # owner-side load shed (ISSUE 9): the batch is already
+                # accepted at THIS edge, so it spills for deferred
+                # redelivery honoring the OWNER's Retry-After — an
+                # app-level reject by classification (the retry pump
+                # counts it in retry_app_rejects, never
+                # retry_transport_failures, and never toward the poison
+                # budget). The owner's hint propagates to the caller as
+                # retry_after_s backpressure.
+                ra = getattr(e, "retry_after_s", None)
+                self.forward_queue.spill(r, kind, tenant, fid,
+                                         payloads=plist,
+                                         defer_s=ra)
+                out = {"spilled": len(plist),
+                       "shed_deferred": len(plist)}
+                if ra is not None:
+                    out["retry_after_s"] = ra
+                return out
             # oversize single payload (unsplittable) or an owner-side
             # application error: spill WITHOUT tripping the circuit (the
             # peer is up) — the retry pump re-attempts and the retry
@@ -650,6 +668,8 @@ class ClusterEngine:
                                                  current_traceparent,
                                                  new_traceparent)
 
+        from sitewhere_tpu.utils.qos import ShedError
+
         tp = current_traceparent() or new_traceparent(self.rank)
         route_rec = self.local.flight.begin(
             "route", tenant=tenant, n_payloads=len(payloads),
@@ -659,6 +679,25 @@ class ClusterEngine:
             route_rec.mark("commit")   # partition decided
             local_ingest = (self.local.ingest_json_batch if kind == "json"
                             else self.local.ingest_binary_batch)
+            qos = getattr(self.local, "qos", None)
+            local_plist = by_rank.get(self.rank)
+            if qos is not None and local_plist:
+                # the facade IS the edge for its own sub-batch, and it
+                # decides BEFORE any forward leaves this rank: a local
+                # shed refuses the whole call with a typed ShedError
+                # (REST answers 429 + Retry-After) while nothing has
+                # been applied, forwarded, or spilled yet — the caller
+                # retries the full batch. A shed decided mid-call would
+                # instead silently drop the local payloads next to
+                # remote-owned ones the forward queue durably redelivers.
+                d = qos.admit(tenant, len(local_plist))
+                if not d.admitted:
+                    raise ShedError(
+                        f"tenant {tenant!r} shed at facade "
+                        f"({d.reason}): retry after "
+                        f"{d.retry_after_s:.3f}s", tenant=tenant,
+                        retry_after_s=d.retry_after_s,
+                        reason=d.reason or "shed")
             summaries = []
             forwarded = 0
             for r, plist in by_rank.items():
@@ -674,7 +713,13 @@ class ClusterEngine:
                 route_rec.add("forward_ranks",
                               sorted(r for r in by_rank if r != self.rank))
                 route_rec.mark("dispatch")   # last forward left this rank
+        # retry_after_s is a HINT, not a count: surface the largest one
+        # instead of letting the numeric merge sum hints across ranks
+        retry_hints = [s.pop("retry_after_s") for s in summaries
+                       if isinstance(s, dict) and "retry_after_s" in s]
         merged = _merge_counts(summaries)
+        if retry_hints:
+            merged["retry_after_s"] = max(retry_hints)
         if route_rec.trace_id is not None:
             route_rec.add_counts(merged)
             merged["trace_id"] = route_rec.trace_id
@@ -1452,15 +1497,37 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     (DeviceStateRouter.java:62-72). Handlers bind to the concrete engine,
     never the ClusterEngine facade, so routed calls cannot recurse."""
 
+    def _admit(tenant: str, n: int) -> None:
+        """Owner-side admission (ISSUE 9): the OWNER of a forwarded
+        batch enforces its tenant buckets/saturation valve — shedding at
+        the edge rank alone would let forwards bypass the owner's
+        discipline. A shed raises a typed ``code=429`` RpcError carrying
+        the owner's Retry-After, which the sender's ForwardQueue
+        classifies as an APP reject (deferred + retried, never a
+        transport failure, never poison-dead-lettered)."""
+        qos = getattr(engine, "qos", None)
+        if qos is None:
+            return
+        d = qos.admit(tenant or "default", n)
+        if not d.admitted:
+            from sitewhere_tpu.rpc.protocol import RpcError
+
+            raise RpcError(
+                f"tenant {tenant!r} shed at owner ({d.reason}): retry "
+                f"after {d.retry_after_s:.3f}s", 429,
+                retry_after_s=d.retry_after_s)
+
     def ingest_json(payloads: list = None, tenant: str = "default",
                     lens: list = None, _attachment: bytes = None):
-        return engine.ingest_json_batch(
-            _wire_payloads(payloads, lens, _attachment), tenant)
+        plist = _wire_payloads(payloads, lens, _attachment)
+        _admit(tenant, len(plist))
+        return engine.ingest_json_batch(plist, tenant)
 
     def ingest_binary(payloads: list = None, tenant: str = "default",
                       lens: list = None, _attachment: bytes = None):
-        return engine.ingest_binary_batch(
-            _wire_payloads(payloads, lens, _attachment), tenant)
+        plist = _wire_payloads(payloads, lens, _attachment)
+        _admit(tenant, len(plist))
+        return engine.ingest_binary_batch(plist, tenant)
 
     def ingest_forward(fid: str, payloads: list = None,
                        tenant: str = "default", encoding: str = "json",
@@ -1470,7 +1537,11 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         double-ingest). Record AFTER ingest: a crash in between costs a
         duplicate (at-least-once), never a loss. A fid OLDER than the
         registry's eviction watermark can no longer be proven un-applied
-        — it dead-letters (preserved, counted) instead of re-applying."""
+        — it dead-letters (preserved, counted) instead of re-applying.
+        Admission runs AFTER the dedup verdict (a duplicate redelivery
+        must not burn tokens) and BEFORE any ingest (a shed is
+        all-or-nothing for the sub-batch, so a later redelivery applies
+        it exactly once)."""
         reg = getattr(engine, "spill_registry", None)
         if reg is not None:
             verdict = reg.check(fid)
@@ -1484,6 +1555,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
                                  for p in plist]})
                 return {"stale_forward": len(plist)}
         plist = _wire_payloads(payloads, lens, _attachment)
+        _admit(tenant, len(plist))
         if encoding == "binary":
             summary = engine.ingest_binary_batch(plist, tenant)
         else:
@@ -1497,6 +1569,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
 
         req = request_from_envelope(envelope)
         req.tenant = tenant
+        _admit(tenant, 1)
         engine.process(req)
         return {"accepted": True}
 

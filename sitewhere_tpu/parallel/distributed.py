@@ -89,6 +89,20 @@ class DistributedConfig:
     archive_cache_segments: int = 8    # LRU segment-decode cache depth
     flight_recorder: bool = True       # batch-lifecycle flight recorder
     flight_capacity: int = 1024        # lifecycle records retained
+    qos: bool = False                  # overload discipline (utils/qos.py):
+                                       # per-tenant token-bucket admission
+                                       # consulted at the ingest EDGES
+                                       # (REST/RPC/cluster forward), plus
+                                       # weighted-fair ingest turns —
+                                       # same contract as EngineConfig.qos
+    tenant_rates: dict | None = None   # tenant -> admitted events/s
+    qos_default_rate_eps: float = 0.0  # rate for unlisted tenants (0 = off)
+    qos_burst_s: float = 2.0           # bucket depth, seconds of rate
+    tenant_weights: dict | None = None # WFQ weights (default equal)
+    shed_threshold: int = 0            # staged-row saturation valve (0 =
+                                       # auto: 4 * batch_capacity_per_shard
+                                       # * n_shards)
+    qos_min_retry_after_s: float = 0.05
 
 
 class _StackedBuffer:
@@ -404,6 +418,26 @@ class DistributedEngine(IngestHostMixin):
                 cache_segments=c.archive_cache_segments)
             self._spool_trigger = max(self.archive.segment_rows,
                                       acap // 2 - c.batch_capacity_per_shard)
+        # overload discipline (ISSUE 9): same contract as the single-node
+        # engine — admission at the edges (the cluster RPC ingest
+        # handlers consult engine.qos at the OWNER), WFQ turns on the
+        # batch-ingest critical section. The replica applier and WAL
+        # recovery call the ingest methods directly and therefore can
+        # never shed a durable event.
+        if getattr(c, "qos", False):
+            from sitewhere_tpu.utils.qos import (AdmissionController,
+                                                 WeightedFairGate)
+
+            self.qos = AdmissionController(
+                tenant_rates=c.tenant_rates,
+                default_rate_eps=c.qos_default_rate_eps,
+                burst_s=c.qos_burst_s,
+                shed_threshold=(c.shed_threshold
+                                or 4 * c.batch_capacity_per_shard
+                                * self.n_shards),
+                backlog_fn=lambda: self.staged_count,
+                min_retry_after_s=c.qos_min_retry_after_s)
+            self._wfq_gate = WeightedFairGate(c.tenant_weights)
 
     # ---------------------------------------------------------------- routing
     def _route(self, gid: int) -> tuple[int, int]:

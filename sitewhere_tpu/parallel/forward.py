@@ -212,6 +212,11 @@ class ForwardQueue:
         # queue for the whole transport retry budget
         self.app_reject_attempts = app_reject_attempts
         self._attempts: dict[str, int] = {}
+        # per-file redelivery deferrals (monotonic deadline): a 429
+        # owner-shed honors the owner's Retry-After instead of hammering
+        # a saturated peer every pump interval. In-memory on purpose: a
+        # restart just earns one extra 429.
+        self._defer: dict[str, float] = {}
         self.counters = {"spilled_batches": 0, "spilled_payloads": 0,
                          "redelivered_batches": 0, "deadlettered_batches": 0,
                          "retry_failures": 0, "retry_app_rejects": 0,
@@ -243,11 +248,13 @@ class ForwardQueue:
     # ------------------------------------------------------------ spill
     def spill(self, rank: int, kind: str, tenant: str, fid: str,
               payloads: list[bytes] | None = None,
-              envelope: dict | None = None) -> None:
+              envelope: dict | None = None,
+              defer_s: float | None = None) -> None:
         """Persist one undeliverable forward (kind: "json" | "binary" |
         "envelope"). Atomic write: tmp + rename, CRC over the body. The
         bound traceparent rides the record so a redelivery hours later
-        still joins the original batch's trace."""
+        still joins the original batch's trace. ``defer_s`` (an owner
+        Retry-After on a 429 shed) delays the first redelivery attempt."""
         from sitewhere_tpu.utils.tracing import current_traceparent
 
         rec = {"fid": fid, "kind": kind, "tenant": tenant,
@@ -272,6 +279,8 @@ class ForwardQueue:
         with open(tmp, "rb") as fh:
             os.fsync(fh.fileno())
         tmp.rename(peer_dir / name)
+        if defer_s is not None and defer_s > 0:
+            self._defer[name] = time.monotonic() + defer_s
         self.counters["spilled_batches"] += 1
         self.counters["spilled_payloads"] += len(payloads or []) or 1
         logger.warning("forward to rank %d spilled (%s, %d payloads)",
@@ -317,13 +326,24 @@ class ForwardQueue:
           dead-letter the poison file after ``app_reject_attempts``, and
           CONTINUE to the next file — one poison batch must not
           head-of-line-block every batch behind it for the whole
-          transport budget (up to 5 minutes before this fix)."""
+          transport budget (up to 5 minutes before this fix).
+
+        A ``code=429`` app reject (owner-side load shed, ISSUE 9) is
+        retryABLE by design: it counts in ``retry_app_rejects`` like any
+        app reject, but it NEVER counts toward the poison budget (an
+        admitted batch must not dead-letter because the owner was
+        briefly saturated) and its redelivery defers by the owner's
+        Retry-After."""
         from sitewhere_tpu.rpc.protocol import RpcError
 
         redelivered = 0
         for peer_dir in sorted(self.dir.glob("rank-*")):
             rank = int(peer_dir.name.split("-")[1])
             for path in sorted(peer_dir.glob("spill-*.json")):
+                if self._defer.get(path.name, 0.0) > time.monotonic():
+                    continue   # owner asked for backoff; later files
+                               # may already be due (dedup + the ring
+                               # absorb the reorder, like app rejects)
                 rec = self._load(path)
                 if rec is None:
                     logger.error("corrupt spill %s -> deadletter", path)
@@ -336,6 +356,15 @@ class ForwardQueue:
                 except RpcError as e:
                     self.counters["retry_failures"] += 1
                     self.counters["retry_app_rejects"] += 1
+                    if getattr(e, "code", None) == 429:
+                        ra = (getattr(e, "retry_after_s", None)
+                              or self.retry_interval_s)
+                        self._defer[path.name] = time.monotonic() + ra
+                        logger.warning(
+                            "forward to rank %d shed by owner (%s); "
+                            "deferring %s for %.3fs", rank, e,
+                            path.name, ra)
+                        continue
                     n = self._attempts.get(path.name, 0) + 1
                     self._attempts[path.name] = n
                     if n >= self.app_reject_attempts:
@@ -358,6 +387,7 @@ class ForwardQueue:
                         continue
                     break   # keep order: don't skip ahead of an outage
                 self._attempts.pop(path.name, None)
+                self._defer.pop(path.name, None)
                 path.unlink()
                 redelivered += 1
                 self.counters["redelivered_batches"] += 1
@@ -368,6 +398,7 @@ class ForwardQueue:
         dl.mkdir(parents=True, exist_ok=True)
         path.rename(dl / path.name)
         self._attempts.pop(path.name, None)
+        self._defer.pop(path.name, None)
         self.counters["deadlettered_batches"] += 1
 
     # ------------------------------------------------------- lifecycle

@@ -78,7 +78,8 @@ class RpcClient:
                     continue
                 if "error" in frame:
                     fut.set_exception(
-                        RpcError(frame["error"], frame.get("code", 500)))
+                        RpcError(frame["error"], frame.get("code", 500),
+                                 retry_after_s=frame.get("retryAfterS")))
                 else:
                     fut.set_result(frame.get("result"))
         except asyncio.CancelledError:

@@ -40,11 +40,17 @@ ATTACH_BIT = 0x80000000
 
 
 class RpcError(Exception):
-    """Remote error surfaced to the caller (code mirrors HTTP semantics)."""
+    """Remote error surfaced to the caller (code mirrors HTTP semantics).
+    ``retry_after_s`` rides error frames as ``retryAfterS`` for
+    ``code=429`` load-shed rejects (ISSUE 9): the sender's retry
+    machinery honors the OWNER's backoff hint instead of inventing its
+    own."""
 
-    def __init__(self, message: str, code: int = 500):
+    def __init__(self, message: str, code: int = 500,
+                 retry_after_s: float | None = None):
         super().__init__(message)
         self.code = code
+        self.retry_after_s = retry_after_s
 
 
 def _default(o):

@@ -30,6 +30,7 @@ from typing import Any, Awaitable, Callable
 
 from sitewhere_tpu.core.types import EventType
 from sitewhere_tpu.rpc.protocol import RpcError, encode_frame, read_frame
+from sitewhere_tpu.utils.qos import ShedError, admit_or_raise
 
 logger = logging.getLogger(__name__)
 
@@ -215,6 +216,15 @@ class RpcServer:
             resp = r.resp
         except RpcError as e:
             resp = {"id": rid, "error": str(e), "code": e.code}
+            if getattr(e, "retry_after_s", None) is not None:
+                resp["retryAfterS"] = e.retry_after_s
+        except ShedError as e:
+            # typed load shed from an admission edge or an arena-stall
+            # translation: the RPC form of REST's 429 + Retry-After —
+            # an app-level reject the forward retry machinery can
+            # classify (never a transport failure)
+            resp = {"id": rid, "error": str(e), "code": 429,
+                    "retryAfterS": e.retry_after_s}
         except (KeyError, ValueError, TypeError) as e:
             resp = {"id": rid, "error": str(e), "code": 400}
         except Exception as e:
@@ -425,6 +435,17 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
 
         req = request_from_envelope(envelope)
         req.tenant = tenant
+        # ingest edge: per-tenant admission (ISSUE 9) — a shed surfaces
+        # as a typed 429 app-reject, never a silent drop. On a cluster
+        # facade admission is per OWNER: this edge admits only
+        # locally-owned devices (a remote owner's handler sheds with
+        # its own 429) — charging the edge rank's bucket for
+        # remote-owned traffic would double-charge the tenant.
+        eng = inst.engine
+        if not hasattr(eng, "cluster_config"):
+            admit_or_raise(eng, tenant, 1)
+        elif eng.owner(req.device_token) == eng.rank:
+            admit_or_raise(eng.local, tenant, 1)
         inst.engine.process(req)
         inst.engine.flush()
         return {"accepted": True}

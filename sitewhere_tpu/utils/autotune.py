@@ -49,6 +49,12 @@ G_CHUNK = REGISTRY.gauge(
 C_ADJUST = REGISTRY.counter(
     "swtpu_autotune_adjustments",
     "Autotuner knob adjustments, labeled by knob and direction")
+G_SHED = REGISTRY.gauge(
+    "swtpu_autotune_shed_threshold",
+    "QoS saturation shed threshold chosen by the SLO autotuner")
+G_P99 = REGISTRY.gauge(
+    "swtpu_autotune_p99_ms",
+    "worst per-tenant ingest-e2e p99 the SLO autotuner last observed")
 
 
 def decide(stats: dict, current: dict, bounds: dict) -> list[tuple]:
@@ -93,6 +99,61 @@ def decide(stats: dict, current: dict, bounds: dict) -> list[tuple]:
     return out
 
 
+def decide_slo(p99_ms: float | None, target_ms: float, stats: dict,
+               current: dict, bounds: dict) -> list[tuple]:
+    """Pure SLO policy (ISSUE 9): steer toward a per-tenant ingest-e2e
+    p99 TARGET instead of raw throughput. Proposals only fire outside
+    the hysteresis dead band [0.5x, 1.25x] around the target, so scrape
+    noise cannot ping-pong a knob.
+
+    Violating (p99 > 1.25x target) — relieve the measured bottleneck
+    first (the same stage attribution as the throughput policy: decode
+    dominance widens fan-out, device dominance overlaps programs, a
+    latency-costly scan chunk halves), then TIGHTEN the shed threshold
+    (shed earlier: trade goodput for tail). Comfortable (p99 < 0.5x
+    target) — RELAX the shed threshold back toward bounds so goodput
+    recovers once the tail is safe. One change per evaluation, like the
+    throughput policy; the caller applies the first proposal."""
+    out: list[tuple] = []
+    if p99_ms is None or target_ms is None or target_ms <= 0:
+        return out
+    decode = stats.get("decode_ms") or 0.0
+    wal = stats.get("wal_ms") or 0.0
+    wait = stats.get("dispatch_wait_ms") or 0.0
+    device = stats.get("device_ms") or 0.0
+    host = decode + wal
+    workers = current.get("ingest_workers", 1)
+    depth = current.get("dispatch_depth", 1)
+    chunk = current.get("scan_chunk", 1)
+    shed = current.get("shed_threshold")
+    why = f"p99 {p99_ms:.1f}ms vs target {target_ms:.1f}ms"
+    if p99_ms > 1.25 * target_ms:
+        if (decode > device and decode > wal + wait
+                and workers < bounds["max_workers"]):
+            out.append(("ingest_workers", workers + 1,
+                        f"{why}: decode {decode:.2f}ms dominates; "
+                        "widen fan-out"))
+        if (device > 1.5 * max(host, 1e-9)
+                and depth < bounds["max_depth"]):
+            out.append(("dispatch_depth", depth + 1,
+                        f"{why}: device {device:.2f}ms dominates; "
+                        "overlap programs"))
+        if chunk > 1:
+            out.append(("scan_chunk", max(1, chunk // 2),
+                        f"{why}: scan chunk adds K-1 batches of "
+                        "latency; halve it"))
+        if shed is not None and shed > bounds.get("min_shed", 1):
+            out.append(("shed_threshold",
+                        max(bounds.get("min_shed", 1), shed // 2),
+                        f"{why}: shed earlier to protect the tail"))
+    elif p99_ms < 0.5 * target_ms:
+        if shed is not None and shed < bounds.get("max_shed", shed):
+            out.append(("shed_threshold",
+                        min(bounds["max_shed"], shed * 2),
+                        f"{why}: tail is safe; admit more"))
+    return out
+
+
 class StageTimeAutotuner:
     """Periodic controller over one engine's ingest knobs.
 
@@ -122,15 +183,32 @@ class StageTimeAutotuner:
         self._since = 0
         self.evaluations = 0
         self.label = f"e{next(_ENGINE_IDS)}"
+        # SLO objective (ISSUE 9): with a p99 target configured, the
+        # controller steers toward the target (decide_slo) instead of
+        # raw throughput, and additionally owns the QoS shed threshold
+        self.slo_target_ms = getattr(engine.config,
+                                     "slo_p99_target_ms", None)
+        # per-series (bucket counts, total) snapshot from the previous
+        # evaluation — slo_p99_ms() steers on the delta, never the
+        # cumulative-forever histogram
+        self._slo_prev: dict[tuple, tuple[list[int], int]] = {}
+        bc = max(1, getattr(engine.config, "batch_capacity", 1))
+        self.min_shed = bc
+        self.max_shed = 64 * bc * max(1, getattr(engine.config,
+                                                 "scan_chunk", 1))
 
     def current(self) -> dict:
         eng = self.engine
         sharder = getattr(eng, "_sharder", None)
-        return {
+        out = {
             "ingest_workers": (sharder.active_workers if sharder else 1),
             "dispatch_depth": max(1, eng.config.dispatch_depth),
             "scan_chunk": max(1, eng.config.scan_chunk),
         }
+        qos = getattr(eng, "qos", None)
+        out["shed_threshold"] = (qos.shed_threshold if qos is not None
+                                 else None)
+        return out
 
     def note_dispatch(self) -> None:
         self._since += 1
@@ -153,31 +231,110 @@ class StageTimeAutotuner:
             out[key] = statistics.median(vals) if vals else None
         return out
 
+    def slo_p99_ms(self) -> float | None:
+        """Worst per-tenant ingest-e2e p99 (ms) over the WINDOW since
+        the previous evaluation, read off the registry's SLO histogram
+        (``swtpu_ingest_e2e_seconds``) and restricted to THIS engine's
+        tenants — the registry is process-global. Windowing matters:
+        the histogram is cumulative-forever, so a lifetime quantile
+        would let one early overload (jit warmup, a single burst) pin
+        the reading above target for the rest of the process and
+        ratchet the shed threshold to its floor with no way to observe
+        recovery — each evaluation therefore diffs the bucket counts
+        against its previous snapshot and interpolates the quantile
+        from the delta (same bounding-bucket rule as
+        ``Histogram.quantile``; overflow clamps to the last finite
+        bound). ``None`` when the window saw no observations — the
+        policy then holds rather than acting on stale data. Harvests
+        pending flight records first through the same consume-once
+        drain the scrape exporter uses; both feed ONE histogram, so
+        exactly-once totals hold regardless of who drains first.
+
+        Known limit: the tenant filter keys on this engine's interner,
+        and every engine interns tenant "default" at construction — two
+        SLO-targeted engines in one process therefore share the
+        default-tenant series (same registry-is-process-global caveat as
+        the PR-7 SLO tests, which isolate with fresh tenant names).
+        Steer real multi-engine deployments with named tenants."""
+        from sitewhere_tpu.utils.metrics import harvest_slo, slo_metrics
+
+        harvest_slo(self.engine)
+        hist = slo_metrics()["ingest_e2e"]
+        with hist._lock:
+            snap = {k: (list(v), hist._totals.get(k, 0))
+                    for k, v in hist._counts.items()}
+        lookup = getattr(self.engine.tenants, "lookup", None)
+        worst = None
+        for key, (counts, total) in snap.items():
+            tenant = dict(key).get("tenant")
+            if tenant is None or (lookup is not None
+                                  and lookup(tenant) < 0):
+                continue
+            prev_counts, prev_total = self._slo_prev.get(
+                key, ([0] * len(counts), 0))
+            self._slo_prev[key] = (counts, total)
+            delta = [c - p for c, p in zip(counts, prev_counts)]
+            n = total - prev_total
+            if n <= 0:
+                continue
+            target = 0.99 * n
+            acc = 0
+            q = hist.buckets[-1]
+            for i, c in enumerate(delta):
+                if c and acc + c >= target:
+                    lo = hist.buckets[i - 1] if i else 0.0
+                    hi = hist.buckets[i]
+                    frac = min(1.0, max(0.0, (target - acc) / c))
+                    q = lo + (hi - lo) * frac
+                    break
+                acc += c
+            if worst is None or q > worst:
+                worst = q
+        return worst * 1000.0 if worst is not None else None
+
     def evaluate(self) -> dict | None:
         """One control step: measure, decide, apply at most one change,
-        export gauges. Returns the applied decision (or None)."""
+        export gauges. With an SLO target the decision rule is
+        ``decide_slo`` (p99-vs-target with hysteresis, shed threshold
+        included); otherwise the throughput rule ``decide``. Returns the
+        applied decision (or None)."""
         self.evaluations += 1
         stats = self.window_stats()
         applied = None
+        p99_ms = None
+        if self.slo_target_ms is not None:
+            p99_ms = self.slo_p99_ms()
+            if p99_ms is not None:
+                G_P99.set(p99_ms, engine=self.label)
         if stats is not None:
             cur = self.current()
             bounds = {"max_workers": self.max_workers,
                       "max_depth": self.max_depth,
-                      "max_chunk": self.max_chunk}
-            for knob, value, reason in decide(stats, cur, bounds):
+                      "max_chunk": self.max_chunk,
+                      "min_shed": self.min_shed,
+                      "max_shed": self.max_shed}
+            if self.slo_target_ms is not None:
+                proposals = decide_slo(p99_ms, self.slo_target_ms,
+                                       stats, cur, bounds)
+            else:
+                proposals = decide(stats, cur, bounds)
+            for knob, value, reason in proposals:
                 if knob == "scan_chunk" and not self.adapt_scan_chunk:
                     continue
                 self.engine.set_ingest_tuning(**{knob: value})
                 applied = {"knob": knob, "from": cur[knob], "to": value,
-                           "reason": reason, "stats": stats}
+                           "reason": reason, "stats": stats,
+                           "p99_ms": p99_ms}
                 self.decisions.append(applied)
                 del self.decisions[:-64]
                 C_ADJUST.inc(engine=self.label, knob=knob,
-                             direction="up" if value > cur[knob]
+                             direction="up" if value > (cur[knob] or 0)
                              else "down")
                 break
         cur = self.current()
         G_WORKERS.set(cur["ingest_workers"], engine=self.label)
         G_DEPTH.set(cur["dispatch_depth"], engine=self.label)
         G_CHUNK.set(cur["scan_chunk"], engine=self.label)
+        if cur.get("shed_threshold") is not None:
+            G_SHED.set(cur["shed_threshold"], engine=self.label)
         return applied

@@ -113,12 +113,17 @@ class Gauge(_LabeledSeries):
     def dec(self, amount: float = 1.0, **labels) -> None:
         self.inc(-amount, **labels)
 
-    def retain(self, keys: set) -> None:
+    def retain(self, keys: set, **scope) -> None:
         """Drop series not written by the current export — a drained
         queue's age gauge or a dead rank's counters must disappear, not
-        freeze at their last sample."""
+        freeze at their last sample. ``scope`` label filters limit the
+        sweep to one writer's series (e.g. ``engine="e0"``) so exporters
+        sharing a gauge never retain-away each other's samples."""
         with self._lock:
             for key in [k for k in self._values if k not in keys]:
+                if scope and any(dict(key).get(a) != v
+                                 for a, v in scope.items()):
+                    continue
                 del self._values[key]
 
 
@@ -465,6 +470,47 @@ def slo_metrics(registry: MetricsRegistry | None = None) -> dict:
     }
 
 
+def qos_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """Overload-discipline instruments (ISSUE 9). Kept OUT of
+    engine.metrics() (dispatch-shape equality) like the query /
+    replication / archive instruments. Every series carries an
+    ``engine`` label (the controller's autotuner-style ``e<n>`` tag) —
+    the REGISTRY is process-global, so in-process cluster ranks and
+    multi-engine tests would otherwise merge counters and
+    last-writer-win each other's gauges.
+
+      swtpu_qos_admitted_total   events admitted, per tenant (live)
+      swtpu_qos_shed_total       events shed, per tenant + reason
+                                 ("rate" | "saturated" | "stall"; live)
+      swtpu_qos_bucket_fill      token-bucket balance per tenant (scrape)
+      swtpu_qos_saturated        1 while backlog >= shed threshold
+      swtpu_qos_shed_threshold   current saturation threshold (rows)
+      swtpu_qos_wfq_vtime        weighted-fair virtual time per tenant,
+                                 labeled by resource (ingest | query)
+    """
+    reg = registry or REGISTRY
+    return {
+        "admitted": reg.counter(
+            "swtpu_qos_admitted_total",
+            "events admitted by per-tenant admission control"),
+        "shed": reg.counter(
+            "swtpu_qos_shed_total",
+            "events shed by admission control, per tenant and reason"),
+        "fill": reg.gauge(
+            "swtpu_qos_bucket_fill",
+            "admission token-bucket balance per tenant"),
+        "saturated": reg.gauge(
+            "swtpu_qos_saturated",
+            "1 while the engine backlog exceeds the shed threshold"),
+        "threshold": reg.gauge(
+            "swtpu_qos_shed_threshold",
+            "staged-row backlog beyond which ingest sheds"),
+        "wfq_vtime": reg.gauge(
+            "swtpu_qos_wfq_vtime",
+            "weighted-fair virtual time per tenant and resource"),
+    }
+
+
 def cluster_metrics_instruments(registry: MetricsRegistry | None
                                 = None) -> dict:
     """Cluster data-plane instruments (ISSUE 7):
@@ -645,10 +691,55 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
 
     # SLO latency plane (ISSUE 7): drain completed ingest lifecycles the
     # recorder accumulated since the last scrape into the per-tenant e2e
-    # histogram — each record observed exactly once, weighted by its
-    # payload count, with a trace-id exemplar when the batch landed in
-    # the slowest decile of its tenant's series (a p99 spike on the
-    # scrape then links straight to /api/instance/trace/<id>)
+    # histogram (the SLO autotuner shares the same drain via
+    # harvest_slo — both feed ONE histogram, so exactly-once totals hold
+    # no matter which consumer drains first)
+    harvest_slo(engine, reg)
+
+    # overload-discipline plane (ISSUE 9): admission-bucket balances,
+    # saturation state, and the weighted-fair virtual clocks — the
+    # admitted/shed counters are incremented LIVE by the controller;
+    # only balances/clocks are sampled here at scrape time
+    qos = getattr(engine, "qos", None)
+    if qos is not None:
+        inst = qos_metrics(reg)
+        lbl = getattr(qos, "label", "e?")
+        fill = inst["fill"]
+        current: set[tuple] = set()
+        for tenant, tokens in qos.bucket_fill().items():
+            fill.set(tokens, tenant=tenant, engine=lbl)
+            current.add(tuple(sorted({"tenant": tenant,
+                                      "engine": lbl}.items())))
+        fill.retain(current, engine=lbl)
+        inst["threshold"].set(qos.shed_threshold, engine=lbl)
+        vt = inst["wfq_vtime"]
+        keep: set[tuple] = set()
+        gate = getattr(engine, "_wfq_gate", None)
+        if gate is not None:
+            for tenant, v in gate.vtimes().items():
+                vt.set(v, tenant=tenant, resource="ingest", engine=lbl)
+                keep.add(tuple(sorted({"tenant": tenant,
+                                       "resource": "ingest",
+                                       "engine": lbl}.items())))
+        picker = getattr(getattr(engine, "_query_batcher", None),
+                         "_wfq", None)
+        if picker is not None:
+            for tenant, v in picker.vtimes().items():
+                vt.set(v, tenant=tenant, resource="query", engine=lbl)
+                keep.add(tuple(sorted({"tenant": tenant,
+                                       "resource": "query",
+                                       "engine": lbl}.items())))
+        vt.retain(keep, engine=lbl)
+
+
+def harvest_slo(engine, registry: MetricsRegistry | None = None) -> None:
+    """Drain completed ingest lifecycles into the per-tenant e2e SLO
+    histogram — each record observed exactly once, weighted by its
+    payload count, with a trace-id exemplar when the batch landed in the
+    slowest decile of its tenant's series (a p99 spike on the scrape
+    then links straight to /api/instance/trace/<id>). Shared by the
+    scrape exporter and the SLO autotuner."""
+    reg = registry or REGISTRY
     harvest = getattr(engine, "slo_harvest", None)
     if callable(harvest):
         hist = slo_metrics(reg)["ingest_e2e"]

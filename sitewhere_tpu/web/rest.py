@@ -18,6 +18,7 @@ import base64
 import dataclasses
 import functools
 import json
+import math
 from typing import Any
 
 import numpy as np
@@ -134,12 +135,38 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     @web.middleware
     async def error_middleware(request: web.Request, handler):
+        from sitewhere_tpu.rpc.protocol import RpcError
+        from sitewhere_tpu.utils.qos import ShedError
+
         try:
             return await handler(request)
         except EntityNotFound as e:
             return json_response({"error": str(e)}, status=404)
         except DuplicateToken as e:
             return json_response({"error": str(e)}, status=409)
+        except ShedError as e:
+            # overload discipline (ISSUE 9): an admission shed (or a
+            # translated arena stall) answers 429 with an explicit
+            # Retry-After — the client backs off instead of timing out
+            return json_response(
+                {"error": str(e), "retryAfterS": e.retry_after_s,
+                 "reason": e.reason},
+                status=429,
+                headers={"Retry-After":
+                         str(max(1, math.ceil(e.retry_after_s)))})
+        except RpcError as e:
+            # a forwarded single request shed at its OWNER rank comes
+            # back as a typed code=429 RpcError (the synchronous
+            # all-or-nothing envelope contract re-raises owner app
+            # errors) — answer the same 429 + Retry-After the local
+            # edge would, not a 500
+            if getattr(e, "code", None) != 429:
+                raise
+            ra = getattr(e, "retry_after_s", None) or 0.05
+            return json_response(
+                {"error": str(e), "retryAfterS": ra, "reason": "shed"},
+                status=429,
+                headers={"Retry-After": str(max(1, math.ceil(ra)))})
         except (ValueError, KeyError, EventDecodeException) as e:
             return json_response({"error": str(e)}, status=400)
 
@@ -533,10 +560,28 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     # --- device events (ingest via REST + query) -------------------------
     async def post_device_event(request: web.Request):
+        from sitewhere_tpu.utils.qos import admit_or_raise
+
         body = await request.json()
         body.setdefault("deviceToken", request.match_info["token"])
         req = request_from_envelope(body)
         req.tenant = request.get("tenant", req.tenant)
+        # ingest edge: per-tenant admission (ISSUE 9). A shed raises
+        # ShedError, which the error middleware answers as 429 +
+        # Retry-After — explicit backpressure, never a silent drop.
+        # On a cluster facade admission is per OWNER: this edge admits
+        # only locally-owned devices (a remote owner's handler sheds
+        # with a code=429 RpcError the middleware translates the same
+        # way) — charging the edge rank's bucket for remote-owned
+        # traffic would double-charge the tenant and cap cluster-wide
+        # throughput at one rank's rate. Admission stays at the edge,
+        # never inside process(): internal emitters (zone/anomaly
+        # alerts, scheduler fires) must not shed derived events.
+        eng = inst.engine
+        if not hasattr(eng, "cluster_config"):
+            admit_or_raise(eng, req.tenant, 1)
+        elif eng.owner(req.device_token) == eng.rank:
+            admit_or_raise(eng.local, req.tenant, 1)
         inst.engine.process(req)
         inst.engine.flush()
         return json_response({"accepted": True}, status=201)
@@ -1314,13 +1359,23 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     async def post_event_batch(request: web.Request):
         """Accept a JSON array of DeviceRequest envelopes in one call — the
         bulk ingest surface the per-device POST cannot batch. Rows decode
-        through the native batch path when available."""
+        through the native batch path when available. Admission (ISSUE 9)
+        is all-or-nothing at this edge; on a cluster facade the facade
+        itself admits per owning rank (local sub-batch + owner-side
+        handlers), so the edge does not double-charge the local bucket —
+        a fully shed facade batch still answers 429 + Retry-After."""
         from sitewhere_tpu.ingest.decoders import split_json_array
+        from sitewhere_tpu.utils.qos import admit_or_raise
 
         body = await request.read()
         rows = split_json_array(body)   # raw slices; decoded once, natively
-        res = inst.engine.ingest_json_batch(
-            rows, tenant=request.get("tenant", "default"))
+        tenant = request.get("tenant", "default")
+        if not hasattr(inst.engine, "cluster_config"):
+            admit_or_raise(inst.engine, tenant, len(rows))
+        # a fully-shed facade sub-batch raises its own typed ShedError
+        # inside ingest_json_batch (all-or-nothing), which the error
+        # middleware maps to 429 + Retry-After like the edge check above
+        res = inst.engine.ingest_json_batch(rows, tenant=tenant)
         inst.engine.flush()
         return json_response(res, status=201)
 

@@ -1,0 +1,780 @@
+"""Overload discipline (ISSUE 9): per-tenant admission control,
+weighted-fair scheduling, load shedding, and the SLO-steered autotuner.
+
+Pinned properties:
+  * token-bucket admission is DETERMINISTIC under a seeded/manual clock
+    (same clock trace => same decision trace);
+  * weighted-fair queuing: 2:1 weights => ~2:1 admitted throughput under
+    saturation, for both the ingest gate and query-round membership;
+  * shed-then-recover: no admitted event is lost or double-applied
+    across a shed/retry cycle, and the WAL holds exactly the admitted
+    payloads;
+  * a 429 surfaced for a forwarded batch lands in retry_app_rejects
+    (never retry_transport_failures), defers by the owner's Retry-After,
+    never poison-dead-letters, and delivers exactly once on recovery;
+  * the full-metrics-dict equality across dispatch shapes still holds
+    with QoS on (engine.metrics() carries NO QoS keys);
+  * ArenaPool.acquire(timeout_s=...) raises a typed ArenaStallError on a
+    wedged dispatch, which the engine translates to a shed;
+  * loadgen's abusive-tenant knob stays seed-deterministic and
+    OpenLoopResult reports per-tenant shed counts.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.utils.qos import (AdmissionController, ManualClock,
+                                     ShedError, WeightedFairGate,
+                                     WFQPicker, admit_or_raise)
+
+
+def _meas(token, seq=0, value=1.0):
+    return json.dumps({
+        "deviceToken": token, "type": "DeviceMeasurement",
+        "request": {"name": "t", "value": value,
+                    "metadata": {"seq": str(seq)}}}).encode()
+
+
+def _small_cfg(**kw):
+    base = dict(device_capacity=64, token_capacity=128,
+                assignment_capacity=128, store_capacity=4096,
+                batch_capacity=16, channels=4)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+# --------------------------------------------------------------- buckets
+def test_token_bucket_deterministic_under_manual_clock():
+    """Same config + same clock trace => byte-identical decision trace
+    (the chaos-replay property). Refill arithmetic is exact."""
+
+    def trace():
+        clk = ManualClock()
+        ac = AdmissionController(tenant_rates={"qos-det": 10.0},
+                                 burst_s=1.0, clock=clk)
+        out = []
+        for i in range(14):
+            d = ac.admit("qos-det", 1)
+            out.append((d.admitted, round(d.retry_after_s, 6), d.reason))
+            if i == 11:
+                clk.advance(0.35)
+        return out
+
+    t1, t2 = trace(), trace()
+    assert t1 == t2
+    # capacity = 10 tokens: 10 admits, then rate sheds with an exact
+    # retry hint (1 token / 10 eps = 0.1s), then the 0.35s refill buys
+    # exactly 3 more admits
+    assert [a for a, _, _ in t1[:10]] == [True] * 10
+    assert t1[10] == (False, 0.1, "rate")
+    assert t1[11] == (False, 0.1, "rate")
+    assert [a for a, _, _ in t1[12:]] == [True, True]
+    # an oversized request (n > bucket capacity) admits against a FULL
+    # bucket and goes into debt — the bucket can never hold n tokens, so
+    # refusing it would 429-loop the caller forever on a retry hint that
+    # waiting cannot satisfy; the debt throttles what follows instead
+    # (long-run rate preserved)
+    ac2 = AdmissionController(tenant_rates={"qos-det2": 10.0}, burst_s=1.0,
+                              clock=ManualClock())
+    assert ac2.admit("qos-det2", 25).admitted        # full bucket: debt
+    d = ac2.admit("qos-det2", 1)                     # balance now -15
+    assert not d.admitted and d.retry_after_s == pytest.approx(1.6)
+
+
+def test_admission_saturation_valve_and_unlimited_default():
+    backlog = {"n": 0}
+    clk = ManualClock()
+    ac = AdmissionController(shed_threshold=100,
+                             backlog_fn=lambda: backlog["n"], clock=clk,
+                             min_retry_after_s=0.07)
+    # unlimited default rate: any volume admits while not saturated
+    assert ac.admit("qos-sat", 10_000).admitted
+    backlog["n"] = 100
+    d = ac.admit("qos-sat", 1)
+    assert (d.admitted, d.reason) == (False, "saturated")
+    assert d.retry_after_s == pytest.approx(0.07)
+    backlog["n"] = 99
+    assert ac.admit("qos-sat", 1).admitted
+    assert ac.shed_by_tenant["qos-sat"] == 1
+
+
+def test_admit_or_raise_typed_shed():
+    class H:
+        qos = AdmissionController(tenant_rates={"qos-t": 1.0},
+                                  burst_s=1.0, clock=ManualClock())
+
+    admit_or_raise(H(), "qos-t", 1)
+    with pytest.raises(ShedError) as ei:
+        admit_or_raise(H(), "qos-t", 5)
+    assert ei.value.reason == "rate" and ei.value.retry_after_s > 0
+    admit_or_raise(object(), "qos-t", 99)   # no controller = no-op
+
+
+# ------------------------------------------------------------------ WFQ
+def test_wfq_gate_two_to_one_ratio_under_saturation():
+    """2:1 weights => ~2:1 granted turns while both tenants always have
+    a waiter (the gate itself is the scheduler, so the ratio is a
+    property of the virtual-time rule, not the OS scheduler)."""
+    gate = WeightedFairGate({"wfq-a": 2.0, "wfq-b": 1.0})
+    stop = threading.Event()
+    start = threading.Barrier(4)   # every tenant is contending from
+                                   # grant #1 — no head start
+
+    def hammer(tenant):
+        start.wait()
+        while not stop.is_set():
+            with gate.turn(tenant, 1):
+                # a non-trivial turn: the GIL must rotate so BOTH
+                # tenants actually contend (a no-op body lets one
+                # thread blast the whole budget in a single GIL slice)
+                time.sleep(0.0005)
+                if gate.grants.get("wfq-a", 0) + \
+                        gate.grants.get("wfq-b", 0) >= 600:
+                    stop.set()
+
+    ts = [threading.Thread(target=hammer, args=(t,))
+          for t in ("wfq-a", "wfq-b") for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    ratio = gate.grants["wfq-a"] / max(1, gate.grants["wfq-b"])
+    assert 1.5 <= ratio <= 2.7, gate.grants
+
+
+def test_wfq_gate_uncontended_is_immediate_and_idle_banks_nothing():
+    gate = WeightedFairGate({"solo": 1.0})
+    for _ in range(5):
+        with gate.turn("solo"):
+            pass
+    # a fresh tenant entering later starts at the current virtual clock,
+    # not at 0 — it may not starve the incumbent with banked silence
+    with gate.turn("late"):
+        pass
+    assert gate.vtimes()["late"] >= gate._vnow - 1.0
+
+
+def test_wfq_picker_exact_weighted_membership():
+    p = WFQPicker({"qa": 2.0, "qb": 1.0})
+    entries = ([{"tenant": "qa", "i": i} for i in range(8)]
+               + [{"tenant": "qb", "i": i} for i in range(8)])
+    sel, rest = p.pick(entries, 6)
+    counts = {"qa": 0, "qb": 0}
+    for e in sel:
+        counts[e["tenant"]] += 1
+    assert counts == {"qa": 4, "qb": 2}
+    # FIFO within a tenant, rest preserves arrival order
+    assert [e["i"] for e in sel if e["tenant"] == "qa"] == [0, 1, 2, 3]
+    assert len(rest) == 10 and [e["i"] for e in rest
+                                if e["tenant"] == "qb"] == list(range(2, 8))
+    # a tenant alone gets the whole round regardless of weight
+    sel2, rest2 = p.pick([{"tenant": "qb", "i": i} for i in range(4)], 3)
+    assert len(sel2) == 3 and len(rest2) == 1
+
+
+def test_query_batcher_wfq_round_membership():
+    """With QoS on, an overflowing query round grants slots by weight
+    instead of arrival order: a flooding tenant cannot fill every slot
+    of the next round ahead of another tenant's single query."""
+    eng = Engine(_small_cfg(qos=True, query_coalesce=4,
+                            tenant_weights={"qf-a": 1.0, "qf-b": 1.0}))
+    b = eng._query_batcher
+    assert b._wfq is not None
+    flood = [{"tenant": "qf-a", "i": i} for i in range(6)]
+    other = [{"tenant": "qf-b", "i": 0}]
+    sel, rest = b._wfq.pick(flood + other, 4)
+    assert {"qf-b"} <= {e["tenant"] for e in sel}
+    # tenant flows through query_events into the batcher entry
+    captured = {}
+    orig = b.run
+
+    def spy(params, limit, archive=None, tenant=None):
+        captured["tenant"] = tenant
+        return orig(params, limit, archive=archive, tenant=tenant)
+
+    b.run = spy
+    eng.query_events(tenant="default", limit=5)
+    assert captured["tenant"] == "default"
+
+
+def test_engine_wfq_fairness_under_saturation():
+    """Engine-level WFQ: tenants hammering batch ingest through the gate
+    get admitted throughput ~ their 2:1 weights. The EXACT ratio rule is
+    pinned deterministically at the gate level above; this test pins the
+    WIRING (the gate really orders batch ingest) so the band tolerates
+    OS-scheduler skew on a loaded box: two threads per tenant keep a
+    waiter parked on both sides, and the run stops on a GRANT COUNT, not
+    wall time, so a slow box still collects a meaningful sample."""
+    eng = Engine(_small_cfg(qos=True,
+                            tenant_weights={"ewf-a": 2.0, "ewf-b": 1.0},
+                            batch_capacity=32))
+    payloads = {t: [_meas(f"{t}-{i}") for i in range(8)]
+                for t in ("ewf-a", "ewf-b")}
+    stop = threading.Event()
+    start = threading.Barrier(4)
+
+    def hammer(tenant):
+        start.wait()
+        while not stop.is_set():
+            eng.ingest_json_batch(payloads[tenant], tenant)
+            g = eng._wfq_gate.grants
+            if g.get("ewf-a", 0) + g.get("ewf-b", 0) >= 180:
+                stop.set()
+
+    ts = [threading.Thread(target=hammer, args=(t,))
+          for t in ("ewf-a", "ewf-b") for _ in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    eng.flush()
+    g = eng._wfq_gate.grants
+    ratio = g["ewf-a"] / max(1, g["ewf-b"])
+    assert 1.3 <= ratio <= 3.5, g
+
+
+# ------------------------------------------------- shed-then-recover
+def test_shed_then_recover_no_loss_no_dup_wal_clean(tmp_path):
+    """A shed/retry cycle loses nothing and double-applies nothing: the
+    edge retries shed frames until admitted; afterwards the persisted
+    count equals the admitted count exactly and the WAL holds exactly
+    one record per admitted payload (shed frames never touch it)."""
+    clk = ManualClock()
+    eng = Engine(_small_cfg(qos=True, wal_dir=str(tmp_path / "wal"),
+                            store_capacity=8192, batch_capacity=64))
+    eng.qos = AdmissionController(tenant_rates={"sr-t": 40.0},
+                                  burst_s=1.0, clock=clk)
+    frames = [[_meas(f"sr-{j}", seq=i * 10 + j) for j in range(10)]
+              for i in range(12)]
+    admitted = sheds = 0
+    backlog = list(frames)
+    rounds = 0
+    while backlog and rounds < 100:
+        rounds += 1
+        still = []
+        for f in backlog:
+            d = eng.qos.admit("sr-t", len(f))
+            if d.admitted:
+                eng.ingest_json_batch(f, "sr-t")
+                admitted += len(f)
+            else:
+                sheds += 1
+                still.append(f)   # the client retries after Retry-After
+        backlog = still
+        clk.advance(0.5)
+    assert not backlog and sheds > 0     # the cycle actually shed
+    eng.flush()
+    assert admitted == 120
+    counters = eng.tenant_pipeline_counters().get("sr-t", {})
+    assert counters.get("accepted") == 120          # no loss
+    assert counters.get("dedup_dropped", 0) == 0    # no double-apply
+    # WAL clean: exactly one record per ADMITTED payload
+    from sitewhere_tpu.utils.ingestlog import IngestLog
+
+    eng.wal.sync()
+    records = list(IngestLog(tmp_path / "wal", readonly=True).replay())
+    assert len(records) == 120
+
+
+def test_metrics_dict_equality_across_dispatch_shapes_with_qos():
+    """The PR-2..5 parity pin extended: with QoS enabled, engine.metrics()
+    must still be EQUAL across scan_chunk shapes — every QoS instrument
+    lives in the Prometheus registry, none leak into metrics()."""
+    def build(chunk):
+        return Engine(_small_cfg(qos=True, scan_chunk=chunk,
+                                 store_capacity=4096,
+                                 tenant_rates={"mq-t": 1e9}))
+
+    a, b = build(1), build(4)
+    b.epoch = a.epoch
+    payloads = [_meas(f"mq-{i % 10}", seq=i) for i in range(64)]
+    for eng in (a, b):
+        for lo in range(0, 64, 16):
+            eng.ingest_json_batch(payloads[lo:lo + 16], "mq-t")
+        eng.flush()
+    assert a.metrics() == b.metrics()
+    assert not any(k.startswith("qos") or "shed" in k
+                   for k in a.metrics())
+
+
+# ------------------------------------------------------- arena stall
+def test_arena_pool_acquire_timeout_raises_typed_stall():
+    from sitewhere_tpu.ingest.arena import ArenaPool, ArenaStallError
+
+    class Wedged:
+        def is_ready(self):
+            return False
+
+    pool = ArenaPool(2, rows=8, channels=2)
+    a1 = pool.acquire()
+    a2 = pool.acquire()
+    pool.retire(a1, Wedged())
+    pool.retire(a2, Wedged())
+    t0 = time.monotonic()
+    with pytest.raises(ArenaStallError):
+        pool.acquire(timeout_s=0.05)
+    assert time.monotonic() - t0 < 2.0
+    assert pool.waits == 1
+    # a wedged ticket that becomes ready is reclaimed normally
+    class Ready:
+        def is_ready(self):
+            return True
+
+    pool2 = ArenaPool(1, rows=8, channels=2)
+    b1 = pool2.acquire()
+    import numpy as np
+
+    pool2.retire(b1, np.zeros(1))
+    assert pool2.acquire(timeout_s=0.05) is b1
+
+
+def test_engine_translates_arena_stall_to_shed():
+    eng = Engine(_small_cfg(qos=True, arena_stall_timeout_s=0.02,
+                            tenant_rates={}))
+    if eng._arena_pool is None:
+        pytest.skip("native arena path unavailable")
+    from sitewhere_tpu.ingest.arena import ArenaStallError
+
+    def stall(timeout_s=None):
+        raise ArenaStallError("wedged (test)")
+
+    eng._arena_fill = None
+    eng._arena_pool.acquire = stall
+    with pytest.raises(ShedError) as ei:
+        eng.ingest_json_batch([_meas("st-0")], "st-t")
+    assert ei.value.reason == "stall"
+    assert eng._stall_sheds == 1
+    assert eng.qos.shed_by_tenant.get("st-t") == 1
+
+
+# ------------------------------------------------------------- loadgen
+def test_loadgen_abusive_knob_deterministic_and_additive():
+    from sitewhere_tpu.loadgen import (OpenLoopSpec, TenantLoad,
+                                       build_open_loop_schedule,
+                                       schedule_fingerprint)
+
+    def spec(mult):
+        return OpenLoopSpec(
+            tenants=(TenantLoad("lg-a", 400.0, abusive_mult=mult,
+                                abusive_period_s=0.4,
+                                abusive_burst_s=0.2),),
+            duration_s=1.0, frame_size=32, seed=7)
+
+    s_base = build_open_loop_schedule(spec(1.0))
+    s_abuse = build_open_loop_schedule(spec(3.0))
+    # determinism: same spec => identical fingerprint, both shapes
+    assert (schedule_fingerprint(s_base)
+            == schedule_fingerprint(build_open_loop_schedule(spec(1.0))))
+    assert (schedule_fingerprint(s_abuse)
+            == schedule_fingerprint(build_open_loop_schedule(spec(3.0))))
+    n_base = sum(len(op.payloads or ()) for op in s_base)
+    n_abuse = sum(len(op.payloads or ()) for op in s_abuse)
+    # bursts cover half the horizon at +2x rate => ~2x total volume
+    assert n_abuse > 1.5 * n_base
+    # the extra arrivals land INSIDE the burst windows only
+    in_win = out_win = 0
+    base_arrivals = set()
+    for op in s_base:
+        for a in op.arrivals or ():
+            base_arrivals.add(a)
+    for op in s_abuse:
+        for a in op.arrivals or ():
+            if a in base_arrivals:
+                continue
+            if (a % 0.4) < 0.2:
+                in_win += 1
+            else:
+                out_win += 1
+    assert in_win > 0 and out_win == 0
+
+
+def test_open_loop_reports_per_tenant_sheds():
+    from sitewhere_tpu.loadgen import (OpenLoopSpec, TenantLoad,
+                                       build_open_loop_schedule,
+                                       run_open_loop)
+
+    eng = Engine(_small_cfg(qos=True, store_capacity=8192,
+                            batch_capacity=64,
+                            tenant_rates={"ol-noisy": 50.0},
+                            qos_burst_s=2.0))   # capacity 100: the first
+                                               # noisy frames admit, the
+                                               # flood past them sheds
+    sched = build_open_loop_schedule(OpenLoopSpec(
+        tenants=(TenantLoad("ol-good", 300.0, n_devices=16),
+                 TenantLoad("ol-noisy", 1500.0, n_devices=16)),
+        duration_s=0.6, frame_size=32, seed=3))
+    res = run_open_loop(eng, sched, checkpoint_frames=2,
+                        time_scale=0.05)   # replay fast: admission uses
+                                           # the real clock, so the
+                                           # noisy offer is ~20x its cap
+    noisy = res.per_tenant["ol-noisy"]
+    good = res.per_tenant["ol-good"]
+    assert noisy["shed"] > 0 and good["shed"] == 0
+    assert res.shed_events == noisy["shed"]
+    assert res.events == good["events"] + noisy["events"]
+    # zero admitted loss: device-side accepted == admitted per tenant
+    eng.flush()
+    tpc = eng.tenant_pipeline_counters()
+    assert tpc["ol-good"]["accepted"] == good["events"]
+    assert tpc["ol-noisy"]["accepted"] == noisy["events"]
+
+
+# ------------------------------------------------------ SLO autotuner
+def test_decide_slo_policy_pure():
+    from sitewhere_tpu.utils.autotune import decide_slo
+
+    bounds = {"max_workers": 4, "max_depth": 4, "max_chunk": 8,
+              "min_shed": 64, "max_shed": 4096}
+    cur = {"ingest_workers": 1, "dispatch_depth": 1, "scan_chunk": 1,
+           "shed_threshold": 1024}
+    flat = {"decode_ms": 1.0, "wal_ms": 1.0, "dispatch_wait_ms": 1.0,
+            "device_ms": 1.0}
+    # dead band (hysteresis): no proposals between 0.5x and 1.25x
+    assert decide_slo(45.0, 50.0, flat, cur, bounds) == []
+    assert decide_slo(30.0, 50.0, flat, cur, bounds) == []
+    # violating + decode-bound: widen fan-out FIRST, shed tightening is
+    # queued behind it
+    hot = {"decode_ms": 8.0, "wal_ms": 0.5, "dispatch_wait_ms": 0.5,
+           "device_ms": 2.0}
+    props = decide_slo(90.0, 50.0, hot, cur, bounds)
+    assert props[0][0] == "ingest_workers" and props[0][1] == 2
+    assert props[-1][0] == "shed_threshold" and props[-1][1] == 512
+    # violating with no stage dominance: tighten the shed threshold
+    props = decide_slo(90.0, 50.0, flat, cur, bounds)
+    assert props[0][0] == "shed_threshold" and props[0][1] == 512
+    # threshold never tightens below min_shed
+    low = dict(cur, shed_threshold=64)
+    assert decide_slo(90.0, 50.0, flat, low, bounds) == []
+    # comfortable: relax the threshold (and nothing else)
+    props = decide_slo(10.0, 50.0, flat, cur, bounds)
+    assert props == [("shed_threshold", 2048, props[0][2])]
+    # no p99 measurement yet: no action
+    assert decide_slo(None, 50.0, flat, cur, bounds) == []
+
+
+def test_autotuner_slo_objective_steers_shed_threshold():
+    """End to end: an engine with qos + autotune + a hopeless p99 target
+    tightens its shed threshold from the real SLO histogram reading.
+    The engine's own per-dispatch hook drives the evaluations
+    (autotune_interval=1), and the violating branch relieves the
+    measured bottleneck FIRST (which stage dominates depends on the
+    box), so ingest rounds continue until the bounded
+    workers/depth/chunk headroom is spent and the threshold tightens."""
+    eng = Engine(_small_cfg(qos=True, autotune=True, autotune_interval=1,
+                            slo_p99_target_ms=0.0001,
+                            store_capacity=8192, batch_capacity=32))
+    tuner = eng._autotuner
+    assert tuner is not None and tuner.slo_target_ms == 0.0001
+    before = eng.qos.shed_threshold
+    for r in range(10):
+        for i in range(4):
+            eng.ingest_json_batch(
+                [_meas(f"slo-{j}", seq=(r * 4 + i) * 16 + j)
+                 for j in range(16)], "slo-tune-t")
+            eng.flush()
+        if eng.qos.shed_threshold < before:
+            break
+    sheds = [d for d in tuner.decisions
+             if d["knob"] == "shed_threshold"]
+    assert sheds and sheds[-1]["p99_ms"] > 0.0001
+    assert eng.qos.shed_threshold < before
+    # the threshold knob went through the set_ingest_tuning choke point
+    assert eng.config.shed_threshold == eng.qos.shed_threshold
+
+
+# ------------------------------------------------- cluster forwarding
+def test_forward_shed_classifies_app_reject_and_recovers(tmp_path):
+    """ISSUE 9 satellite: a 429 shed at the OWNER of a forwarded batch
+    is honest end to end — the sender spills it with the owner's
+    Retry-After (summary carries shed_deferred + retry_after_s), the
+    retry pump counts it in retry_app_rejects (NEVER
+    retry_transport_failures), it never poison-dead-letters, and once
+    the owner's bucket refills the batch delivers exactly once."""
+    from tests.test_forward import _close, _mk_forwarding_cluster
+    from tests.test_cluster import meas, tokens_owned_by
+
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        clk = ManualClock()
+        c1.local.qos = AdmissionController(
+            tenant_rates={"fs-t": 10.0}, burst_s=0.2, clock=clk,
+            min_retry_after_s=0.01)
+        c1.local.qos.admit("fs-t", 2)        # drain the owner's bucket
+        remote = tokens_owned_by(1, 2, prefix="fsh")
+        s = c0.ingest_json_batch(
+            [meas(t, "t", 1.0, 100 + i) for i, t in enumerate(remote)],
+            tenant="fs-t")
+        # spilled for deferred redelivery, with the owner's hint
+        assert s["spilled"] == 2 and s["shed_deferred"] == 2
+        assert s["retry_after_s"] == pytest.approx(0.2)
+        q = queues[0]
+        q.app_reject_attempts = 2            # would poison fast if 429
+                                             # counted toward the budget
+        assert q.metrics()["forward_queue_depth"] == 1
+        # within the deferral window the pump does not even attempt
+        assert q.retry_once() == 0
+        assert q.counters["retry_app_rejects"] == 0
+        time.sleep(0.25)                     # deferral (real clock) over;
+                                             # owner clock still frozen
+        for _ in range(3):                   # >> app_reject_attempts
+            q.retry_once()
+            time.sleep(0.25)
+        m = q.metrics()
+        assert m["forward_retry_app_rejects"] >= 3
+        assert m["forward_retry_transport_failures"] == 0
+        assert m["forward_deadlettered_poison"] == 0    # 429 never poisons
+        assert m["forward_queue_depth"] == 1
+        # owner recovers: bucket refills on ITS clock, batch delivers
+        clk.advance(5.0)
+        time.sleep(0.25)
+        assert q.retry_once() == 1
+        c1.flush()
+        for t in remote:
+            assert c0.query_events(device_token=t)["total"] == 1, t
+    finally:
+        _close(clusters, regs, host)
+
+
+def test_rpc_edge_shed_is_typed_429():
+    """The instance RPC ingest edge sheds with a typed code=429 error
+    frame carrying retryAfterS (the wire form of Retry-After)."""
+    import asyncio
+
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+    from sitewhere_tpu.rpc.client import RpcClient
+    from sitewhere_tpu.rpc.protocol import RpcError
+    from sitewhere_tpu.rpc.server import build_instance_rpc, system_jwt
+
+    inst = SiteWhereTpuInstance(InstanceConfig(engine=_small_cfg()))
+    inst.engine.qos = AdmissionController(
+        tenant_rates={"default": 10.0}, burst_s=0.1, clock=ManualClock())
+
+    async def go():
+        srv = build_instance_rpc(inst)
+        port = await srv.start()
+        cli = await RpcClient(port=port, tenant="default",
+                              auth_token=system_jwt(inst)).connect()
+        env = {"deviceToken": "rpc-shed-0", "type": "DeviceMeasurement",
+               "request": {"name": "t", "value": 1.0}}
+        assert (await cli.call("DeviceEventManagement.addDeviceEvent",
+                               envelope=env))["accepted"]
+        with pytest.raises(RpcError) as ei:
+            await cli.call("DeviceEventManagement.addDeviceEvent", envelope=env)
+        assert ei.value.code == 429
+        assert ei.value.retry_after_s == pytest.approx(0.1)
+        await cli.close()
+        await srv.stop()
+
+    asyncio.new_event_loop().run_until_complete(go())
+
+
+def test_facade_local_shed_is_all_or_nothing(tmp_path):
+    """A locally-owned sub-batch refused by the facade's bucket refuses
+    the WHOLE mixed-ownership call with a typed ShedError BEFORE any
+    forward leaves the rank — never a success summary that silently
+    drops the local payloads while remote-owned ones of the same call
+    spill for durable redelivery. The refused batch retries verbatim
+    once the bucket refills, landing every event exactly once."""
+    from tests.test_cluster import meas, tokens_owned_by
+    from tests.test_forward import _close, _mk_forwarding_cluster
+
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0, c1 = clusters
+    try:
+        clk = ManualClock()
+        c0.local.qos = AdmissionController(
+            tenant_rates={"lf-t": 10.0}, burst_s=0.2, clock=clk,
+            min_retry_after_s=0.01)
+        c0.local.qos.admit("lf-t", 2)        # drain the facade's bucket
+        local = tokens_owned_by(0, 1, prefix="lsh")
+        remote = tokens_owned_by(1, 1, prefix="lsh")
+        batch = [meas(t, "t", 1.0, 100 + i)
+                 for i, t in enumerate(local + remote)]
+        with pytest.raises(ShedError) as ei:
+            c0.ingest_json_batch(batch, tenant="lf-t")
+        assert ei.value.retry_after_s == pytest.approx(0.1)
+        # nothing applied, forwarded, or spilled: the caller owns the
+        # retry of the full batch
+        assert queues[0].metrics()["forward_queue_depth"] == 0
+        c0.flush()
+        c1.flush()
+        for t in local + remote:
+            assert c0.query_events(device_token=t)["total"] == 0, t
+        # the bucket refills on the facade's clock; the same batch lands
+        clk.advance(1.0)
+        c0.ingest_json_batch(batch, tenant="lf-t")
+        c0.flush()
+        c1.flush()
+        for t in local + remote:
+            assert c0.query_events(device_token=t)["total"] == 1, t
+    finally:
+        _close(clusters, regs, host)
+
+
+def test_facade_single_event_edge_admits_per_owner(tmp_path):
+    """The REST edge over a cluster facade admits ONLY locally-owned
+    devices against the local bucket (remote owners run their own
+    admission), so remote-owned traffic never double-charges the edge
+    rank — and a locally-owned shed still answers an explicit 429."""
+    import asyncio
+    import base64
+
+    import aiohttp
+
+    from sitewhere_tpu.engine import EngineConfig
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+    from sitewhere_tpu.web.rest import start_server
+    from tests.test_cluster import tokens_owned_by
+    from tests.test_forward import _close, _mk_forwarding_cluster
+
+    clusters, queues, regs, servers, host, ports = \
+        _mk_forwarding_cluster(tmp_path)
+    c0, c1 = clusters
+    loop = asyncio.new_event_loop()
+    inst = SiteWhereTpuInstance(
+        InstanceConfig(engine=EngineConfig()), engine=c0)
+    clk = ManualClock()
+    c0.local.qos = AdmissionController(
+        tenant_rates={"default": 10.0}, burst_s=0.2, clock=clk,
+        min_retry_after_s=0.01)
+    c0.local.qos.admit("default", 2)         # drain the local bucket
+    server = loop.run_until_complete(start_server(inst))
+    base = f"http://127.0.0.1:{server.port}"
+    session = aiohttp.ClientSession(loop=loop)
+    try:
+        async def token():
+            basic = base64.b64encode(b"admin:password").decode()
+            async with session.get(
+                    f"{base}/api/authapi/jwt",
+                    headers={"Authorization": f"Basic {basic}"}) as r:
+                return (await r.json())["token"]
+
+        jwt = loop.run_until_complete(token())
+        hdr = {"Authorization": f"Bearer {jwt}"}
+        body = {"type": "DeviceMeasurement",
+                "request": {"name": "t", "value": 1.0}}
+
+        async def post(tok):
+            async with session.post(
+                    f"{base}/api/devices/{tok}/events", json=body,
+                    headers=hdr) as r:
+                return r.status, await r.json()
+
+        (local_tok,) = tokens_owned_by(0, 1, prefix="seo")
+        (remote_tok,) = tokens_owned_by(1, 1, prefix="seo")
+        admitted_before = c0.local.qos.admitted_events
+        # remote-owned: forwarded to its owner untouched by the local
+        # bucket (owner has no qos configured => admitted there)
+        st, _ = loop.run_until_complete(post(remote_tok))
+        assert st == 201
+        assert c0.local.qos.admitted_events == admitted_before
+        # locally-owned: the drained local bucket sheds explicitly
+        st, resp = loop.run_until_complete(post(local_tok))
+        assert st == 429
+        assert resp["reason"] == "rate"
+        assert c0.local.qos.shed_by_tenant["default"] == 1
+        # refilled bucket: the same locally-owned post lands
+        clk.advance(1.0)
+        st, _ = loop.run_until_complete(post(local_tok))
+        assert st == 201
+    finally:
+        loop.run_until_complete(session.close())
+        loop.run_until_complete(server.cleanup())
+        loop.close()
+        _close(clusters, regs, host)
+
+
+def test_rest_edge_sheds_429_with_retry_after(tmp_path):
+    """The REST ingest edge answers a shed with 429 + a Retry-After
+    header (integer-ceiled) and a machine-readable retryAfterS body —
+    for both the single-event POST and the bulk batch endpoint."""
+    import asyncio
+    import base64
+
+    import aiohttp
+
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+    from sitewhere_tpu.web.rest import start_server
+
+    loop = asyncio.new_event_loop()
+    inst = SiteWhereTpuInstance(InstanceConfig(engine=_small_cfg()))
+    inst.engine.qos = AdmissionController(
+        tenant_rates={"default": 4.0}, burst_s=0.5, clock=ManualClock())
+    server = loop.run_until_complete(start_server(inst))
+    base = f"http://127.0.0.1:{server.port}"
+    session = aiohttp.ClientSession(loop=loop)
+    try:
+        async def token():
+            basic = base64.b64encode(b"admin:password").decode()
+            async with session.get(
+                    f"{base}/api/authapi/jwt",
+                    headers={"Authorization": f"Basic {basic}"}) as r:
+                return (await r.json())["token"]
+
+        jwt = loop.run_until_complete(token())
+        hdr = {"Authorization": f"Bearer {jwt}"}
+        body = {"type": "DeviceMeasurement",
+                "request": {"name": "t", "value": 1.0}}
+
+        async def post(path, payload):
+            async with session.post(base + path, json=payload,
+                                    headers=hdr) as r:
+                return r.status, r.headers, await r.json()
+
+        st, _, _ = loop.run_until_complete(
+            post("/api/devices/rq-0/events", body))
+        assert st == 201
+        st, _, _ = loop.run_until_complete(
+            post("/api/devices/rq-0/events", body))
+        assert st == 201    # bucket capacity 2: both initial tokens spent
+        st, headers, resp = loop.run_until_complete(
+            post("/api/devices/rq-0/events", body))
+        assert st == 429
+        assert int(headers["Retry-After"]) >= 1
+        assert resp["retryAfterS"] == pytest.approx(0.25)
+        assert resp["reason"] == "rate"
+        # bulk endpoint: an entirely shed batch answers 429 too
+        rows = [json.loads(_meas(f"rq-b{i}")) for i in range(4)]
+        st, headers, resp = loop.run_until_complete(
+            post("/api/events/batch", rows))
+        assert st == 429 and "Retry-After" in headers
+    finally:
+        loop.run_until_complete(session.close())
+        loop.run_until_complete(server.cleanup())
+        loop.close()
+
+
+@pytest.mark.slow
+def test_wfq_gate_ratio_stress():
+    """Heavy variant: 4 tenants, 3:2:1:1 weights, 4 threads each."""
+    gate = WeightedFairGate({"sa": 3.0, "sb": 2.0, "sc": 1.0, "sd": 1.0})
+    stop = threading.Event()
+    start = threading.Barrier(16)
+
+    def hammer(tenant):
+        start.wait()
+        while not stop.is_set():
+            with gate.turn(tenant, 1):
+                time.sleep(0.0002)
+                if sum(gate.grants.values()) >= 7000:
+                    stop.set()
+
+    ts = [threading.Thread(target=hammer, args=(t,))
+          for t in ("sa", "sb", "sc", "sd") for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    g = gate.grants
+    assert 1.2 <= g["sa"] / max(1, g["sb"]) <= 1.9, g
+    assert 2.2 <= g["sa"] / max(1, g["sc"]) <= 4.0, g
