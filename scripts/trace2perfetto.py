@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Convert a captured debug bundle into standalone Perfetto trace files.
+
+A debug bundle (``GET /api/instance/debug/bundle``) carries the slowest
+traces the engine's flight/span rings still hold, each as a list of raw
+Chrome-trace events (``slowestTraces[*].events``). This tool re-wraps
+one of them — or every one — into the finished Chrome-trace-event JSON
+document that https://ui.perfetto.dev and chrome://tracing load
+directly, using the SAME stitch/renumber pass the live
+``/api/instance/trace/<id>/timeline`` endpoint runs
+(:func:`sitewhere_tpu.utils.tracing.finish_timeline`), so an offline
+bundle and a live pull of the same trace render identically.
+
+Usage:
+    python scripts/trace2perfetto.py BUNDLE.json            # slowest trace
+    python scripts/trace2perfetto.py BUNDLE.json --trace ID -o out.json
+    python scripts/trace2perfetto.py BUNDLE.json --all -o DIR
+
+Imports stay jax-free (tracing pulls only the metrics registry), so the
+converter runs anywhere the bundle landed — a laptop triaging a
+production snapshot needs no accelerator stack.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from sitewhere_tpu.utils.tracing import finish_timeline  # noqa: E402
+
+
+def convert(bundle: dict, trace_id: str | None = None) -> list[dict]:
+    """The finished timeline document(s) for ``trace_id`` (or the
+    slowest trace when None). Raises SystemExit with a useful message
+    when the bundle holds no such trace."""
+    traces = bundle.get("slowestTraces") or []
+    if not traces:
+        sys.exit("bundle holds no traces (slowestTraces is empty — was "
+                 "the flight recorder enabled?)")
+    if trace_id is not None:
+        traces = [t for t in traces if t.get("traceId") == trace_id]
+        if not traces:
+            sys.exit(f"trace {trace_id} not in bundle; available: "
+                     + ", ".join(t.get("traceId", "?")
+                                 for t in bundle["slowestTraces"]))
+    return [finish_timeline(t["traceId"], t.get("events") or [])
+            for t in traces]
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(
+        description="debug bundle -> standalone Perfetto trace JSON")
+    ap.add_argument("bundle", help="debug-bundle JSON file "
+                    "(GET /api/instance/debug/bundle)")
+    ap.add_argument("--trace", help="trace id to extract "
+                    "(default: the slowest trace in the bundle)")
+    ap.add_argument("--all", action="store_true",
+                    help="convert every trace in the bundle (-o names a "
+                    "directory)")
+    ap.add_argument("-o", "--out", help="output file (or directory with "
+                    "--all); default: <trace_id>.perfetto.json")
+    args = ap.parse_args(argv)
+
+    bundle = json.loads(pathlib.Path(args.bundle).read_text())
+    docs = convert(bundle, None if args.all else args.trace)
+    if not args.all:
+        docs = docs[:1]
+
+    outdir = pathlib.Path(args.out) if (args.all and args.out) else None
+    if outdir is not None:
+        outdir.mkdir(parents=True, exist_ok=True)
+    for doc in docs:
+        if outdir is not None:
+            path = outdir / f"{doc['traceId']}.perfetto.json"
+        elif args.out:
+            path = pathlib.Path(args.out)
+        else:
+            path = pathlib.Path(f"{doc['traceId']}.perfetto.json")
+        path.write_text(json.dumps(doc))
+        print(f"{doc['traceId']}: {sum(1 for e in doc['traceEvents'] if e.get('ph') == 'X')} "
+              f"events -> {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -253,6 +253,17 @@ class IngestHostMixin:
     def recent_traces(self, limit: int = 50) -> list[dict]:
         return self.flight.recent(limit)
 
+    def get_trace_timeline(self, trace_id: str) -> dict:
+        """One trace as a Chrome-trace-event document (loads directly in
+        Perfetto / chrome://tracing): flight-record lifecycle intervals
+        merged with the span tracer's live spans. The cluster facade
+        overrides with a rank fan-out so one trace id yields one
+        multi-rank timeline."""
+        from sitewhere_tpu.utils.tracing import (finish_timeline,
+                                                 timeline_events)
+
+        return finish_timeline(trace_id, timeline_events(self, trace_id))
+
     def slo_harvest(self) -> list:
         """Completed ingest lifecycles not yet exported to the SLO plane.
         Drained (exactly once each) by the Prometheus exporter at SCRAPE
@@ -677,6 +688,21 @@ class EngineConfig:
                                        # few dict writes per BATCH — bench
                                        # gates it at <= 3% of host e2e
     flight_capacity: int = 1024        # lifecycle records retained
+    span_trace: bool = True            # hierarchical span tracer (ISSUE
+                                       # 10, utils/tracing.SpanTracer):
+                                       # live spans for forward hops,
+                                       # replica send/apply, shard
+                                       # decode, query rounds, scheduler
+                                       # fires; ingest lifecycle spans
+                                       # derive from flight records at
+                                       # export — bench hard-gates the
+                                       # on-vs-off delta <= 3%
+    span_capacity: int = 4096          # completed spans retained
+    span_sample: float = 1.0           # head-based keep fraction (seeded
+                                       # deterministic per trace id);
+                                       # the slowest decile per span
+                                       # name is kept regardless
+    span_seed: int = 0                 # sampling hash seed
     query_coalesce: int = 16           # max concurrent event queries fused
                                        # into ONE device program by the
                                        # shared-scan query batcher (1
@@ -1040,7 +1066,7 @@ class QueryBatcher:
         self._metrics["queries"].inc()
 
     def run(self, params: tuple, limit: int, archive: dict | None = None,
-            tenant: str | None = None):
+            tenant: str | None = None, trace_id: str | None = None):
         """Submit one predicate set (``ops.query.QueryParams`` field order,
         plain ints) at a bucketed ``limit``. ``archive`` — ``{"limit":
         exact_page, "filters": {...}}`` — asks the round to ALSO scan the
@@ -1058,7 +1084,7 @@ class QueryBatcher:
                  "event": threading.Event(), "result": None,
                  "cursors": None, "q": 0, "error": None,
                  "archive": archive, "archive_result": None,
-                 "tenant": tenant or "default"}
+                 "tenant": tenant or "default", "trace": trace_id}
         if self.engine.lock._is_owned():
             # a caller already INSIDE the engine lock (RLock re-entrancy
             # was always legal on this path) must not park as a follower:
@@ -1075,7 +1101,10 @@ class QueryBatcher:
         if lead:
             self._drain()
         else:
+            wait_sp = self.engine.tracer.begin(
+                "query.coalesce_wait", trace_id=trace_id)
             entry["event"].wait()
+            wait_sp.end(q=entry["q"])
         if entry["error"] is not None:
             raise entry["error"]
         return (entry["result"], entry["cursors"], entry["q"],
@@ -1133,35 +1162,50 @@ class QueryBatcher:
                 cols.append(jnp.asarray(np.asarray(col, np.int32)))
             staged.append((entries, self._compiled_for(qpad, limit),
                            QueryParams(*cols)))
+        # round-level spans attribute to the round leader's first entry
+        # trace (the round is one shared unit of work); per-query device
+        # and format intervals live on each query's own flight record
+        round_trace = next((e["trace"] for e in batch if e["trace"]), None)
         launched = []
-        with eng.lock:
-            store = eng.state.store
-            cursors = None
-            if eng.archive is not None:
-                # fresh buffers (eager add): the snapshot's own arrays are
-                # donated away by the next ingest dispatch, so the archive
-                # merge must not touch them after the lock is released
-                cursors = (store.epoch + 0, store.cursor + 0,
-                           store.arena_capacity)
-            for entries, compiled, params in staged:
-                # async enqueue only — the device executes (and is
-                # awaited) after the lock is released
-                res = compiled(store, params)
-                launched.append((entries, res))
-                qn = len(entries)
-                self.programs += 1
-                self.coalesced += qn
-                self.max_coalesced = max(self.max_coalesced, qn)
-                self._metrics["batch"].observe(float(qn))
-                self._metrics["programs"].inc()
+        # span context managers (not bare begin/end): a device or archive
+        # error in this round is caught by _drain and the round keeps
+        # serving — an unclosed span would stay on the leader thread's
+        # span stack and mis-parent every later span on that thread
+        with eng.tracer.begin("query.round.snapshot",
+                              trace_id=round_trace, q=len(batch)) as snap_sp:
+            with eng.lock:
+                store = eng.state.store
+                cursors = None
+                if eng.archive is not None:
+                    # fresh buffers (eager add): the snapshot's own arrays
+                    # are donated away by the next ingest dispatch, so the
+                    # archive merge must not touch them after the lock is
+                    # released
+                    cursors = (store.epoch + 0, store.cursor + 0,
+                               store.arena_capacity)
+                for entries, compiled, params in staged:
+                    # async enqueue only — the device executes (and is
+                    # awaited) after the lock is released
+                    res = compiled(store, params)
+                    launched.append((entries, res))
+                    qn = len(entries)
+                    self.programs += 1
+                    self.coalesced += qn
+                    self.max_coalesced = max(self.max_coalesced, qn)
+                    self._metrics["batch"].observe(float(qn))
+                    self._metrics["programs"].inc()
+            snap_sp.annotate(programs=len(launched))
         # batched tiered reads: while the fused ring programs execute on
         # device, the leader serves every archive request of the round in
         # ONE pass — the eviction cap is computed once from the round's
-        # shared snapshot cursors, the planner's zone-map/bloom tables are
-        # built once, and each surviving segment decodes at most once into
-        # the archive's LRU cache no matter how many queries touch it. The
-        # engine lock is held for the disk scan (archive files are mutated
-        # by _spool/compact under it), exactly like the per-query merge it
+        # shared snapshot cursors, ONE SegmentPlanner call plans every
+        # request against the shared zone-map/bloom tables
+        # (EventArchive.query_batch; planner calls per round == 1, pinned
+        # by test + exported as swtpu_archive_planner_calls_total), and
+        # each surviving segment decodes at most once into the archive's
+        # LRU cache no matter how many queries touch it. The engine lock
+        # is held for the disk scan (archive files are mutated by
+        # _spool/compact under it), exactly like the per-query merge it
         # replaces — but once per round instead of once per query.
         archive_entries = [e for e in batch if e["archive"] is not None]
         if archive_entries and eng.archive is not None and cursors is not None:
@@ -1172,18 +1216,27 @@ class QueryBatcher:
                     max_pos = {a: int(ep[a]) * acap + int(cu[a]) - acap
                                for a in range(len(cu))}
                     if any(v > 0 for v in max_pos.values()):
-                        for e in archive_entries:
-                            req = e["archive"]
-                            e["archive_result"] = eng.archive.query(
-                                max_pos=max_pos, limit=req["limit"],
-                                **req["filters"])
-        for entries, res in launched:
-            host = _fetch_query_result(res)
-            for q, entry in enumerate(entries):
-                entry["result"] = type(host)(*(col[q] for col in host))
-                entry["cursors"] = cursors
-                entry["q"] = len(entries)
-                entry["event"].set()
+                        with eng.tracer.begin(
+                                "query.round.archive",
+                                trace_id=round_trace,
+                                queries=len(archive_entries)) as arch_sp:
+                            decoded0 = eng.archive.plan_decoded
+                            results = eng.archive.query_batch(
+                                [e["archive"] for e in archive_entries],
+                                max_pos=max_pos)
+                            for e, res in zip(archive_entries, results):
+                                e["archive_result"] = res
+                            arch_sp.annotate(
+                                segments_decoded=eng.archive.plan_decoded
+                                - decoded0)
+        with eng.tracer.begin("query.round.fetch", trace_id=round_trace):
+            for entries, res in launched:
+                host = _fetch_query_result(res)
+                for q, entry in enumerate(entries):
+                    entry["result"] = type(host)(*(col[q] for col in host))
+                    entry["cursors"] = cursors
+                    entry["q"] = len(entries)
+                    entry["event"].set()
 
 
 class Engine(IngestHostMixin):
@@ -1304,6 +1357,23 @@ class Engine(IngestHostMixin):
                                      enabled=c.flight_recorder)
         self._staged_traces: list = []
         self._pending_traces: list[list] = []
+        # hierarchical span tracer (ISSUE 10): live spans for the
+        # operations flight records don't time (shard decode, query
+        # rounds, forward hops, replication legs); a cluster facade
+        # re-stamps .rank like it does for the flight recorder
+        from sitewhere_tpu.utils.metrics import next_engine_label
+        from sitewhere_tpu.utils.tracing import SpanTracer
+
+        self.tracer = SpanTracer(capacity=c.span_capacity,
+                                 enabled=c.span_trace,
+                                 sample=c.span_sample, seed=c.span_seed)
+        if self._sharder is not None:
+            self._sharder.tracer = self.tracer
+        # process-unique engine label scoping this engine's series on the
+        # process-global registry (the SLO harvest writes under it, so
+        # one in-process engine's autotuner can never steer on another's
+        # tenants — ISSUE 10 satellite closing the PR-9 known limit)
+        self.metrics_label = next_engine_label()
         # shared-scan batched query engine: concurrent query_events calls
         # coalesce into one fused multi-predicate device program; string
         # lookups and the store snapshot happen under the lock, the device
@@ -1647,6 +1717,11 @@ class Engine(IngestHostMixin):
                          else payloads[pos:pos + take])
                 lo = arena.cursor
                 dec = self._sharder or self._native_decoder
+                if dec is self._sharder:
+                    # per-shard decode spans (ISSUE 10) attribute to this
+                    # batch's trace; the engine lock serializes arena
+                    # decode, so a plain attribute is race-free
+                    dec.current_trace = rec.trace_id
                 n_ok, collisions = dec.decode_into(
                     chunk, arena, lo, binary=binary)
                 rec.mark("decode")
@@ -2687,7 +2762,7 @@ class Engine(IngestHostMixin):
                 area=area_id, customer=customer_id)}
         row, cursors, coalesced, archive_res = self._query_batcher.run(
             params, bucket_limit(limit), archive=archive_req,
-            tenant=tenant)
+            tenant=tenant, trace_id=rec.trace_id)
         rec.mark("device")
         rec.add("coalesced", coalesced)
         # every result column is already ONE host numpy array (the

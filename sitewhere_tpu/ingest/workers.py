@@ -33,6 +33,7 @@ import logging
 import multiprocessing as mp
 import os
 import threading
+import time
 from multiprocessing import shared_memory
 
 import numpy as np
@@ -232,6 +233,12 @@ class ShardedArenaDecoder:
         self.active_workers = n_workers   # autotuner-adjustable fan-out
         self.last_workers = 1             # shards used by the last batch
         self.sharded_batches = 0
+        # span plumbing (ISSUE 10): the engine sets ``tracer`` once and
+        # ``current_trace`` per batch (under its lock, which serializes
+        # arena decode) so each shard's native scan records a span on
+        # the batch's trace — both default off for direct constructors
+        self.tracer = None
+        self.current_trace: str | None = None
         self._ctxs = [self.lib.swtpu_shard_create(decoder.handle)
                       for _ in range(n_workers)]
 
@@ -304,12 +311,19 @@ class ShardedArenaDecoder:
                       arena, row0: int, binary: bool):
         c = ctypes
         collisions = c.c_int32(0)
+        t0 = time.perf_counter_ns()
         args = self.decoder.arena_out_args(arena, row0, row0 + cnt,
                                            collisions)
         n_ok = int(self.py_lib.swtpu_shard_decode_arena_pylist(
             self._ctxs[w], payloads, np.int32(start), np.int32(cnt),
             np.int32(self.decoder.channels), *args,
             np.int32(1 if binary else 0)))
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            tracer.record("ingest.shard_decode", t0,
+                          time.perf_counter_ns(),
+                          trace_id=self.current_trace, shard=w,
+                          payloads=cnt)
         if n_ok < 0:
             return None
         return n_ok, int(collisions.value)

@@ -92,6 +92,8 @@ class SiteWhereTpuInstance(LifecycleComponent):
         self.batch = BatchOperationManager()
         self.batch.register_handler(BatchCommandInvocationHandler(self.commands))
         self.scheduler = ScheduleManager()
+        # schedule fires record spans on the engine's tracer (ISSUE 10)
+        self.scheduler.tracer = getattr(self.engine, "tracer", None)
         self.scheduler.register_executor(
             "CommandInvocation", command_invocation_executor(self.commands)
         )

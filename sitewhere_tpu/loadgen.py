@@ -370,6 +370,11 @@ class OpenLoopResult:
     max_lateness_s: float
     per_tenant: dict
     shed_events: int = 0
+    # span/trace coverage (ISSUE 10): fraction of a sample of this run's
+    # ingest trace ids that still resolve on the engine (flight records
+    # or spans) after the run — the observability plane's own SLO. None
+    # when the run ingested nothing.
+    trace_coverage: float | None = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -395,6 +400,8 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
     # and are never submitted (the client saw an explicit 429)
     qos = getattr(engine, "qos", None)
     shed: dict[str, int] = {}
+    trace_sample: list[str] = []   # first few ingest trace ids: span
+    #                                coverage is checked after the run
     mutations = 0
     max_late = 0.0
     frames = 0
@@ -429,7 +436,10 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
                                        + len(op.payloads))
                     continue
             submit = time.perf_counter()
-            engine.ingest_json_batch(op.payloads, op.tenant)
+            summary = engine.ingest_json_batch(op.payloads, op.tenant)
+            tid = (summary or {}).get("trace_id")
+            if tid and len(trace_sample) < 16:
+                trace_sample.append(tid)
             pending.append((op.tenant,
                             [t0 + a * time_scale for a in op.arrivals],
                             submit))
@@ -465,6 +475,22 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
             mutations += 1
     checkpoint()
     wall = time.perf_counter() - t0
+    # span/trace coverage (ISSUE 10): every sampled ingest trace id must
+    # still resolve to a non-empty timeline (flight-record intervals or
+    # live spans) — the observability plane's own SLO, reported by the
+    # bench cluster leg
+    coverage = None
+    get_tl = getattr(engine, "get_trace_timeline", None)
+    if trace_sample and get_tl is not None:
+        hits = 0
+        for tid in trace_sample:
+            try:
+                doc = get_tl(tid)
+            except Exception:
+                continue
+            if any(e.get("ph") == "X" for e in doc.get("traceEvents", ())):
+                hits += 1
+        coverage = round(hits / len(trace_sample), 3)
     horizon = max((op.t_s for op in schedule), default=0.0) * time_scale
     per_tenant = {}
     for tenant in sorted(set(per) | set(shed)):
@@ -485,7 +511,8 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
         queries=len(qlat), query_p99_ms=qp["p99_ms"],
         history_queries=len(hlat), history_p99_ms=hp["p99_ms"],
         mutations=mutations, max_lateness_s=round(max_late, 4),
-        per_tenant=per_tenant, shed_events=sum(shed.values()))
+        per_tenant=per_tenant, shed_events=sum(shed.values()),
+        trace_coverage=coverage)
 
 
 async def run_rest_load(base_url: str, jwt: str, n_workers: int = 5,

@@ -142,6 +142,10 @@ class ScheduleManager:
         # owner sees which windows its follower already covered — the
         # no-double-fire half of scheduler fire-over
         self.on_fired: Callable[[ScheduledJob], None] | None = None
+        # span tracer (ISSUE 10): the instance wires the engine's tracer
+        # in so every schedule fire records a span (its own fresh trace);
+        # None = untraced (direct constructors, tests)
+        self.tracer = None
 
     # CRUD ----------------------------------------------------------------
     def create_schedule(self, token: str, name: str, trigger_type: str,
@@ -226,6 +230,9 @@ class ScheduleManager:
                 continue
             job.fired_count += 1
             job.last_fired_ms = now_ms
+            sp = (self.tracer.begin("schedule.fire", job=job.meta.token,
+                                    jobType=job.job_type)
+                  if self.tracer is not None else None)
             try:
                 res = self.executors[job.job_type](job)
                 if asyncio.iscoroutine(res):
@@ -233,6 +240,11 @@ class ScheduleManager:
                 job.last_error = None
             except Exception as e:
                 job.last_error = str(e)
+            finally:
+                if sp is not None:
+                    if job.last_error:
+                        sp.annotate(error=job.last_error)
+                    sp.end()
             if self.on_fired is not None:
                 try:
                     self.on_fired(job)

@@ -415,6 +415,9 @@ class ClusterEngine:
         # the rank stamps every flight record (and trace-id generation),
         # so cross-rank trace views attribute records correctly
         self.local.flight.rank = config.rank
+        # ditto for the span tracer: timeline events carry pid=rank, the
+        # stitch key of the multi-rank Perfetto view (ISSUE 10)
+        self.local.tracer.rank = config.rank
         self.search_index = None          # see attach_search_index
         self.command_service = None       # see attach_command_service
         self.forward_queue = None         # see attach_forwarding
@@ -602,59 +605,76 @@ class ClusterEngine:
         if self.forward_queue is None:
             method = ("Cluster.ingestJson" if kind == "json"
                       else "Cluster.ingestBinary")
-            t0 = time.perf_counter()
-            res = self._peer(r).call(method, lens=lens, tenant=tenant,
-                                     _attachment=b"".join(plist))
-            hop.observe(time.perf_counter() - t0, dst=str(r))
+            with self.local.tracer.begin("forward.hop", dst=r,
+                                         payloads=len(plist)):
+                t0 = time.perf_counter()
+                res = self._peer(r).call(method, lens=lens, tenant=tenant,
+                                         _attachment=b"".join(plist))
+                hop.observe(time.perf_counter() - t0, dst=str(r))
             return res
         fid = self._next_fid()
+        tracer = self.local.tracer
         if self.forward_queue.circuit_open(r):
             # a known-down peer: spill without paying the connect
             # timeout (or the blob join) per batch; the retry pump
             # closes the circuit
             self.forward_queue.spill(r, kind, tenant, fid,
                                      payloads=plist)
+            with tracer.begin("forward.spill", dst=r, fid=fid,
+                              reason="circuit_open",
+                              payloads=len(plist)):
+                pass
             return {"spilled": len(plist)}
-        try:
-            t0 = time.perf_counter()
-            res = self._peer(r).call(
-                "Cluster.ingestForward", fid=fid, lens=lens,
-                tenant=tenant, encoding=kind,
-                _attachment=b"".join(plist))
-            hop.observe(time.perf_counter() - t0, dst=str(r))
-            return res
-        except (ConnectionError, TimeoutError):
-            self.forward_queue.trip(r)
-            self.forward_queue.spill(r, kind, tenant, fid,
-                                     payloads=plist)
-            return {"spilled": len(plist)}
-        except RpcError as e:
-            if getattr(e, "code", None) == 429:
-                # owner-side load shed (ISSUE 9): the batch is already
-                # accepted at THIS edge, so it spills for deferred
-                # redelivery honoring the OWNER's Retry-After — an
-                # app-level reject by classification (the retry pump
-                # counts it in retry_app_rejects, never
-                # retry_transport_failures, and never toward the poison
-                # budget). The owner's hint propagates to the caller as
-                # retry_after_s backpressure.
-                ra = getattr(e, "retry_after_s", None)
+        # the with-block (not bare begin/end) closes the span on EVERY
+        # exit — an exception type this except-ladder doesn't catch must
+        # not leave an open span on the forwarding thread's stack
+        with tracer.begin("forward.hop", dst=r,
+                          payloads=len(plist)) as hop_sp:
+            try:
+                t0 = time.perf_counter()
+                res = self._peer(r).call(
+                    "Cluster.ingestForward", fid=fid, lens=lens,
+                    tenant=tenant, encoding=kind,
+                    _attachment=b"".join(plist))
+                hop.observe(time.perf_counter() - t0, dst=str(r))
+                return res
+            except (ConnectionError, TimeoutError):
+                hop_sp.annotate(error="transport", spilled=True)
+                self.forward_queue.trip(r)
                 self.forward_queue.spill(r, kind, tenant, fid,
-                                         payloads=plist,
-                                         defer_s=ra)
-                out = {"spilled": len(plist),
-                       "shed_deferred": len(plist)}
-                if ra is not None:
-                    out["retry_after_s"] = ra
-                return out
-            # oversize single payload (unsplittable) or an owner-side
-            # application error: spill WITHOUT tripping the circuit (the
-            # peer is up) — the retry pump re-attempts and the retry
-            # budget dead-letters a poison batch; data is never lost to
-            # an exception racing out of a half-applied ingest call
-            self.forward_queue.spill(r, kind, tenant, fid,
-                                     payloads=plist)
-            return {"spilled": len(plist)}
+                                         payloads=plist)
+                return {"spilled": len(plist)}
+            except RpcError as e:
+                if getattr(e, "code", None) == 429:
+                    # owner-side load shed (ISSUE 9): the batch is
+                    # already accepted at THIS edge, so it spills for
+                    # deferred redelivery honoring the OWNER's
+                    # Retry-After — an app-level reject by
+                    # classification (the retry pump counts it in
+                    # retry_app_rejects, never
+                    # retry_transport_failures, and never toward the
+                    # poison budget). The owner's hint propagates to
+                    # the caller as retry_after_s backpressure.
+                    ra = getattr(e, "retry_after_s", None)
+                    hop_sp.annotate(error="shed_429", spilled=True)
+                    self.forward_queue.spill(r, kind, tenant, fid,
+                                             payloads=plist,
+                                             defer_s=ra)
+                    out = {"spilled": len(plist),
+                           "shed_deferred": len(plist)}
+                    if ra is not None:
+                        out["retry_after_s"] = ra
+                    return out
+                # oversize single payload (unsplittable) or an
+                # owner-side application error: spill WITHOUT tripping
+                # the circuit (the peer is up) — the retry pump
+                # re-attempts and the retry budget dead-letters a
+                # poison batch; data is never lost to an exception
+                # racing out of a half-applied ingest call
+                hop_sp.annotate(error="app_reject", spilled=True)
+                self.forward_queue.spill(r, kind, tenant, fid,
+                                         payloads=plist)
+                return {"spilled": len(plist)}
 
     def _ingest_routed(self, payloads: list[bytes], tenant: str,
                        kind: str) -> dict:
@@ -1093,6 +1113,27 @@ class ClusterEngine:
         reference scraping one replica; cross-rank journeys resolve via
         get_trace)."""
         return self.local.flight.recent(limit)
+
+    def get_trace_timeline(self, trace_id: str) -> dict:
+        """One trace id -> ONE stitched multi-rank Chrome-trace timeline
+        (ISSUE 10): each rank contributes its local events (flight-record
+        lifecycle intervals + live spans, pid = rank) through the same
+        tolerant fan-out as get_trace, and the merge renumbers pids/tids
+        with process/thread metadata so Perfetto shows one lane group per
+        rank. A down rank degrades the view; it must not 500 the
+        endpoint."""
+        from sitewhere_tpu.utils.tracing import (finish_timeline,
+                                                 timeline_events)
+
+        keyed = self._fanout_keyed(
+            timeline_events(self.local, trace_id),
+            "Cluster.traceTimeline", tolerant=True, traceId=trace_id)
+        events: list[dict] = []
+        for r, res in keyed.items():
+            if isinstance(res, PeerDown) or not res:
+                continue
+            events.extend(res)
+        return finish_timeline(trace_id, events)
 
     def make_feed_consumer(self, group_id: str, **kw):
         """Rank-local feed (outbound connectors run per-rank over the
@@ -1717,6 +1758,13 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def trace_recent(limit: int = 50):
         return engine.flight.recent(limit)
 
+    def trace_timeline(traceId: str):
+        # rank-LOCAL chrome events (pid = this rank); the calling
+        # facade stitches rank lists into one timeline document
+        from sitewhere_tpu.utils.tracing import timeline_events
+
+        return timeline_events(engine, traceId)
+
     for name, fn in {
         "Cluster.ingestJson": ingest_json,
         "Cluster.ingestBinary": ingest_binary,
@@ -1751,6 +1799,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.searchInfo": search_info,
         "Cluster.traceGet": trace_get,
         "Cluster.traceRecent": trace_recent,
+        "Cluster.traceTimeline": trace_timeline,
         "Cluster.flush": flush,
     }.items():
         srv.register(name, fn)

@@ -89,6 +89,12 @@ class DistributedConfig:
     archive_cache_segments: int = 8    # LRU segment-decode cache depth
     flight_recorder: bool = True       # batch-lifecycle flight recorder
     flight_capacity: int = 1024        # lifecycle records retained
+    span_trace: bool = True            # hierarchical span tracer (ISSUE
+                                       # 10) — same contract as
+                                       # EngineConfig.span_trace
+    span_capacity: int = 4096          # completed spans retained
+    span_sample: float = 1.0           # head-based keep fraction
+    span_seed: int = 0                 # sampling hash seed
     qos: bool = False                  # overload discipline (utils/qos.py):
                                        # per-tenant token-bucket admission
                                        # consulted at the ingest EDGES
@@ -390,6 +396,16 @@ class DistributedEngine(IngestHostMixin):
                                      enabled=c.flight_recorder)
         self._staged_traces: list = []
         self._pending_traces: list[list] = []
+        # span tracer + process-unique engine label (ISSUE 10) — same
+        # wiring as the single-node Engine; ClusterEngine re-stamps
+        # .rank exactly like it does for the flight recorder
+        from sitewhere_tpu.utils.metrics import next_engine_label
+        from sitewhere_tpu.utils.tracing import SpanTracer
+
+        self.tracer = SpanTracer(capacity=c.span_capacity,
+                                 enabled=c.span_trace,
+                                 sample=c.span_sample, seed=c.span_seed)
+        self.metrics_label = next_engine_label()
         # fair tenancy: per-shard {tenant_id: deque[_FairChunk]}
         self._fair_queues: list[dict[int, collections.deque]] = [
             {} for _ in range(self.n_shards)]

@@ -303,7 +303,17 @@ class ForwardQueue:
 
         peer = self.cluster._peer(rank)
         kind = rec["kind"]
-        with bind_traceparent(rec.get("tp")):
+        # the spilled record's traceparent re-binds here, so the
+        # redelivery span (ISSUE 10) — possibly hours later — still
+        # lands on the original batch's timeline
+        from sitewhere_tpu.utils.tracing import NULL_SPAN
+
+        tracer = getattr(getattr(self.cluster, "local", self.cluster),
+                         "tracer", None)
+        with bind_traceparent(rec.get("tp")), \
+                (tracer.begin("forward.redeliver", dst=rank,
+                              fid=rec["fid"], kind=kind)
+                 if tracer is not None else NULL_SPAN):
             if kind == "envelope":
                 peer.call("Cluster.forwardEnvelope", fid=rec["fid"],
                           envelope=rec["envelope"], tenant=rec["tenant"])

@@ -156,6 +156,10 @@ class _Pub:
     ticket: int               # WAL append sequence (durability gate)
     now_ms: int               # leader staging clock (byte-identity pin)
     publish_ms: float
+    tp: str | None = None     # originating batch's traceparent: the
+                              # sender binds it around the apply RPC so
+                              # the standby-apply span (and any standby
+                              # records) join the ingest trace (ISSUE 10)
 
 
 def _standby_config(engine) -> dict:
@@ -167,6 +171,8 @@ def _standby_config(engine) -> dict:
     cfg["wal_dir"] = None
     cfg["archive_dir"] = None
     cfg["flight_recorder"] = False
+    cfg["span_trace"] = False   # apply spans are recorded by the HOST
+    #                             rank's tracer, on the ingest trace
     return cfg
 
 
@@ -231,11 +237,20 @@ class ReplicaFeed:
         if not self.followers:
             return
         kind = "json" if tag == WAL_JSON else "binary"
+        # the publishing thread is the ingest thread with its flight
+        # record bound: carry the batch's trace so the follower's apply
+        # span (ISSUE 10) lands on the same timeline
+        tp = None
+        rec = self.cluster.local.flight.current()
+        if rec.trace_id is not None:
+            from sitewhere_tpu.utils.tracing import new_traceparent
+
+            tp = new_traceparent(self.rank, trace_id=rec.trace_id)
         with self._cv:
             self._seq += 1
             self._buffer.append(_Pub(self._seq, kind, tenant,
                                      list(payloads), ticket, int(now_ms),
-                                     time.time() * 1000))
+                                     time.time() * 1000, tp))
             self.counters["published"] += 1
             _replication_instruments()["published"].inc()
             if len(self._buffer) > self.max_buffer:
@@ -372,6 +387,9 @@ class ReplicaFeed:
                 backoff = min(backoff * 2, 2.0)
 
     def _send(self, follower: int, pub: _Pub) -> None:
+        from sitewhere_tpu.utils.tracing import (bind_traceparent,
+                                                 trace_id_of)
+
         eng = self.cluster.local
         if eng.wal is not None:
             # the durability gate: a follower must never apply a frame
@@ -380,11 +398,21 @@ class ReplicaFeed:
         lens = [len(p) for p in pub.payloads]
         with self._lock:
             adv = self._seq
-        reply = self.cluster._peer(follower).call(
-            "Cluster.replicaApply", leader=self.rank, seq=pub.seq,
-            epoch=self.epoch, encoding=pub.kind, tenant=pub.tenant,
-            lens=lens, nowMs=pub.now_ms, publishMs=pub.publish_ms,
-            adv=adv, _attachment=b"".join(pub.payloads))
+        t0 = time.perf_counter_ns()
+        with bind_traceparent(pub.tp):
+            # the bound traceparent rides the RPC frame: the follower's
+            # handler (and its apply span) joins the batch's trace
+            reply = self.cluster._peer(follower).call(
+                "Cluster.replicaApply", leader=self.rank, seq=pub.seq,
+                epoch=self.epoch, encoding=pub.kind, tenant=pub.tenant,
+                lens=lens, nowMs=pub.now_ms, publishMs=pub.publish_ms,
+                adv=adv, _attachment=b"".join(pub.payloads))
+        tracer = getattr(eng, "tracer", None)
+        if tracer is not None and tracer.enabled and pub.tp is not None:
+            tracer.record("repl.send", t0, time.perf_counter_ns(),
+                          trace_id=trace_id_of(pub.tp),
+                          follower=follower, seq=pub.seq,
+                          payloads=len(pub.payloads))
         self.cluster.health.record_success(follower)
         if reply.get("unknown"):
             self._needs_resync[follower] = True
@@ -675,7 +703,21 @@ class ReplicaApplier:
                 self.counters["gap_rejects"] += 1
                 return {"expect": st.applied_seq + 1, **out}
             plist = _wire_payloads(payloads, lens, _attachment)
+            # standby-apply span (ISSUE 10): the sender bound the
+            # originating batch's traceparent around this RPC, so the
+            # span lands on the ingest trace — recorded into THIS
+            # rank's tracer (the standby engine records nothing itself)
+            from sitewhere_tpu.utils.tracing import (current_traceparent,
+                                                     trace_id_of)
+
+            tracer = getattr(self.cluster.local, "tracer", None)
+            tid = trace_id_of(current_traceparent())
+            t0 = time.perf_counter_ns()
             self._ingest(st, encoding, tenant, plist, nowMs)
+            if tracer is not None and tracer.enabled and tid is not None:
+                tracer.record("repl.apply", t0, time.perf_counter_ns(),
+                              trace_id=tid, leader=leader, seq=seq,
+                              payloads=len(plist))
             st.applied_seq = seq
             st.advertised_seq = max(int(adv), seq)
             st.last_feed_mono = time.monotonic()

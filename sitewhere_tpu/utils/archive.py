@@ -231,6 +231,12 @@ class SegmentPlanner:
     def __init__(self, archive: "EventArchive"):
         self.archive = archive
         self._gen = -1
+        # planning passes served (one per plan()/plan_batch() call, NOT
+        # per predicate set): the batcher round batches its Q archive
+        # requests into ONE call, so calls per round must be exactly 1 —
+        # exported as swtpu_archive_planner_calls_total and pinned by
+        # tests/test_archive_pushdown.py
+        self.calls = 0
 
     # ---------------------------------------------------------- tables
     def _refresh(self) -> None:
@@ -302,7 +308,34 @@ class SegmentPlanner:
         newest-first list of ``(scan_order, segment, full_match, ts_hi,
         cap_covers)`` tuples and ``considered`` counts the segments the
         eviction cap admitted (what an unpruned scan would have opened)."""
+        self.calls += 1
         self._refresh()
+        return self._plan_refreshed(
+            max_pos=max_pos, device=device, etype=etype, tenant=tenant,
+            assignment=assignment, aux0=aux0, aux1=aux1, area=area,
+            customer=customer, since_ms=since_ms, until_ms=until_ms,
+            device_parts=device_parts, assignment_parts=assignment_parts)
+
+    def plan_batch(self, requests: list, *, max_pos=None) -> list:
+        """Evaluate N predicate sets in ONE planner call (ISSUE 10
+        satellite): the table refresh — the expensive half when the index
+        generation moved (stats back-fill, vectorized column tables) —
+        runs once for the whole batch, and ``calls`` counts the batch as
+        a single planning pass. ``requests`` are filter-kwarg dicts (the
+        keys :meth:`plan` accepts, minus ``max_pos``, which is shared —
+        one batcher round has one snapshot cursor capture). Returns one
+        ``(rows, considered)`` per request, each identical to what a
+        standalone :meth:`plan` would return."""
+        self.calls += 1
+        self._refresh()
+        return [self._plan_refreshed(max_pos=max_pos, **req)
+                for req in requests]
+
+    def _plan_refreshed(self, *, max_pos=None, device=None, etype=None,
+                        tenant=None, assignment=None, aux0=None, aux1=None,
+                        area=None, customer=None, since_ms=None,
+                        until_ms=None, device_parts=None,
+                        assignment_parts=None):
         n = len(self._segs)
         if not n:
             return [], 0
@@ -939,21 +972,74 @@ class EventArchive:
         a shard-LOCAL id filter to the partitions of its owning shard (mesh
         engines — the id namespaces repeat per shard). Returns
         (total_matching, top rows) where each row is a plain dict of
-        scalars/arrays in ring column layout plus ``part``/``pos``."""
+        scalars/arrays in ring column layout plus ``part``/``pos``.
+
+        Implementation: a one-request :meth:`query_batch` — the batched
+        entry point is the product path (one planner call per batcher
+        round); this wrapper keeps the historical signature for direct
+        callers (DistributedEngine._merge_archive, tests, the oracle
+        parity matrix)."""
+        return self.query_batch(
+            [{"limit": limit, "filters": dict(
+                device=device, etype=etype, tenant=tenant,
+                assignment=assignment, aux0=aux0, aux1=aux1, area=area,
+                customer=customer, since_ms=since_ms, until_ms=until_ms,
+                device_parts=device_parts,
+                assignment_parts=assignment_parts)}],
+            max_pos=max_pos)[0]
+
+    @property
+    def planner_calls(self) -> int:
+        """Planning passes served (shared-table evaluations, one per
+        plan/plan_batch call) — the swtpu_archive_planner_calls_total
+        source; a batcher round contributes exactly 1."""
+        return self._planner.calls
+
+    def query_batch(self, requests: list, *,
+                    max_pos: dict[int, int] | None = None) -> list:
+        """Serve N pushdown queries against ONE planner call (ISSUE 10
+        satellite — the PR-8 follow-up): each request is ``{"limit": n,
+        "filters": {...}}`` in :class:`SegmentPlanner` filter-kwarg shape,
+        all sharing one eviction-cap capture (``max_pos`` — the batcher
+        round snapshots cursors once). Per-request results are
+        byte-identical to a standalone :meth:`query` with the same
+        arguments (pinned in tests/test_archive_pushdown.py); segment
+        decodes still dedupe across requests through the LRU
+        :class:`SegmentCache`."""
+        plans = self._planner.plan_batch(
+            [r["filters"] for r in requests], max_pos=max_pos)
+        out = []
+        for req, (plan_rows, considered) in zip(requests, plans):
+            self.queries += 1
+            self.plan_considered += considered
+            self.plan_pruned += considered - len(plan_rows)
+            out.append(self._scan_planned(
+                plan_rows, max_pos, max(0, int(req["limit"])),
+                req["filters"]))
+        return out
+
+    def _scan_planned(self, plan_rows: list, max_pos, limit: int,
+                      filters: dict) -> tuple[int, list[dict]]:
+        """The post-plan decode/materialize pass of one pushdown query —
+        the body :meth:`query` always had, factored so query_batch can
+        run it per request behind a single shared planning pass. Must
+        stay byte-identical to the retained :meth:`query_unpruned`
+        oracle. ``limit`` <= 0 is a count-only page: (total, []) —
+        matches the oracle's limit=0 behavior (Engine clamps to >= 1,
+        but the distributed path forwards the caller's limit
+        verbatim)."""
         from sitewhere_tpu.ops.query import host_filter_mask
 
-        self.queries += 1
-        # limit <= 0 is a count-only page: (total, []) — matches the
-        # oracle's limit=0 behavior (Engine clamps to >= 1, but the
-        # distributed path forwards the caller's limit verbatim)
-        limit = max(0, limit)
-        plan_rows, considered = self._planner.plan(
-            max_pos=max_pos, device=device, etype=etype, tenant=tenant,
-            assignment=assignment, aux0=aux0, aux1=aux1, area=area,
-            customer=customer, since_ms=since_ms, until_ms=until_ms,
-            device_parts=device_parts, assignment_parts=assignment_parts)
-        self.plan_considered += considered
-        self.plan_pruned += considered - len(plan_rows)
+        device = filters.get("device")
+        etype = filters.get("etype")
+        tenant = filters.get("tenant")
+        assignment = filters.get("assignment")
+        aux0 = filters.get("aux0")
+        aux1 = filters.get("aux1")
+        area = filters.get("area")
+        customer = filters.get("customer")
+        since_ms = filters.get("since_ms")
+        until_ms = filters.get("until_ms")
         pred_cols = ["valid", "ts_ms"]
         for col, v in (("device", device), ("etype", etype),
                        ("tenant", tenant), ("assignment", assignment),

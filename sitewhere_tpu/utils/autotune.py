@@ -250,12 +250,13 @@ class StageTimeAutotuner:
         drain the scrape exporter uses; both feed ONE histogram, so
         exactly-once totals hold regardless of who drains first.
 
-        Known limit: the tenant filter keys on this engine's interner,
-        and every engine interns tenant "default" at construction — two
-        SLO-targeted engines in one process therefore share the
-        default-tenant series (same registry-is-process-global caveat as
-        the PR-7 SLO tests, which isolate with fresh tenant names).
-        Steer real multi-engine deployments with named tenants."""
+        Scope (ISSUE 10 satellite, closing the PR-9 known limit): the
+        harvest stamps every series with the harvesting engine's
+        ``engine=e<n>`` label (metrics.harvest_slo), and this reader
+        keeps ONLY its own engine's series — two SLO-targeted engines in
+        one process no longer share the default-tenant reading, so one
+        rank's steering can never act on another rank's tenants (pinned
+        by a two-engine test in tests/test_qos.py)."""
         from sitewhere_tpu.utils.metrics import harvest_slo, slo_metrics
 
         harvest_slo(self.engine)
@@ -263,12 +264,12 @@ class StageTimeAutotuner:
         with hist._lock:
             snap = {k: (list(v), hist._totals.get(k, 0))
                     for k, v in hist._counts.items()}
-        lookup = getattr(self.engine.tenants, "lookup", None)
+        mine = getattr(self.engine, "metrics_label", None)
         worst = None
         for key, (counts, total) in snap.items():
-            tenant = dict(key).get("tenant")
-            if tenant is None or (lookup is not None
-                                  and lookup(tenant) < 0):
+            labels = dict(key)
+            tenant = labels.get("tenant")
+            if tenant is None or labels.get("engine") != mine:
                 continue
             prev_counts, prev_total = self._slo_prev.get(
                 key, ([0] * len(counts), 0))

@@ -12,9 +12,22 @@ serves the standard text format.
 from __future__ import annotations
 
 import bisect
+import itertools
 import threading
 import time
 from typing import Iterator
+
+# process-unique engine labels ("e0", "e1", ...) scoping one engine's
+# series on the process-global registry — the SLO harvest (and anything
+# else steering per-engine) writes under ``engine=<label>`` so
+# in-process multi-engine tests and loopback cluster ranks can never
+# read each other's tenants (ISSUE 10 satellite; same convention as the
+# autotuner's and the QoS controller's labels)
+_ENGINE_LABELS = itertools.count()
+
+
+def next_engine_label() -> str:
+    return f"e{next(_ENGINE_LABELS)}"
 
 _DEFAULT_BUCKETS = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
@@ -191,6 +204,55 @@ class Histogram:
         with self._lock:
             return self._totals.get(key, 0)
 
+    def _matching_keys(self, labels: dict) -> list[tuple]:
+        want = {k: str(v) for k, v in labels.items()}
+        return [key for key in self._totals
+                if all(k in dict(key) and str(dict(key)[k]) == v
+                       for k, v in want.items())]
+
+    def count_where(self, **labels) -> int:
+        """Total observations summed over every series whose label set
+        CONTAINS ``labels`` — the aggregate view for series that carry
+        scoping labels (the SLO histogram's ``engine=e<n>``): a test
+        asserting "every ingested event observed once" sums across
+        engines with ``count_where(tenant=...)``."""
+        with self._lock:
+            return sum(self._totals[k] for k in self._matching_keys(labels))
+
+    def quantile_where(self, q: float, **labels) -> float | None:
+        """:meth:`quantile` over the MERGED bucket counts of every series
+        matching the ``labels`` subset — one per-tenant quantile across
+        in-process ranks whose observations landed under different
+        ``engine`` labels."""
+        with self._lock:
+            keys = self._matching_keys(labels)
+            if not keys:
+                return None
+            counts = [0] * len(self.buckets)
+            total = 0
+            for k in keys:
+                for i, c in enumerate(self._counts[k]):
+                    counts[i] += c
+                total += self._totals[k]
+        return self._quantile_from(q, counts, total)
+
+    def _quantile_from(self, q: float, counts, total) -> float | None:
+        """The histogram_quantile interpolation rule over one (possibly
+        merged) bucket-count vector — shared by :meth:`quantile` and
+        :meth:`quantile_where` so the two readings can never diverge."""
+        if not counts or not total:
+            return None
+        target = q * total
+        acc = 0
+        for i, c in enumerate(counts):
+            if c and acc + c >= target:
+                lo = self.buckets[i - 1] if i else 0.0
+                hi = self.buckets[i]
+                frac = min(1.0, max(0.0, (target - acc) / c))
+                return lo + (hi - lo) * frac
+            acc += c
+        return self.buckets[-1]
+
     def quantile(self, q: float, **labels) -> float | None:
         """Bucket-quantile estimate: locate the bounding bucket, then
         linearly interpolate within it — the standard
@@ -201,20 +263,9 @@ class Histogram:
         into); None until a series observes."""
         key = tuple(sorted(labels.items()))
         with self._lock:
-            counts = self._counts.get(key)
+            counts = list(self._counts.get(key) or ())
             total = self._totals.get(key, 0)
-            if not counts or not total:
-                return None
-            target = q * total
-            acc = 0
-            for i, c in enumerate(counts):
-                if c and acc + c >= target:
-                    lo = self.buckets[i - 1] if i else 0.0
-                    hi = self.buckets[i]
-                    frac = min(1.0, max(0.0, (target - acc) / c))
-                    return lo + (hi - lo) * frac
-                acc += c
-            return self.buckets[-1]
+        return self._quantile_from(q, counts, total)
 
     def expose(self, exemplars: bool = False) -> Iterator[str]:
         """Prometheus text exposition. ``exemplars`` appends OpenMetrics
@@ -387,6 +438,10 @@ def archive_metrics(registry: MetricsRegistry | None = None) -> dict:
         "count_shortcuts": reg.gauge(
             "swtpu_archive_count_shortcut_total",
             "provably-full-match segments counted from stats alone"),
+        "planner_calls": reg.gauge(
+            "swtpu_archive_planner_calls_total",
+            "segment-planner planning passes served (a batcher round's "
+            "archive requests share exactly one)"),
         "cache_hits": reg.gauge(
             "swtpu_archive_cache_hits_total",
             "segment-decode cache calls served without touching disk"),
@@ -637,6 +692,7 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
         inst["pruned"].set(arch.plan_pruned)
         inst["decoded"].set(arch.plan_decoded)
         inst["count_shortcuts"].set(arch.count_shortcuts)
+        inst["planner_calls"].set(arch.planner_calls)
         inst["cache_hits"].set(arch.cache.hits)
         inst["cache_loads"].set(arch.cache.loads)
         inst["corrupt"].set(arch.corrupt_segments)
@@ -689,6 +745,21 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
                   "batch lifecycle records held by the flight "
                   "recorder").set(len(flight))
 
+    # span plane (ISSUE 10) — scrape-time sync of the tracer's own
+    # counters; like every PR-10 instrument these stay OUT of
+    # engine.metrics() (dispatch-shape equality pin)
+    tracer = getattr(engine, "tracer", None)
+    if tracer is not None:
+        reg.gauge("swtpu_span_records",
+                  "completed spans held by the span tracer").set(
+                      len(tracer))
+        reg.gauge("swtpu_spans_recorded_total",
+                  "spans inserted into the tracer ring").set(
+                      tracer.recorded)
+        reg.gauge("swtpu_spans_sampled_out_total",
+                  "spans dropped by the head+tail sampling verdict").set(
+                      tracer.sampled_out)
+
     # SLO latency plane (ISSUE 7): drain completed ingest lifecycles the
     # recorder accumulated since the last scrape into the per-tenant e2e
     # histogram (the SLO autotuner shares the same drain via
@@ -738,11 +809,18 @@ def harvest_slo(engine, registry: MetricsRegistry | None = None) -> None:
     payload count, with a trace-id exemplar when the batch landed in the
     slowest decile of its tenant's series (a p99 spike on the scrape
     then links straight to /api/instance/trace/<id>). Shared by the
-    scrape exporter and the SLO autotuner."""
+    scrape exporter and the SLO autotuner.
+
+    Every series carries the harvesting engine's ``engine=e<n>`` label
+    (ISSUE 10 satellite): the registry is process-global, so without the
+    scope one in-process engine's ``decide_slo`` would steer on another
+    engine's default-tenant p99 — the PR-9 documented leak. Aggregate
+    readers sum across engines via ``count_where``/``quantile_where``."""
     reg = registry or REGISTRY
     harvest = getattr(engine, "slo_harvest", None)
     if callable(harvest):
         hist = slo_metrics(reg)["ingest_e2e"]
+        lbl = getattr(engine, "metrics_label", "e?")
         for rec in harvest():
             end = rec.stages.get("device_ready")
             if end is None:
@@ -750,11 +828,11 @@ def harvest_slo(engine, registry: MetricsRegistry | None = None) -> None:
             secs = max(0.0, (end - rec.t0_ns) / 1e9)
             ex = None
             if rec.trace_id is not None:
-                q90 = hist.quantile(0.9, tenant=rec.tenant)
+                q90 = hist.quantile(0.9, tenant=rec.tenant, engine=lbl)
                 if q90 is None or secs >= q90:
                     ex = rec.trace_id
             hist.observe_n(secs, max(1, int(rec.n_payloads)),
-                           exemplar=ex, tenant=rec.tenant)
+                           exemplar=ex, tenant=rec.tenant, engine=lbl)
 
 
 # --------------------------------------------------------------------------

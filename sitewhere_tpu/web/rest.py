@@ -297,9 +297,61 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
             return json_response({"error": "trace not found"}, status=404)
         return json_response(res)
 
+    # --- span plane (ISSUE 10): Perfetto timelines, thread profiler,
+    # debug bundle --------------------------------------------------------
+    async def trace_timeline(request: web.Request):
+        """One trace id -> a Chrome-trace-event document (loads directly
+        in Perfetto / chrome://tracing). Clustered engines stitch every
+        rank's events into one multi-rank timeline; off-loop like every
+        peer-touching surface."""
+        fn = getattr(inst.engine, "get_trace_timeline", None)
+        if fn is None:
+            return json_response({"error": "no span tracer"}, status=404)
+        res = await asyncio.to_thread(fn, request.match_info["traceId"])
+        if not any(e.get("ph") == "X" for e in res.get("traceEvents", ())):
+            return json_response({"error": "trace not found"}, status=404)
+        return json_response(res)
+
+    async def profile(request: web.Request):
+        """Wall-clock sampling profiler over the live engine threads
+        (WAL commit thread, replica senders, forward retry pump, decode
+        workers, RPC executors). Default output: folded stacks, one
+        ``thread;frame;...;leaf count`` line each — pipe straight into
+        flamegraph.pl; ``format=json`` returns the structured form."""
+        from sitewhere_tpu.utils.tracing import profile_threads
+
+        try:
+            seconds = float(request.query.get("seconds", 1.0))
+            interval = float(request.query.get("intervalS", 0.01))
+        except ValueError:
+            return json_response({"error": "bad seconds/intervalS"},
+                                 status=400)
+        seconds = max(0.05, min(seconds, 30.0))
+        interval = max(0.001, min(interval, 1.0))
+        prof = await asyncio.to_thread(profile_threads, seconds, interval)
+        if request.query.get("format") == "json":
+            return json_response(prof)
+        return web.Response(text=prof["folded"] + "\n",
+                            content_type="text/plain")
+
+    async def debug_bundle_doc(request: web.Request):
+        """One self-contained JSON snapshot for offline triage: config,
+        metrics (dict + strict-0.0.4 exposition), recent flights, the
+        slowest traces with timelines, recent spans, and WAL/archive/
+        replication/forward/QoS posture. Feed it to
+        scripts/trace2perfetto.py for a standalone Perfetto file."""
+        from sitewhere_tpu.utils.tracing import debug_bundle
+
+        return json_response(
+            await asyncio.to_thread(debug_bundle, inst.engine))
+
+    r.add_get("/api/instance/profile", profile)
+    r.add_get("/api/instance/debug/bundle", debug_bundle_doc)
+
     # register /recent BEFORE the {traceId} pattern: aiohttp resolves in
     # registration order and "recent" must not parse as a trace id
     r.add_get("/api/instance/trace/recent", trace_recent)
+    r.add_get("/api/instance/trace/{traceId}/timeline", trace_timeline)
     r.add_get("/api/instance/trace/{traceId}", trace_get)
 
     # --- script management (reference: Instance.java scripting @Path

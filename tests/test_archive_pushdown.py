@@ -480,6 +480,91 @@ def test_archive_gauges_exported_at_scrape(tmp_path):
             == arch.plan_pruned + arch.plan_decoded + arch.count_shortcuts)
     assert "archived_rows" in eng.metrics()      # pre-existing key only
     assert not any(k.startswith("swtpu_archive") for k in eng.metrics())
+    # planner passes export too (ISSUE 10 satellite: the batched round
+    # contributes exactly one — pinned below)
+    assert inst["planner_calls"].value() == arch.planner_calls > 0
+
+
+# ------------------------------------------- batched planning (ISSUE 10)
+def test_query_batch_is_one_planner_call_with_per_query_parity(tmp_path):
+    """N archive requests through query_batch share exactly ONE planner
+    pass, and every per-request result is identical to a standalone
+    query() with the same arguments."""
+    eng = small_engine(tmp_path)
+    fill_history(eng)
+    arch = eng.archive
+    dev3 = eng.token_device[eng.tokens.lookup("pd-3")]
+    ten1 = eng.tenants.lookup("ten1")
+    reqs = [
+        {"limit": 5, "filters": {}},
+        {"limit": 3, "filters": {"device": dev3}},
+        {"limit": 10, "filters": {"tenant": ten1}},
+        {"limit": 0, "filters": {"since_ms": 1000, "until_ms": 1015}},
+        {"limit": 4, "filters": {"device": 999999}},
+    ]
+    mp = {0: 64}
+    before = arch.planner_calls
+    batched = arch.query_batch(reqs, max_pos=mp)
+    assert arch.planner_calls == before + 1          # ONE pass for all N
+    assert len(batched) == len(reqs)
+    for req, got in zip(reqs, batched):
+        want = arch.query(max_pos=mp, limit=req["limit"],
+                          **req["filters"])
+        assert got[0] == want[0]
+        assert [(r["part"], r["pos"]) for r in got[1]] == \
+            [(r["part"], r["pos"]) for r in want[1]]
+
+
+def test_batcher_round_plans_archive_requests_once(monkeypatch, tmp_path):
+    """Engine-level pin: ALL archive requests of one QueryBatcher round
+    ride a single SegmentPlanner call (the PR-8 follow-up — previously
+    shared tables but per-query plan evaluation)."""
+    import sitewhere_tpu.engine as engine_mod
+
+    eng = small_engine(tmp_path)
+    fill_history(eng, n=256, devices=8)
+    eng.query_events(limit=5)                 # warm compile
+    orig_fetch = engine_mod._fetch_query_result
+    gate = threading.Event()
+
+    def slow_fetch(tree):
+        gate.wait(5.0)
+        return orig_fetch(tree)
+
+    monkeypatch.setattr(engine_mod, "_fetch_query_result", slow_fetch)
+    results: dict[int, dict] = {}
+    errors: list[Exception] = []
+
+    def query(i):
+        try:
+            results[i] = eng.query_events(device_token=f"pd-{i}", limit=64)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    calls0 = eng.archive.planner_calls
+    threads = [threading.Thread(target=query, args=(i,)) for i in range(8)]
+    threads[0].start()
+    while eng._query_batcher.programs == 0 and threads[0].is_alive():
+        threading.Event().wait(0.005)
+    for t in threads[1:]:
+        t.start()
+    deadline = 300
+    while len(eng._query_batcher._queue) < 7 and deadline:
+        threading.Event().wait(0.01)
+        deadline -= 1
+    gate.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert eng._query_batcher.max_coalesced >= 2
+    # two rounds ran (the leader's own, then the 7 coalesced followers):
+    # one planner pass EACH — not one per query
+    assert eng.archive.planner_calls - calls0 == 2, \
+        (eng.archive.planner_calls, calls0)
+    for i in range(8):
+        assert results[i]["total"] == 32
+        assert all(e["deviceToken"] == f"pd-{i}"
+                   for e in results[i]["events"])
 
 
 # ------------------------------------------------------------------ stress

@@ -94,11 +94,11 @@ def test_slo_harvest_consumes_each_record_once(tmp_path):
     try:
         _ingest_both_ranks(c0, n=8, prefix="hv")
         hist = slo_metrics()["ingest_e2e"]
-        before = hist.count(tenant="default")
+        before = hist.count_where(tenant="default")
         c0.cluster_metrics()
-        mid = hist.count(tenant="default")
+        mid = hist.count_where(tenant="default")
         c0.cluster_metrics()          # second scrape: nothing new
-        after = hist.count(tenant="default")
+        after = hist.count_where(tenant="default")
         assert mid - before >= 8      # every ingested event observed once
         assert after == mid
     finally:
@@ -314,9 +314,9 @@ def test_open_loop_cluster_load_stress(tmp_path):
         lint_prometheus(text)
         assert 'rank="0"' in text and 'rank="1"' in text
         hist = slo_metrics()["ingest_e2e"]
-        assert hist.count(tenant="load-a") == \
+        assert hist.count_where(tenant="load-a") == \
             res.per_tenant["load-a"]["events"]
-        assert hist.count(tenant="load-b") == \
+        assert hist.count_where(tenant="load-b") == \
             res.per_tenant["load-b"]["events"]
         # no loss: the cluster-merged persisted counter accounts every
         # event (the RING query would undercount here by design — this

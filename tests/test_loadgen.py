@@ -318,8 +318,8 @@ def test_open_loop_slo_histogram_matches_loadgen_p99():
     reg = MetricsRegistry()
     export_engine_metrics(eng, reg)                        # harvests SLO
     hist = reg.histogram("swtpu_ingest_e2e_seconds")
-    assert hist.count(tenant="slo") == res.per_tenant["slo"]["events"]
-    slo_p99 = hist.quantile(0.99, tenant="slo")
+    assert hist.count_where(tenant="slo") == res.per_tenant["slo"]["events"]
+    slo_p99 = hist.quantile_where(0.99, tenant="slo")
     load_p99 = res.per_tenant["slo"]["service_p99_ms"] / 1e3
     i = bisect.bisect_left(E2E_LATENCY_BUCKETS, load_p99)
     i = min(i, len(E2E_LATENCY_BUCKETS) - 1)

@@ -192,9 +192,10 @@ def test_query_batcher_wfq_round_membership():
     captured = {}
     orig = b.run
 
-    def spy(params, limit, archive=None, tenant=None):
+    def spy(params, limit, archive=None, tenant=None, trace_id=None):
         captured["tenant"] = tenant
-        return orig(params, limit, archive=archive, tenant=tenant)
+        return orig(params, limit, archive=archive, tenant=tenant,
+                    trace_id=trace_id)
 
     b.run = spy
     eng.query_events(tenant="default", limit=5)
@@ -454,6 +455,32 @@ def test_decide_slo_policy_pure():
     assert props == [("shed_threshold", 2048, props[0][2])]
     # no p99 measurement yet: no action
     assert decide_slo(None, 50.0, flat, cur, bounds) == []
+
+
+def test_slo_harvest_scoped_to_own_engine():
+    """ISSUE 10 satellite, closing the PR-9 known limit: the SLO harvest
+    stamps every swtpu_ingest_e2e series with the harvesting engine's
+    engine=e<n> label and the autotuner's reader keeps only its OWN
+    engine's series — so with TWO in-process engines sharing the
+    process-global registry, engine A's steering can never act on
+    engine B's tenants (before the scope, both engines shared the
+    default-tenant series and A would have read B's p99)."""
+    from sitewhere_tpu.utils.metrics import slo_metrics
+
+    a = Engine(_small_cfg(autotune=True, slo_p99_target_ms=50.0))
+    b = Engine(_small_cfg(autotune=True, slo_p99_target_ms=50.0))
+    assert a.metrics_label != b.metrics_label
+    # the leak scenario: the SAME (default) tenant, ingested into B only
+    b.ingest_json_batch([_meas(f"scope-{i}", seq=i) for i in range(16)])
+    b.flush()
+    # B's reader sees its own window ...
+    assert b._autotuner.slo_p99_ms() is not None
+    # ... A's sees nothing: B's series live under B's engine label (A
+    # harvests first inside slo_p99_ms — its own records only)
+    assert a._autotuner.slo_p99_ms() is None
+    hist = slo_metrics()["ingest_e2e"]
+    assert hist.count(tenant="default", engine=b.metrics_label) >= 16
+    assert hist.count(tenant="default", engine=a.metrics_label) == 0
 
 
 def test_autotuner_slo_objective_steers_shed_threshold():

@@ -441,6 +441,56 @@ def test_trace_endpoints(api):
     assert status == 400
 
 
+def test_span_plane_endpoints(api):
+    """Span-plane REST surface (ISSUE 10): /trace/<id>/timeline serves a
+    Perfetto-loadable Chrome-trace document, /profile serves folded
+    stacks (flamegraph.pl-ready) or structured JSON, and /debug/bundle
+    is one self-contained triage snapshot whose embedded exposition
+    stays on the strict 0.0.4 surface — lint-clean, NO exemplar syntax
+    (the exposition-lint satellite extended to the new endpoints)."""
+    from tests.test_metrics_exposition import lint_prometheus
+
+    call, inst, loop = api
+    rows = [
+        {"deviceToken": f"sp-{i % 2}", "type": "DeviceMeasurement",
+         "request": {"name": "t", "value": float(i)}}
+        for i in range(6)
+    ]
+    status, res = call("POST", "/api/events/batch", rows)
+    assert status == 201
+    tid = res["trace_id"]
+    # stitched timeline document: root lifecycle + stage intervals,
+    # numeric pids/tids with naming metadata (chrome://tracing loads it)
+    status, doc = call("GET", f"/api/instance/trace/{tid}/timeline")
+    assert status == 200 and doc["traceId"] == tid
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert {"ingest", "ingest.decode", "ingest.device"} <= \
+        {e["name"] for e in xs}
+    assert any(e["name"] == "process_name" for e in doc["traceEvents"])
+    status, _ = call("GET", "/api/instance/trace/" + "0" * 32 + "/timeline")
+    assert status == 404
+    # profiler: folded stacks by default, JSON on request, clamped input
+    status, folded = call("GET", "/api/instance/profile",
+                          params={"seconds": "0.1"}, raw=True)
+    assert status == 200
+    for line in folded.decode().strip().splitlines():
+        stack, n = line.rsplit(" ", 1)
+        assert ";" in stack and int(n) >= 1
+    status, prof = call("GET", "/api/instance/profile",
+                        params={"seconds": "0.1", "format": "json"})
+    assert status == 200 and prof["samples"] >= 1
+    status, _ = call("GET", "/api/instance/profile",
+                     params={"seconds": "nope"})
+    assert status == 400
+    # debug bundle: self-contained, exposition lint-clean, exemplar-free
+    status, bundle = call("GET", "/api/instance/debug/bundle")
+    assert status == 200
+    assert bundle["flights"] and bundle["config"]
+    assert any(t["traceId"] == tid for t in bundle["slowestTraces"])
+    lint_prometheus(bundle["prometheus"])
+    assert "# {" not in bundle["prometheus"]
+
+
 def test_prometheus_exposition_lints_over_rest(api):
     """The full /api/instance/metrics/prometheus payload passes the
     promtool-style structural lint (PR 3 satellite)."""

@@ -1,0 +1,326 @@
+"""Span-level tracing & profiling plane (ISSUE 10).
+
+Pins the acceptance surface: the SpanTracer ring mirrors the
+flight-recorder contracts (fixed capacity + wrap-around eviction,
+disabled => hot paths are no-ops), head-based sampling is seeded and
+deterministic per TRACE (all spans of one trace agree, across tracers
+with the same seed), the tail-keep pass rescues slow outliers from a
+head-drop, one ingested-then-queried event on a 2-rank replicated
+cluster resolves BY ITS SINGLE TRACE ID to one stitched multi-rank
+Chrome-trace timeline (owner lifecycle + forward hop + standby apply),
+and none of it leaks into ``engine.metrics()`` (the dispatch-shape
+equality pin runs with tracing enabled — span_trace defaults on).
+
+scripts/trace2perfetto.py is smoke-invoked here so the offline
+converter can't rot.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.loadgen import generate_measurements_message
+from sitewhere_tpu.utils.tracing import (NULL_SPAN, SpanTracer,
+                                         debug_bundle, new_trace_id,
+                                         profile_threads)
+
+SMALL = dict(device_capacity=64, token_capacity=128,
+             assignment_capacity=128, store_capacity=4096,
+             batch_capacity=16, channels=4)
+
+
+def _engine(**kw) -> Engine:
+    cfg = dict(SMALL)
+    cfg.update(kw)
+    return Engine(EngineConfig(**cfg))
+
+
+def _batch(prefix="sp", n=16, base=0):
+    return [generate_measurements_message(f"{prefix}-{i % 8}", base + i)
+            for i in range(n)]
+
+
+# ===================================================================
+# SpanTracer unit pins (mirror the flight-recorder contracts)
+# ===================================================================
+
+def test_span_ring_wraps_and_reindexes():
+    """A full ring evicts oldest-first and unindexes the evicted span —
+    the same bounded-memory pin as the flight recorder's."""
+    tr = SpanTracer(capacity=4)
+    tids = [new_trace_id() for _ in range(10)]
+    for i, tid in enumerate(tids):
+        tr.record(f"op{i}", 0, 1000, trace_id=tid)
+    assert len(tr) == 4
+    assert tr.recorded == 10 and tr.dropped == 6
+    for tid in tids[:6]:                      # evicted: index cleaned
+        assert tr.spans_of(tid) == []
+    for tid in tids[6:]:                      # survivors resolve
+        assert len(tr.spans_of(tid)) == 1
+    names = {d["name"] for d in tr.recent(10)}
+    assert names == {"op6", "op7", "op8", "op9"}
+
+
+def test_disabled_tracer_is_noop():
+    """enabled=False => begin() hands out the shared null span, record()
+    drops, nothing allocates in the ring — the disabled-recorder pin."""
+    tr = SpanTracer(capacity=8, enabled=False)
+    sp = tr.begin("ingest.decode", payloads=5)
+    assert sp is NULL_SPAN
+    sp.annotate(extra=1)
+    sp.end()                                   # idempotent no-op
+    with tr.begin("query.round") as sp2:
+        assert sp2 is NULL_SPAN
+    assert tr.record("repl.apply", 0, 100, trace_id="ab" * 16) is None
+    assert len(tr) == 0 and tr.recorded == 0 and tr.sampled_out == 0
+    assert tr.recent(10) == []
+
+
+def test_head_sampling_seeded_deterministic_and_trace_consistent():
+    """The head verdict is a pure hash of (trace id, seed): two tracers
+    with the same seed agree on every trace; every span of one trace
+    shares its verdict (a sampled trace is complete, not shredded)."""
+    a = SpanTracer(capacity=1024, sample=0.5, seed=7)
+    b = SpanTracer(capacity=1024, sample=0.5, seed=7)
+    c = SpanTracer(capacity=1024, sample=0.5, seed=8)
+    tids = [new_trace_id() for _ in range(200)]
+    va = [a.head_sampled(t) for t in tids]
+    assert va == [b.head_sampled(t) for t in tids]
+    assert va != [c.head_sampled(t) for t in tids]   # seed matters
+    assert 20 < sum(va) < 180                        # ~half kept
+    # all spans of one kept trace land; all spans of one dropped trace
+    # are sampled out together (uniform durations defeat tail-keep only
+    # once its window has history — use a fresh name per trace)
+    kept = next(t for t, v in zip(tids, va) if v)
+    dropped = next(t for t, v in zip(tids, va) if not v)
+    for i in range(3):
+        a.record(f"k{i}", 0, 1000, trace_id=kept)
+    assert len(a.spans_of(kept)) == 3
+    tr2 = SpanTracer(capacity=1024, sample=0.0, seed=7)
+    for i in range(40):                     # saturate one name's window
+        tr2.record("drop.me", 0, 1000, trace_id=dropped)
+    assert tr2.sampled_out > 0
+
+
+def test_tail_keep_rescues_slow_outliers():
+    """sample=0: head drops everything, but a slowest-decile span still
+    lands in the ring — the records an operator hunts survive any
+    sampling rate."""
+    tr = SpanTracer(capacity=256, sample=0.0)
+    tid = new_trace_id()
+    for i in range(64):                     # constant-duration baseline
+        tr.record("repl.send", 0, 1_000_000, trace_id=tid)
+    assert tr.sampled_out > 0               # uniform stream IS sampled out
+    slow = tr.record("repl.send", 0, 50_000_000, trace_id=tid)
+    assert slow is not None                 # 50ms outlier tail-kept
+    assert any(d["durUs"] == 50_000.0 for d in tr.spans_of(tid))
+
+
+def test_nested_spans_inherit_trace_and_parent():
+    tr = SpanTracer(capacity=64)
+    tid = new_trace_id()
+    with tr.begin("query.round", trace_id=tid, q=3) as root:
+        with tr.begin("query.round.archive") as child:
+            assert child.trace_id == tid
+            assert child.parent_id == root.span_id
+    spans = tr.spans_of(tid)
+    assert len(spans) == 2
+    by_name = {d["name"]: d for d in spans}
+    assert by_name["query.round.archive"]["parentId"] == \
+        by_name["query.round"]["spanId"]
+    assert by_name["query.round"]["tags"] == {"q": 3}
+
+
+# ===================================================================
+# Engine-level: lifecycle timelines, metrics() isolation
+# ===================================================================
+
+def test_ingest_timeline_has_lifecycle_spans(tmp_path):
+    """One ingested batch's trace id yields a Chrome-trace document with
+    the decode/WAL/dispatch/device stage intervals (derived from the
+    flight record — the hot path pays nothing new) ready for Perfetto."""
+    eng = _engine(wal_dir=str(tmp_path / "wal"))
+    s = eng.ingest_json_batch(_batch())
+    eng.flush()
+    doc = eng.get_trace_timeline(s["trace_id"])
+    assert doc["traceId"] == s["trace_id"]
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in xs}
+    assert {"ingest", "ingest.decode", "ingest.wal_append",
+            "ingest.dispatch_wait", "ingest.device"} <= names
+    # flight-derived stage intervals nest inside the lifecycle root on
+    # the wall axis (live spans — e.g. ingest.shard_decode — ride a
+    # DIFFERENT wall anchor, the import-time perf_counter offset, so
+    # they may drift a few ms relative to the record's time.time() base)
+    root = next(e for e in xs if e["name"] == "ingest")
+    for e in xs:
+        if e["name"].startswith("ingest.") and e.get("cat") == "flight":
+            assert e["ts"] >= root["ts"] - 1e-3
+            assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1e-3
+    # Perfetto requirements: numeric pids/tids + naming metadata
+    assert all(isinstance(e["pid"], int) and isinstance(e["tid"], int)
+               for e in doc["traceEvents"])
+    assert any(e["name"] == "process_name" for e in doc["traceEvents"])
+
+
+def test_query_round_records_spans_on_the_query_trace():
+    eng = _engine()
+    eng.ingest_json_batch(_batch(prefix="qs"))
+    eng.flush()
+    res = eng.query_events(device_token="qs-1")
+    assert res["total"] >= 1
+    names = {d["name"] for d in eng.tracer.recent(50)}
+    assert {"query.round.snapshot", "query.round.fetch"} <= names
+
+
+def test_tracer_stays_out_of_engine_metrics():
+    """The dispatch-shape equality pin (test_ingest.py) runs with
+    span_trace on by default; this is the explicit half — toggling the
+    tracer cannot change the metrics() dict at all."""
+    on = _engine(span_trace=True)
+    off = _engine(span_trace=False)
+    b = _batch(prefix="mx")
+    on.ingest_json_batch(b)
+    on.flush()
+    on.query_events(device_token="mx-1")
+    off.ingest_json_batch(b)
+    off.flush()
+    off.query_events(device_token="mx-1")
+    m_on, m_off = on.metrics(), off.metrics()
+    assert set(m_on) == set(m_off)
+    assert not any("span" in k for k in m_on)
+    assert m_on == m_off
+
+
+# ===================================================================
+# Wall-clock sampling profiler
+# ===================================================================
+
+def test_profile_threads_folds_named_stacks():
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            sum(range(500))
+
+    t = threading.Thread(target=busy, name="prof-victim", daemon=True)
+    t.start()
+    try:
+        prof = profile_threads(0.3, interval_s=0.01,
+                               thread_filter=lambda n: n == "prof-victim")
+        assert prof["samples"] >= 5
+        assert prof["threads"] == ["prof-victim"]
+        assert prof["folded"]
+        for line in prof["folded"].splitlines():
+            stack, n = line.rsplit(" ", 1)
+            assert stack.startswith("prof-victim;") and int(n) >= 1
+        assert any(".busy" in s for s in prof["stacks"])
+    finally:
+        stop.set()
+        t.join(2)
+
+
+# ===================================================================
+# Debug bundle + offline Perfetto converter (satellite)
+# ===================================================================
+
+def test_debug_bundle_and_trace2perfetto_roundtrip(tmp_path):
+    """The bundle is one self-contained JSON document (config, strict
+    0.0.4 exposition with NO exemplar syntax, flights, slowest traces
+    with events, spans, WAL posture), and scripts/trace2perfetto.py
+    converts it into a standalone Perfetto file — smoke-invoked as a
+    subprocess so the converter can't rot."""
+    from tests.test_metrics_exposition import lint_prometheus
+
+    eng = _engine(wal_dir=str(tmp_path / "wal"))
+    for k in range(3):
+        eng.ingest_json_batch(_batch(prefix="db", base=k * 100))
+        eng.flush()
+    bundle = debug_bundle(eng)
+    assert bundle["config"]["span_trace"] is True
+    assert bundle["flights"] and bundle["slowestTraces"]
+    assert bundle["wal"]["groupCommit"] is not None
+    assert bundle["spanStats"]["capacity"] == eng.tracer.capacity
+    # the embedded exposition stays on the 0.0.4 surface: lint-clean,
+    # no exemplar syntax (satellite: exposition lint over new endpoints)
+    lint_prometheus(bundle["prometheus"])
+    assert "# {" not in bundle["prometheus"]
+    slowest = bundle["slowestTraces"][0]
+    assert slowest["traceId"] and slowest["events"]
+
+    path = tmp_path / "bundle.json"
+    path.write_text(json.dumps(bundle))
+    out = tmp_path / "trace.perfetto.json"
+    r = subprocess.run(
+        [sys.executable, "scripts/trace2perfetto.py", str(path),
+         "--trace", slowest["traceId"], "-o", str(out)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(out.read_text())
+    assert doc["traceId"] == slowest["traceId"]
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert xs and any(e["name"] == "ingest" for e in xs)
+    assert any(e["name"] == "process_name" for e in doc["traceEvents"])
+
+
+# ===================================================================
+# Acceptance: stitched multi-rank timeline on a replicated cluster
+# ===================================================================
+
+def test_stitched_multirank_timeline(tmp_path):
+    """One ingested-then-queried event on a 2-rank RF=2 cluster
+    resolves, by its single trace id, to ONE stitched Chrome-trace
+    timeline: decode/WAL/dispatch/device spans on the OWNER rank, the
+    forward-hop span on the ingress rank, and the standby-apply span on
+    the follower — every span event tagged with that trace id."""
+    from tests.test_cluster import _close, meas, tokens_owned_by
+    from tests.test_cluster_observability import _mk_replicated_cluster
+
+    clusters, feeds, host = _mk_replicated_cluster(tmp_path)
+    c0, _c1 = clusters
+    try:
+        # rank-1-owned tokens via rank 0: ingress forwards, rank 1 owns
+        # the lifecycle, rank 0 hosts leader-1's standby
+        toks = tokens_owned_by(1, 3, prefix="stl")
+        s = c0.ingest_json_batch([meas(t, "t", 1.0, 80 + i)
+                                  for i, t in enumerate(toks)])
+        c0.flush()
+        tid = s["trace_id"]
+        assert tid and len(tid) == 32
+        assert c0.query_events(device_token=toks[0])["total"] == 1
+        deadline = time.monotonic() + 20        # standby apply is async
+        while (not all(f.drained() for f in feeds)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+
+        doc = c0.get_trace_timeline(tid)
+        assert doc["traceId"] == tid
+        # pid metadata names each rank's lane group
+        rank_of_pid = {e["pid"]: e["args"]["name"]
+                       for e in doc["traceEvents"]
+                       if e.get("name") == "process_name"}
+        assert set(rank_of_pid.values()) == {"rank 0", "rank 1"}
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        by_rank = {}
+        for e in xs:
+            by_rank.setdefault(rank_of_pid[e["pid"]], set()).add(e["name"])
+        # owner lifecycle: decode -> WAL -> dispatch -> device on rank 1
+        assert {"ingest.decode", "ingest.wal_append",
+                "ingest.dispatch_wait", "ingest.device"} \
+            <= by_rank["rank 1"], by_rank
+        # ingress: the forward hop (live span) on rank 0
+        assert "forward.hop" in by_rank["rank 0"], by_rank
+        # replication: leader-1's send + the follower's standby apply
+        assert "repl.send" in by_rank["rank 1"], by_rank
+        assert "repl.apply" in by_rank["rank 0"], by_rank
+        # every span event carries THE trace id (one trace, one document)
+        for e in xs:
+            if e.get("cat") == "span":
+                assert e["args"]["traceId"] == tid
+    finally:
+        for f in feeds:
+            f.stop()
+        _close(clusters, host)
