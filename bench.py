@@ -406,6 +406,78 @@ def main() -> None:
         f"{span_overhead_pct:.2f}% "
         f"(off={span_eps_off:,.0f} on={span_eps_on:,.0f} ev/s)")
 
+    # ------------------------------------------------------------------
+    # Devicewatch overhead (ISSUE 11): the compile/retrace watchdog's
+    # per-dispatch work (shape-key hash over the call pytree + verdict
+    # lookup) toggles PER BATCH inside the same continuous stream
+    # (flight recorder + span tracer stay ON in both modes). Same
+    # interleaved median-per-mode / min-of-sessions estimator; smoke
+    # hard-gates the delta <= 3%.
+    from sitewhere_tpu.utils.devicewatch import WATCH as _DWATCH
+    from sitewhere_tpu.utils.devicewatch import (compile_totals,
+                                                 memory_ledger)
+
+    def _dw_session() -> tuple[float, float, float]:
+        per_mode: dict[bool, list[float]] = {False: [], True: []}
+        for k in range(_TR_TOTAL):
+            enabled = bool((k + k // _TR_UNIQ) % 2)
+            _DWATCH.enabled = enabled
+            b = tbatches[k % _TR_UNIQ]
+            t1 = time.perf_counter()
+            teng.ingest_json_batch(b)
+            if teng.staged_count:
+                teng.flush_async()
+            per_mode[enabled].append(time.perf_counter() - t1)
+        teng.barrier()
+        med_off = _tstats.median(per_mode[False])
+        med_on = _tstats.median(per_mode[True])
+        return (max(0.0, (med_on - med_off) / med_off * 100),
+                SZ_BATCH / med_on, SZ_BATCH / med_off)
+
+    dw_sessions = [_dw_session() for _ in range(3)]
+    _DWATCH.enabled = True
+    dw_overhead_pct, dw_eps_on, dw_eps_off = min(dw_sessions)
+    log(f"devicewatch overhead: sessions "
+        f"{[round(s[0], 2) for s in dw_sessions]}% -> "
+        f"{dw_overhead_pct:.2f}% "
+        f"(off={dw_eps_off:,.0f} on={dw_eps_on:,.0f} ev/s)")
+
+    # memory-ledger reconciliation (ISSUE 11 hard gate): the ledger's
+    # ring-store bytes must equal the byte size the CONFIG implies
+    # (recomputed independently via eval_shape — no allocation), and the
+    # arena-pool bytes must equal n_arenas x a freshly-built arena of
+    # the configured geometry. Catches silent drift between what the
+    # engine allocates and what the ledger claims.
+    from sitewhere_tpu.core.store import EventStore
+    from sitewhere_tpu.core.types import DEFAULT_VALUE_CHANNELS
+    from sitewhere_tpu.ingest.arena import StagingArena
+
+    _hc = EngineConfig(**HEADLINE_CFG)
+    dw_led = memory_ledger(eng)
+    _exp_store = sum(
+        int(np.prod(l.shape)) * l.dtype.itemsize
+        for l in jax.tree_util.tree_leaves(jax.eval_shape(
+            lambda: EventStore.zeros(_hc.store_capacity,
+                                     DEFAULT_VALUE_CHANNELS,
+                                     _hc.tenant_arenas))))
+    _k = max(1, _hc.scan_chunk)
+    _exp_arena = None
+    if eng._arena_pool is not None:
+        _exp_arena = (eng._arena_pool.n_arenas
+                      * StagingArena(_hc.batch_capacity * _k,
+                                     DEFAULT_VALUE_CHANNELS,
+                                     lanes=_k).nbytes)
+    dw_ledger_reconciles = (
+        dw_led["components"].get("ring_store") == _exp_store
+        and (_exp_arena is None
+             or dw_led["components"].get("arena_pool") == _exp_arena))
+    log(f"devicewatch memory ledger: ring_store "
+        f"{dw_led['components'].get('ring_store'):,} (expected "
+        f"{_exp_store:,}), arena_pool "
+        f"{dw_led['components'].get('arena_pool')} (expected "
+        f"{_exp_arena}), reconciles={dw_ledger_reconciles}; "
+        f"hwm={dw_led['highWatermarks']}")
+
     # span-depth report: one traced batch -> its rank-local timeline;
     # depth counts the longest parent chain across flight-derived stage
     # intervals and live spans (how much hierarchy one trace id buys)
@@ -939,6 +1011,36 @@ def main() -> None:
             f"{cl_obs_pct:.2f}% (off={cl_obs_off:,.0f} "
             f"on={cl_obs_on:,.0f} ev/s)")
 
+        # (b2) warm every op family the open-loop run will exercise
+        # (ingest, all three query variants incl. the cross-rank fan-out,
+        # register + update mutations) with a short throwaway open-loop
+        # slice, then wait for the replica feeds to drain so the standby
+        # engines' programs are compiled too. From here on the run is
+        # STEADY STATE: a compile observed during the measured run is a
+        # latency cliff the SLO histograms would launder into "one slow
+        # frame" — hard-gated to zero below (ISSUE 11).
+        kwarm_spec = OpenLoopSpec(
+            tenants=tuple(TenantLoad(t, 220.0, n_devices=64,
+                                     device_prefix=f"{t}-warm",
+                                     query_every=1, mutate_every=1)
+                          for t in ("alpha", "bravo", "charlie")),
+            duration_s=1.2, frame_size=64, seed=43)
+        run_open_loop(kc0, build_open_loop_schedule(kwarm_spec),
+                      checkpoint_frames=2)
+        # deterministic top-up: all three loadgen query variants, against
+        # a token owned by EACH rank (the open-loop spec draws them
+        # stochastically)
+        for r in range(2):
+            wtok = next(t for t in ktoks if owner_rank(t, 2) == r)
+            kc0.query_events(device_token=wtok, limit=20)
+        kc0.query_events(limit=20)
+        kc0.query_events(since_ms=0, limit=20)
+        kdl = time.monotonic() + 20
+        while (not all(f.drained() for f in kfeeds)
+               and time.monotonic() < kdl):
+            time.sleep(0.05)
+        cl_compiles0 = compile_totals()
+
         # (c) seeded open-loop mixed-tenant run at ~40% of the measured
         # ceiling: per-event wire->state latency INCLUDING queueing
         # delay, plus interleaved queries and entity mutations
@@ -953,6 +1055,17 @@ def main() -> None:
         ksched = build_open_loop_schedule(kspec)
         olr = run_open_loop(kc0, ksched, checkpoint_frames=4)
         cl_events += olr.events
+        # steady-state recompiles during the measured run (ISSUE 11 hard
+        # gate == 0): the loadgen's own per-family delta plus the global
+        # devicewatch totals delta (covers the standby appliers too)
+        cl_compiles_during = {
+            fam: n - cl_compiles0.get(fam, 0)
+            for fam, n in compile_totals().items()
+            if n - cl_compiles0.get(fam, 0)}
+        cl_steady_recompiles = sum(cl_compiles_during.values())
+        log(f"cluster steady-state recompiles during open loop: "
+            f"{cl_steady_recompiles} {cl_compiles_during or ''} "
+            f"(loadgen saw {olr.compile_counts})")
         log(f"cluster open loop: offered {olr.offered_eps:,.0f} ev/s, "
             f"achieved {olr.events_per_s:,.0f} ev/s over {olr.wall_s}s; "
             f"{olr.queries} queries (p99={olr.query_p99_ms}ms), "
@@ -1124,6 +1237,12 @@ def main() -> None:
             "cluster_trace_coverage": olr.trace_coverage,
             "cluster_timeline_ranks": cl_timeline_ranks,
             "cluster_timeline_events": cl_timeline_events,
+            # device plane (ISSUE 11): compiles observed DURING the
+            # measured open-loop run — hard-gated to zero in smoke (a
+            # mid-run compile is a latency cliff the SLO histograms
+            # launder into "one slow frame")
+            "cluster_steady_recompiles": cl_steady_recompiles,
+            "cluster_compiles_during_run": cl_compiles_during,
         }
 
     # ------------------------------------------------------------------
@@ -1666,6 +1785,15 @@ def main() -> None:
                 "span_events_per_s_off": round(span_eps_off),
                 "span_timeline_events": span_timeline_events,
                 "span_timeline_depth": span_timeline_depth,
+                # device plane (ISSUE 11): watchdog cost (smoke gates
+                # <= 3%), zero-excess-retraces and ledger reconciliation
+                # are smoke gates below; compile posture reports
+                "devicewatch_overhead_pct": round(dw_overhead_pct, 2),
+                "devicewatch_events_per_s_on": round(dw_eps_on),
+                "devicewatch_events_per_s_off": round(dw_eps_off),
+                "devicewatch_excess_retraces": _DWATCH.excess_total(),
+                "devicewatch_ledger_reconciles": dw_ledger_reconciles,
+                "devicewatch_compiles": compile_totals(),
                 # shared-scan batched query engine (ISSUE 5): concurrent
                 # read throughput/latency, read+write interleave, and the
                 # kernel-level amortization of one fused program vs Q
@@ -1753,6 +1881,19 @@ def main() -> None:
         log(f"FAIL: span tracing overhead {span_overhead_pct:.2f}% "
             "> 3% of host e2e throughput")
         sys.exit(1)
+    if smoke and dw_overhead_pct > 3.0:
+        log(f"FAIL: devicewatch overhead {dw_overhead_pct:.2f}% "
+            "> 3% of host e2e throughput")
+        sys.exit(1)
+    if smoke and _DWATCH.excess_total() != 0:
+        log(f"FAIL: {_DWATCH.excess_total()} excess retrace(s) across "
+            "the smoke run — some program family churned shapes beyond "
+            "its declared budget")
+        sys.exit(1)
+    if smoke and not dw_ledger_reconciles:
+        log("FAIL: memory ledger ring/arena byte totals do not "
+            "reconcile with the configured capacities")
+        sys.exit(1)
     if smoke and shard_equal is False:
         log("FAIL: sharded-decode (workers=2) results diverge from the "
             "single-worker run")
@@ -1831,6 +1972,12 @@ def main() -> None:
         if cl["cluster_scrape_ranks"] < 2 or not cl["cluster_scrape_has_slo"]:
             log("FAIL: federated scrape did not cover every live rank "
                 "with SLO histograms")
+            sys.exit(1)
+        if cl["cluster_steady_recompiles"] != 0:
+            log(f"FAIL: {cl['cluster_steady_recompiles']} XLA "
+                f"compile(s) {cl['cluster_compiles_during_run']} during "
+                "the steady-state open-loop run — a mid-run compile is "
+                "a latency cliff the SLO histograms launder")
             sys.exit(1)
 
 

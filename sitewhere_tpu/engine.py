@@ -44,6 +44,9 @@ from sitewhere_tpu.core.types import (
 )
 from sitewhere_tpu.ingest.requests import DecodedRequest, RequestType
 from sitewhere_tpu.pipeline import (
+    FAMILY_PACKED_SCAN,
+    FAMILY_STEP,
+    FAMILY_SWEEP,
     PipelineConfig,
     PipelineState,
     StepOutput,
@@ -743,6 +746,14 @@ class EngineConfig:
                                        # shed threshold toward this
                                        # per-tenant ingest-e2e p99 target
                                        # instead of raw throughput
+    devicewatch: bool = True           # device-plane telemetry (ISSUE
+                                       # 11, utils/devicewatch.py): XLA
+                                       # compile/retrace watchdog over
+                                       # every program family, memory
+                                       # ledger, per-program cost —
+                                       # bench hard-gates the on-vs-off
+                                       # delta <= 3% and zero excess
+                                       # retraces across the smoke run
 
 
 @dataclasses.dataclass
@@ -985,6 +996,27 @@ def _admin_set_assignment_status(state: PipelineState, assignment_id, status, ac
     return dataclasses.replace(state, registry=reg)
 
 
+def _watch_admin_jits() -> None:
+    """Put every module-level admin updater under the devicewatch
+    ``admin`` family (ISSUE 11): compiles counted/timed, no budget —
+    these are shared by every engine in the process, so distinct engine
+    shapes are legitimate distinct programs."""
+    from sitewhere_tpu.utils.devicewatch import watched_jit
+
+    g = globals()
+    for name in ("_admin_create_device", "_admin_set_device_active",
+                 "_admin_set_parent", "_admin_update_device",
+                 "_admin_add_assignment", "_admin_update_assignment",
+                 "_admin_set_assignment_status"):
+        g[name] = watched_jit(g[name], family="admin")
+    g["_tenant_event_counts"] = watched_jit(
+        g["_tenant_event_counts"], family="admin",
+        static_argnames=("t_cap",))
+
+
+_watch_admin_jits()
+
+
 def _fetch_query_result(tree):
     """Materialize a launched query program's outputs on the host. A
     module-level seam (not inlined at the call site) so tests can pin
@@ -1048,9 +1080,18 @@ class QueryBatcher:
             pstruct = QueryParams(*(
                 jax.ShapeDtypeStruct((qpad,), jnp.int32)
                 for _ in QueryParams._fields))
+            t0 = time.perf_counter()
             fn = query_store_batch.lower(self._store_struct, pstruct,
                                          limit=limit).compile()
+            dt = time.perf_counter() - t0
             self._programs[key] = fn
+            # devicewatch (ISSUE 11): exact AOT compile seconds + cost;
+            # budget = one program per (Q bucket, limit bucket), the
+            # shape invariant clamp_page_size/bucket_limit exist to hold
+            watch = getattr(self.engine, "devicewatch", None)
+            if watch is not None:
+                watch.record_aot("query.batch", key=key, bucket=key,
+                                 seconds=dt, compiled=fn)
         return fn
 
     def attach_wfq(self, weights: dict | None) -> None:
@@ -1293,16 +1334,27 @@ class Engine(IngestHostMixin):
             analytics_window=c.analytics_window,
             store_arenas=c.tenant_arenas,
         )
-        self._step = make_pipeline_step(
+        # device-plane watchdog (ISSUE 11): every program family this
+        # engine dispatches goes through a passthrough shape-key watch —
+        # compiles timed, retrace budgets enforced (one program per
+        # family per engine; legitimate transitions grant allowance).
+        # Created BEFORE the steps so the arena rebuild path can re-wrap.
+        from sitewhere_tpu.utils.devicewatch import EngineWatch
+
+        self.devicewatch = EngineWatch(enabled=c.devicewatch)
+        self._backlog_hwm = 0   # staged-row high-watermark (reset on
+                                # scrape via take_backlog_hwm)
+        self._step = self.devicewatch.wrap(make_pipeline_step(
             PipelineConfig(auto_register=c.auto_register, default_device_type=0)
-        )
-        self._scan_step = make_packed_scan_step(
+        ), FAMILY_STEP, cost=True)
+        self._scan_step = self.devicewatch.wrap(make_packed_scan_step(
             PipelineConfig(auto_register=c.auto_register, default_device_type=0),
             c.batch_capacity, c.channels,
-        )
+        ), FAMILY_PACKED_SCAN, cost=True)
         self._staged_batches: list = []   # emitted host batches awaiting a
                                           # scan-chunk dispatch
-        self._sweep = make_presence_sweep()
+        self._sweep = self.devicewatch.wrap(make_presence_sweep(),
+                                            FAMILY_SWEEP)
         self._buf = HostEventBuffer(c.batch_capacity, c.channels)
         # zero-copy arena ingest (native batch decode only): the scanner
         # writes straight into pooled SoA staging buffers that the jit
@@ -1471,12 +1523,16 @@ class Engine(IngestHostMixin):
             n_arenas, c.batch_capacity * k, c.channels, lanes=k)
         self._arena_step = None
         if k > 1:
-            from sitewhere_tpu.pipeline import make_arena_scan_step
+            from sitewhere_tpu.pipeline import (FAMILY_ARENA_SCAN,
+                                                make_arena_scan_step)
 
-            self._arena_step = make_arena_scan_step(
+            # fresh watch scope per rebuild: a scan-chunk retune is a
+            # DECLARED program change, not shape churn
+            self._arena_step = self.devicewatch.wrap(make_arena_scan_step(
                 PipelineConfig(auto_register=c.auto_register,
                                default_device_type=0),
-                c.batch_capacity, c.channels, k)
+                c.batch_capacity, c.channels, k), FAMILY_ARENA_SCAN,
+                cost=True)
 
     def set_ingest_tuning(self, *, scan_chunk: int | None = None,
                           dispatch_depth: int | None = None,
@@ -1529,6 +1585,16 @@ class Engine(IngestHostMixin):
                 + (self._arena_fill.cursor if self._arena_fill is not None
                    else 0)
                 + sum(int(np.sum(b.valid)) for b in self._staged_batches))
+
+    def take_backlog_hwm(self, reset: bool = True) -> int:
+        """Max staged-row backlog observed since the last reset (ISSUE 11
+        satellite). The Prometheus scrape resets it — each sample is
+        "worst case this scrape window"; peeks (REST ledger, debug
+        bundle) pass ``reset=False``."""
+        hwm = max(self._backlog_hwm, self.staged_count)
+        if reset:
+            self._backlog_hwm = self.staged_count
+        return hwm
 
     def _sync_mirrors(self) -> None:
         """Make host mirrors current: run any staged batch and absorb any
@@ -2006,6 +2072,13 @@ class Engine(IngestHostMixin):
         ONE ``lax.scan`` program per chunk — one transfer group + one
         dispatch per K batches, the remote-chip amortizer."""
         with self.lock:
+            # staged-backlog high-watermark (ISSUE 11 satellite): sample
+            # at the dispatch entry, where the backlog peaks — scrape
+            # reads "worst case this window", not the instantaneous 0 a
+            # drained engine shows (reset on scrape)
+            staged = self.staged_count
+            if staged > self._backlog_hwm:
+                self._backlog_hwm = staged
             # drain fair queues whenever rows are queued (even if the flag
             # was toggled off afterwards — queued rows must never strand)
             if self._fair_queued:
@@ -2977,10 +3050,23 @@ class Engine(IngestHostMixin):
         from sitewhere_tpu.pipeline import ZoneTable
 
         with self.lock:
+            # a zone install/remove that CHANGES the zones leaf's
+            # abstract shape (None <-> ZoneTable, or a different zone
+            # count/vertex capacity) is a DECLARED recompile of every
+            # step family — grant the watchdog budgets one more shape.
+            # A no-op (clearing already-None zones, reinstalling the
+            # same shape) must NOT grant: leaked allowance would let
+            # genuine shape churn pass the retrace budget unflagged.
+            old = self.state.zones
             if not polygons:
-                self.state = dataclasses.replace(self.state, zones=None)
+                if old is not None:
+                    self.devicewatch.allow(1)
+                    self.state = dataclasses.replace(self.state,
+                                                     zones=None)
                 return
             verts, valid = pack_zones(polygons, max_vertices)
+            if old is None or tuple(old.verts.shape) != verts.shape:
+                self.devicewatch.allow(1)
             self.state = dataclasses.replace(
                 self.state, zones=ZoneTable(jnp.asarray(verts),
                                             jnp.asarray(valid)))

@@ -93,6 +93,15 @@ class StagingArena:
     def room(self) -> int:
         return self.rows - self.cursor
 
+    @property
+    def nbytes(self) -> int:
+        """Host bytes this arena pins (data columns AND decoder scratch —
+        all preallocated for the arena's lifetime; the memory ledger's
+        per-arena unit). Derived from the array-valued slots so a future
+        column is counted the day it is added."""
+        return sum(v.nbytes for name in self.__slots__
+                   if isinstance((v := getattr(self, name)), np.ndarray))
+
     def view_batch(self) -> EventBatch:
         """The full-capacity numpy-backed EventBatch over the arena's
         arrays (no copies; rows past the cursor must already be masked
@@ -139,6 +148,12 @@ class ArenaPool:
         # arena's host buffers has completed
         self._inflight: collections.deque = collections.deque()
         self.waits = 0   # times acquire had to block on the oldest dispatch
+        self._occupancy_hwm = 0   # max arenas simultaneously out of the
+                                  # free list (capacity headroom, ISSUE 11)
+        # per-arena footprint cached at construction: nbytes must hold
+        # even at the instant every arena is checked out (fill arena +
+        # in-flight dispatches can empty both lists)
+        self._arena_nbytes = self._free[0].nbytes
 
     @property
     def free_count(self) -> int:
@@ -147,6 +162,24 @@ class ArenaPool:
     @property
     def inflight_count(self) -> int:
         return len(self._inflight)
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes held by the pool's staging buffers (free, filling
+        and in-flight arenas all stay allocated for the pool's lifetime
+        — the memory-ledger component; sized from construction-time
+        geometry, so it holds even when every arena is checked out)."""
+        return self.n_arenas * self._arena_nbytes
+
+    def take_occupancy_hwm(self, reset: bool = True) -> int:
+        """Max arenas simultaneously out of the free pool since the last
+        reset. The Prometheus scrape resets it (each sample = worst case
+        this window); peeks pass ``reset=False``."""
+        current = self.n_arenas - len(self._free)
+        hwm = max(self._occupancy_hwm, current)
+        if reset:
+            self._occupancy_hwm = current
+        return hwm
 
     def acquire(self, timeout_s: float | None = None) -> StagingArena:
         """A fillable arena; blocks on the oldest in-flight dispatch when
@@ -160,7 +193,11 @@ class ArenaPool:
         if not self._free:
             self.waits += 1
             self._reclaim_oldest(timeout_s)
-        return self._free.pop()
+        arena = self._free.pop()
+        occupied = self.n_arenas - len(self._free)
+        if occupied > self._occupancy_hwm:
+            self._occupancy_hwm = occupied
+        return arena
 
     def retire(self, arena: StagingArena, ticket, traces: list = ()) -> None:
         """Hand a dispatched arena back; it recycles once ``ticket`` is

@@ -375,6 +375,12 @@ class OpenLoopResult:
     # or spans) after the run — the observability plane's own SLO. None
     # when the run ingested nothing.
     trace_coverage: float | None = None
+    # device plane (ISSUE 11): XLA programs compiled per watched family
+    # DURING this run (devicewatch totals delta). A warm steady-state
+    # run should show {} — any entry is a latency cliff the SLO
+    # histograms would otherwise launder into "one slow frame". None
+    # when devicewatch is unavailable/disabled.
+    compile_counts: dict | None = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -406,6 +412,16 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
     max_late = 0.0
     frames = 0
     events = 0
+    # devicewatch (ISSUE 11): snapshot per-family compile totals so the
+    # result reports compiles observed DURING the run
+    compiles0 = None
+    try:
+        from sitewhere_tpu.utils.devicewatch import WATCH, compile_totals
+
+        if WATCH.enabled:
+            compiles0 = compile_totals()
+    except ImportError:
+        pass
     t0 = time.perf_counter()
 
     def checkpoint():
@@ -501,6 +517,14 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
             **{f"e2e_{k}": v for k, v in _pcts(e2e).items()},
             **{f"service_{k}": v for k, v in _pcts(svc).items()},
         }
+    compile_counts = None
+    if compiles0 is not None:
+        from sitewhere_tpu.utils.devicewatch import compile_totals
+
+        compile_counts = {
+            fam: n - compiles0.get(fam, 0)
+            for fam, n in compile_totals().items()
+            if n - compiles0.get(fam, 0)}
     qp = _pcts(qlat)
     hp = _pcts(hlat)
     return OpenLoopResult(
@@ -512,7 +536,7 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
         history_queries=len(hlat), history_p99_ms=hp["p99_ms"],
         mutations=mutations, max_lateness_s=round(max_late, 4),
         per_tenant=per_tenant, shed_events=sum(shed.values()),
-        trace_coverage=coverage)
+        trace_coverage=coverage, compile_counts=compile_counts)
 
 
 async def run_rest_load(base_url: str, jwt: str, n_workers: int = 5,

@@ -64,3 +64,11 @@ def points_in_zones(points: jax.Array, verts: jax.Array,
     crosses = straddles & jnp.where(by > ay, lhs < rhs, lhs > rhs)
     inside = jnp.sum(crosses, axis=2) % 2 == 1    # [N, Z]
     return inside & zone_valid[None, :]
+
+
+# devicewatch (ISSUE 11): standalone containment calls (zone REST
+# checks, tests) report compiles under the geofence family; calls
+# inlined into the pipeline step trace pass through untouched.
+from sitewhere_tpu.utils.devicewatch import watched_jit  # noqa: E402
+
+points_in_zones = watched_jit(points_in_zones, family="geofence")

@@ -221,3 +221,16 @@ def query_store(
         vmask=store.vmask[top],
         aux=store.aux[top],
     )
+
+
+# devicewatch (ISSUE 11): both query kernels report compiles/shape keys
+# under the query families. Passthrough shims — dispatch, ``.lower``
+# (the QueryBatcher's AOT seam, which records its own exact compile
+# timings per (Q bucket, limit bucket)), and in-jit inlining (the
+# sharded engine's _stacked_query) all behave exactly as before.
+from sitewhere_tpu.utils.devicewatch import watched_jit  # noqa: E402
+
+query_store_batch = watched_jit(query_store_batch, family="query.batch",
+                                static_argnames=("limit",))
+query_store = watched_jit(query_store, family="query.scan",
+                          static_argnames=("limit",))

@@ -62,6 +62,15 @@ def read_range(store: EventStore, start: jax.Array, count: int,
     )
 
 
+# devicewatch (ISSUE 11): the archive spool and feed consumers read the
+# ring through this one program — compiles (one per (count, arena,
+# store shape)) land under the readback family.
+from sitewhere_tpu.utils.devicewatch import watched_jit  # noqa: E402
+
+read_range = watched_jit(read_range, family="readback",
+                         static_argnames=("count", "arena"))
+
+
 def absolute_cursor(store: EventStore) -> int:
     """Total events ever written, summed over arenas — monotone under
     appends, the durable-watermark scalar."""

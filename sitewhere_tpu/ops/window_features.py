@@ -91,6 +91,15 @@ def window_features(windows: jax.Array, tile_m: int = 256,
     return out[:m]
 
 
+# devicewatch (ISSUE 11): the analytics feature extractor (Pallas on
+# TPU) reports compiles under its own family — a window-shape churn in
+# the anomaly service shows up here, not as silent recompile stalls.
+from sitewhere_tpu.utils.devicewatch import watched_jit  # noqa: E402
+
+window_features = watched_jit(window_features, family="window_features",
+                              static_argnames=("tile_m", "force_pallas"))
+
+
 def normalize_windows(windows: jax.Array, features: jax.Array,
                       eps: float = 1e-6) -> jax.Array:
     """Standardize windows with the extracted per-channel mean/std — the

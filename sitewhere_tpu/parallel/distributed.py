@@ -317,6 +317,27 @@ def _admin_set_assignment_status_stacked(state: PipelineState, shard, aid,
     return dataclasses.replace(state, registry=reg)
 
 
+def _watch_stacked_admin_jits() -> None:
+    """Devicewatch (ISSUE 11): the stacked admin updaters report
+    compiles under one ``distributed.admin`` family — unbudgeted, like
+    the single-node admin family (shared across every mesh config in
+    the process)."""
+    from sitewhere_tpu.utils.devicewatch import watched_jit
+
+    g = globals()
+    for name in ("_admin_create_device_stacked",
+                 "_admin_set_device_active_stacked",
+                 "_admin_update_device_stacked",
+                 "_admin_set_parent_stacked",
+                 "_admin_add_assignment_stacked",
+                 "_admin_update_assignment_stacked",
+                 "_admin_set_assignment_status_stacked"):
+        g[name] = watched_jit(g[name], family="distributed.admin")
+
+
+_watch_stacked_admin_jits()
+
+
 class DistributedEngine(IngestHostMixin):
     """Multi-shard product engine: one object per host serving the whole
     mesh. All mutations serialize through one lock (single-writer semantics,

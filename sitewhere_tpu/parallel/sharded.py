@@ -362,3 +362,19 @@ def _stacked_sweep(state: PipelineState, now_ms, missing_ms):
 
     ds, newly = jax.vmap(one)(state.device_state, state.registry.device_active)
     return dataclasses.replace(state, device_state=ds), newly
+
+
+# devicewatch (ISSUE 11): the SPMD program families. Call sites resolve
+# these module globals at dispatch time, so the end-of-module shims
+# cover every ShardedEngine/DistributedEngine. Unbudgeted (one process
+# serves many mesh/capacity configs across tests); the ROADMAP-2
+# pjit/shard_map work inherits this seam as its instrument panel.
+from sitewhere_tpu.utils.devicewatch import watched_jit  # noqa: E402
+
+_sharded_step = watched_jit(
+    _sharded_step, family="sharded.step",
+    static_argnames=("config", "mesh", "exchange", "tokens_per_shard",
+                     "bucket"))
+_stacked_query = watched_jit(_stacked_query, family="sharded.query",
+                             static_argnames=("limit",))
+_stacked_sweep = watched_jit(_stacked_sweep, family="sharded.sweep")

@@ -40,6 +40,18 @@ from sitewhere_tpu.ops.segment import compact_valid_front
 from sitewhere_tpu.ops.window import merge_batch_state, presence_sweep
 
 
+# devicewatch program-family names (ISSUE 11) for the compiled steps
+# these builders return: every engine wraps each program in a
+# utils/devicewatch watch scope under these names — one budgeted
+# program per engine per family, so a shape churn (a batch that stopped
+# padding, a dtype that drifted) is a LOUD retrace-excess event instead
+# of a silent compile storm. Defined here, next to the builders, so the
+# engine and the tests can never disagree on the names.
+FAMILY_STEP = "ingest.step"
+FAMILY_PACKED_SCAN = "ingest.packed_scan"
+FAMILY_ARENA_SCAN = "ingest.arena_scan"
+FAMILY_SWEEP = "presence.sweep"
+
 # per-tenant device-side counter grid: tenants bucket by ``id %
 # TENANT_COUNTER_BUCKETS`` (static, so the compiled program never
 # re-traces as tenants grow; deployments beyond 64 tenants alias buckets

@@ -623,6 +623,14 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
 
         return await asyncio.to_thread(federated_exposition, inst.engine)
 
+    async def device_memory():
+        """Device-plane memory ledger + compile posture (ISSUE 11) —
+        the RPC twin of GET /api/instance/device/memory. Off-loop: the
+        ledger walks live arrays and archive caches."""
+        from sitewhere_tpu.utils.devicewatch import device_memory_payload
+
+        return await asyncio.to_thread(device_memory_payload, inst.engine)
+
     families: dict[str, Handler] = {
         "DeviceManagement.getDeviceByToken": get_device_by_token,
         "DeviceManagement.createDevice": create_device,
@@ -675,6 +683,7 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         "LabelGeneration.listGenerators": list_label_generators,
         "Instance.clusterHealth": cluster_health,
         "Instance.clusterMetrics": cluster_metrics,
+        "Instance.deviceMemory": device_memory,
     }
     tenant_admin: dict[str, Handler] = {
         "TenantManagement.createTenant": create_tenant,

@@ -179,6 +179,14 @@ class SegmentCache:
         self.hits = 0      # calls fully served from cache
         self.loads = 0     # np.load file opens (misses, counted per open)
 
+    @property
+    def nbytes(self) -> int:
+        """Host bytes held by decoded segment columns — the memory
+        ledger's segment-cache component (ISSUE 11)."""
+        return sum(col.nbytes for entry in self._entries.values()
+                   for col in entry.values()
+                   if hasattr(col, "nbytes"))
+
     def columns(self, directory: pathlib.Path, path: str,
                 names: tuple) -> dict:
         entry = self._entries.get(path)

@@ -566,6 +566,88 @@ def qos_metrics(registry: MetricsRegistry | None = None) -> dict:
     }
 
 
+# compile-wall-time buckets (seconds): XLA compiles run 10ms (tiny admin
+# updaters) to tens of seconds (the fused scan step on a loaded host) —
+# the default latency ladder would squash every compile into +Inf
+COMPILE_BUCKETS = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def devicewatch_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """Device-plane telemetry instruments (ISSUE 11). Kept OUT of
+    ``engine.metrics()`` (dispatch-shape equality) like every plane
+    before it. The ``swtpu_xla_*`` series are PROCESS-scoped (one XLA
+    compile cache per process — in-process cluster ranks share it); the
+    ``swtpu_device_mem_*`` gauges carry the exporting engine's
+    ``engine=e<n>`` label because each engine owns its own stores.
+
+      swtpu_xla_compile_seconds      wall time of compiling dispatches,
+                                     per program family (for jit-watched
+                                     families this is the first dispatch
+                                     of a new shape key — trace+compile+
+                                     first run, the latency cliff a
+                                     retrace actually costs; the AOT
+                                     query path times lower()+compile()
+                                     exactly)
+      swtpu_xla_compiles_total       distinct programs compiled
+      swtpu_xla_cache_hits_total     watched dispatches served by an
+                                     already-compiled program
+      swtpu_xla_retrace_excess_total shape-churn compiles beyond a
+                                     scope's declared budget (the
+                                     watchdog's loud counter)
+      swtpu_xla_programs_live        distinct program references held by
+                                     live watch scopes, per family
+      swtpu_xla_program_flops /      cost_analysis() of the most recent
+      swtpu_xla_program_bytes_accessed   compile, per family
+      swtpu_device_exec_seconds      device execution time per family,
+                                     harvested from flight records at
+                                     scrape time (no hot-path syncs)
+      swtpu_device_mem_bytes         memory-ledger component sizes
+      swtpu_device_mem_hwm           high-watermarks (reset on scrape)
+    """
+    reg = registry or REGISTRY
+    return {
+        "compile": reg.histogram(
+            "swtpu_xla_compile_seconds",
+            "XLA compile wall time per program family (jit-watched "
+            "families time the compiling dispatch)",
+            buckets=COMPILE_BUCKETS),
+        "compiles": reg.counter(
+            "swtpu_xla_compiles_total",
+            "distinct XLA programs compiled, per family"),
+        "hits": reg.counter(
+            "swtpu_xla_cache_hits_total",
+            "watched dispatches served by an already-compiled program"),
+        "excess": reg.counter(
+            "swtpu_xla_retrace_excess_total",
+            "compiles beyond a watch scope's declared shape budget "
+            "(shape churn)"),
+        "live": reg.gauge(
+            "swtpu_xla_programs_live",
+            "distinct program references held by live watch scopes"),
+        "flops": reg.gauge(
+            "swtpu_xla_program_flops",
+            "cost_analysis flops of the family's most recent compile"),
+        "bytes": reg.gauge(
+            "swtpu_xla_program_bytes_accessed",
+            "cost_analysis bytes accessed of the family's most recent "
+            "compile"),
+        "exec": reg.histogram(
+            "swtpu_device_exec_seconds",
+            "device execution time per program family, harvested from "
+            "flight records at scrape time"),
+        "mem": reg.gauge(
+            "swtpu_device_mem_bytes",
+            "memory-ledger component bytes (ring store, arenas, segment "
+            "cache, live arrays), per engine"),
+        "mem_hwm": reg.gauge(
+            "swtpu_device_mem_hwm",
+            "memory-ledger high-watermarks since the last scrape "
+            "(reset on scrape), per engine"),
+    }
+
+
 def cluster_metrics_instruments(registry: MetricsRegistry | None
                                 = None) -> dict:
     """Cluster data-plane instruments (ISSUE 7):
@@ -673,6 +755,20 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
                       pool.inflight_count)
         reg.gauge("swtpu_arena_pool_waits",
                   "times ingest blocked on arena recycle").set(pool.waits)
+        # capacity headroom (ISSUE 11 satellite): worst occupancy since
+        # the last scrape, not just "now" — RESET on scrape, so each
+        # sample reads "worst case this scrape window"
+        take_hwm = getattr(pool, "take_occupancy_hwm", None)
+        if take_hwm is not None:
+            reg.gauge("swtpu_arena_pool_occupancy_hwm",
+                      "max arenas simultaneously out of the free pool "
+                      "since the last scrape (reset on scrape)").set(
+                          take_hwm())
+    take_backlog = getattr(engine, "take_backlog_hwm", None)
+    if take_backlog is not None:
+        reg.gauge("swtpu_staged_backlog_hwm_rows",
+                  "max staged-row ingest backlog since the last scrape "
+                  "(reset on scrape)").set(take_backlog())
 
     pending = getattr(engine, "_pending_outs", None)
     if pending is not None:
@@ -767,6 +863,16 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
     # no matter which consumer drains first)
     harvest_slo(engine, reg)
 
+    # device plane (ISSUE 11): compile/retrace posture, memory ledger,
+    # and the query-path device-time harvest. Lazy import keeps this
+    # module importable without jax (offline tooling pins it).
+    try:
+        from sitewhere_tpu.utils import devicewatch as _dw
+    except ImportError:
+        _dw = None
+    if _dw is not None:
+        _dw.export_devicewatch(engine, reg)
+
     # overload-discipline plane (ISSUE 9): admission-bucket balances,
     # saturation state, and the weighted-fair virtual clocks — the
     # admitted/shed counters are incremented LIVE by the controller;
@@ -820,6 +926,11 @@ def harvest_slo(engine, registry: MetricsRegistry | None = None) -> None:
     harvest = getattr(engine, "slo_harvest", None)
     if callable(harvest):
         hist = slo_metrics(reg)["ingest_e2e"]
+        # device-plane sibling (ISSUE 11): the dispatch->device_ready
+        # interval of the SAME records feeds the per-family device
+        # execution-time histogram. It rides THIS drain because the
+        # records are consume-once — a second consumer would see nothing
+        exec_hist = devicewatch_metrics(reg)["exec"]
         lbl = getattr(engine, "metrics_label", "e?")
         for rec in harvest():
             end = rec.stages.get("device_ready")
@@ -833,6 +944,9 @@ def harvest_slo(engine, registry: MetricsRegistry | None = None) -> None:
                     ex = rec.trace_id
             hist.observe_n(secs, max(1, int(rec.n_payloads)),
                            exemplar=ex, tenant=rec.tenant, engine=lbl)
+            disp = rec.stages.get("dispatch")
+            if disp is not None and end >= disp:
+                exec_hist.observe((end - disp) / 1e9, family="ingest")
 
 
 # --------------------------------------------------------------------------

@@ -710,4 +710,14 @@ def debug_bundle(engine) -> dict:
     if qos is not None:
         bundle["qos"] = {"shedThreshold": qos.shed_threshold,
                          "bucketFill": qos.bucket_fill()}
+    # device plane (ISSUE 11): the memory-ledger breakdown (a PEEK —
+    # high-watermarks stay armed for the next scrape) plus per-family
+    # compile posture, so one bundle answers "what is resident and what
+    # has been retracing" without another round trip
+    try:
+        from sitewhere_tpu.utils.devicewatch import device_memory_payload
+
+        bundle["device"] = device_memory_payload(engine)
+    except Exception as e:          # never take the bundle down with it
+        bundle["device"] = {"error": repr(e)}
     return bundle

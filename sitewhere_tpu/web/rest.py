@@ -334,6 +334,35 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return web.Response(text=prof["folded"] + "\n",
                             content_type="text/plain")
 
+    async def device_memory(request: web.Request):
+        """Device-plane memory ledger (ISSUE 11): byte breakdown of the
+        ring store / state tables / staging arenas / segment cache,
+        live-array totals, backend memory_stats where available, the
+        capacity high-watermarks (peek — only the Prometheus scrape
+        resets them) and per-family compile posture."""
+        from sitewhere_tpu.utils.devicewatch import device_memory_payload
+
+        return json_response(
+            await asyncio.to_thread(device_memory_payload, inst.engine))
+
+    async def device_profile(request: web.Request):
+        """Capture a ``jax.profiler`` device trace for ``?ms=N``
+        milliseconds into a named directory and return its location —
+        the hardware-timeline sibling of the PR-10 Perfetto export (on
+        TPU the trace carries real XLA op timelines; load the returned
+        directory in TensorBoard's profile plugin or Perfetto)."""
+        from sitewhere_tpu.utils.devicewatch import capture_device_profile
+
+        try:
+            ms = float(request.query.get("ms", 500))
+        except ValueError:
+            return json_response({"error": "bad ms"}, status=400)
+        try:
+            res = await asyncio.to_thread(capture_device_profile, ms)
+        except Exception as e:   # profiler unavailable on this backend
+            return json_response({"error": repr(e)}, status=503)
+        return json_response(res)
+
     async def debug_bundle_doc(request: web.Request):
         """One self-contained JSON snapshot for offline triage: config,
         metrics (dict + strict-0.0.4 exposition), recent flights, the
@@ -345,7 +374,11 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
         return json_response(
             await asyncio.to_thread(debug_bundle, inst.engine))
 
+    # register /profile/device BEFORE /profile would not matter (exact
+    # paths), but keep the device-plane family together
+    r.add_get("/api/instance/profile/device", device_profile)
     r.add_get("/api/instance/profile", profile)
+    r.add_get("/api/instance/device/memory", device_memory)
     r.add_get("/api/instance/debug/bundle", debug_bundle_doc)
 
     # register /recent BEFORE the {traceId} pattern: aiohttp resolves in

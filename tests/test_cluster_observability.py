@@ -55,6 +55,20 @@ def test_cluster_metrics_is_one_lint_clean_rank_labeled_exposition(tmp_path):
         assert text.count("# TYPE swtpu_ingest_e2e_seconds histogram") == 1
         # the per-tenant SLO histogram harvested from flight records
         assert 'swtpu_ingest_e2e_seconds_bucket{' in text
+        # device plane (ISSUE 11): every rank's scrape carries the XLA
+        # watchdog counters and the memory-ledger gauges — the federated
+        # payload is the single pane the ROADMAP-2 sharded-store work
+        # reads "does tenants x devices still fit one chip's HBM" from
+        for rank in ("0", "1"):
+            assert (f'swtpu_xla_compiles_total{{rank="{rank}",'
+                    f'family="sharded.step"}}') in text
+        import re as _re
+
+        for rank in ("0", "1"):
+            assert _re.search(
+                rf'swtpu_device_mem_bytes\{{rank="{rank}",'
+                r'component="ring_store",engine="e\d+"\}', text), (
+                f"rank {rank} exports no memory ledger")
     finally:
         _close(clusters, host)
 

@@ -175,6 +175,13 @@ def test_full_engine_exposition_lints():
     assert 'swtpu_engine_processed{tenant="all"} 6' in text
     assert 'swtpu_pipeline_accepted{tenant="default"} 6' in text
     assert "swtpu_dispatch_inflight" in text
+    # device plane (ISSUE 11): the scrape-time exports land in the SAME
+    # registry and must lint with everything else (the live watchdog
+    # counters go to the process-global REGISTRY, checked in
+    # tests/test_devicewatch.py)
+    assert 'swtpu_device_mem_bytes{component="ring_store"' in text
+    assert "swtpu_xla_programs_live" in text
+    assert "swtpu_staged_backlog_hwm_rows" in text
 
 
 # --------------------------------------------------------- API separation

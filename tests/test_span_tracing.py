@@ -158,8 +158,12 @@ def test_ingest_timeline_has_lifecycle_spans(tmp_path):
     root = next(e for e in xs if e["name"] == "ingest")
     for e in xs:
         if e["name"].startswith("ingest.") and e.get("cat") == "flight":
-            assert e["ts"] >= root["ts"] - 1e-3
-            assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1e-3
+            # tolerance: ts values are wall-clock MICROseconds (~1.8e15),
+            # where one float64 ULP is ~0.25us — summing (base+t0)+dur
+            # can land up to ~0.5us past the exactly-representable root
+            # end, so a sub-ULP tolerance flakes on wall-clock parity
+            assert e["ts"] >= root["ts"] - 1.0
+            assert e["ts"] + e["dur"] <= root["ts"] + root["dur"] + 1.0
     # Perfetto requirements: numeric pids/tids + naming metadata
     assert all(isinstance(e["pid"], int) and isinstance(e["tid"], int)
                for e in doc["traceEvents"])
