@@ -1711,6 +1711,246 @@ def main() -> None:
         f"{arch.cache.hits}/{arch.cache.loads}, "
         f"count shortcuts {arch.count_shortcuts}")
 
+    # ------------------------------------------------------------------
+    # Streaming-rules CEP leg (ISSUE 13): the on-device rules tier rides
+    # the fused step, so its cost, parity, and replay discipline gate:
+    #  * overhead: rules-on vs rules-off engines over IDENTICAL batches,
+    #    interleaved per batch, median per mode, min of sessions (the
+    #    PR-3 estimator) — smoke gate <= 3% of ingest throughput
+    #  * metrics() dispatch-shape equality WITH rules enabled (scan_chunk
+    #    1 vs 2, byte-equal dicts incl. rule_fires) — smoke gate
+    #  * rollup-vs-recompute parity against the host oracle — smoke gate
+    #  * alert parity + chaos: owner fire keys == oracle; kill/recover
+    #    re-evaluation over WAL replay loses nothing and dups nothing
+    #    (dedup-keyed by rule+group+window) — smoke gates
+    # ------------------------------------------------------------------
+    from sitewhere_tpu.rules import RulesManager, RuleSet
+    from sitewhere_tpu.rules import oracle as _roracle
+
+    RL_BATCH = 1024 if smoke else 8192
+    RL_BATCHES = 8 if smoke else 24
+    RL_DEVS = 128
+    RL_RULESET = {
+        "name": "bench",
+        "rules": [
+            {"name": "hot", "kind": "threshold", "channel": "temp",
+             "op": ">", "value": 90.0, "cooldownMs": 1000},
+            {"name": "burst", "kind": "window", "agg": "count",
+             "channel": "temp", "op": ">=", "value": 4, "windowMs": 2000,
+             "where": {"channel": "temp", "op": ">", "value": 90.0}},
+            {"name": "updown", "kind": "sequence",
+             "first": {"channel": "temp", "op": ">", "value": 90.0},
+             "then": {"channel": "temp", "op": "<", "value": 5.0},
+             "withinMs": 4000},
+            {"name": "silent", "kind": "absence", "channel": "temp",
+             "deadlineMs": 4000},
+        ],
+        "rollups": [{"name": "temp-2s", "channel": "temp",
+                     "windowMs": 2000, "scope": "device"}],
+    }
+
+    def _rules_engine(chunk: int = 1, rules: bool = True,
+                      wal_dir: str | None = None, store: int = 1 << 15):
+        e = Engine(EngineConfig(
+            device_capacity=1 << 10, token_capacity=1 << 12,
+            assignment_capacity=1 << 12, store_capacity=store,
+            batch_capacity=RL_BATCH, channels=8, scan_chunk=chunk,
+            rule_groups=256, rollup_buckets=16, wal_dir=wal_dir))
+        m = None
+        if rules:
+            m = RulesManager(e)
+            # lazy compile (shared jit cache across same-shape engines);
+            # the compile-before-swap AOT path is pinned by tests
+            m.load(RuleSet.parse(RL_RULESET), precompile=False)
+        return e, m
+
+    _rl_base = None  # epoch-relative payloads: values exactly f32-
+    #                  representable (halves) so sum parity is
+    #                  rounding-order independent
+    RL_CUT = RL_BATCHES * RL_BATCH // 2   # device rl-0 goes quiet here
+    #                                       (feeds the absence rule)
+
+    def _rl_event(i: int) -> tuple[int, float, int]:
+        """ONE deterministic formula for event i: (device, value, ts) —
+        shared by the payload builder and the oracle's event list so the
+        two views can never drift."""
+        d = i % RL_DEVS
+        if d == 0 and i >= RL_CUT:
+            d = 1
+        # ~3% of events cross the 90.0 threshold
+        v = 96.5 if (i % 37) == 0 else 20.0 + (i % 80) * 0.5
+        if (i % 149) == 0:
+            v = 2.5                   # sequence "then" candidates
+        return d, v, i * 2
+
+    def _rl_pay(i: int) -> bytes:
+        d, v, ts = _rl_event(i)
+        return json.dumps({
+            "deviceToken": f"rl-{d}", "type": "DeviceMeasurements",
+            "request": {"measurements": {"temp": v},
+                        "eventDate": _rl_base + ts}}).encode()
+
+    # (a) overhead: same prebuilt batches through a rules-on and a
+    # rules-off engine, alternating per batch (shared drift
+    # environment). The engines carry the FULL headline dimensions
+    # (device tables, store, batch) — the same ingest-path denominator
+    # every other <=3% overhead gate (flight/span/devicewatch) measures
+    # against.
+    def _rules_headline_engine(rules: bool):
+        e = Engine(EngineConfig(**HEADLINE_CFG, rule_groups=256,
+                                rollup_buckets=16))
+        m = None
+        if rules:
+            m = RulesManager(e)
+            m.load(RuleSet.parse(RL_RULESET), precompile=False)
+        return e, m
+
+    ron, _rmgr_on = _rules_headline_engine(True)
+    roff, _ = _rules_headline_engine(False)
+    roff.epoch = ron.epoch
+    _rl_base = int(ron.epoch.base_unix_s * 1000)
+    _RL_UNIQ = 6
+    rbatches = [[_rl_pay(b * SZ_BATCH + i) for i in range(SZ_BATCH)]
+                for b in range(_RL_UNIQ)]
+    for b in rbatches:                # warm both programs
+        for e in (ron, roff):
+            e.ingest_json_batch(b)
+            if e.staged_count:
+                e.flush_async()
+    ron.barrier()
+    roff.barrier()
+
+    def _rules_session() -> tuple[float, float, float]:
+        per_mode: dict[bool, list[float]] = {False: [], True: []}
+        for k in range(_TR_TOTAL):
+            with_rules = bool((k + k // _RL_UNIQ) % 2)
+            e = ron if with_rules else roff
+            b = rbatches[k % _RL_UNIQ]
+            t1 = time.perf_counter()
+            e.ingest_json_batch(b)
+            if e.staged_count:
+                e.flush_async()
+            per_mode[with_rules].append(time.perf_counter() - t1)
+        ron.barrier()
+        roff.barrier()
+        med_off = _tstats.median(per_mode[False])
+        med_on = _tstats.median(per_mode[True])
+        return (max(0.0, (med_on - med_off) / med_off * 100),
+                SZ_BATCH / med_on, SZ_BATCH / med_off)
+
+    rules_sessions = [_rules_session() for _ in range(3)]
+    rules_overhead_pct, rules_eps_on, rules_eps_off = min(rules_sessions)
+    log(f"rules overhead: sessions "
+        f"{[round(s[0], 2) for s in rules_sessions]}% -> "
+        f"{rules_overhead_pct:.2f}% "
+        f"(off={rules_eps_off:,.0f} on={rules_eps_on:,.0f} ev/s)")
+
+    # (b) dispatch-shape metrics equality WITH rules (scan_chunk 1 vs 2)
+    ra, rma = _rules_engine(chunk=1)
+    rb, rmb = _rules_engine(chunk=2)
+    rb.epoch = ra.epoch
+    _rl_base = int(ra.epoch.base_unix_s * 1000)
+    rl_events = []                     # oracle's view of the stream
+    for bi in range(RL_BATCHES):
+        payloads = [_rl_pay(bi * RL_BATCH + i) for i in range(RL_BATCH)]
+        for e in (ra, rb):
+            e.ingest_json_batch(payloads)
+            if e.staged_count:
+                e.flush_async()
+        for i in range(RL_BATCH):
+            d, v, ts = _rl_event(bi * RL_BATCH + i)
+            rl_events.append({"ts": ts, "group": d, "value": v})
+    ra.flush()
+    rb.flush()
+    al_a = rma.poll()
+    al_b = rmb.poll()
+    ra.flush()
+    rb.flush()
+    rules_metrics_equal = ra.metrics() == rb.metrics()
+    log(f"rules metrics dispatch-shape equality (chunk 1 vs 2): "
+        f"{rules_metrics_equal} (alerts {len(al_a)} vs {len(al_b)})")
+
+    # (c) alert parity vs the host oracle (devices interned in first-seen
+    # order, so group id == token suffix here)
+    _keys = lambda alerts: {a["alternateId"] for a in alerts}
+    exp = set()
+    for g, w in _roracle.threshold_fire_keys(
+            rl_events, op=0, value=90.0, cooldown_ms=1000):
+        exp.add(f"swr:hot:rl-{g}:{w}")
+    for g, w in _roracle.window_fire_keys(
+            rl_events, agg="count", op=1, value=4, window_ms=2000,
+            where=(0, 90.0)):
+        exp.add(f"swr:burst:rl-{g}:{w}")
+    for g, w in _roracle.sequence_fire_keys(
+            [dict(e, value_b=e["value"]) for e in rl_events],
+            op_a=0, val_a=90.0, op_b=2, val_b=5.0, within_ms=4000):
+        exp.add(f"swr:updown:rl-{g}:{w}")
+    for g, w in _roracle.absence_fire_keys(
+            rl_events, op=1, value=float("-inf"), deadline_ms=4000):
+        exp.add(f"swr:silent:rl-{g}:{w}")
+    rules_alert_parity = _keys(al_a) == exp and _keys(al_b) == exp
+    rules_fires_total = int(ra.metrics().get("rule_fires", 0))
+    log(f"rules alert parity vs oracle: {rules_alert_parity} "
+        f"({len(exp)} expected, {len(al_a)} emitted, "
+        f"fires={rules_fires_total})")
+
+    # (d) rollup-vs-recompute byte parity (count/min/max exact; sums are
+    # halves, so float32 order-of-addition cannot round)
+    rules_rollup_parity = True
+    _otab = _roracle.rollup_oracle(rl_events, window_ms=2000, buckets=16)
+    _oby_group: dict[int, dict] = {}
+    for (g, slot), st in _otab.items():
+        _oby_group.setdefault(g, {})[st[0] * 2000] = st
+    for g in range(0, RL_DEVS, 17):   # sample of devices
+        got = rma.read_rollup("temp-2s", group=f"rl-{g}", limit=100)
+        want = _oby_group.get(g, {})
+        got_map = {b["windowStartMs"]:
+                   (b["count"], b["sum"], b["min"], b["max"])
+                   for b in got["buckets"]}
+        want_map = {w: (st[1], st[2], st[3], st[4])
+                    for w, st in want.items()}
+        if got_map != want_map:
+            rules_rollup_parity = False
+            log(f"rollup PARITY MISMATCH rl-{g}: {got_map} vs {want_map}")
+    log(f"rules rollup parity vs recompute: {rules_rollup_parity}")
+
+    # (e) chaos: snapshot-before-traffic, half the stream + a poll, the
+    # other half UNpolled, kill, recover, re-evaluate over WAL replay
+    import shutil as _rshutil
+
+    rdir = _tempfile.mkdtemp(prefix="swtpu-bench-rules-")
+    rc, rmc = _rules_engine(wal_dir=f"{rdir}/wal")
+    _rl_base = int(rc.epoch.base_unix_s * 1000)
+    from sitewhere_tpu.utils.checkpoint import (replay_wal_into,
+                                                restore_engine,
+                                                save_engine)
+
+    save_engine(rc, f"{rdir}/snap")
+    half = RL_BATCHES // 2
+    for bi in range(half):
+        rc.ingest_json_batch(
+            [_rl_pay(bi * RL_BATCH + i) for i in range(RL_BATCH)])
+    rc.flush()
+    al_c1 = rmc.poll()                 # emitted (WAL-carried) alerts
+    for bi in range(half, RL_BATCHES):
+        rc.ingest_json_batch(
+            [_rl_pay(bi * RL_BATCH + i) for i in range(RL_BATCH)])
+    rc.flush()                         # fires pending, NEVER polled
+    rc.wal.sync()
+    rc.wal.close()
+    del rc                             # "SIGKILL"
+    r2 = restore_engine(f"{rdir}/snap")
+    rm2 = RulesManager(r2)
+    rm2.load(RuleSet.parse(RL_RULESET), precompile=False)
+    replay_wal_into(r2, 0, f"{rdir}/wal")
+    al_c2 = rm2.poll()
+    rules_chaos_no_dup = not (_keys(al_c1) & _keys(al_c2))
+    rules_chaos_no_loss = (_keys(al_c1) | _keys(al_c2)) == exp
+    log(f"rules chaos (kill/recover re-evaluation): no_loss="
+        f"{rules_chaos_no_loss} no_dup={rules_chaos_no_dup} "
+        f"(pre-crash {len(al_c1)}, recovered {len(al_c2)})")
+    _rshutil.rmtree(rdir, ignore_errors=True)
+
     n_load_batches = (len(runs) * N_BATCH + WARM_BATCH
                       + (1 if len(runs) > 1 else 0))
     expected = n_load_batches * SZ_BATCH
@@ -1821,6 +2061,20 @@ def main() -> None:
                 "archive_cache_hits": arch.cache.hits,
                 "archive_cache_loads": arch.cache.loads,
                 "archive_count_shortcuts": arch.count_shortcuts,
+                # streaming-rules CEP tier (ISSUE 13): fused in-step rule
+                # evaluation cost (gate <= 3%), dispatch-shape metrics
+                # equality WITH rules, oracle-pinned alert + rollup
+                # parity, and kill/recover re-evaluation no-loss/no-dup
+                "rules_overhead_pct": round(rules_overhead_pct, 2),
+                "rules_events_per_s_on": round(rules_eps_on),
+                "rules_events_per_s_off": round(rules_eps_off),
+                "rules_metrics_equal": rules_metrics_equal,
+                "rules_alert_parity": rules_alert_parity,
+                "rules_rollup_parity": rules_rollup_parity,
+                "rules_chaos_no_loss": rules_chaos_no_loss,
+                "rules_chaos_no_dup": rules_chaos_no_dup,
+                "rules_fires": rules_fires_total,
+                "rules_alerts_emitted": len(al_a),
                 **({"smoke": True} if smoke else {}),
                 "binary_wire_events_per_s": round(bin_eps),
                 "device_step_events_per_s": round(eps),
@@ -1930,6 +2184,24 @@ def main() -> None:
             f"> {ARCHIVE_P99_BUDGET_MS:.0f}ms budget over a "
             f"{archive_ring_multiple:.1f}x-ring archive with concurrent "
             "ingest")
+        sys.exit(1)
+    if smoke and rules_overhead_pct > 3.0:
+        log(f"FAIL: streaming-rules evaluation overhead "
+            f"{rules_overhead_pct:.2f}% > 3% of ingest throughput")
+        sys.exit(1)
+    if smoke and not rules_metrics_equal:
+        log("FAIL: engine.metrics() differs across dispatch shapes WITH "
+            "rules enabled (scan_chunk 1 vs 2)")
+        sys.exit(1)
+    if smoke and not rules_alert_parity:
+        log("FAIL: rule alert keys diverge from the host oracle")
+        sys.exit(1)
+    if smoke and not rules_rollup_parity:
+        log("FAIL: rollup reads diverge from the host-side recompute")
+        sys.exit(1)
+    if smoke and not (rules_chaos_no_loss and rules_chaos_no_dup):
+        log("FAIL: kill/recover rule re-evaluation lost or duplicated "
+            "alert events (dedup key discipline broken)")
         sys.exit(1)
     if smoke and replication_failover_ok is False:
         log("FAIL: failover read did not land within the detection "

@@ -325,6 +325,21 @@ def apply_tenant_config(instance, config: dict | str | pathlib.Path,
             summary["destinations"].append(dest.destination_id)
         if "router" in routing:
             instance.commands.router = build_router(routing["router"])
+    # streaming rules (ISSUE 13): a "streamingRules" section installs a
+    # rule set through the manager's compile-before-swap path, so the
+    # tenant-config hot-reload plumbing (file watcher / REST POST) swaps
+    # rules with the same discipline as event sources. The rule set is
+    # INSTANCE-wide (one manager per engine) — only the "default"
+    # tenant's config may carry it, so one tenant's apply can never
+    # silently replace another's standing rules
+    rules_doc = config.get("streamingRules")
+    if rules_doc and hasattr(instance, "rules"):
+        if tenant != "default":
+            raise ConfigError(
+                "streamingRules is instance-wide: configure it on the "
+                "'default' tenant (per-tenant scoping goes in each "
+                "rule's 'tenant' filter)")
+        summary["streamingRules"] = instance.rules.load(rules_doc)
     if hasattr(instance, "tenant_configs"):
         instance.tenant_configs[tenant] = {
             "config": config, "summary": summary,
@@ -426,6 +441,17 @@ async def reload_tenant_config(instance, config: dict | str | pathlib.Path,
         build_destination(spec)
     if "router" in routing:
         build_router(routing["router"])
+    if config.get("streamingRules"):
+        from sitewhere_tpu.rules import RuleSet, RuleSetError
+
+        if tenant != "default":
+            raise ConfigError(
+                "streamingRules is instance-wide: configure it on the "
+                "'default' tenant")
+        try:
+            RuleSet.parse(config["streamingRules"])
+        except RuleSetError as e:
+            raise ConfigError(f"streamingRules: {e}") from e
 
     # id collisions would raise MID-apply (after teardown) — reject them
     # while the old graph is still whole. An id is free if it is unused or

@@ -459,6 +459,37 @@ class IngestHostMixin:
                         failed += 1
         return {"decoded": len(payloads) - failed, "failed": failed}
 
+    def _wal_admin_register(self, token: str, device_type: str,
+                            tenant: str, area: str | None,
+                            customer: str | None) -> None:
+        """WAL-carry an ADMIN-path device registration as its wire-form
+        REGISTER envelope, in the same critical section as the mutation —
+        so the non-wire REST/RPC ``register_device`` becomes WAL-
+        replayable AND replica-feed visible (a promoted standby serves
+        the same registry; closes the PR-6 documented limit). The wire
+        path already logged its own envelope and re-enters under
+        ``_wal_suppress``, so this no-ops there; replay and standby apply
+        run with no live WAL and no-op too."""
+        if self.wal is None or getattr(self._wal_local, "depth", 0):
+            return
+        from sitewhere_tpu.ingest.decoders import encode_binary_request
+        from sitewhere_tpu.ingest.requests import (DecodedRequest,
+                                                   RequestType)
+
+        extras = {"deviceTypeToken": device_type}
+        if area:
+            extras["areaToken"] = area
+        if customer:
+            extras["customerToken"] = customer
+        req = DecodedRequest(type=RequestType.REGISTER_DEVICE,
+                             device_token=token, tenant=tenant,
+                             extras=extras)
+        try:
+            self._wal_append(WAL_BINARY, [encode_binary_request(req)],
+                             tenant)
+        finally:
+            self._clear_now_pin()
+
     def process(self, req) -> None:
         """Stage one decoded request (the per-request / protocol-receiver
         path); flushes when the staging batch fills. Registration and
@@ -483,14 +514,18 @@ class IngestHostMixin:
                 except KeyError:
                     pass
             if req.type is RequestType.REGISTER_DEVICE:
-                self.register_device(
-                    req.device_token,
-                    device_type=req.extras.get("deviceTypeToken",
-                                               self.config.default_device_type),
-                    tenant=req.tenant,
-                    area=req.extras.get("areaToken"),
-                    customer=req.extras.get("customerToken"),
-                )
+                # the envelope above IS this registration's WAL record:
+                # suppress the admin path's own record or it double-logs
+                with self._wal_suppress():
+                    self.register_device(
+                        req.device_token,
+                        device_type=req.extras.get(
+                            "deviceTypeToken",
+                            self.config.default_device_type),
+                        tenant=req.tenant,
+                        area=req.extras.get("areaToken"),
+                        customer=req.extras.get("customerToken"),
+                    )
                 self._clear_now_pin()
                 return
             if req.type is RequestType.MAP_DEVICE:
@@ -746,6 +781,20 @@ class EngineConfig:
                                        # shed threshold toward this
                                        # per-tenant ingest-e2e p99 target
                                        # instead of raw throughput
+    rule_groups: int = 1024            # streaming-rules CEP tier (ISSUE
+                                       # 13, rules/): group slots (device/
+                                       # area/tenant ids) each rule and
+                                       # rollup tracks on device; ids
+                                       # beyond this count as out-of-band
+                                       # (visible in rule counters)
+    rollup_buckets: int = 32           # tumbling-window ring depth per
+                                       # (rollup, group) — how much
+                                       # materialized history a rollup
+                                       # serves before windows recycle
+    rule_pending: int = 4              # pending-fire ring depth per
+                                       # (rule, group): fires surviving
+                                       # between harvest polls (overflow
+                                       # drops oldest, counted)
     devicewatch: bool = True           # device-plane telemetry (ISSUE
                                        # 11, utils/devicewatch.py): XLA
                                        # compile/retrace watchdog over
@@ -1280,6 +1329,63 @@ class QueryBatcher:
                     entry["event"].set()
 
 
+# rule/rollup PARAMETER columns (ops/rules.py table halves): a swap that
+# keeps shapes AND static layout replaces exactly these and preserves
+# the carried state (kind/scope/agg/op live in the static layout)
+_RULE_PARAM_FIELDS = ("active", "etype", "tenant", "ch_a", "val_a",
+                      "ch_b", "val_b", "window_ms")
+_ROLLUP_PARAM_FIELDS = ("channel", "scope", "etype", "window_ms")
+
+
+def _swap_sig(state: PipelineState) -> tuple:
+    """Abstract signature of the SWAPPABLE state leaves (zones + rules —
+    the only PipelineState subtrees whose shape can change at runtime).
+    Two states with equal signatures dispatch through the same compiled
+    program."""
+    sub = (state.zones, state.rules)
+    return (jax.tree_util.tree_structure(sub),
+            tuple((leaf.shape, str(leaf.dtype))
+                  for leaf in jax.tree_util.tree_leaves(sub)))
+
+
+class _PrecompiledStep:
+    """AOT-compiled dispatch program installed by a rule-set swap
+    (compile-before-swap: the executable was built OFF the engine lock
+    while the old program kept serving). Calls the executable while the
+    engine's swap epoch matches the one it was installed under; a later
+    declared shape change (zones install, rules clear) bumps the epoch
+    and this shim falls back to the jit program, which compiles lazily
+    under that change's own allowance. The epoch compare is one integer
+    per dispatch — the hot path never walks the state pytree."""
+
+    def __init__(self, compiled, jit_fn, family: str, sig: tuple):
+        self.compiled = compiled
+        self.jit_fn = jit_fn
+        self.family = family
+        self.sig = sig
+        self._engine = None
+        self._epoch = -1
+
+    def bind(self, engine) -> "_PrecompiledStep":
+        """Arm the shim against the engine's CURRENT swap epoch (called
+        by set_rules at install time, after the swap bumped it)."""
+        self._engine = engine
+        self._epoch = engine._swap_epoch
+        return self
+
+    def __call__(self, state, batch):
+        if (self._engine is not None
+                and self._engine._swap_epoch == self._epoch):
+            return self.compiled(state, batch)
+        return self.jit_fn(state, batch)
+
+    def lower(self, *args, **kwargs):
+        return self.jit_fn.lower(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self.jit_fn, name)
+
+
 class Engine(IngestHostMixin):
     """Single-node engine instance."""
 
@@ -1476,6 +1582,12 @@ class Engine(IngestHostMixin):
                     "capacity is %d — ring may wrap before spooling; "
                     "raise store_capacity or lower scan_chunk/batch_capacity",
                     worst, acap)
+        # streaming-rules CEP tier (ISSUE 13): the harvest program is
+        # built lazily per rules shape; a rule-set swap resets it.
+        # _swap_epoch counts declared state-shape changes (zones + rules
+        # swaps); the precompiled-step shim compares it per dispatch
+        self._rules_harvest_fn = None
+        self._swap_epoch = 0
         # stage-time autotuner (opt-in): adapts dispatch_depth / decode
         # fan-out (and optionally scan_chunk) toward the flight
         # recorder's measured bottleneck, one knob per evaluation
@@ -2321,9 +2433,13 @@ class Engine(IngestHostMixin):
             aid = self._next_assignment
             if did >= self.config.device_capacity:
                 raise RuntimeError("device capacity exhausted")
+            type_name = device_type or self.config.default_device_type
+            # admin-path registrations ride the WAL + replica feed as
+            # their wire-form envelope (standby visibility; PR-6 limit)
+            self._wal_admin_register(token, type_name, tenant, area,
+                                     customer)
             self._next_device += 1
             self._next_assignment += 1
-            type_name = device_type or self.config.default_device_type
             self.state = _admin_create_device(
                 self.state,
                 jnp.int32(token_id), jnp.int32(did), jnp.int32(aid),
@@ -3061,15 +3177,180 @@ class Engine(IngestHostMixin):
             if not polygons:
                 if old is not None:
                     self.devicewatch.allow(1)
+                    self._swap_epoch += 1
                     self.state = dataclasses.replace(self.state,
                                                      zones=None)
                 return
             verts, valid = pack_zones(polygons, max_vertices)
             if old is None or tuple(old.verts.shape) != verts.shape:
                 self.devicewatch.allow(1)
+                self._swap_epoch += 1
             self.state = dataclasses.replace(
                 self.state, zones=ZoneTable(jnp.asarray(verts),
                                             jnp.asarray(valid)))
+
+    # ------------------------------------------------- streaming rules
+    def precompile_rules(self, rules_state):
+        """AOT-compile the HOT dispatch program (single-step or k-lane
+        arena scan — whichever this engine actually dispatches) for a
+        CANDIDATE rules subtree, from ShapeDtypeStructs so no buffers are
+        touched and the engine lock is held only to snapshot shapes. The
+        compile-before-swap half of a rule-set install: ingest keeps
+        serving the old program until this returns, and the first
+        post-swap dispatch is compile-free."""
+        from sitewhere_tpu.core.events import EventBatch
+        from sitewhere_tpu.pipeline import (FAMILY_ARENA_SCAN,
+                                            make_arena_scan_step)
+
+        c = self.config
+        with self.lock:
+            base = dataclasses.replace(self.state, rules=rules_state)
+            state_struct = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), base)
+            sig = _swap_sig(base)
+            k = max(1, c.scan_chunk)
+            arena_scan = self._arena_step is not None
+        cfg = PipelineConfig(auto_register=c.auto_register,
+                             default_device_type=0)
+        if arena_scan:
+            fn = make_arena_scan_step(cfg, c.batch_capacity, c.channels, k)
+            rows, family = c.batch_capacity * k, FAMILY_ARENA_SCAN
+        else:
+            fn = make_pipeline_step(cfg)
+            rows, family = c.batch_capacity, FAMILY_STEP
+        bstruct = jax.eval_shape(
+            lambda: EventBatch.zeros(rows, c.channels))
+        t0 = time.perf_counter()
+        compiled = fn.lower(state_struct, bstruct).compile()
+        logging.getLogger(__name__).info(
+            "rules precompile (%s): %.2fs", family,
+            time.perf_counter() - t0)
+        return _PrecompiledStep(compiled, fn, family, sig)
+
+    def set_rules(self, rules_state, *, precompiled=None,
+                  preserve_state: bool = False) -> None:
+        """Install/replace/remove the streaming-rules subtree. A shape
+        change is a DECLARED recompile of every step family — the
+        watchdog budgets are granted one shape, exactly like
+        ``set_geofence_zones`` — and installs ``precompiled`` (from
+        :meth:`precompile_rules`) as the hot program so the swap never
+        stalls a dispatch. ``preserve_state=True`` (same-shaped rule
+        tables, e.g. a threshold tweak) keeps the carried accumulators
+        and recompiles nothing."""
+        with self.lock:
+            old = self.state.rules
+            if (preserve_state and old is not None
+                    and rules_state is not None):
+                merged_rules = old.rules
+                if old.rules is not None and rules_state.rules is not None:
+                    merged_rules = dataclasses.replace(old.rules, **{
+                        f: getattr(rules_state.rules, f)
+                        for f in _RULE_PARAM_FIELDS})
+                merged_rollups = old.rollups
+                if (old.rollups is not None
+                        and rules_state.rollups is not None):
+                    merged_rollups = dataclasses.replace(old.rollups, **{
+                        f: getattr(rules_state.rollups, f)
+                        for f in _ROLLUP_PARAM_FIELDS})
+                rules_state = dataclasses.replace(
+                    rules_state, rules=merged_rules,
+                    rollups=merged_rollups)
+            changed = (_swap_sig(self.state)
+                       != _swap_sig(dataclasses.replace(
+                           self.state, rules=rules_state)))
+            if changed:
+                # declared program change: one shape of allowance for
+                # every wrapped family, and the lazily-built harvest
+                # program starts over with the new shape
+                self.devicewatch.allow(1)
+                self._swap_epoch += 1
+                self._rules_harvest_fn = None
+            self.state = dataclasses.replace(self.state,
+                                             rules=rules_state)
+            if not changed:
+                return
+            cfg = PipelineConfig(auto_register=self.config.auto_register,
+                                 default_device_type=0)
+            if precompiled is not None:
+                # fresh watch scope: a rule-set swap is a declared
+                # program change (the scan-chunk-retune discipline)
+                precompiled.bind(self)
+                if precompiled.family == FAMILY_STEP:
+                    self._step = self.devicewatch.wrap(
+                        precompiled, FAMILY_STEP, cost=True)
+                else:
+                    self._arena_step = self.devicewatch.wrap(
+                        precompiled, precompiled.family, cost=True)
+            else:
+                # rules removed (or swapped without precompile): drop
+                # any stale AOT shim — on WHICHEVER family it was
+                # installed — and return to the shared jit programs
+                if isinstance(getattr(self._step, "fn", self._step),
+                              _PrecompiledStep):
+                    self._step = self.devicewatch.wrap(
+                        make_pipeline_step(cfg), FAMILY_STEP, cost=True)
+                if (self._arena_step is not None and isinstance(
+                        getattr(self._arena_step, "fn",
+                                self._arena_step), _PrecompiledStep)):
+                    from sitewhere_tpu.pipeline import (
+                        FAMILY_ARENA_SCAN, make_arena_scan_step)
+
+                    self._arena_step = self.devicewatch.wrap(
+                        make_arena_scan_step(
+                            cfg, self.config.batch_capacity,
+                            self.config.channels,
+                            max(1, self.config.scan_chunk)),
+                        FAMILY_ARENA_SCAN, cost=True)
+
+    def poll_rule_fires(self):
+        """Harvest pending rule fires: ONE donated-state device program
+        (``rules.harvest`` family) that advances the harvest cursors,
+        then a single readback. Returns numpy ``(pend_key[R, G, K],
+        pend_val[R, G, K], pend_w[R, G], pend_h[R, G])`` — the
+        ``harvest_fires`` ring contract (each group's ``min(w - h, K)``
+        newest entries, oldest-first at ``(w - n .. w - 1) % K``) — or
+        None when no rules are installed. Reporting-cadence only — the
+        ingest hot loop never calls this."""
+        from sitewhere_tpu.ops.rules import harvest_fires
+        from sitewhere_tpu.pipeline import FAMILY_RULES_HARVEST
+
+        with self.lock:
+            rs = self.state.rules
+            if rs is None or rs.rules is None:
+                return None
+            self._sync_mirrors()
+            if self._rules_harvest_fn is None:
+                def _harvest(state: PipelineState):
+                    new_rules, *fires = harvest_fires(state.rules)
+                    return (dataclasses.replace(state, rules=new_rules),
+                            tuple(fires))
+
+                self._rules_harvest_fn = self.devicewatch.wrap(
+                    jax.jit(_harvest, donate_argnums=(0,)),
+                    FAMILY_RULES_HARVEST)
+            self.state, out = self._rules_harvest_fn(self.state)
+            return jax.device_get(out)
+
+    def rule_counters(self) -> dict:
+        """Device-side CEP counters (status/REST surface; NOT part of
+        metrics() — ``missed``/``late`` depend on harvest cadence and
+        batch partitioning, so they would break the dispatch-shape
+        metrics-equality invariant that ``rule_fires`` preserves)."""
+        with self.lock:
+            rs = getattr(self.state, "rules", None)
+            out: dict = {}
+            if rs is not None and rs.rules is not None:
+                rb = rs.rules
+                f, m, l, o = jax.device_get(
+                    (rb.fires, rb.missed, rb.late, rb.oob))
+                out.update(ruleFires=int(f), ruleMissedFires=int(m),
+                           ruleLateEvents=int(l), ruleOobGroups=int(o),
+                           rulesActive=int(rb.n_rules))
+            if rs is not None and rs.rollups is not None:
+                out.update(
+                    rollupLateEvents=int(jax.device_get(rs.rollups.late)),
+                    rollupsActive=int(rs.rollups.n_rollups))
+            return out
 
     def tenant_pipeline_counters(self) -> dict[str, dict[str, int]]:
         """The device-side per-tenant counter grid (accepted /
@@ -3107,4 +3388,12 @@ class Engine(IngestHostMixin):
             **({"archived_rows": self.archive.total_rows(),
                 "archive_lost_rows": self.archive.lost_rows}
                if self.archive is not None else {}),
+            # CEP tier: only the PARTITION-INVARIANT counters (fires is
+            # a pure function of the event stream; missed/late depend on
+            # harvest cadence and live in rule_counters() instead), so
+            # metrics() equality across dispatch shapes holds WITH rules
+            **({"rule_fires": int(self.state.rules.rules.fires),
+                "rules_active": self.state.rules.rules.n_rules}
+               if self.state.rules is not None
+               and self.state.rules.rules is not None else {}),
         }

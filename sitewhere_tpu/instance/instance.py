@@ -128,6 +128,13 @@ class SiteWhereTpuInstance(LifecycleComponent):
         self.zone_monitor = ZoneMonitor(self.engine, self.device_management)
         self.add_child(self.zone_monitor)
 
+        # streaming rules / continuous rollups (ISSUE 13; the Siddhi-tier
+        # analog): inert until a rule set is installed via REST/RPC, the
+        # tenant config's "streamingRules" section, or a watched file
+        from sitewhere_tpu.rules import RulesManager
+
+        self.rules = RulesManager(self.engine)
+
         # device-initiated stream commands -> stream store + downlink acks
         from sitewhere_tpu.management.streams import DeviceStreamService
 

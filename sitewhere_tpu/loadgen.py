@@ -179,6 +179,24 @@ class TenantLoad:
     abusive_period_s: float = 0.0      # burst window period; 0 (with
                                        # mult > 1) = the whole horizon
     abusive_burst_s: float = 0.0       # burst length within each period
+    rule_trigger_eps: float = 0.0      # rule-trigger traffic (ISSUE 13):
+                                       # a SEPARATE seeded Poisson stream
+                                       # of threshold-crossing
+                                       # measurements (value =
+                                       # rule_value on rule_channel)
+                                       # superimposed on the base load —
+                                       # same additivity/fingerprint
+                                       # discipline as the abusive knob:
+                                       # with the knob OFF (rate 0) the
+                                       # schedule is byte-identical to a
+                                       # pre-knob run
+    rule_period_s: float = 0.0         # trigger burst period; 0 (with
+                                       # eps > 0) = the whole horizon
+    rule_burst_s: float = 0.0          # burst length within each period
+    rule_channel: str = "engine.temperature"   # channel the crossings hit
+    rule_value: float = 96.5           # crossing value (exactly f32-
+                                       # representable so sum-rollup
+                                       # parity is rounding-order-free)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -259,12 +277,41 @@ def build_open_loop_schedule(spec: OpenLoopSpec) -> list[ScheduledOp]:
                             < tl.abusive_burst_s]
             arr = np.sort(np.concatenate([arr, xarr]), kind="stable")
         picks = rng.integers(0, tl.n_devices, len(arr))
+        is_rule = np.zeros(len(arr), bool)
+        if tl.rule_trigger_eps > 0:
+            # rule-trigger traffic (ISSUE 13): threshold-crossing
+            # measurements from their OWN seeded stream, merged after the
+            # base draws — the base stream's draws (and every other
+            # tenant's schedule) are untouched, so a schedule with the
+            # knob OFF keeps its pre-knob fingerprint (the abusive-knob
+            # additivity discipline)
+            rrng = np.random.default_rng([spec.seed, ti, 0x51])
+            rgaps: list[np.ndarray] = []
+            rtotal = 0.0
+            while rtotal < spec.duration_s:
+                g = rrng.exponential(
+                    1.0 / tl.rule_trigger_eps,
+                    size=max(64, int(tl.rule_trigger_eps * 0.25) or 64))
+                rgaps.append(g)
+                rtotal += float(g.sum())
+            rarr = np.cumsum(np.concatenate(rgaps))
+            rarr = rarr[rarr < spec.duration_s]
+            if tl.rule_period_s > 0 and tl.rule_burst_s > 0:
+                rarr = rarr[(rarr % tl.rule_period_s) < tl.rule_burst_s]
+            rpicks = rrng.integers(0, tl.n_devices, len(rarr))
+            order = np.argsort(np.concatenate([arr, rarr]), kind="stable")
+            arr = np.concatenate([arr, rarr])[order]
+            picks = np.concatenate([picks, rpicks])[order]
+            is_rule = np.concatenate(
+                [is_rule, np.ones(len(rarr), bool)])[order]
         mut_registered: set[str] = set()
         n_frames = 0
         for lo in range(0, len(arr), spec.frame_size):
             hi = min(lo + spec.frame_size, len(arr))
             payloads = [generate_measurements_message(
-                f"{prefix}-{int(picks[k])}", ti * 10_000_000 + k)
+                f"{prefix}-{int(picks[k])}", ti * 10_000_000 + k,
+                **({"name": tl.rule_channel, "value": tl.rule_value}
+                   if is_rule[k] else {}))
                 for k in range(lo, hi)]
             frame_t = float(arr[hi - 1])
             ops.append(ScheduledOp(

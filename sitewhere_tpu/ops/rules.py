@@ -1,0 +1,652 @@
+"""Fused streaming-rule + continuous-rollup kernels (the CEP tier).
+
+The reference ships Siddhi 3.1.2 as its complex-event-processing layer:
+standing queries over the event stream (thresholds, windowed aggregates,
+sequences, absence patterns) evaluated per event in a JVM loop. Here a
+rule SET lowers into device-resident parameter tables + carried state
+arrays that ride INSIDE the already-running fused ingest step — a
+standing rule is a predicate that never leaves the batch (the
+``ops/query.query_store_batch`` shared-scan argument, applied to rules).
+
+Cost discipline: the ingest overhead gate is ≤3% of the fused step, so
+the kernel avoids the two expensive vector idioms on both backends —
+**no scatters and no associative scans on the rules path**. One stable
+two-key sort per group scope orders the batch into (group, time) runs;
+everything else is cumulative-max/cumsum prefixes, ``searchsorted``
+run maps, and gathers:
+
+  * per-group run bounds come from ``searchsorted`` over the sorted
+    group column (groups are ascending, so each group's run is an
+    interval);
+  * "most recent selected row at-or-before me" (the sequence A-mark,
+    the absence previous-match, first-fire-of-key detection) is a
+    GLOBAL ``lax.cummax`` over selected row indices, guarded by the
+    run/window start index — valid because within a run the sort makes
+    timestamps ascending;
+  * segmented count/sum prefixes are a global ``cumsum`` minus its
+    value at the segment head (exact for ints; exact for float sums of
+    exactly-representable values — the parity gates use binary halves);
+  * pending fires are looked up by rank via ``searchsorted`` over the
+    global new-key cumsum — up to K distinct fired keys per (rule,
+    group) per batch land in the pending ring, oldest dropped and
+    counted.
+
+The static ``layout`` (kind/scope/agg/ops per rule) is pytree METADATA:
+the compiled program specializes per rule kind — a parameter tweak
+(thresholds, windows, channels) is a plain array swap with zero
+recompiles, while a structural change recompiles under the declared
+swap's devicewatch allowance.
+
+Determinism contract (the replay/standby parity oracle rides on it):
+every update and fire decision is a pure function of the EVENT STREAM
+(event-time ``ts_ms``, values, group ids) — never the host clock, never
+``received_ms`` — and is **batch-partition invariant**: splitting the
+same stream into different batch boundaries yields the same carried
+state and the same fire KEY set. Window (agg, op) combinations are
+restricted to monotone pairs at model-validation time, so "the window
+crossed" is observable at any batch end under the same window key;
+threshold rules lower to extremum windows and fire on the crossing
+event itself; absence fires are keyed by the ``last_seen`` timestamp
+that opened the silence. Fire keys (window id / silence-opening
+timestamp) are the device half of the ``rule+group+window`` dedup
+discipline; the host half (rules/manager.py) turns them into alert
+alternate-ids.
+
+Known boundary: sequence pairing and absence silence detection assume
+per-group EVENT-TIME order matches arrival order (true of real device
+streams and preserved verbatim by WAL replay). A late event — one
+arriving after the global watermark already passed its group's
+deadline — can make an absence key partition-dependent: the trailing
+check may fire a silence that the late arrival would have closed. Such
+fires are still deduped within any one partition; operators ingesting
+heavily out-of-order streams should size ``deadlineMs`` above their
+lateness bound (the standard CEP allowed-lateness discipline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from sitewhere_tpu.core.types import NULL_ID
+from sitewhere_tpu.ops.segment import INT32_MIN, lex_argsort
+
+# rule kinds (KIND_THRESHOLD lowers to KIND_WINDOW in the model — see
+# module docstring — so the kernel only knows three)
+KIND_WINDOW = 0
+KIND_SEQUENCE = 1
+KIND_ABSENCE = 2
+
+# group scopes
+SCOPE_DEVICE = 0
+SCOPE_AREA = 1
+SCOPE_TENANT = 2
+
+# comparison ops
+OP_GT = 0
+OP_GE = 1
+OP_LT = 2
+OP_LE = 3
+NO_PRED = -1
+
+# window aggregates
+AGG_COUNT = 0
+AGG_SUM = 1
+AGG_MIN = 2
+AGG_MAX = 3
+
+F32_INF = jnp.float32(jnp.inf)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RuleBlock:
+    """R rules over G group slots. ``layout`` is STATIC structure (the
+    program specializes on it); the table columns are runtime PARAMETERS
+    (editable without a shape change — a threshold tweak hot-swaps with
+    zero recompiles); state columns are the carried accumulators donated
+    through every step with the rest of PipelineState."""
+
+    # static per-rule structure: ((kind, scope, agg, op_a, op_b), ...)
+    layout: tuple = dataclasses.field(metadata=dict(static=True))
+
+    # ------------------------------------------------ parameters, [R]
+    active: jax.Array     # bool[R]
+    etype: jax.Array      # int32[R] event-type filter (NULL_ID = any)
+    tenant: jax.Array     # int32[R] tenant filter (NULL_ID = any)
+    ch_a: jax.Array       # int32[R] predicate-A value channel
+    val_a: jax.Array      # float32[R]
+    ch_b: jax.Array       # int32[R] predicate-B channel (sequence /
+    val_b: jax.Array      # float32[R]   window contributing filter)
+    window_ms: jax.Array  # int32[R] window / pair horizon / deadline
+
+    # ------------------------------------------------ carried state
+    wm: jax.Array         # int32[] event-time watermark (max ts seen)
+    acc_wid: jax.Array    # int32[R, G] window id being accumulated
+    acc_cnt: jax.Array    # int32[R, G] (count/sum windows)
+    acc_sum: jax.Array    # float32[R, G]
+    mark_ts: jax.Array    # int32[R, G] seq: last pred-A ts; absence:
+    #                       last matching ts (INT32_MIN = never)
+    fired_key: jax.Array  # int32[R, G] newest fired key (dedup guard)
+    # pending-fire ring per (rule, group): up to K un-harvested fires
+    # survive between polls; overflow drops the OLDEST (counted in
+    # ``missed`` — the oldest are the ones a previous owner most likely
+    # already emitted)
+    pend_key: jax.Array   # int32[R, G, K]
+    pend_val: jax.Array   # float32[R, G, K]
+    pend_w: jax.Array     # int32[R, G] total fires written (ring cursor)
+    pend_h: jax.Array     # int32[R, G] fires harvested
+    fires: jax.Array      # int32[] distinct keys fired (partition-inv.)
+    missed: jax.Array     # int32[] fires dropped (ring overflow)
+    late: jax.Array       # int32[] events older than their window carry
+    oob: jax.Array        # int32[] matches whose group id >= G
+
+    @property
+    def n_rules(self) -> int:
+        return len(self.layout)
+
+    @property
+    def groups(self) -> int:
+        return self.acc_wid.shape[1]
+
+    @property
+    def pend_depth(self) -> int:
+        return self.pend_key.shape[2]
+
+    @staticmethod
+    def zeros(table: dict, layout: tuple, groups: int,
+              pending: int = 4) -> "RuleBlock":
+        """Fresh state for a lowered parameter table (``table`` maps the
+        parameter field names to numpy arrays of length R == len(layout));
+        ``layout`` is the static per-rule (kind, scope, agg, op_a, op_b)
+        structure."""
+        r = len(layout)
+        g = int(groups)
+        k = max(1, int(pending))
+        i32 = jnp.int32
+        return RuleBlock(
+            layout=tuple(tuple(int(x) for x in row) for row in layout),
+            active=jnp.asarray(table["active"], jnp.bool_),
+            **{kk: jnp.asarray(table[kk], i32)
+               for kk in ("etype", "tenant", "ch_a", "ch_b",
+                          "window_ms")},
+            val_a=jnp.asarray(table["val_a"], jnp.float32),
+            val_b=jnp.asarray(table["val_b"], jnp.float32),
+            wm=jnp.asarray(INT32_MIN, i32),
+            acc_wid=jnp.full((r, g), INT32_MIN, i32),
+            acc_cnt=jnp.zeros((r, g), i32),
+            acc_sum=jnp.zeros((r, g), jnp.float32),
+            mark_ts=jnp.full((r, g), INT32_MIN, i32),
+            fired_key=jnp.full((r, g), INT32_MIN, i32),
+            pend_key=jnp.full((r, g, k), INT32_MIN, i32),
+            pend_val=jnp.zeros((r, g, k), jnp.float32),
+            pend_w=jnp.zeros((r, g), i32),
+            pend_h=jnp.zeros((r, g), i32),
+            fires=jnp.zeros((), i32),
+            missed=jnp.zeros((), i32),
+            late=jnp.zeros((), i32),
+            oob=jnp.zeros((), i32),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RollupBlock:
+    """P continuous rollups, each a [G, NB] ring of tumbling time-window
+    aggregates of one value channel per device/area/tenant group,
+    maintained incrementally in-step and served by the query path. Stat
+    lanes pack two-wide so each ring update is three scatter passes
+    total (newest-window-id, add(count, sum), max(max, -min))."""
+
+    channel: jax.Array    # int32[P]
+    scope: jax.Array      # int32[P] SCOPE_*
+    etype: jax.Array      # int32[P] (NULL_ID = any)
+    window_ms: jax.Array  # int32[P]
+    wid: jax.Array        # int32[P, G, NB] window id held by each slot
+    adds: jax.Array       # float32[P, G, NB, 2] (count, sum) — counts
+    #                       are exact in f32 below 2^24
+    exts: jax.Array       # float32[P, G, NB, 2] (max, -min)
+    late: jax.Array       # int32[] events older than their slot's window
+
+    # ---- named views (the read surface the manager/tests consume)
+    @property
+    def cnt(self):
+        return self.adds[..., 0].astype(jnp.int32)
+
+    @property
+    def vsum(self):
+        return self.adds[..., 1]
+
+    @property
+    def vmax(self):
+        return self.exts[..., 0]
+
+    @property
+    def vmin(self):
+        return -self.exts[..., 1]
+
+    @property
+    def n_rollups(self) -> int:
+        return self.channel.shape[0]
+
+    @property
+    def groups(self) -> int:
+        return self.wid.shape[1]
+
+    @property
+    def buckets(self) -> int:
+        return self.wid.shape[2]
+
+    @staticmethod
+    def zeros(table: dict, groups: int, buckets: int) -> "RollupBlock":
+        p = len(table["channel"])
+        g, nb = int(groups), int(buckets)
+        i32 = jnp.int32
+        return RollupBlock(
+            **{k: jnp.asarray(table[k], i32)
+               for k in ("channel", "scope", "etype", "window_ms")},
+            wid=jnp.full((p, g, nb), INT32_MIN, i32),
+            adds=jnp.zeros((p, g, nb, 2), jnp.float32),
+            exts=jnp.full((p, g, nb, 2), -F32_INF),
+            late=jnp.zeros((), i32),
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class RulesState:
+    """The CEP tier's slice of PipelineState (``state.rules``)."""
+
+    rules: RuleBlock | None = None
+    rollups: RollupBlock | None = None
+
+
+# --------------------------------------------------------------------------
+# kernel helpers
+# --------------------------------------------------------------------------
+
+def _cmp_static(v, op: int, ref):
+    """Comparison with a STATIC op code (specialized at trace time)."""
+    if op == OP_GT:
+        return v > ref
+    if op == OP_GE:
+        return v >= ref
+    if op == OP_LT:
+        return v < ref
+    return v <= ref
+
+
+def _chans(batch, ch):
+    """Per-rule value channels gathered in ONE pass: [B, R] values and
+    populated-masks for a traced channel-index vector."""
+    return jnp.take(batch.values, ch, axis=1), jnp.take(batch.vmask, ch,
+                                                        axis=1)
+
+
+def _last_at_or_before(sel, iota, guard_start):
+    """For each row, the index of the newest SELECTED row strictly
+    before it within its segment (INT32-style -1 when none): a global
+    running max over selected indices, shifted one row and guarded by
+    the segment-start index. Valid because rows are (group, ts)-sorted,
+    so "newest index" == "newest timestamp"."""
+    last = lax.cummax(jnp.where(sel, iota, -1))
+    prev = jnp.concatenate([jnp.full((1,), -1, jnp.int32), last[:-1]])
+    return jnp.where(prev >= guard_start, prev, -1)
+
+
+class _ScopeView:
+    """One (group, ts)-sorted view of the batch, shared by every rule of
+    a scope: permutation, sorted group/ts columns, run-start indices and
+    per-group run bounds (``searchsorted`` over the ascending groups)."""
+
+    __slots__ = ("perm", "g_s", "ts_s", "live", "seg_start", "start_idx",
+                 "lo", "ends", "has", "iota")
+
+    def __init__(self, gcol, ts, groups):
+        b = gcol.shape[0]
+        (self.g_s, self.ts_s), self.perm = lex_argsort([gcol, ts])
+        self.live = self.g_s < groups
+        self.iota = jnp.arange(b, dtype=jnp.int32)
+        self.seg_start = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), self.g_s[1:] != self.g_s[:-1]])
+        self.start_idx = lax.cummax(
+            jnp.where(self.seg_start, self.iota, -1))
+        gid = jnp.arange(groups, dtype=jnp.int32)
+        self.lo = jnp.searchsorted(self.g_s, gid, side="left"
+                                   ).astype(jnp.int32)
+        self.ends = (jnp.searchsorted(self.g_s, gid, side="right")
+                     .astype(jnp.int32) - 1)
+        self.has = self.ends >= self.lo
+
+
+def _ring_push_multi(pend_key, pend_val, pend_w, pend_h, fired_key,
+                     sv: _ScopeView, new_key, key_e, val_e):
+    """Push every distinct fired key (per group, run order, newest-K
+    kept) into the [G, K] pending ring — rank lookups via searchsorted
+    over the global new-key cumsum; no scatters. Returns updated ring +
+    cursors + fired_key and the (fires, missed) deltas."""
+    g, k = pend_key.shape
+    nk = new_key.astype(jnp.int32)
+    c_glob = jnp.cumsum(nk)
+    lo_safe = jnp.where(sv.has, sv.lo, 0)
+    end_safe = jnp.where(sv.has, sv.ends, 0)
+    base = jnp.where(sv.has, c_glob[lo_safe] - nk[lo_safe], 0)
+    c_g = jnp.where(sv.has, c_glob[end_safe] - base, 0)       # [G]
+    kept = jnp.minimum(c_g, k)
+    # ranks (1-based within the run's new-key rows) of the kept fires
+    jj = jnp.arange(k, dtype=jnp.int32)[None, :]              # [1, K]
+    want = jj < kept[:, None]
+    target = base[:, None] + (c_g - kept)[:, None] + jj + 1
+    rows = jnp.searchsorted(c_glob, jnp.where(want, target, -1),
+                            side="left").astype(jnp.int32)
+    rows = jnp.clip(rows, 0, new_key.shape[0] - 1)
+    keys_gk = key_e[rows]
+    vals_gk = val_e[rows]
+    slot = (pend_w[:, None] + jj) % k
+    onehot = slot[:, :, None] == jnp.arange(k)[None, None, :]  # [G,K,K]
+    write = want[:, :, None] & onehot
+    pend_key = jnp.where(jnp.any(write, 1),
+                         jnp.sum(jnp.where(write, keys_gk[:, :, None], 0),
+                                 axis=1),
+                         pend_key)
+    pend_val = jnp.where(jnp.any(write, 1),
+                         jnp.sum(jnp.where(write, vals_gk[:, :, None],
+                                           0.0), axis=1),
+                         pend_val)
+    pending_before = jnp.clip(pend_w - pend_h, 0, k)
+    missed = (jnp.sum(jnp.maximum(0, pending_before + kept - k))
+              + jnp.sum(c_g - kept))
+    pend_w = pend_w + c_g
+    last_key = jnp.where(c_g > 0, keys_gk[jnp.arange(g), kept - 1],
+                         INT32_MIN)
+    fired_key = jnp.maximum(fired_key, last_key)
+    return (pend_key, pend_val, pend_w, fired_key,
+            jnp.sum(c_g), missed)
+
+
+def _pend_push_one(pend_key, pend_val, pend_w, pend_h, fire, key, val):
+    """Append at most one fire per group (the absence trailing check)."""
+    k = pend_key.shape[1]
+    slot = pend_w % k
+    onehot = slot[:, None] == jnp.arange(k)[None, :]
+    write = fire[:, None] & onehot
+    overflow = fire & (pend_w - pend_h >= k)
+    return (jnp.where(write, key[:, None], pend_key),
+            jnp.where(write, val[:, None], pend_val),
+            pend_w + fire.astype(jnp.int32),
+            jnp.sum(overflow.astype(jnp.int32)))
+
+
+def _rules_block_update(rb: RuleBlock, batch, dev, area,
+                        base_valid) -> RuleBlock:
+    g = rb.groups
+    ts = batch.ts_ms
+    wm_new = jnp.maximum(
+        rb.wm, jnp.max(jnp.where(batch.valid, ts, INT32_MIN)))
+    gcols = {SCOPE_DEVICE: dev, SCOPE_AREA: area,
+             SCOPE_TENANT: batch.tenant_id}
+    views: dict[int, _ScopeView] = {}
+    new_state = {f: [] for f in ("acc_wid", "acc_cnt", "acc_sum",
+                                 "mark_ts", "fired_key", "pend_key",
+                                 "pend_val", "pend_w")}
+    fires_n = jnp.zeros((), jnp.int32)
+    missed_n = jnp.zeros((), jnp.int32)
+    late_n = jnp.zeros((), jnp.int32)
+    oob_n = jnp.zeros((), jnp.int32)
+    va_all, vma_all = _chans(batch, rb.ch_a)          # [B, R]
+    vb_all, vmb_all = _chans(batch, rb.ch_b)
+
+    for r, (kind, scope, agg, op_a, op_b) in enumerate(rb.layout):
+        sv = views.get(scope)
+        if sv is None:
+            gc = gcols[scope]
+            key = jnp.where(base_valid & (gc >= 0) & (gc < g), gc, g)
+            sv = views[scope] = _ScopeView(key, ts, g)
+        win = jnp.maximum(rb.window_ms[r], 1)
+        et_ok = (rb.etype[r] == NULL_ID) | (batch.etype == rb.etype[r])
+        tn_ok = ((rb.tenant[r] == NULL_ID)
+                 | (batch.tenant_id == rb.tenant[r]))
+        ev_ok = base_valid & et_ok & tn_ok & rb.active[r]
+        v_a, vm_a = va_all[:, r], vma_all[:, r]
+        # out-of-capacity groups: count matches that fell off the table
+        oob_raw = ev_ok & vm_a & ((gcols[scope] < 0)
+                                  | (gcols[scope] >= g))
+        oob_n += jnp.sum(oob_raw.astype(jnp.int32))
+
+        ts_s = sv.ts_s
+        g_safe = jnp.minimum(sv.g_s, g - 1)
+        fired_row = jnp.where(sv.live, rb.fired_key[r][g_safe],
+                              jnp.iinfo(jnp.int32).max)
+
+        acc_wid_r, acc_cnt_r, acc_sum_r = (rb.acc_wid[r], rb.acc_cnt[r],
+                                           rb.acc_sum[r])
+        mark_r = rb.mark_ts[r]
+        fired_r = rb.fired_key[r]
+
+        if kind == KIND_WINDOW:
+            m = ev_ok & vm_a
+            if op_b != NO_PRED:   # contributing-event filter
+                m &= vmb_all[:, r] & _cmp_static(vb_all[:, r], op_b,
+                                                 rb.val_b[r])
+            m_s = m[sv.perm] & sv.live
+            v_s = v_a[sv.perm]
+            wid = ts_s // win
+            prev_wid = jnp.concatenate([wid[:1] - 1, wid[:-1]])
+            wstart = sv.seg_start | (wid != prev_wid)
+            wstart_idx = lax.cummax(jnp.where(wstart, sv.iota, -1))
+            cw = jnp.where(sv.live, acc_wid_r[g_safe], INT32_MIN)
+            join = (cw > INT32_MIN) & (wid == cw)
+            late_n += jnp.sum((m_s & (wid < cw)).astype(jnp.int32))
+            eff = m_s & (wid >= cw)
+            if agg in (AGG_COUNT, AGG_SUM):
+                x = (jnp.where(eff, 1, 0).astype(jnp.int32)
+                     if agg == AGG_COUNT else jnp.where(eff, v_s, 0.0))
+                cx = jnp.cumsum(x)
+                seg = cx - (cx[wstart_idx] - x[wstart_idx])  # inclusive
+                carry = jnp.where(
+                    join,
+                    (acc_cnt_r[g_safe] if agg == AGG_COUNT
+                     else acc_sum_r[g_safe]),
+                    jnp.zeros((), x.dtype))
+                tot = seg + carry
+                totf = tot.astype(jnp.float32)
+                fire = (eff & _cmp_static(totf, op_a, rb.val_a[r])
+                        & (wid > fired_row))
+                # first fire of a window: the exclusive total had not
+                # crossed (carry-crossed windows fired a batch ago and
+                # are blocked by the dedup guard)
+                new_key = fire & ~_cmp_static(
+                    (tot - x).astype(jnp.float32), op_a, rb.val_a[r])
+                key_e, val_e = wid, totf
+                # run-end accumulator (totals of the newest window)
+                end_safe = jnp.where(sv.has, sv.ends, 0)
+                wid_end = wid[end_safe]
+                upd = sv.has & (wid_end >= jnp.where(
+                    acc_wid_r > INT32_MIN, acc_wid_r, INT32_MIN))
+                tot_end = tot[end_safe]
+                if agg == AGG_COUNT:
+                    acc_cnt_r = jnp.where(upd, tot_end, acc_cnt_r)
+                else:
+                    acc_sum_r = jnp.where(upd, tot_end, acc_sum_r)
+                acc_wid_r = jnp.where(upd, wid_end, acc_wid_r)
+            else:
+                # extremum windows (thresholds lower here): the running
+                # max/min crosses exactly when some EVENT crosses, so
+                # fires are per-event with no accumulator at all
+                cross = eff & _cmp_static(v_s, op_a, rb.val_a[r])
+                fire = cross & (wid > fired_row)
+                prior = _last_at_or_before(cross, sv.iota, wstart_idx)
+                new_key = fire & (prior < 0)
+                key_e, val_e = wid, v_s
+                end_safe = jnp.where(sv.has, sv.ends, 0)
+                wid_end = wid[end_safe]
+                upd = sv.has & (wid_end >= acc_wid_r)
+                acc_wid_r = jnp.where(upd, wid_end, acc_wid_r)
+        elif kind == KIND_SEQUENCE:
+            m_a = (ev_ok & vm_a
+                   & _cmp_static(v_a, op_a, rb.val_a[r]))[sv.perm] \
+                & sv.live
+            m_b = (ev_ok & vmb_all[:, r]
+                   & _cmp_static(vb_all[:, r], op_b,
+                                 rb.val_b[r]))[sv.perm] & sv.live
+            prev_a = _last_at_or_before(m_a, sv.iota, sv.start_idx)
+            a_ts = jnp.where(prev_a >= 0,
+                             ts_s[jnp.maximum(prev_a, 0)],
+                             jnp.where(sv.live, mark_r[g_safe],
+                                       INT32_MIN))
+            fire = (m_b & (a_ts > INT32_MIN) & (ts_s >= a_ts)
+                    & (ts_s - a_ts <= win))
+            key_e = ts_s // win
+            fire &= key_e > fired_row
+            val_e = (ts_s - a_ts).astype(jnp.float32)
+            prev_f = _last_at_or_before(fire, sv.iota, sv.start_idx)
+            new_key = fire & ((prev_f < 0)
+                              | (key_e[jnp.maximum(prev_f, 0)] != key_e))
+        else:  # KIND_ABSENCE
+            m_a = (ev_ok & vm_a
+                   & _cmp_static(v_a, op_a, rb.val_a[r]))[sv.perm] \
+                & sv.live
+            prev_m = _last_at_or_before(m_a, sv.iota, sv.start_idx)
+            prev_ts = jnp.where(prev_m >= 0,
+                                ts_s[jnp.maximum(prev_m, 0)],
+                                jnp.where(sv.live, mark_r[g_safe],
+                                          INT32_MIN))
+            # a match after a silence longer than the deadline fires,
+            # keyed by the silence-opening timestamp
+            fire = (m_a & (prev_ts > INT32_MIN)
+                    & (ts_s - prev_ts > win))
+            key_e = prev_ts
+            fire &= key_e > fired_row
+            val_e = (ts_s - prev_ts).astype(jnp.float32)
+            prev_f = _last_at_or_before(fire, sv.iota, sv.start_idx)
+            new_key = fire & ((prev_f < 0)
+                              | (key_e[jnp.maximum(prev_f, 0)] != key_e))
+
+        if kind in (KIND_SEQUENCE, KIND_ABSENCE):
+            # mark = newest pred-A/matching timestamp (run-end gather)
+            last_sel = lax.cummax(jnp.where(m_a, sv.iota, -1))
+            end_safe = jnp.where(sv.has, sv.ends, 0)
+            le = last_sel[end_safe]
+            in_run = sv.has & (le >= sv.lo)
+            mark_r = jnp.where(in_run,
+                               jnp.maximum(mark_r,
+                                           ts_s[jnp.maximum(le, 0)]),
+                               mark_r)
+
+        (pk, pv, pw, fired_r, f_n, m_n) = _ring_push_multi(
+            rb.pend_key[r], rb.pend_val[r], rb.pend_w[r], rb.pend_h[r],
+            fired_r, sv, new_key, key_e, val_e)
+        fires_n += f_n
+        missed_n += m_n
+
+        if kind == KIND_ABSENCE:
+            # trailing: the watermark passed last_seen + deadline with
+            # no new match (at most one per group per batch)
+            trail = (rb.active[r] & (mark_r > INT32_MIN)
+                     & (wm_new - mark_r > win) & (mark_r > fired_r))
+            pk, pv, pw, over = _pend_push_one(
+                pk, pv, pw, rb.pend_h[r], trail, mark_r,
+                (wm_new - mark_r).astype(jnp.float32))
+            fired_r = jnp.where(trail, mark_r, fired_r)
+            fires_n += jnp.sum(trail.astype(jnp.int32))
+            missed_n += over
+
+        new_state["acc_wid"].append(acc_wid_r)
+        new_state["acc_cnt"].append(acc_cnt_r)
+        new_state["acc_sum"].append(acc_sum_r)
+        new_state["mark_ts"].append(mark_r)
+        new_state["fired_key"].append(fired_r)
+        new_state["pend_key"].append(pk)
+        new_state["pend_val"].append(pv)
+        new_state["pend_w"].append(pw)
+
+    return dataclasses.replace(
+        rb, wm=wm_new,
+        **{f: jnp.stack(v) for f, v in new_state.items()},
+        fires=rb.fires + fires_n,
+        missed=rb.missed + missed_n,
+        late=rb.late + late_n,
+        oob=rb.oob + oob_n)
+
+
+def _rollup_block_update(ro: RollupBlock, batch, groups3,
+                         base_valid) -> RollupBlock:
+    p, g, nb = ro.wid.shape
+    b = batch.capacity
+    ts = batch.ts_ms
+
+    et_ok = ((ro.etype[None, :] == NULL_ID)
+             | (batch.etype[:, None] == ro.etype[None, :]))
+    v = jnp.take(batch.values, ro.channel, axis=1)        # [B, P]
+    vm = jnp.take(batch.vmask, ro.channel, axis=1)
+    g_bp = groups3[ro.scope].T                            # [B, P]
+    rel = (base_valid[:, None] & et_ok & vm & (g_bp >= 0) & (g_bp < g))
+    win = jnp.maximum(ro.window_ms, 1)[None, :]
+    wid = ts[:, None] // win
+    slot = wid % nb
+    p_bp = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32)[None, :], (b, p))
+    # sentinel on the leading index drops irrelevant points
+    pi = jnp.where(rel, p_bp, p)
+    gi = jnp.minimum(jnp.maximum(g_bp, 0), g - 1)
+    # pass 1: the newest window id per touched slot wins the slot
+    wid_new = ro.wid.at[pi, gi, slot].max(wid, mode="drop")
+    stale = wid_new != ro.wid
+    adds0 = jnp.where(stale[..., None], 0.0, ro.adds)
+    exts0 = jnp.where(stale[..., None], -F32_INF, ro.exts)
+    # pass 2/3: events carrying the slot's (new) window id contribute;
+    # older ones are late (counted, never mixed into a newer window)
+    contrib = rel & (wid == wid_new.at[pi, gi, slot].get(
+        mode="fill", fill_value=INT32_MIN))
+    pc = jnp.where(contrib, p_bp, p)
+    ones = jnp.ones_like(v)
+    return dataclasses.replace(
+        ro,
+        wid=wid_new,
+        adds=adds0.at[pc, gi, slot].add(
+            jnp.stack([ones, v], axis=-1), mode="drop"),
+        exts=exts0.at[pc, gi, slot].max(
+            jnp.stack([v, -v], axis=-1), mode="drop"),
+        late=ro.late + jnp.sum((rel & ~contrib).astype(jnp.int32)))
+
+
+def rules_update(rs: RulesState, batch, dev, found, registry) -> RulesState:
+    """One batch through the CEP tier: called INSIDE ``pipeline_step`` on
+    the post-lookup view (``dev``/``found`` from ops/lookup), so rules and
+    rollups see exactly the rows that persist. Pure event-time function —
+    see the module docstring's determinism contract."""
+    if rs.rules is None and rs.rollups is None:
+        return rs
+    base_valid = batch.valid & found
+    n_dev = registry.device_area.shape[0]
+    dev_safe = jnp.clip(dev, 0, n_dev - 1)
+    area = jnp.where(found, registry.device_area[dev_safe], NULL_ID)
+
+    rules = rs.rules
+    if rules is not None:
+        rules = _rules_block_update(rules, batch, dev, area, base_valid)
+
+    rollups = rs.rollups
+    if rollups is not None:
+        groups3 = jnp.stack([dev, area, batch.tenant_id])  # [3, B]
+        rollups = _rollup_block_update(rollups, batch, groups3,
+                                       base_valid)
+    return RulesState(rules=rules, rollups=rollups)
+
+
+def harvest_fires(rules_state: RulesState):
+    """Drain the pending-fire rings (pure; the engine jits this with
+    state donation under the ``rules.harvest`` devicewatch family).
+    Returns ``(new_rules_state, pend_key, pend_val, pend_w, pend_h)`` —
+    the harvest cursor advances to the write cursor; the host
+    reconstructs each group's ``min(w - h, K)`` newest entries from the
+    ring (oldest-first at slots ``(w - n .. w - 1) % K``)."""
+    rb = rules_state.rules
+    if rb is None:
+        z = jnp.zeros((0, 0))
+        return rules_state, z, z, z, z
+    cleared = dataclasses.replace(rb, pend_h=rb.pend_w)
+    return (dataclasses.replace(rules_state, rules=cleared),
+            rb.pend_key, rb.pend_val, rb.pend_w, rb.pend_h)

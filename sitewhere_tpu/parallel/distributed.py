@@ -871,9 +871,13 @@ class DistributedEngine(IngestHostMixin):
             aid = int(self._next_assignment[shard])
             if did >= self.config.device_capacity_per_shard:
                 raise RuntimeError(f"device capacity exhausted on shard {shard}")
+            type_name = device_type or self.config.default_device_type
+            # admin-path registrations ride the WAL + replica feed as
+            # their wire-form envelope (standby visibility; PR-6 limit)
+            self._wal_admin_register(token, type_name, tenant, area,
+                                     customer)
             self._next_device[shard] += 1
             self._next_assignment[shard] += 1
-            type_name = device_type or self.config.default_device_type
             self.sharded.state = _admin_create_device_stacked(
                 self.sharded.state,
                 jnp.int32(shard), jnp.int32(local_tok),

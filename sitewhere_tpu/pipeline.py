@@ -36,6 +36,7 @@ from sitewhere_tpu.models.windows import TelemetryWindows, append_measurements
 from sitewhere_tpu.ops.lookup import expand_assignments, lookup_devices
 from sitewhere_tpu.ops.persist import append_events
 from sitewhere_tpu.ops.registration import register_misses
+from sitewhere_tpu.ops.rules import RulesState, rules_update
 from sitewhere_tpu.ops.segment import compact_valid_front
 from sitewhere_tpu.ops.window import merge_batch_state, presence_sweep
 
@@ -51,6 +52,7 @@ FAMILY_STEP = "ingest.step"
 FAMILY_PACKED_SCAN = "ingest.packed_scan"
 FAMILY_ARENA_SCAN = "ingest.arena_scan"
 FAMILY_SWEEP = "presence.sweep"
+FAMILY_RULES_HARVEST = "rules.harvest"
 
 # per-tenant device-side counter grid: tenants bucket by ``id %
 # TENANT_COUNTER_BUCKETS`` (static, so the compiled program never
@@ -118,6 +120,12 @@ class PipelineState:
     # optional geofence polygons for the in-step geofence-hit counter
     # (Engine.set_geofence_zones); None keeps the lane at zero.
     zones: ZoneTable | None = None
+    # optional streaming-rules CEP tier (ops/rules.py): rule parameter
+    # tables + carried accumulators + continuous rollups, evaluated
+    # inside this same program at ingest cadence. None (the default)
+    # compiles the step without the tier — zero cost when unused.
+    # Installed/swapped by Engine.set_rules (rules/manager.py).
+    rules: RulesState | None = None
 
     @staticmethod
     def create(
@@ -307,6 +315,15 @@ def pipeline_step(
             batch.seq, batch.values,
         )
 
+    # 5.5 streaming-rules CEP tier (ops/rules.py): standing rules +
+    #     continuous rollups evaluate on the post-lookup view INSIDE this
+    #     same program — a rule is a predicate that never leaves the
+    #     batch. Fires land in device-resident pending slots harvested at
+    #     reporting cadence (Engine.poll_rule_fires); nothing here syncs.
+    rules = state.rules
+    if rules is not None:
+        rules = rules_update(rules, batch, res.device, res.found, reg)
+
     # 6. windowed device-state merge (device-state analog)
     new_device_state = merge_batch_state(
         state.device_state,
@@ -343,6 +360,7 @@ def pipeline_step(
         metrics=metrics,
         windows=windows,
         zones=state.zones,
+        rules=rules,
     )
     out = StepOutput(
         n_found=n_found,

@@ -631,6 +631,25 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
 
         return await asyncio.to_thread(device_memory_payload, inst.engine)
 
+    # --- streaming rules & rollups (ISSUE 13; RPC twins of /api/rules) ----
+    async def rules_status():
+        return await asyncio.to_thread(inst.rules.status)
+
+    async def rules_set(ruleSet: dict):
+        # validate+lower+AOT-compile off-loop; RuleSetError propagates as
+        # a typed RPC error with the active set untouched
+        return await asyncio.to_thread(inst.rules.load, ruleSet)
+
+    async def rules_poll(flush: bool = True):
+        return await asyncio.to_thread(inst.rules.poll, bool(flush))
+
+    async def rules_rollup(name: str, group: str = None,
+                           pageSize: int = 100):
+        from sitewhere_tpu.ops.query import clamp_page_size
+
+        return await asyncio.to_thread(inst.rules.read_rollup, name,
+                                       group, clamp_page_size(pageSize))
+
     families: dict[str, Handler] = {
         "DeviceManagement.getDeviceByToken": get_device_by_token,
         "DeviceManagement.createDevice": create_device,
@@ -684,6 +703,10 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         "Instance.clusterHealth": cluster_health,
         "Instance.clusterMetrics": cluster_metrics,
         "Instance.deviceMemory": device_memory,
+        "Rules.getStatus": rules_status,
+        "Rules.setRuleSet": rules_set,
+        "Rules.poll": rules_poll,
+        "Rules.readRollup": rules_rollup,
     }
     tenant_admin: dict[str, Handler] = {
         "TenantManagement.createTenant": create_tenant,

@@ -566,6 +566,38 @@ def qos_metrics(registry: MetricsRegistry | None = None) -> dict:
     }
 
 
+def rules_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """Streaming-rules CEP tier instruments (ISSUE 13). Kept OUT of
+    engine.metrics() (dispatch-shape equality) like the query / qos /
+    replication instruments; the partition-invariant ``rule_fires``
+    counter IS in metrics() — these cover the host-side lifecycle.
+
+      swtpu_rules_swaps_total           rule-set installs/hot-reloads
+      swtpu_rules_reload_errors_total   rejected rule-set documents
+                                        (the active set kept serving)
+      swtpu_rules_alerts_total          alert events emitted through
+                                        the ingest pipeline
+      swtpu_rules_suppressed_total      fires suppressed by the
+                                        rule+group+window dedup key
+                                        (replay / standby promotion)
+    """
+    reg = registry or REGISTRY
+    return {
+        "swaps": reg.counter(
+            "swtpu_rules_swaps_total",
+            "rule-set installs and hot-reload swaps"),
+        "reload_errors": reg.counter(
+            "swtpu_rules_reload_errors_total",
+            "rule-set documents rejected at validate/compile time"),
+        "alerts": reg.counter(
+            "swtpu_rules_alerts_total",
+            "rule alert events emitted through the ingest pipeline"),
+        "suppressed": reg.counter(
+            "swtpu_rules_suppressed_total",
+            "rule fires suppressed by the dedup key (replay/standby)"),
+    }
+
+
 # compile-wall-time buckets (seconds): XLA compiles run 10ms (tiny admin
 # updaters) to tens of seconds (the fused scan step on a loaded host) —
 # the default latency ladder would squash every compile into +Inf

@@ -596,6 +596,58 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
     r.add_post("/api/microservices/{identifier}/tenants/{tenant}"
                "/configuration", _admin(update_tenant_configuration))
 
+    # --- streaming rules & continuous rollups (ISSUE 13; the reference's
+    # Siddhi-app deployment surface) ---------------------------------------
+    async def get_rules(request: web.Request):
+        rs = inst.rules.ruleset
+        return json_response({
+            "ruleSet": rs.doc if rs is not None else None,
+            "status": await asyncio.to_thread(inst.rules.status)})
+
+    async def put_rules(request: web.Request):
+        from sitewhere_tpu.rules import RuleSetError
+
+        body = await request.json()
+        doc = body.get("ruleSet", body)
+        try:
+            # validate+lower+AOT-compile off the gateway loop; a bad
+            # document 400s with the active set untouched
+            summary = await asyncio.to_thread(inst.rules.load, doc)
+        except RuleSetError as e:
+            return json_response({"error": str(e)}, status=400)
+        return json_response({"summary": summary}, status=201)
+
+    async def delete_rules(request: web.Request):
+        await asyncio.to_thread(inst.rules.clear)
+        return json_response({"cleared": True})
+
+    async def poll_rules(request: web.Request):
+        body = (await request.json()) if request.content_length else {}
+        alerts = await asyncio.to_thread(
+            inst.rules.poll, bool(body.get("flush", True)))
+        return json_response({"alerts": alerts})
+
+    async def list_rollups(request: web.Request):
+        return json_response(
+            [dataclasses.asdict(m) for m in inst.rules.rollup_meta])
+
+    async def read_rollup(request: web.Request):
+        try:
+            doc = await asyncio.to_thread(
+                inst.rules.read_rollup, request.match_info["name"],
+                request.query.get("group"),
+                _page_size(request.query))
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return json_response(doc)
+
+    r.add_get("/api/rules", get_rules)
+    r.add_post("/api/rules", _admin(put_rules))
+    r.add_delete("/api/rules", _admin(delete_rules))
+    r.add_post("/api/rules/poll", _admin(poll_rules))
+    r.add_get("/api/rules/rollups", list_rollups)
+    r.add_get("/api/rules/rollups/{name}", read_rollup)
+
     # --- devices ----------------------------------------------------------
     async def create_device(request: web.Request):
         body = await request.json()

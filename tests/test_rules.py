@@ -1,0 +1,426 @@
+"""Streaming-rules CEP tier (ISSUE 13): fused in-step rule evaluation,
+continuous rollups, hot reload, and the surfaces.
+
+The contract pinned here:
+  * every rule kind (threshold / windowed aggregate / sequence / absence)
+    fires exactly the key set the sequential host oracle computes, and
+    the fire set is BATCH-PARTITION INVARIANT (the replay/standby parity
+    foundation);
+  * rollup reads match a host-side recompute exactly;
+  * alert events ride the normal ingest pipeline (persisted, queryable
+    by their rule+group+window alternate id);
+  * rule-set hot reload is compile-before-swap: a parameter tweak
+    preserves carried state and compiles nothing, a shape change rides a
+    devicewatch allowance (never an excess retrace), and a bad document
+    is rejected loudly with the active set still serving.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.engine import Engine, EngineConfig, _PrecompiledStep
+from sitewhere_tpu.rules import RuleSet, RuleSetError, RulesManager
+from sitewhere_tpu.rules import oracle
+from sitewhere_tpu.utils.devicewatch import WATCH, strict_retraces
+
+CFG = dict(device_capacity=256, token_capacity=512,
+           assignment_capacity=512, store_capacity=4096,
+           batch_capacity=32, channels=4, rule_groups=64,
+           rollup_buckets=8)
+
+RULESET = {
+    "name": "t",
+    "rules": [
+        {"name": "hot", "kind": "threshold", "channel": "temp",
+         "op": ">", "value": 90.0, "cooldownMs": 1000},
+        {"name": "burst", "kind": "window", "agg": "count",
+         "channel": "temp", "op": ">=", "value": 3, "windowMs": 2000,
+         "where": {"channel": "temp", "op": ">", "value": 50.0}},
+        {"name": "updown", "kind": "sequence",
+         "first": {"channel": "temp", "op": ">", "value": 90.0},
+         "then": {"channel": "temp", "op": "<", "value": 5.0},
+         "withinMs": 4000},
+        {"name": "silent", "kind": "absence", "channel": "temp",
+         "deadlineMs": 3000},
+    ],
+    "rollups": [{"name": "temp-1s", "channel": "temp",
+                 "windowMs": 1000, "scope": "device"}],
+}
+
+
+def _engine(**kw):
+    return Engine(EngineConfig(**{**CFG, **kw}))
+
+
+def _meas(eng, tok, v, ts_rel):
+    return json.dumps({
+        "deviceToken": tok, "type": "DeviceMeasurement",
+        "request": {"name": "temp", "value": v,
+                    "eventDate": int(eng.epoch.base_unix_s * 1000)
+                    + ts_rel}}).encode()
+
+
+# deterministic stream: (device-suffix, value, ts) — halves only, so
+# float32 sum parity is rounding-order free
+def _stream(n=96, devs=6, quiet_after=None):
+    out = []
+    for i in range(n):
+        d = i % devs
+        if quiet_after is not None and d == 0 and i >= quiet_after:
+            d = 1
+        v = 96.5 if i % 11 == 0 else 20.0 + (i % 40) * 0.5
+        if i % 23 == 0:
+            v = 2.5
+        out.append((d, v, i * 100))
+    return out
+
+
+def _oracle_keys(events, final_wm):
+    ev = [{"ts": ts, "group": d, "value": v, "value_b": v}
+          for d, v, ts in events]
+    exp = set()
+    for g, w in oracle.threshold_fire_keys(ev, op=0, value=90.0,
+                                           cooldown_ms=1000):
+        exp.add(f"swr:hot:r-{g}:{w}")
+    for g, w in oracle.window_fire_keys(ev, agg="count", op=1, value=3,
+                                        window_ms=2000, where=(0, 50.0)):
+        exp.add(f"swr:burst:r-{g}:{w}")
+    for g, w in oracle.sequence_fire_keys(ev, op_a=0, val_a=90.0,
+                                          op_b=2, val_b=5.0,
+                                          within_ms=4000):
+        exp.add(f"swr:updown:r-{g}:{w}")
+    for g, w in oracle.absence_fire_keys(ev, op=1, value=float("-inf"),
+                                         deadline_ms=3000,
+                                         final_watermark=final_wm):
+        exp.add(f"swr:silent:r-{g}:{w}")
+    return exp
+
+
+def _run(eng, events, chunk=32):
+    for lo in range(0, len(events), chunk):
+        eng.ingest_json_batch([_meas(eng, f"r-{d}", v, ts)
+                               for d, v, ts in events[lo:lo + chunk]])
+        eng.flush()
+
+
+def test_all_rule_kinds_match_oracle_and_alerts_persist():
+    eng = _engine()
+    mgr = RulesManager(eng)
+    mgr.load(RULESET)
+    events = _stream(quiet_after=48)
+    _run(eng, events)
+    alerts = mgr.poll()
+    got = {a["alternateId"] for a in alerts}
+    assert got == _oracle_keys(events, final_wm=events[-1][2])
+    assert eng.metrics()["rule_fires"] == len(got)
+    eng.flush()
+    # alert events persisted through the NORMAL pipeline: queryable by
+    # type and by their dedup alternate id
+    from sitewhere_tpu.core.types import EventType
+
+    q = eng.query_events(etype=EventType.ALERT, limit=100)
+    assert q["total"] == len(got)
+    one = alerts[0]
+    byid = eng.query_events(alternate_id=one["alternateId"], limit=10)
+    assert byid["total"] == 1
+    assert byid["events"][0]["alertType"] == one["alertType"]
+    # a second poll harvests nothing new and re-emits nothing
+    assert mgr.poll() == []
+    # rollup parity, exact
+    ev = [{"ts": ts, "group": d, "value": v} for d, v, ts in events]
+    want = oracle.rollup_oracle(ev, window_ms=1000, buckets=8)
+    for g in range(6):
+        got_r = mgr.read_rollup("temp-1s", group=f"r-{g}")
+        got_map = {b["windowStartMs"]: (b["count"], b["sum"], b["min"],
+                                        b["max"])
+                   for b in got_r["buckets"]}
+        want_map = {st[0] * 1000: (st[1], st[2], st[3], st[4])
+                    for (gg, s), st in want.items() if gg == g}
+        assert got_map == want_map, f"rollup mismatch for r-{g}"
+
+
+def test_fire_set_is_batch_partition_invariant():
+    """Same stream, radically different ingest batch boundaries ->
+    identical fire keys, identical rule_fires counter, identical rollup
+    state (the replay/standby re-evaluation contract)."""
+    events = _stream(n=80, quiet_after=40)
+    results = []
+    for chunk in (80, 7, 1):
+        eng = _engine()
+        mgr = RulesManager(eng)
+        mgr.load(RULESET, precompile=False)
+        _run(eng, events, chunk=chunk)
+        alerts = mgr.poll()
+        rollup = mgr.read_rollup("temp-1s", group="r-1")
+        results.append(({a["alternateId"] for a in alerts},
+                        eng.metrics()["rule_fires"],
+                        rollup["buckets"]))
+    assert results[0] == results[1] == results[2]
+    assert results[0][0]     # the scenario actually fired
+
+
+def test_threshold_dedup_within_window_and_refire_next_window():
+    eng = _engine()
+    mgr = RulesManager(eng)
+    mgr.load({"rules": [{"name": "hot", "kind": "threshold",
+                         "channel": "temp", "op": ">", "value": 90.0,
+                         "cooldownMs": 1000}]})
+    # three crossings inside one window -> ONE alert; next window refires
+    _run(eng, [(0, 95.0, 100), (0, 97.5, 200), (0, 99.0, 900),
+               (0, 95.0, 1500)], chunk=2)
+    alerts = mgr.poll()
+    assert sorted(a["key"] for a in alerts) == [0, 1]
+    assert all(a["rule"] == "hot" for a in alerts)
+
+
+def test_hot_reload_param_tweak_preserves_state_and_program(tmp_path):
+    eng = _engine()
+    mgr = RulesManager(eng)
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(RULESET))
+    mgr.watch_file(path)
+    step_before = eng._step
+    # two of the three window events land BEFORE the swap
+    _run(eng, [(0, 60.0, 100), (0, 61.0, 200)], chunk=2)
+    compiles_before = WATCH.compile_totals()
+    doc = json.loads(json.dumps(RULESET))
+    doc["rules"][0]["value"] = 80.0        # tweak another rule's param
+    path.write_text(json.dumps(doc))
+    import os
+
+    os.utime(path, (path.stat().st_mtime + 2,) * 2)
+    assert mgr.check_reload() is True
+    assert eng._step is step_before        # no rewrap...
+    assert WATCH.compile_totals() == compiles_before   # ...no recompile
+    # third event completes the carried window -> the accumulator
+    # survived the swap
+    _run(eng, [(0, 62.0, 300)], chunk=1)
+    alerts = mgr.poll()
+    assert any(a["rule"] == "burst" for a in alerts)
+
+
+def test_window_change_resets_state_instead_of_preserving():
+    """Fire keys are denominated in window units: a cooldown/window
+    tweak must NOT take the preserve-state path, or old-unit fired keys
+    would suppress the rule until uptime catches up (review-found)."""
+    eng = _engine()
+    mgr = RulesManager(eng)
+    doc = {"rules": [{"name": "hot", "kind": "threshold",
+                      "channel": "temp", "op": ">", "value": 90.0,
+                      "cooldownMs": 1000}]}
+    mgr.load(doc, precompile=False)
+    _run(eng, [(0, 95.0, 500_000)], chunk=1)   # fired_key = 500
+    assert len(mgr.poll()) == 1
+    doc2 = json.loads(json.dumps(doc))
+    doc2["rules"][0]["cooldownMs"] = 60_000
+    summary = mgr.load(doc2, precompile=False)
+    assert summary["preservedState"] is False
+    # under the new 60s windows this crossing is wid 11 — it must fire
+    # (old-unit fired_key=500 would have silently swallowed it)
+    _run(eng, [(0, 96.0, 700_000)], chunk=1)
+    assert [a["key"] for a in mgr.poll()] == [700_000 // 60_000]
+
+
+def test_hot_reload_shape_change_is_allowance_not_excess(tmp_path):
+    eng = _engine()
+    mgr = RulesManager(eng)
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(RULESET))
+    mgr.watch_file(path)
+    _run(eng, [(0, 95.0, 100)], chunk=1)
+    # adding a rule changes the device-table shapes: a DECLARED swap —
+    # strict mode must not see an excess retrace, and the precompiled
+    # program must have been built OFF the engine lock
+    doc = json.loads(json.dumps(RULESET))
+    doc["rules"].append({"name": "cold", "kind": "threshold",
+                         "channel": "temp", "op": "<", "value": -50.0,
+                         "cooldownMs": 1000})
+    path.write_text(json.dumps(doc))
+    import os
+
+    os.utime(path, (path.stat().st_mtime + 2,) * 2)
+    seen = {}
+    orig = eng.precompile_rules
+
+    def spy(rules_state):
+        seen["locked_during_compile"] = eng.lock._is_owned()
+        return orig(rules_state)
+
+    eng.precompile_rules = spy
+    excess0 = WATCH.excess_total()
+    with strict_retraces():
+        assert mgr.check_reload() is True
+        _run(eng, [(0, 95.0, 1100), (0, -60.0, 1200)], chunk=2)
+    assert WATCH.excess_total() == excess0
+    assert seen["locked_during_compile"] is False
+    # the installed hot program is the AOT-compiled shim
+    assert isinstance(getattr(eng._step, "fn", eng._step),
+                      _PrecompiledStep)
+    alerts = mgr.poll()
+    assert {a["rule"] for a in alerts} >= {"hot", "cold"}
+
+
+def test_bad_ruleset_rejected_loudly_old_set_keeps_serving(tmp_path):
+    eng = _engine()
+    mgr = RulesManager(eng)
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(RULESET))
+    mgr.watch_file(path)
+    import os
+
+    for bad in ("{not json", json.dumps({"rules": [
+            {"name": "x", "kind": "window", "agg": "count",
+             "channel": "temp", "op": "<", "value": 1,
+             "windowMs": 1000}]})):   # non-monotone (agg, op) combo
+        path.write_text(bad)
+        os.utime(path, (path.stat().st_mtime + 2,) * 2)
+        with pytest.raises((RuleSetError, ValueError)):
+            mgr.check_reload()
+        assert mgr.ruleset is not None and mgr.ruleset.name == "t"
+    assert mgr.reload_errors == 2
+    # the active set still evaluates
+    _run(eng, [(0, 95.0, 100)], chunk=1)
+    assert any(a["rule"] == "hot" for a in mgr.poll())
+
+
+def test_ruleset_validation_errors():
+    with pytest.raises(RuleSetError):
+        RuleSet.parse({"rules": []})                     # empty
+    with pytest.raises(RuleSetError):
+        RuleSet.parse({"rules": [{"name": "a:b", "kind": "threshold",
+                                  "channel": "t", "op": ">",
+                                  "value": 1}]})         # ':' in name
+    with pytest.raises(RuleSetError):
+        RuleSet.parse({"rules": [{"name": "a", "kind": "nope"}]})
+    with pytest.raises(RuleSetError):
+        RuleSet.parse({"rules": [
+            {"name": "a", "kind": "sequence",
+             "first": {"channel": "t", "op": ">", "value": 1},
+             "then": {"channel": "t", "op": "<", "value": 0}}]})
+    with pytest.raises(RuleSetError):                    # dup names
+        RuleSet.parse({"rules": [
+            {"name": "a", "kind": "threshold", "channel": "t",
+             "op": ">", "value": 1},
+            {"name": "a", "kind": "threshold", "channel": "t",
+             "op": ">", "value": 2}]})
+
+
+def test_area_scoped_rule_fires_on_emitter_device():
+    eng = _engine()
+    eng.register_device("a-1", tenant="default", area="zone-a")
+    eng.register_device("a-2", tenant="default", area="zone-a")
+    mgr = RulesManager(eng)
+    mgr.load({"rules": [{"name": "area-hot", "kind": "window",
+                         "agg": "count", "channel": "temp", "op": ">=",
+                         "value": 3, "windowMs": 10000,
+                         "scope": "area"}]})
+    # three events across TWO devices of one area cross the count
+    _run(eng, [], chunk=1)
+    eng.ingest_json_batch([_meas(eng, "a-1", 10.0, 100),
+                           _meas(eng, "a-2", 11.0, 200),
+                           _meas(eng, "a-1", 12.0, 300)])
+    eng.flush()
+    alerts = mgr.poll()
+    assert len(alerts) == 1
+    a = alerts[0]
+    assert a["scope"] == "area" and a["group"] == "zone-a"
+    assert a["deviceToken"].startswith("swrules-")
+    # the emitter device persisted the alert through the normal path
+    from sitewhere_tpu.core.types import EventType
+
+    eng.flush()
+    q = eng.query_events(device_token=a["deviceToken"],
+                         etype=EventType.ALERT, limit=10)
+    assert q["total"] == 1
+
+
+def test_metrics_dict_equality_across_dispatch_shapes_with_rules():
+    """The standing dispatch-shape pin, WITH the CEP tier enabled:
+    scan_chunk 1 vs 4 produce byte-equal metrics dicts (rule_fires
+    included) after identical streams + polls."""
+    events = _stream(n=64)
+
+    def build(chunk):
+        e = _engine(scan_chunk=chunk)
+        m = RulesManager(e)
+        m.load(RULESET, precompile=False)
+        return e, m
+
+    a, ma = build(1)
+    b, mb = build(4)
+    b.epoch = a.epoch
+    for eng, mgr in ((a, ma), (b, mb)):
+        for lo in range(0, len(events), 16):
+            eng.ingest_json_batch([_meas(a, f"r-{d}", v, ts)
+                                   for d, v, ts in events[lo:lo + 16]])
+        eng.flush()
+        mgr.poll()
+        eng.flush()
+    assert a.metrics() == b.metrics()
+    assert a.metrics()["rule_fires"] > 0
+
+
+def test_rules_rest_surface():
+    """REST CRUD + rollup reads + status over a live gateway."""
+    import asyncio
+    import base64
+
+    import aiohttp
+
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+    from sitewhere_tpu.web.rest import start_server
+
+    loop = asyncio.new_event_loop()
+    inst = SiteWhereTpuInstance(InstanceConfig(engine=EngineConfig(**CFG)))
+    server = loop.run_until_complete(start_server(inst))
+    session = aiohttp.ClientSession(loop=loop)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        async def get_token():
+            basic = base64.b64encode(b"admin:password").decode()
+            async with session.get(
+                    f"{base}/api/authapi/jwt",
+                    headers={"Authorization": f"Basic {basic}"}) as r:
+                return (await r.json())["token"]
+
+        token = loop.run_until_complete(get_token())
+
+        def call(method, path, json_body=None, params=None):
+            async def go():
+                async with session.request(
+                        method, base + path, json=json_body,
+                        params=params,
+                        headers={"Authorization": f"Bearer {token}"}) as r:
+                    return r.status, await r.json()
+
+            return loop.run_until_complete(go())
+
+        st, body = call("POST", "/api/rules", RULESET)
+        assert st == 201 and body["summary"]["rules"] == 4
+        st, body = call("POST", "/api/rules", {"rules": [
+            {"name": "bad", "kind": "window", "agg": "count",
+             "channel": "t", "op": "<", "value": 1, "windowMs": 10}]})
+        assert st == 400
+        eng = inst.engine
+        eng.ingest_json_batch([_meas(eng, "rest-0", 95.0, 100)])
+        eng.flush()
+        st, body = call("POST", "/api/rules/poll", {"flush": False})
+        assert st == 200
+        assert {a["rule"] for a in body["alerts"]} == {"hot"}
+        st, body = call("GET", "/api/rules")
+        assert st == 200 and body["status"]["alertsEmitted"] == 1
+        assert body["ruleSet"]["name"] == "t"
+        st, body = call("GET", "/api/rules/rollups")
+        assert st == 200 and body[0]["name"] == "temp-1s"
+        st, body = call("GET", "/api/rules/rollups/temp-1s",
+                        params={"group": "rest-0"})
+        assert st == 200 and body["buckets"][0]["count"] == 1
+        st, _ = call("GET", "/api/rules/rollups/nope")
+        assert st == 404
+    finally:
+        loop.run_until_complete(session.close())
+        loop.run_until_complete(server.cleanup())
+        loop.close()
