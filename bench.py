@@ -442,6 +442,41 @@ def main() -> None:
         f"{dw_overhead_pct:.2f}% "
         f"(off={dw_eps_off:,.0f} on={dw_eps_on:,.0f} ev/s)")
 
+    # ------------------------------------------------------------------
+    # Conservation-ledger overhead (ISSUE 14): the flow ledger's
+    # per-batch counting (a dict add per staging site + one np.sum per
+    # dispatch) toggles PER BATCH inside the same continuous stream
+    # (flight + span + devicewatch stay ON in both modes). Same
+    # interleaved median-per-mode / min-of-sessions estimator; smoke
+    # hard-gates the delta <= 3%. NOTE: toggling leaves teng's own
+    # ledger deliberately unbalanced — teng is never audited; the
+    # balance gates below run on the headline/fairness/rules/chaos
+    # engines, whose ledgers count for their whole lifetime.
+    def _cv_session() -> tuple[float, float, float]:
+        per_mode: dict[bool, list[float]] = {False: [], True: []}
+        for k in range(_TR_TOTAL):
+            enabled = bool((k + k // _TR_UNIQ) % 2)
+            teng.ledger.enabled = enabled
+            b = tbatches[k % _TR_UNIQ]
+            t1 = time.perf_counter()
+            teng.ingest_json_batch(b)
+            if teng.staged_count:
+                teng.flush_async()
+            per_mode[enabled].append(time.perf_counter() - t1)
+        teng.barrier()
+        med_off = _tstats.median(per_mode[False])
+        med_on = _tstats.median(per_mode[True])
+        return (max(0.0, (med_on - med_off) / med_off * 100),
+                SZ_BATCH / med_on, SZ_BATCH / med_off)
+
+    cv_sessions = [_cv_session() for _ in range(3)]
+    teng.ledger.enabled = True
+    conservation_overhead_pct, cv_eps_on, cv_eps_off = min(cv_sessions)
+    log(f"conservation ledger overhead: sessions "
+        f"{[round(s[0], 2) for s in cv_sessions]}% -> "
+        f"{conservation_overhead_pct:.2f}% "
+        f"(off={cv_eps_off:,.0f} on={cv_eps_on:,.0f} ev/s)")
+
     # memory-ledger reconciliation (ISSUE 11 hard gate): the ledger's
     # ring-store bytes must equal the byte size the CONFIG implies
     # (recomputed independently via eval_shape — no allocation), and the
@@ -1193,6 +1228,21 @@ def main() -> None:
             f"across {cl_timeline_ranks} ranks (trace {stl_tid}); "
             f"open-loop trace coverage {olr.trace_coverage}")
 
+        # conservation audit over BOTH ranks (ISSUE 14): after the
+        # chaos slice healed and the feeds drained, every rank's ledger
+        # must balance — forwarded ingest, spill/redelivery, and
+        # replication racing included. Rank ledgers never merge; each
+        # balances against its own device counters.
+        from sitewhere_tpu.utils.conservation import (
+            build_ledger as _cv_build, check_conservation as _cv_check)
+
+        cl_cv_violations = []
+        for c in kclusters:
+            cl_cv_violations.extend(
+                v.to_dict() for v in _cv_check(_cv_build(c)))
+        log(f"cluster conservation: {len(cl_cv_violations)} violation(s)"
+            + (f" {cl_cv_violations}" if cl_cv_violations else ""))
+
         for f in kfeeds:
             f.stop()
         for c in kclusters:
@@ -1243,6 +1293,9 @@ def main() -> None:
             # launder into "one slow frame")
             "cluster_steady_recompiles": cl_steady_recompiles,
             "cluster_compiles_during_run": cl_compiles_during,
+            # conservation plane (ISSUE 14): both ranks' ledgers must
+            # balance after the chaos slice heals — hard smoke gate
+            "conservation_cluster_violations": len(cl_cv_violations),
         }
 
     # ------------------------------------------------------------------
@@ -1949,7 +2002,66 @@ def main() -> None:
     log(f"rules chaos (kill/recover re-evaluation): no_loss="
         f"{rules_chaos_no_loss} no_dup={rules_chaos_no_dup} "
         f"(pre-crash {len(al_c1)}, recovered {len(al_c2)})")
+    # conservation through the kill/recover leg (ISSUE 14): the
+    # recovered engine's ledger (rebased at restore, counting the WAL
+    # replay + the post-recovery alert emissions) must balance to zero
+    from sitewhere_tpu.utils.conservation import (build_ledger,
+                                                  check_conservation)
+
+    r2.flush()
+    _cv_chaos = [v.to_dict()
+                 for v in check_conservation(build_ledger(r2, rm2))]
+    conservation_chaos_violations = len(_cv_chaos)
+    log(f"conservation (kill/recover leg): {conservation_chaos_violations}"
+        f" violation(s)" + (f" {_cv_chaos}" if _cv_chaos else ""))
     _rshutil.rmtree(rdir, ignore_errors=True)
+
+    # ------------------------------------------------------------------
+    # Conservation audits (ISSUE 14): the ledger must balance to ZERO
+    # violations at the end of the headline, QoS-fairness, and rules
+    # legs (the kill/recover and cluster legs audited above, in place).
+    # The headline engine runs the real ConservationAuditor twice (its
+    # two-read confirmation rule) and contributes the per-stage
+    # watermark-lag report.
+    from sitewhere_tpu.utils.conservation import ConservationAuditor
+
+    eng.flush()
+    _cv_aud = ConservationAuditor(eng, interval_s=60.0)
+    _cv_aud.audit()
+    _cv_led, _ = _cv_aud.audit()
+    conservation_headline_violations = len(_cv_aud.last_violations)
+    conservation_watermark_lag = dict(_cv_led["lag"])
+    # auditor-pass cost: each audit holds the engine lock while forcing
+    # the device counter readbacks, so a slow audit IS periodic ingest
+    # stall. Gate the implied duty cycle at the default 5s production
+    # cadence (InstanceConfig.conservation_audit_s) <= 3%.
+    _cv_times = []
+    for _ in range(5):
+        t1 = time.perf_counter()
+        _cv_aud.audit()
+        _cv_times.append((time.perf_counter() - t1) * 1e3)
+    conservation_audit_ms = round(_tstats.median(_cv_times), 2)
+    conservation_audit_duty_pct = round(
+        100.0 * conservation_audit_ms / 5000.0, 3)
+    log(f"conservation (headline leg): "
+        f"{conservation_headline_violations} violation(s) over "
+        f"{_cv_aud.audits} audits; audit pass median "
+        f"{conservation_audit_ms}ms ({conservation_audit_duty_pct}% "
+        f"duty at the 5s cadence); watermarks {_cv_led['watermarks']}; "
+        f"lag {conservation_watermark_lag}"
+        + (f"; {_cv_aud.last_violations}"
+           if _cv_aud.last_violations else ""))
+    _cv_fair = [v.to_dict()
+                for v in check_conservation(build_ledger(fair_eng))]
+    conservation_fairness_violations = len(_cv_fair)
+    _cv_rules = [v.to_dict() for e, m_ in ((ra, rma), (rb, rmb))
+                 for v in check_conservation(build_ledger(e, m_))]
+    conservation_rules_violations = len(_cv_rules)
+    log(f"conservation (fairness leg): {conservation_fairness_violations}"
+        f" violation(s)" + (f" {_cv_fair}" if _cv_fair else ""))
+    log(f"conservation (rules leg, both dispatch shapes): "
+        f"{conservation_rules_violations} violation(s)"
+        + (f" {_cv_rules}" if _cv_rules else ""))
 
     n_load_batches = (len(runs) * N_BATCH + WARM_BATCH
                       + (1 if len(runs) > 1 else 0))
@@ -2075,6 +2187,27 @@ def main() -> None:
                 "rules_chaos_no_dup": rules_chaos_no_dup,
                 "rules_fires": rules_fires_total,
                 "rules_alerts_emitted": len(al_a),
+                # conservation ledger & audit plane (ISSUE 14): counting
+                # cost (gate <= 3%), and the ledger must balance to ZERO
+                # violations at the end of the headline / kill-recover /
+                # fairness / rules legs (the cluster leg's twin rides
+                # the cl dict); per-stage watermark lag reports
+                "conservation_overhead_pct":
+                    round(conservation_overhead_pct, 2),
+                "conservation_events_per_s_on": round(cv_eps_on),
+                "conservation_events_per_s_off": round(cv_eps_off),
+                "conservation_audit_ms": conservation_audit_ms,
+                "conservation_audit_duty_pct":
+                    conservation_audit_duty_pct,
+                "conservation_headline_violations":
+                    conservation_headline_violations,
+                "conservation_chaos_violations":
+                    conservation_chaos_violations,
+                "conservation_fairness_violations":
+                    conservation_fairness_violations,
+                "conservation_rules_violations":
+                    conservation_rules_violations,
+                "conservation_watermark_lag": conservation_watermark_lag,
                 **({"smoke": True} if smoke else {}),
                 "binary_wire_events_per_s": round(bin_eps),
                 "device_step_events_per_s": round(eps),
@@ -2203,6 +2336,28 @@ def main() -> None:
         log("FAIL: kill/recover rule re-evaluation lost or duplicated "
             "alert events (dedup key discipline broken)")
         sys.exit(1)
+    if smoke and conservation_overhead_pct > 3.0:
+        log(f"FAIL: conservation ledger overhead "
+            f"{conservation_overhead_pct:.2f}% > 3% of host e2e "
+            "throughput")
+        sys.exit(1)
+    if smoke and conservation_audit_duty_pct > 3.0:
+        log(f"FAIL: conservation audit pass costs "
+            f"{conservation_audit_ms}ms — "
+            f"{conservation_audit_duty_pct}% duty at the default 5s "
+            "cadence (> 3%): the auditor's lock-held device readbacks "
+            "have become a periodic ingest stall")
+        sys.exit(1)
+    for _cv_name, _cv_n in (
+            ("headline", conservation_headline_violations),
+            ("kill/recover", conservation_chaos_violations),
+            ("QoS-fairness", conservation_fairness_violations),
+            ("rules", conservation_rules_violations)):
+        if smoke and _cv_n:
+            log(f"FAIL: conservation ledger did not balance at the end "
+                f"of the {_cv_name} leg ({_cv_n} violation(s)) — an "
+                "event flow equation is leaking")
+            sys.exit(1)
     if smoke and replication_failover_ok is False:
         log("FAIL: failover read did not land within the detection "
             "budget with a stale_ms watermark")
@@ -2250,6 +2405,11 @@ def main() -> None:
                 f"compile(s) {cl['cluster_compiles_during_run']} during "
                 "the steady-state open-loop run — a mid-run compile is "
                 "a latency cliff the SLO histograms launder")
+            sys.exit(1)
+        if cl["conservation_cluster_violations"]:
+            log(f"FAIL: conservation ledger did not balance on "
+                f"{cl['conservation_cluster_violations']} rank "
+                "equation(s) after the cluster chaos slice healed")
             sys.exit(1)
 
 

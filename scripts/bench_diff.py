@@ -1,0 +1,184 @@
+#!/usr/bin/env python3
+"""Compare two bench JSONs against the BENCH_SCHEMA.md gate table.
+
+The missing tooling for tracking the bench trajectory across PRs:
+
+    python scripts/bench_diff.py OLD.json NEW.json
+
+* prints a per-field delta table for every numeric field the two runs
+  share (report fields included — they trend, they never gate);
+* re-evaluates every **gate** field of the NEW run against the schema's
+  thresholds, gate-vs-report aware: report-field movement (throughput
+  noise on a shared box is ±30%) NEVER fails the diff, a violated hard
+  gate ALWAYS does;
+* a gate that PASSED in the old run but is absent from the new run is
+  also a regression — a leg silently dropping out of the bench must not
+  read as green.
+
+Exit status: 0 = no gate regression, 1 = gate regression(s), 2 = usage
+or unreadable input. Offline tool: stdlib only (the import-hygiene
+sweep pins that this module imports with jax blocked).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+# The gate table, mirroring BENCH_SCHEMA.md. Kinds:
+#   "true"     -> value must be truthy
+#   "zero"     -> value must equal 0
+#   "max"      -> value must be <= threshold
+#   "min"      -> value must be >= threshold
+#   "ge-field" -> value must be >= the named OTHER field of the SAME
+#                 run (the schema's relational gates, e.g. batched QPS
+#                 must beat sequential QPS)
+# A gate absent from BOTH runs is fine (the leg didn't run — e.g.
+# hardware-only fields); see the drop rule above for one-sided absence.
+GATES: dict[str, tuple[str, "float | str | None"]] = {
+    "query_batched_qps": ("ge-field", "query_sequential_qps"),
+    "trace_overhead_pct": ("max", 3.0),
+    "span_overhead_pct": ("max", 3.0),
+    "devicewatch_overhead_pct": ("max", 3.0),
+    "rules_overhead_pct": ("max", 3.0),
+    "cluster_obs_overhead_pct": ("max", 3.0),
+    "conservation_overhead_pct": ("max", 3.0),
+    "conservation_audit_duty_pct": ("max", 3.0),
+    "archive_query_p99_ms": ("max", 1000.0),
+    "archive_ring_multiple": ("min", 10.0),
+    "fairness_abuser_offered_admitted_ratio": ("min", 5.0),
+    "cluster_events_total": ("min", 100_000),
+    "cluster_scrape_ranks": ("min", 2),
+    "devicewatch_excess_retraces": ("zero", None),
+    "fairness_admitted_loss": ("zero", None),
+    "cluster_steady_recompiles": ("zero", None),
+    "conservation_headline_violations": ("zero", None),
+    "conservation_fairness_violations": ("zero", None),
+    "conservation_rules_violations": ("zero", None),
+    "conservation_chaos_violations": ("zero", None),
+    "conservation_cluster_violations": ("zero", None),
+    "shard_smoke_stores_equal": ("true", None),
+    "groupcommit_smoke_amortized": ("true", None),
+    "groupcommit_smoke_no_loss": ("true", None),
+    "query_batch_parity": ("true", None),
+    "archive_parity": ("true", None),
+    "archive_pruning_fires": ("true", None),
+    "replication_smoke_failover_ok": ("true", None),
+    "replication_smoke_no_loss": ("true", None),
+    "rules_metrics_equal": ("true", None),
+    "rules_alert_parity": ("true", None),
+    "rules_rollup_parity": ("true", None),
+    "rules_chaos_no_loss": ("true", None),
+    "rules_chaos_no_dup": ("true", None),
+    "fairness_isolation_ok": ("true", None),
+    "cluster_chaos_no_loss": ("true", None),
+    "cluster_scrape_has_slo": ("true", None),
+    "devicewatch_ledger_reconciles": ("true", None),
+}
+
+
+def gate_passes(kind: str, threshold, value, run: dict | None = None) -> bool:
+    if kind == "true":
+        return bool(value)
+    if kind == "zero":
+        return value == 0
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return False
+    if kind == "max":
+        return value <= threshold
+    if kind == "min":
+        return value >= threshold
+    if kind == "ge-field":
+        other = (run or {}).get(threshold)
+        if not _numeric(other):
+            return False          # relational gate with no counterpart
+        return value >= other
+    raise ValueError(f"unknown gate kind {kind!r}")
+
+
+def _numeric(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def diff_fields(old: dict, new: dict) -> list[tuple[str, float, float, str]]:
+    """(field, old, new, delta-text) for every shared numeric field."""
+    rows = []
+    for key in sorted(set(old) & set(new)):
+        a, b = old[key], new[key]
+        if not (_numeric(a) and _numeric(b)):
+            continue
+        if a:
+            delta = f"{100.0 * (b - a) / abs(a):+.1f}%"
+        else:
+            delta = "n/a" if b == a else "new!=0"
+        rows.append((key, a, b, delta))
+    return rows
+
+
+def check_gates(old: dict, new: dict) -> list[str]:
+    """Hard-gate regressions of NEW vs the schema (and vs OLD's gate
+    coverage). Returns failure messages, empty when clean."""
+    failures = []
+    for field, (kind, threshold) in GATES.items():
+        in_old, in_new = field in old, field in new
+        if in_new and not gate_passes(kind, threshold, new[field], new):
+            bound = ("truthy" if kind == "true" else "0" if kind == "zero"
+                     else f">= field {threshold!r} "
+                          f"({new.get(threshold)!r})"
+                     if kind == "ge-field"
+                     else f"{'<=' if kind == 'max' else '>='} {threshold}")
+            failures.append(
+                f"GATE {field}: new value {new[field]!r} violates {bound}")
+        elif (in_old and not in_new
+              and gate_passes(kind, threshold, old[field], old)):
+            failures.append(
+                f"GATE {field}: passed in old run but ABSENT from new "
+                "run (leg dropped out)")
+    return failures
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) != 3:
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        with open(argv[1]) as f:
+            old = json.load(f)
+        with open(argv[2]) as f:
+            new = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: cannot read inputs: {e}", file=sys.stderr)
+        return 2
+    if not isinstance(old, dict) or not isinstance(new, dict):
+        print("bench_diff: inputs must be bench JSON objects",
+              file=sys.stderr)
+        return 2
+
+    rows = diff_fields(old, new)
+    if rows:
+        width = max(len(r[0]) for r in rows)
+        print(f"{'field'.ljust(width)}  {'old':>14}  {'new':>14}  delta")
+        for key, a, b, delta in rows:
+            mark = " [gate]" if key in GATES else ""
+            print(f"{key.ljust(width)}  {a:>14g}  {b:>14g}  "
+                  f"{delta}{mark}")
+    only_old = sorted(k for k in old if k not in new)
+    only_new = sorted(k for k in new if k not in old)
+    if only_old:
+        print(f"fields only in old run: {', '.join(only_old)}")
+    if only_new:
+        print(f"fields only in new run: {', '.join(only_new)}")
+
+    failures = check_gates(old, new)
+    for msg in failures:
+        print(f"FAIL: {msg}", file=sys.stderr)
+    if failures:
+        print(f"bench_diff: {len(failures)} hard-gate regression(s)",
+              file=sys.stderr)
+        return 1
+    print("bench_diff: no hard-gate regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -803,6 +803,14 @@ class EngineConfig:
                                        # bench hard-gates the on-vs-off
                                        # delta <= 3% and zero excess
                                        # retraces across the smoke run
+    conservation: bool = True          # event conservation ledger
+                                       # (ISSUE 14, utils/conservation):
+                                       # per-stage flow counters the
+                                       # audit plane balances against
+                                       # the device counters; cost is
+                                       # one dict add per batch + one
+                                       # np.sum per dispatch — bench
+                                       # hard-gates the delta <= 3%
 
 
 @dataclasses.dataclass
@@ -1532,6 +1540,14 @@ class Engine(IngestHostMixin):
         # one in-process engine's autotuner can never steer on another's
         # tenants — ISSUE 10 satellite closing the PR-9 known limit)
         self.metrics_label = next_engine_label()
+        # event conservation ledger (ISSUE 14): flow counters at the
+        # staging and dispatch boundaries; everything else the audit
+        # plane samples from counters that already exist. The auditor
+        # (utils/conservation.ConservationAuditor) attaches itself here.
+        from sitewhere_tpu.utils.conservation import FlowLedger
+
+        self.ledger = FlowLedger(enabled=c.conservation)
+        self.conservation_auditor = None
         # shared-scan batched query engine: concurrent query_events calls
         # coalesce into one fused multi-predicate device program; string
         # lookups and the store snapshot happen under the lock, the device
@@ -1732,6 +1748,7 @@ class Engine(IngestHostMixin):
         flushes when the batch fills. Caller holds the lock."""
         self.host_counters["staged_copy_rows"] = \
             self.host_counters.get("staged_copy_rows", 0) + 1
+        self.ledger.add("staged_rows", 1)
         if self.config.fair_tenancy:
             i32 = np.int32
             has_vals = mask is not None and (mask.any() or values.any())
@@ -2028,6 +2045,7 @@ class Engine(IngestHostMixin):
         summary["staged"] += staged
         self.host_counters["arena_rows"] = \
             self.host_counters.get("arena_rows", 0) + staged
+        self.ledger.add("staged_rows", staged)
 
     def _dispatch_arena(self) -> None:
         """Dispatch the fill arena (full or partial — rows past the
@@ -2039,6 +2057,9 @@ class Engine(IngestHostMixin):
         if arena is None or arena.cursor == 0:
             return
         arena.valid[arena.cursor:] = False
+        # conservation ledger: valid rows leaving the staging tier (the
+        # failed-decode padding below the cursor never dispatches)
+        self.ledger.add("dispatched_rows", int(np.sum(arena.valid)))
         traces, arena.traces = arena.traces, []
         # durability watermark: every WAL record of this arena's batches
         # must be fsync'd before the device program runs (group commit
@@ -2096,6 +2117,7 @@ class Engine(IngestHostMixin):
                         aux1=res.aux1[idxs],
                     ))
                 self.channel_map.collisions += res.collisions
+                self.ledger.add("staged_rows", len(idxs))
                 return {"decoded": int(np.sum(ok)) + n_reg_ok, "failed": failed,
                         "staged": int(len(idxs))}
             staged = 0
@@ -2135,6 +2157,7 @@ class Engine(IngestHostMixin):
             # per batch to prove the arena path stays copy-free)
             self.host_counters["staged_copy_rows"] = \
                 self.host_counters.get("staged_copy_rows", 0) + staged
+            self.ledger.add("staged_rows", staged)
             return {"decoded": int(np.sum(ok)) + n_reg_ok, "failed": failed,
                     "staged": staged}
 
@@ -2213,6 +2236,7 @@ class Engine(IngestHostMixin):
                 self._wal_gate(traces)
                 for rec in traces:
                     rec.mark("dispatch")
+                self.ledger.add("dispatched_rows", n_staged)
                 self.state, out = self._step(self.state, batch)
                 self._enqueue_out(out, traces)
                 # ring head has advanced: each staged row persists up to
@@ -2247,6 +2271,8 @@ class Engine(IngestHostMixin):
             self._wal_gate(traces)
             for rec in traces:
                 rec.mark("dispatch")
+            self.ledger.add("dispatched_rows",
+                            sum(int(np.sum(b.valid)) for b in chunk))
             self.state, outs = self._scan_step(self.state,
                                                pack_batches(chunk))
             self._enqueue_out(outs, traces)
@@ -2302,19 +2328,34 @@ class Engine(IngestHostMixin):
         if self._rows_since_spool >= self._spool_trigger:
             self._spool()
 
+    def ring_heads(self) -> dict[int, int]:
+        """Absolute ring write head per archive partition (= arena) —
+        the ONE definition shared by the archive spooler and the
+        conservation audit plane (ISSUE 14), so spill cursors are
+        always compared against the heads the spooler advances to.
+        Caller holds the lock (small device readback)."""
+        from sitewhere_tpu.ops.readback import arena_cursor
+
+        store = self.state.store
+        return {a: arena_cursor(store, a) for a in range(store.arenas)}
+
+    def ring_arena_capacity(self) -> int:
+        """Rows one archive partition's ring holds before wrapping —
+        the capacity bound of the conservation archive-spill equation."""
+        return int(self.state.store.arena_capacity)
+
     def _spool(self) -> None:
         """Spill full segments of not-yet-archived ring rows to disk.
         Caller holds the lock. Reads use ONE compiled ``read_range``
         program (fixed ``segment_rows`` count) per segment; partial tails
         stay in the ring (still queryable there), so the archive only ever
         holds whole segments."""
-        from sitewhere_tpu.ops.readback import arena_cursor, read_range
+        from sitewhere_tpu.ops.readback import read_range
 
         store = self.state.store
-        acap = store.arena_capacity
+        acap = self.ring_arena_capacity()
         rows = self.archive.segment_rows
-        for a in range(store.arenas):
-            head = arena_cursor(store, a)
+        for a, head in self.ring_heads().items():
             start = self.archive.spilled(a)
             if head - start > acap:   # wrapped before we got here
                 self.archive.note_lost(head - acap - start)

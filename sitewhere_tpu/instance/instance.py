@@ -46,6 +46,12 @@ class InstanceConfig:
     index_events: bool = True
     script_root: str | None = None   # versioned tenant-script store dir;
                                      # None -> per-instance temp dir
+    conservation_audit_s: float = 5.0  # background conservation-audit
+                                       # cadence (ISSUE 14); the thread
+                                       # runs only between start() and
+                                       # stop(). 0 disables the thread —
+                                       # GET /api/instance/conservation
+                                       # still audits on demand
 
 
 class SiteWhereTpuInstance(LifecycleComponent):
@@ -135,6 +141,16 @@ class SiteWhereTpuInstance(LifecycleComponent):
 
         self.rules = RulesManager(self.engine)
 
+        # event conservation audit plane (ISSUE 14): always-on invariant
+        # checking while the instance runs. Constructed here (so REST
+        # and the debug bundle can serve its posture immediately) but
+        # the thread only spins between start() and stop().
+        from sitewhere_tpu.utils.conservation import ConservationAuditor
+
+        self.conservation_auditor = ConservationAuditor(
+            self.engine, rules_manager=self.rules,
+            interval_s=self.config.conservation_audit_s or 5.0)
+
         # device-initiated stream commands -> stream store + downlink acks
         from sitewhere_tpu.management.streams import DeviceStreamService
 
@@ -191,7 +207,12 @@ class SiteWhereTpuInstance(LifecycleComponent):
         # (run_rank fills in rank/peers/ports once the rank can serve)
         self.health_extra: dict = {}
 
+    async def on_start(self) -> None:
+        if self.config.conservation_audit_s:
+            self.conservation_auditor.start()
+
     async def on_stop(self) -> None:
+        self.conservation_auditor.stop()
         if self._scripts_tmpdir is not None:
             import shutil
 

@@ -1340,6 +1340,30 @@ class ClusterEngine:
         _cluster_instruments()["scrapes"].inc()
         return "\n".join(lines) + "\n"
 
+    def conservation(self) -> dict:
+        """Cluster-wide conservation audit (ISSUE 14): every live
+        rank's ledger + verdict under its rank key, plus a cluster
+        roll-up of the violation count. Rank ledgers are NEVER merged
+        into one snapshot — each rank's equations balance against its
+        own device counters; a DOWN rank degrades to an ``unreachable``
+        entry instead of failing the audit surface."""
+        from sitewhere_tpu.utils.conservation import conservation_payload
+
+        keyed = self._fanout_keyed(conservation_payload(self),
+                                   "Cluster.conservation", tolerant=True)
+        by_rank: dict[str, dict] = {}
+        violations = 0
+        for r, res in keyed.items():
+            if isinstance(res, PeerDown):
+                by_rank[str(r)] = {"unreachable": True,
+                                   "reason": res.reason}
+            else:
+                by_rank[str(r)] = res
+                violations += len(res.get("violations", ()))
+        return {"clustered": self.n_ranks > 1, "rank": self.rank,
+                "byRank": by_rank, "violations": violations,
+                "balanced": violations == 0}
+
     def cluster_status(self) -> dict:
         """The operator's cluster page: this rank's identity, every
         rank's reachability + device count, and the durability gauges.
@@ -1752,6 +1776,15 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def flush():
         return engine.flush()
 
+    def conservation():
+        """This rank's conservation ledger + verdict (ISSUE 14) — the
+        facade's ``conservation()`` fans these out into one by-rank
+        document; rank ledgers never merge into one snapshot (each
+        rank's equations balance against its OWN device counters)."""
+        from sitewhere_tpu.utils.conservation import conservation_payload
+
+        return conservation_payload(engine)
+
     def trace_get(traceId: str):
         return engine.flight.records_of(traceId)
 
@@ -1800,6 +1833,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.traceGet": trace_get,
         "Cluster.traceRecent": trace_recent,
         "Cluster.traceTimeline": trace_timeline,
+        "Cluster.conservation": conservation,
         "Cluster.flush": flush,
     }.items():
         srv.register(name, fn)

@@ -109,6 +109,9 @@ class DistributedConfig:
                                        # auto: 4 * batch_capacity_per_shard
                                        # * n_shards)
     qos_min_retry_after_s: float = 0.05
+    conservation: bool = True          # event conservation ledger
+                                       # (ISSUE 14) — same contract as
+                                       # EngineConfig.conservation
 
 
 class _StackedBuffer:
@@ -427,6 +430,12 @@ class DistributedEngine(IngestHostMixin):
                                  enabled=c.span_trace,
                                  sample=c.span_sample, seed=c.span_seed)
         self.metrics_label = next_engine_label()
+        # event conservation ledger (ISSUE 14) — Engine-parity flow
+        # counters at the staging/dispatch boundaries of the mesh
+        from sitewhere_tpu.utils.conservation import FlowLedger
+
+        self.ledger = FlowLedger(enabled=c.conservation)
+        self.conservation_auditor = None
         # fair tenancy: per-shard {tenant_id: deque[_FairChunk]}
         self._fair_queues: list[dict[int, collections.deque]] = [
             {} for _ in range(self.n_shards)]
@@ -509,6 +518,7 @@ class DistributedEngine(IngestHostMixin):
         """Stage one converted event row into its owning shard's buffer
         (``token_id`` is the GLOBAL interner id). Caller holds the lock."""
         shard, local = self._route(token_id)
+        self.ledger.add("staged_rows", 1)
         has_vals = mask is not None and mask.any()
         if self.config.fair_tenancy:
             i32 = np.int32
@@ -616,6 +626,7 @@ class DistributedEngine(IngestHostMixin):
                 if b.room(s) == 0:
                     self.flush_async()
             self.channel_map.collisions += res.collisions
+            self.ledger.add("staged_rows", staged)
             return {"decoded": int(np.sum(ok)) + n_reg_ok, "failed": failed,
                     "staged": staged}
 
@@ -711,6 +722,7 @@ class DistributedEngine(IngestHostMixin):
             if not self._buf.total():
                 return
             n_staged = int(max(self._buf.counts))  # worst shard's rows
+            self.ledger.add("dispatched_rows", self._buf.total())
             batch = self._buf.emit()
             traces, self._staged_traces = self._staged_traces, []
             for rec in traces:
@@ -726,6 +738,25 @@ class DistributedEngine(IngestHostMixin):
                 if self._rows_since_spool >= self._spool_trigger:
                     self._spool()
 
+    def ring_heads(self) -> dict[int, int]:
+        """Absolute ring write head per archive partition (part =
+        shard * arenas + arena) — the ONE definition shared by the
+        archive spooler and the conservation audit plane (ISSUE 14).
+        Caller holds the lock (one small device readback)."""
+        store = self.state.store
+        arenas = store.cursor.shape[-1]
+        acap = self.ring_arena_capacity()
+        ep = np.asarray(jax.device_get(store.epoch)).astype(np.int64)
+        cu = np.asarray(jax.device_get(store.cursor)).astype(np.int64)
+        heads = ep * acap + cu
+        return {s * arenas + a: int(heads[s, a])
+                for s in range(self.n_shards) for a in range(arenas)}
+
+    def ring_arena_capacity(self) -> int:
+        """Rows one (shard, arena) sub-ring holds before wrapping."""
+        return (self.config.store_capacity_per_shard
+                // self.state.store.cursor.shape[-1])
+
     def _spool(self) -> None:
         """Spill full archive segments from every (shard, arena) sub-ring.
         Caller holds the lock. One fixed-count ``read_range`` program per
@@ -734,16 +765,14 @@ class DistributedEngine(IngestHostMixin):
 
         store = self.state.store
         arenas = store.cursor.shape[-1]
-        acap = self.config.store_capacity_per_shard // arenas
+        acap = self.ring_arena_capacity()
         rows = self.archive.segment_rows
-        ep = np.asarray(jax.device_get(store.epoch)).astype(np.int64)
-        cu = np.asarray(jax.device_get(store.cursor)).astype(np.int64)
-        heads = ep * acap + cu
+        heads = self.ring_heads()
         for s in range(self.n_shards):
             shard_store = None
             for a in range(arenas):
                 part = s * arenas + a
-                head = int(heads[s, a])
+                head = heads[part]
                 start = self.archive.spilled(part)
                 if head - start > acap:   # wrapped before we got here
                     self.archive.note_lost(head - acap - start)
@@ -1859,6 +1888,9 @@ def restore_distributed(directory) -> DistributedEngine:
     eng.device_slots = {int(k): list(v)
                         for k, v in host["device_slots"].items()}
     eng.dead_letters = list(host["dead_letters"])
+    # conservation ledger (ISSUE 14): rebase over the restored device
+    # counters BEFORE any WAL replay (engine.py restore_engine parity)
+    eng.ledger.rebase(eng)
     return eng
 
 

@@ -518,6 +518,19 @@ class ReplicaFeed:
             self._needs_resync[follower] = False
             self._trim_locked()
 
+    def watermarks(self) -> dict:
+        """Feed-side conservation watermarks (ISSUE 14): publish seq,
+        the published counter (the two must agree — checked by
+        ``check_conservation``), per-follower acked seqs, and the
+        retained buffer depth — one consistent read under the feed
+        lock."""
+        with self._lock:
+            return {"seq": self._seq,
+                    "published": self.counters["published"],
+                    "acked": {str(f): self._acked.get(f, 0)
+                              for f in self.followers},
+                    "buffer": len(self._buffer)}
+
     # -------------------------------------------------------------- metrics
     def metrics(self) -> dict:
         with self._lock:

@@ -631,6 +631,18 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
 
         return await asyncio.to_thread(device_memory_payload, inst.engine)
 
+    async def conservation():
+        """Conservation ledger + audit verdict (ISSUE 14) — the RPC
+        twin of GET /api/instance/conservation. Off-loop: the ledger
+        reads device counters (and a cluster facade fans out)."""
+        from sitewhere_tpu.utils.conservation import conservation_payload
+
+        fn = getattr(inst.engine, "conservation", None)
+        if callable(fn):
+            return await asyncio.to_thread(fn)
+        return await asyncio.to_thread(conservation_payload, inst.engine,
+                                       inst.rules)
+
     # --- streaming rules & rollups (ISSUE 13; RPC twins of /api/rules) ----
     async def rules_status():
         return await asyncio.to_thread(inst.rules.status)
@@ -703,6 +715,7 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         "Instance.clusterHealth": cluster_health,
         "Instance.clusterMetrics": cluster_metrics,
         "Instance.deviceMemory": device_memory,
+        "Instance.conservation": conservation,
         "Rules.getStatus": rules_status,
         "Rules.setRuleSet": rules_set,
         "Rules.poll": rules_poll,

@@ -67,6 +67,12 @@ class RulesManager:
         self.reload_errors = 0
         self.alerts_emitted = 0
         self.alerts_suppressed = 0
+        # conservation accounting (ISSUE 14): every harvested fire must
+        # land in exactly one sink — emitted, dedup-suppressed, or
+        # skipped (stale meta row / unresolvable group token); the
+        # audit plane checks harvested == emitted + suppressed + skipped
+        self.fires_harvested = 0
+        self.harvest_skipped = 0
         self._inst = rules_metrics()
 
     # ----------------------------------------------------------- install
@@ -210,17 +216,26 @@ class RulesManager:
         by_tenant: dict[str, list[bytes]] = {}
         with self._mu:
             meta = list(self.meta)
+        # conservation accounting tallies LOCALLY and commits in ONE
+        # _mu block after the alert batches ingested: a concurrent
+        # audit must read either the pre-poll or the post-poll
+        # counters, never a mid-harvest state where harvested has run
+        # ahead of its sinks (harvested == emitted + suppressed +
+        # skipped is a checked equation)
+        skipped = suppressed = 0
         for r, g, key, val in fires:
             if r >= len(meta):
-                continue           # stale pend row from a narrower set
+                skipped += 1       # stale pend row from a narrower set
+                continue
             m = meta[r]
             group_tok = self._group_token(m.scope, g)
             if group_tok is None:
+                skipped += 1
                 continue
             dedup = f"{ALERT_KEY_PREFIX}{m.name}:{group_tok}:{key}"
             with self._mu:
                 if dedup in self._emitted:
-                    self.alerts_suppressed += 1
+                    suppressed += 1
                     self._inst["suppressed"].inc()
                     continue
                 self._emitted.add(dedup)
@@ -228,7 +243,11 @@ class RulesManager:
                                              dedup, by_tenant))
         for tenant, payloads in by_tenant.items():
             eng.ingest_json_batch(payloads, tenant)
-        self.alerts_emitted += len(alerts)
+        with self._mu:
+            self.fires_harvested += len(fires)
+            self.harvest_skipped += skipped
+            self.alerts_suppressed += suppressed
+            self.alerts_emitted += len(alerts)
         if alerts:
             self._inst["alerts"].inc(len(alerts))
             eng.host_counters["rule_alerts"] = \

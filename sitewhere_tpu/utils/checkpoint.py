@@ -152,6 +152,10 @@ def restore_engine(directory: str | pathlib.Path) -> Engine:
     engine._next_device = host["next_device"]
     engine._next_assignment = host["next_assignment"]
     engine.dead_letters = list(host["dead_letters"])
+    # conservation ledger (ISSUE 14): the restored device counters carry
+    # the pre-crash history this process never staged — rebase BEFORE
+    # any WAL replay so the ledger balances over replayed rows only
+    engine.ledger.rebase(engine)
     return engine
 
 

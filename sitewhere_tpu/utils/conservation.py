@@ -1,0 +1,620 @@
+"""Event conservation ledger & continuous audit plane (ISSUE 14).
+
+The platform's core promise is that no tenant event silently vanishes
+between ingest, persistence, aggregation, and delivery. PRs 6/9/12
+prove the zero-loss/zero-dup guarantees inside individual chaos tests;
+this module makes loss continuously *measurable* in a live system:
+
+  * :class:`FlowLedger` — host-side flow counters incremented at the
+    two boundaries the engine itself controls (rows staged, valid rows
+    dispatched to the device). Every other stage the ledger reports is
+    sampled from counters that already exist (QoS admission, the
+    device-side tenant counter grid, WAL sequence tickets, the replica
+    feed, the forward spill queue, the archive spill cursors, the CEP
+    harvest counters), so the ingest hot path pays only a dict add per
+    batch plus one vectorized ``np.sum`` per dispatch.
+  * :func:`build_ledger` — one mutually-consistent snapshot of every
+    stage, taken under the engine lock: per-stage counts, monotone
+    watermarks (WAL durable seq, dispatched rows, feed seq, standby
+    applied seq, archive spill cursor, rollup window id), and the
+    per-stage lag derived from them.
+  * :func:`check_conservation` — a PURE function evaluating the
+    conservation equations over one ledger snapshot. Slack terms are
+    explicit (see ``EQUATIONS``): in-flight staged backlog, the WAL
+    group-commit window, ring-wrap losses the archive already counted.
+  * :class:`ConservationAuditor` — a background thread running the
+    checker on a cadence. A violation must survive two consecutive
+    audits before it escalates (a spill-file rename and its counter
+    update are not atomic with a concurrent audit); escalated
+    violations increment ``swtpu_conservation_violation_total`` and
+    emit one loud structured log line.
+
+Import hygiene: this module must import with jax blocked (the offline
+bench tooling reads ledger documents); jax is imported lazily inside
+the snapshot helpers only.
+
+Conservation equations (the contract future PRs must keep balanced):
+
+  staging-balance       staged_rows == dispatched_rows + backlog_rows
+                        (slack: the staged-but-undispatched backlog,
+                        measured in the same critical section)
+  device-processed      dispatched_rows == device ``processed`` delta
+                        (exact at snapshot: reading the device counter
+                        forces every dispatched program)
+  device-disposition    accepted + invalid == processed (the tenant
+                        counter grid partitions every valid row;
+                        dedup_dropped / geofence_hit are annotations of
+                        accepted rows, not extra dispositions)
+  edge-admission        offered == admitted + edge sheds (offered is
+                        counted independently at admit() entry; sheds
+                        noted after admission — arena stalls — are
+                        subtracted), and the per-tenant shed counts sum
+                        to the total
+  wal-durability        0 <= durable_seq <= appended_seq (the group
+                        commit window is the only legal gap)
+  forward-queue         spilled == redelivered + deadlettered + depth
+                        (dead-letter is the ONLY legal sink; a spilled
+                        batch never just disappears)
+  replication-feed      published == feed_seq and every follower's
+                        acked <= feed_seq (slack: un-acked in-flight
+                        publications; an un-resynced standby gap shows
+                        as acked < seq, never as acked > seq)
+  archive-spill         spilled(part) <= ring_head(part), and
+                        ring_head - spilled <= arena_capacity +
+                        lost_rows (rows wrapped before spooling are
+                        only legal when the archive counted them)
+  rules-harvest         harvested == emitted + suppressed + skipped,
+                        and device missed <= fires, pending >= 0
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+EQUATIONS = (
+    "staging-balance", "device-processed", "device-disposition",
+    "edge-admission", "wal-durability", "forward-queue",
+    "replication-feed", "archive-spill", "rules-harvest",
+)
+
+
+class FlowLedger:
+    """Host-side flow counters for the boundaries nothing else counts.
+
+    All mutation sites hold the engine lock, so no lock of its own;
+    ``enabled`` toggles counting (the bench overhead estimator flips it
+    per batch). ``rebase`` records the device counters a restored
+    snapshot already carries, so a recovered engine's ledger balances
+    over the rows IT staged (WAL replay), not the pre-crash history."""
+
+    __slots__ = ("enabled", "counters", "baseline")
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.counters: dict[str, int] = {"staged_rows": 0,
+                                         "dispatched_rows": 0}
+        self.baseline: dict[str, int] = {}
+
+    def add(self, key: str, n: int) -> None:
+        if self.enabled and n:
+            self.counters[key] = self.counters.get(key, 0) + int(n)
+
+    def rebase(self, engine) -> None:
+        """Snapshot the engine's device-side counters as the baseline —
+        called after a snapshot restore, BEFORE any replay, so the
+        ledger's device deltas cover exactly the rows this process
+        staged."""
+        m = engine.metrics()
+        base = {"processed": int(m.get("processed", 0)),
+                "persisted": int(m.get("persisted", 0))}
+        grid = _grid_totals(engine)
+        for lane, n in grid.items():
+            base[f"grid_{lane}"] = n
+        self.baseline = base
+
+
+def _grid_totals(eng) -> dict[str, int]:
+    """Lane totals of the device-side tenant counter grid (scrape-path
+    readback; {} when the engine has no grid)."""
+    tpc = getattr(eng, "tenant_pipeline_counters", None)
+    if not callable(tpc):
+        return {}
+    totals: dict[str, int] = {}
+    for lanes in tpc().values():
+        for lane, n in lanes.items():
+            totals[lane] = totals.get(lane, 0) + int(n)
+    return totals
+
+
+def _backlog_rows(eng) -> int:
+    """Valid rows staged but not yet dispatched — measured field by
+    field (``staged_count`` counts an arena's failed-decode padding
+    rows too, which never dispatch as valid). Caller holds the lock."""
+    import numpy as np
+
+    n = 0
+    buf = getattr(eng, "_buf", None)
+    if buf is not None:
+        total = getattr(buf, "total", None)
+        n += int(total()) if callable(total) else len(buf)
+    fq = getattr(eng, "_fair_queued", 0)
+    n += int(fq.sum()) if hasattr(fq, "sum") else int(fq)
+    fill = getattr(eng, "_arena_fill", None)
+    if fill is not None:
+        n += int(np.sum(fill.valid[:fill.cursor]))
+    for b in getattr(eng, "_staged_batches", ()):
+        n += int(np.sum(b.valid))
+    return n
+
+
+def _rules_stage(eng, rules_manager) -> dict | None:
+    """Device CEP counters + the manager's harvest accounting."""
+    import jax
+    import numpy as np
+
+    rs = getattr(eng.state, "rules", None)
+    if rs is None or (rs.rules is None and rs.rollups is None):
+        return None
+    out: dict = {}
+    if rs.rules is not None:
+        rb = rs.rules
+        f, m, l, o, pw, ph, wid = jax.device_get(
+            (rb.fires, rb.missed, rb.late, rb.oob, rb.pend_w, rb.pend_h,
+             rb.acc_wid))
+        out.update(fires=int(f), missed=int(m), late=int(l), oob=int(o),
+                   pending=int(np.sum(np.minimum(
+                       np.asarray(pw) - np.asarray(ph),
+                       rb.pend_key.shape[2]))),
+                   max_window_id=int(np.max(wid)))
+    if rs.rollups is not None:
+        wid = np.asarray(jax.device_get(rs.rollups.wid))
+        live = wid[wid > np.iinfo(np.int32).min]
+        out["rollup_window_id"] = int(live.max()) if live.size else None
+        out["rollup_late"] = int(jax.device_get(rs.rollups.late))
+    if rules_manager is not None:
+        # one consistent read under the manager lock: poll() commits
+        # its four counters in a single _mu block, so the harvest
+        # equation is evaluated over pre- or post-poll totals only
+        with rules_manager._mu:
+            out.update(
+                harvested=int(getattr(rules_manager,
+                                      "fires_harvested", 0)),
+                emitted=int(getattr(rules_manager, "alerts_emitted", 0)),
+                suppressed=int(getattr(rules_manager,
+                                       "alerts_suppressed", 0)),
+                skipped=int(getattr(rules_manager, "harvest_skipped", 0)))
+    return out
+
+
+def build_ledger(engine, rules_manager=None) -> dict:
+    """One mutually-consistent flow-accounting snapshot of ``engine``
+    (a cluster facade snapshots its LOCAL rank — rank ledgers federate
+    through the cluster fan-out, never through one merged snapshot).
+    Reads the device counters (forcing in-flight dispatches), so this
+    belongs on scrape/audit cadences, never the ingest hot loop."""
+    eng = getattr(engine, "local", engine)
+    led: FlowLedger | None = getattr(eng, "ledger", None)
+    with eng.lock:
+        base = dict(led.baseline) if led is not None else {}
+        m = eng.metrics()
+        grid = _grid_totals(eng)
+        stages: dict = {}
+        qos = getattr(eng, "qos", None)
+        if qos is not None:
+            with qos._lock:
+                stages["edge"] = {
+                    # offered is counted INDEPENDENTLY at admit() entry
+                    # (never derived from admitted + shed), so the edge
+                    # equation can actually fail on a real ledger
+                    "offered": int(qos.offered_events),
+                    "admitted": int(qos.admitted_events),
+                    "shed": int(qos.shed_events),
+                    # sheds noted AFTER admission (arena stall): those
+                    # events were offered-and-admitted, the checker
+                    # subtracts them from the edge shed total
+                    "shed_noted": int(qos.shed_noted),
+                    "shed_by_tenant": dict(qos.shed_by_tenant)}
+        ing = {"staged_rows": 0, "dispatched_rows": 0,
+               "backlog_rows": _backlog_rows(eng), "counting": False}
+        if led is not None:
+            ing.update(staged_rows=led.counters.get("staged_rows", 0),
+                       dispatched_rows=led.counters.get(
+                           "dispatched_rows", 0),
+                       counting=led.enabled)
+        stages["ingest"] = ing
+        stages["device"] = {
+            "processed": int(m.get("processed", 0))
+                          - base.get("processed", 0),
+            "persisted": int(m.get("persisted", 0))
+                          - base.get("persisted", 0),
+            **{lane: n - base.get(f"grid_{lane}", 0)
+               for lane, n in grid.items()},
+        }
+        wal = getattr(eng, "wal", None)
+        if wal is not None:
+            with wal._lock:
+                appended, durable = int(wal._seq), int(wal._durable_seq)
+            stages["wal"] = {"appended_seq": appended,
+                             "durable_seq": durable,
+                             "group_commit": bool(wal.group_commit)}
+        fq = getattr(eng, "forward_queue", None)
+        if fq is not None:
+            fm = fq.metrics()
+            stages["forward"] = {
+                "spilled_batches": fm["forward_spilled_batches"],
+                "redelivered_batches": fm["forward_redelivered_batches"],
+                "deadlettered_batches":
+                    fm["forward_deadlettered_batches"],
+                "queue_depth": fm["forward_queue_depth"],
+                "open_circuits": fm["forward_open_circuits"],
+            }
+        feed = getattr(eng, "replica_feed", None)
+        applier = getattr(eng, "replica_applier", None)
+        if feed is not None or applier is not None:
+            rep: dict = {}
+            if feed is not None:
+                wm = feed.watermarks()
+                rep.update(feed_seq=wm["seq"], published=wm["published"],
+                           acked=wm["acked"], buffer=wm["buffer"])
+            if applier is not None:
+                rep["applied_by_leader"] = {
+                    str(r): applier.applied(r)
+                    for r in applier.leaders()}
+            stages["replication"] = rep
+        arch = getattr(eng, "archive", None)
+        if arch is not None:
+            # heads/capacity come from the engine's OWN spooler helpers
+            # (engine.ring_heads / ring_arena_capacity) — one definition
+            # for the spooler and its checker, no drift
+            heads = eng.ring_heads()
+            acap = eng.ring_arena_capacity()
+            stages["archive"] = {
+                "parts": {str(p): {"head": h,
+                                   "spilled": arch.spilled(p),
+                                   "capacity": acap}
+                          for p, h in heads.items()},
+                "rows": arch.total_rows(),
+                "lost_rows": int(arch.lost_rows),
+                "expired_rows": int(arch.expired_rows),
+            }
+        rules = _rules_stage(eng, rules_manager)
+        if rules is not None:
+            stages["rules"] = rules
+
+    watermarks: dict = {"dispatched_rows": ing["dispatched_rows"]}
+    lag: dict = {"staged_backlog_rows": ing["backlog_rows"]}
+    if "wal" in stages:
+        w = stages["wal"]
+        watermarks["wal_appended"] = w["appended_seq"]
+        watermarks["wal_durable"] = w["durable_seq"]
+        lag["wal_durable_lag"] = w["appended_seq"] - w["durable_seq"]
+    if "replication" in stages:
+        r = stages["replication"]
+        if "feed_seq" in r:
+            watermarks["feed_seq"] = r["feed_seq"]
+            acked = r.get("acked", {})
+            lag["replication_lag_batches"] = (
+                max(r["feed_seq"] - a for a in acked.values())
+                if acked else 0)
+        if r.get("applied_by_leader"):
+            watermarks["standby_applied"] = r["applied_by_leader"]
+    if "archive" in stages:
+        parts = stages["archive"]["parts"]
+        watermarks["archive_spill"] = {p: v["spilled"]
+                                       for p, v in parts.items()}
+        lag["archive_spill_lag_rows"] = (
+            max((v["head"] - v["spilled"] for v in parts.values()),
+                default=0))
+    if "forward" in stages:
+        lag["forward_queue_depth"] = stages["forward"]["queue_depth"]
+    if "rules" in stages and "rollup_window_id" in stages["rules"]:
+        watermarks["rollup_window_id"] = stages["rules"][
+            "rollup_window_id"]
+
+    return {
+        "generatedMs": int(time.time() * 1000),
+        "rank": getattr(engine, "rank", 0),
+        "engine": getattr(eng, "metrics_label", "e?"),
+        "stages": stages,
+        "watermarks": watermarks,
+        "lag": lag,
+    }
+
+
+@dataclasses.dataclass
+class Violation:
+    """One broken conservation equation: ``lhs`` and ``rhs`` are the
+    evaluated sides, ``slack`` the tolerance the equation already
+    granted when it still failed."""
+
+    equation: str
+    message: str
+    lhs: float
+    rhs: float
+    slack: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def check_conservation(ledger: dict) -> list[Violation]:
+    """Evaluate every conservation equation over one ledger snapshot.
+    Pure: no engine access, no clock — the same ledger always yields
+    the same verdict (the falsifiability tests perturb a ledger by one
+    and must see a Violation)."""
+    out: list[Violation] = []
+
+    def bad(eq: str, msg: str, lhs, rhs, slack: float = 0.0) -> None:
+        out.append(Violation(eq, msg, float(lhs), float(rhs),
+                             float(slack)))
+
+    st = ledger.get("stages", {})
+    ing = st.get("ingest")
+    dev = st.get("device", {})
+    if ing and ing.get("counting"):
+        staged = ing["staged_rows"]
+        dispatched = ing["dispatched_rows"]
+        backlog = ing["backlog_rows"]
+        if staged != dispatched + backlog:
+            bad("staging-balance",
+                f"staged_rows {staged} != dispatched_rows {dispatched} "
+                f"+ backlog {backlog}", staged, dispatched + backlog,
+                slack=backlog)
+        processed = dev.get("processed")
+        if processed is not None and dispatched != processed:
+            bad("device-processed",
+                f"dispatched_rows {dispatched} != device processed "
+                f"{processed}", dispatched, processed)
+    if "accepted" in dev and "invalid" in dev and "processed" in dev:
+        lhs = dev["accepted"] + dev["invalid"]
+        if lhs != dev["processed"]:
+            bad("device-disposition",
+                f"accepted {dev['accepted']} + invalid {dev['invalid']}"
+                f" != processed {dev['processed']}", lhs,
+                dev["processed"])
+    edge = st.get("edge")
+    if edge:
+        edge_shed = edge["shed"] - edge.get("shed_noted", 0)
+        if edge["offered"] != edge["admitted"] + edge_shed:
+            bad("edge-admission",
+                f"offered {edge['offered']} != admitted "
+                f"{edge['admitted']} + edge shed {edge_shed} "
+                f"(shed total {edge['shed']} incl. "
+                f"{edge.get('shed_noted', 0)} post-admission)",
+                edge["offered"], edge["admitted"] + edge_shed,
+                slack=edge.get("shed_noted", 0))
+        by_tenant = sum(edge.get("shed_by_tenant", {}).values())
+        if by_tenant != edge["shed"]:
+            bad("edge-admission",
+                f"per-tenant shed sum {by_tenant} != shed total "
+                f"{edge['shed']}", by_tenant, edge["shed"])
+    wal = st.get("wal")
+    if wal and not (0 <= wal["durable_seq"] <= wal["appended_seq"]):
+        bad("wal-durability",
+            f"durable_seq {wal['durable_seq']} outside "
+            f"[0, appended_seq {wal['appended_seq']}]",
+            wal["durable_seq"], wal["appended_seq"])
+    fwd = st.get("forward")
+    if fwd:
+        rhs = (fwd["redelivered_batches"] + fwd["deadlettered_batches"]
+               + fwd["queue_depth"])
+        if fwd["spilled_batches"] != rhs:
+            bad("forward-queue",
+                f"spilled {fwd['spilled_batches']} != redelivered "
+                f"{fwd['redelivered_batches']} + deadlettered "
+                f"{fwd['deadlettered_batches']} + depth "
+                f"{fwd['queue_depth']}", fwd["spilled_batches"], rhs,
+                slack=fwd["queue_depth"])
+    rep = st.get("replication")
+    if rep and "feed_seq" in rep:
+        if rep["published"] != rep["feed_seq"]:
+            bad("replication-feed",
+                f"published {rep['published']} != feed_seq "
+                f"{rep['feed_seq']}", rep["published"], rep["feed_seq"])
+        for f, acked in rep.get("acked", {}).items():
+            if acked > rep["feed_seq"]:
+                bad("replication-feed",
+                    f"follower {f} acked {acked} > feed_seq "
+                    f"{rep['feed_seq']}", acked, rep["feed_seq"])
+    arch = st.get("archive")
+    if arch:
+        lost = arch.get("lost_rows", 0)
+        for p, v in arch.get("parts", {}).items():
+            if v["spilled"] > v["head"]:
+                bad("archive-spill",
+                    f"part {p} spill cursor {v['spilled']} ahead of "
+                    f"ring head {v['head']}", v["spilled"], v["head"])
+            elif v["head"] - v["spilled"] > v["capacity"] + lost:
+                bad("archive-spill",
+                    f"part {p} unspilled backlog "
+                    f"{v['head'] - v['spilled']} exceeds capacity "
+                    f"{v['capacity']} + counted losses {lost}",
+                    v["head"] - v["spilled"], v["capacity"] + lost,
+                    slack=v["capacity"] + lost)
+    rules = st.get("rules")
+    if rules:
+        if "harvested" in rules:
+            rhs = (rules.get("emitted", 0) + rules.get("suppressed", 0)
+                   + rules.get("skipped", 0))
+            if rules["harvested"] != rhs:
+                bad("rules-harvest",
+                    f"harvested {rules['harvested']} != emitted "
+                    f"{rules.get('emitted', 0)} + suppressed "
+                    f"{rules.get('suppressed', 0)} + skipped "
+                    f"{rules.get('skipped', 0)}", rules["harvested"],
+                    rhs)
+        if "fires" in rules and rules.get("missed", 0) > rules["fires"]:
+            bad("rules-harvest",
+                f"missed {rules['missed']} > fires {rules['fires']}",
+                rules["missed"], rules["fires"])
+        if rules.get("pending", 0) < 0:
+            bad("rules-harvest",
+                f"negative pending ring depth {rules['pending']}",
+                rules["pending"], 0)
+    return out
+
+
+def conservation_metrics(registry=None) -> dict:
+    """The conservation plane's registry instruments. Kept OUT of
+    ``engine.metrics()`` (dispatch-shape equality) like every plane
+    before it:
+
+      swtpu_conservation_violation_total  confirmed violations, per
+                                          equation (auditor-escalated)
+      swtpu_conservation_violations       current violation count of
+                                          the latest audit (gauge)
+      swtpu_conservation_audits_total     audit passes run (gauge,
+                                          scrape-synced)
+      swtpu_flow_rows                     ledger flow counters, labeled
+                                          by stage (staged | dispatched
+                                          | backlog), per engine
+      swtpu_flow_lag                      per-stage lag derived from
+                                          the watermarks at scrape
+    """
+    from sitewhere_tpu.utils.metrics import REGISTRY
+
+    reg = registry or REGISTRY
+    return {
+        "violations_total": reg.counter(
+            "swtpu_conservation_violation_total",
+            "confirmed conservation-equation violations, per equation"),
+        "violations": reg.gauge(
+            "swtpu_conservation_violations",
+            "violations in the most recent conservation audit"),
+        "audits": reg.gauge(
+            "swtpu_conservation_audits_total",
+            "conservation audit passes run"),
+        "flow": reg.gauge(
+            "swtpu_flow_rows",
+            "conservation ledger flow counters, per stage"),
+        "lag": reg.gauge(
+            "swtpu_flow_lag",
+            "per-stage lag derived from the conservation watermarks"),
+    }
+
+
+def export_conservation_metrics(engine, registry=None) -> None:
+    """Scrape-time export of the ledger's host-side counters and the
+    auditor's posture. Deliberately does NOT build a full ledger (the
+    device readbacks stay on the audit cadence); only the cheap host
+    counters and the latest audit verdict land on the scrape."""
+    eng = getattr(engine, "local", engine)
+    led = getattr(eng, "ledger", None)
+    if led is None:
+        return
+    inst = conservation_metrics(registry)
+    lbl = getattr(eng, "metrics_label", "e?")
+    flow = inst["flow"]
+    flow.set(led.counters.get("staged_rows", 0), stage="staged",
+             engine=lbl)
+    flow.set(led.counters.get("dispatched_rows", 0), stage="dispatched",
+             engine=lbl)
+    aud = getattr(eng, "conservation_auditor", None)
+    if aud is not None:
+        inst["violations"].set(len(aud.last_violations), engine=lbl)
+        inst["audits"].set(aud.audits, engine=lbl)
+        for k, v in (aud.last_ledger or {}).get("lag", {}).items():
+            inst["lag"].set(v, stage=k, engine=lbl)
+
+
+class ConservationAuditor:
+    """Background invariant checker: builds a ledger and evaluates the
+    conservation equations every ``interval_s`` seconds. A violation
+    escalates (counter + loud structured log) only when the SAME
+    equation fails two consecutive audits — a spill file's rename and
+    its counter update are not atomic with a concurrent audit, so a
+    single-read imbalance is a suspect, not a verdict."""
+
+    def __init__(self, engine, rules_manager=None,
+                 interval_s: float = 5.0, registry=None):
+        self.engine = engine
+        self.rules_manager = rules_manager
+        self.interval_s = float(interval_s)
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._suspect: set[str] = set()
+        self.audits = 0
+        self.confirmed_total = 0
+        self.last_ledger: dict | None = None
+        self.last_violations: list[dict] = []
+        # attach so the scrape exporter and REST payload can find us
+        getattr(engine, "local", engine).conservation_auditor = self
+
+    def audit(self) -> tuple[dict, list[Violation]]:
+        """One audit pass (also the synchronous entry tests/bench use):
+        returns (ledger, violations) and applies the two-read
+        confirmation rule to the escalation side effects."""
+        ledger = build_ledger(self.engine, self.rules_manager)
+        violations = check_conservation(ledger)
+        self.audits += 1
+        self.last_ledger = ledger
+        self.last_violations = [v.to_dict() for v in violations]
+        now_suspect = {v.equation for v in violations}
+        confirmed = [v for v in violations
+                     if v.equation in self._suspect]
+        self._suspect = now_suspect - {v.equation for v in confirmed}
+        if confirmed:
+            inst = conservation_metrics(self._registry)
+            for v in confirmed:
+                self.confirmed_total += 1
+                inst["violations_total"].inc(equation=v.equation)
+                logger.error(
+                    "CONSERVATION VIOLATION %s",
+                    json.dumps({"equation": v.equation,
+                                "message": v.message, "lhs": v.lhs,
+                                "rhs": v.rhs, "slack": v.slack,
+                                "rank": ledger.get("rank"),
+                                "engine": ledger.get("engine")}))
+        return ledger, violations
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.audit()
+            except Exception:
+                logger.exception("conservation audit pass failed")
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        if self.running:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="swtpu-conservation",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def conservation_payload(engine, rules_manager=None) -> dict:
+    """THE document behind ``GET /api/instance/conservation`` and the
+    ``Instance.conservation`` RPC: a fresh ledger + verdict, plus the
+    background auditor's posture when one is attached."""
+    ledger = build_ledger(engine, rules_manager)
+    violations = check_conservation(ledger)
+    out = {"ledger": ledger,
+           "violations": [v.to_dict() for v in violations],
+           "balanced": not violations}
+    aud = getattr(getattr(engine, "local", engine),
+                  "conservation_auditor", None)
+    if aud is not None:
+        out["auditor"] = {"audits": aud.audits,
+                          "confirmedViolations": aud.confirmed_total,
+                          "intervalS": aud.interval_s,
+                          "running": aud.running}
+    return out

@@ -776,6 +776,33 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
                           f"device-side per-tenant {lane} event count "
                           "(computed in the jit step)").set(n, tenant=ten)
 
+    # CEP-tier cadence-dependent counters (ISSUE 14 satellite): the
+    # missed/late/oob fires live in rule_counters() — deliberately OUT
+    # of engine.metrics() (dispatch-shape equality) — so until now a
+    # pending-ring overflow was invisible unless you polled the Python
+    # API. Scrape-time sync, like every other device-counter export;
+    # an engine without an installed rule set exports nothing.
+    rc = getattr(engine, "rule_counters", None)
+    if callable(rc):
+        counters = rc()
+        for key, name, help_text in (
+                ("ruleFires", "swtpu_rules_fires_total",
+                 "distinct rule fire keys detected on device"),
+                ("ruleMissedFires", "swtpu_rules_missed_total",
+                 "rule fires dropped by pending-ring overflow"),
+                ("ruleLateEvents", "swtpu_rules_late_total",
+                 "events older than their rule window carry"),
+                ("ruleOobGroups", "swtpu_rules_oob_groups_total",
+                 "rule matches whose group id exceeded the group table"),
+                ("rulesActive", "swtpu_rules_active",
+                 "rules in the installed set"),
+                ("rollupLateEvents", "swtpu_rollup_late_total",
+                 "events older than their rollup slot's window"),
+                ("rollupsActive", "swtpu_rollups_active",
+                 "continuous rollups in the installed set")):
+            if key in counters:
+                reg.gauge(name, help_text).set(counters[key])
+
     pool = getattr(engine, "_arena_pool", None)
     if pool is not None:
         reg.gauge("swtpu_arena_pool_arenas",
@@ -894,6 +921,17 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
     # harvest_slo — both feed ONE histogram, so exactly-once totals hold
     # no matter which consumer drains first)
     harvest_slo(engine, reg)
+
+    # conservation plane (ISSUE 14): the flow ledger's host counters +
+    # the background auditor's verdict. Lazy import (jax-free module,
+    # but keep the scrape path's import graph explicit).
+    try:
+        from sitewhere_tpu.utils.conservation import (
+            export_conservation_metrics)
+    except ImportError:
+        export_conservation_metrics = None
+    if export_conservation_metrics is not None:
+        export_conservation_metrics(engine, reg)
 
     # device plane (ISSUE 11): compile/retrace posture, memory ledger,
     # and the query-path device-time harvest. Lazy import keeps this

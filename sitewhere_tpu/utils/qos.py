@@ -159,8 +159,17 @@ class AdmissionController:
         self.min_retry_after_s = float(min_retry_after_s)
         self._lock = threading.Lock()
         self._buckets: dict[str, TokenBucket] = {}
+        # conservation accounting (ISSUE 14): ``offered_events`` counts
+        # at admit() ENTRY, independently of the verdict, so the edge
+        # equation offered == admitted + edge-sheds is falsifiable —
+        # never derived from its own right-hand side. ``shed_noted``
+        # counts sheds recorded via note_shed (e.g. an arena stall AFTER
+        # admission): those events were already offered-and-admitted, so
+        # the checker subtracts them from the edge shed total.
+        self.offered_events = 0
         self.admitted_events = 0
         self.shed_events = 0
+        self.shed_noted = 0
         self.shed_by_tenant: dict[str, int] = {}
         self._metrics = qos_metrics()
 
@@ -182,6 +191,7 @@ class AdmissionController:
         tenant = tenant or "default"
         n = max(1, int(n))
         with self._lock:
+            self.offered_events += n
             now = self._clock()
             if self.shed_threshold and self._backlog_fn is not None:
                 saturated = self._backlog_fn() >= self.shed_threshold
@@ -212,6 +222,7 @@ class AdmissionController:
         by the engine) so the ``swtpu_qos_shed_total`` ledger stays the
         one place sheds are visible."""
         with self._lock:
+            self.shed_noted += max(1, int(n))
             self._count_shed(tenant or "default", max(1, int(n)), reason)
 
     def bucket_fill(self) -> dict[str, float]:

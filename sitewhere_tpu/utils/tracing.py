@@ -710,6 +710,15 @@ def debug_bundle(engine) -> dict:
     if qos is not None:
         bundle["qos"] = {"shedThreshold": qos.shed_threshold,
                          "bucketFill": qos.bucket_fill()}
+    # conservation plane (ISSUE 14): the rank-local flow ledger +
+    # verdict — one bundle answers "where are my events" without
+    # another round trip. Never takes the bundle down with it.
+    try:
+        from sitewhere_tpu.utils.conservation import conservation_payload
+
+        bundle["conservation"] = conservation_payload(engine)
+    except Exception as e:
+        bundle["conservation"] = {"error": repr(e)}
     # device plane (ISSUE 11): the memory-ledger breakdown (a PEEK —
     # high-watermarks stay armed for the next scrape) plus per-family
     # compile posture, so one bundle answers "what is resident and what

@@ -363,6 +363,22 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
             return json_response({"error": repr(e)}, status=503)
         return json_response(res)
 
+    async def conservation_doc(request: web.Request):
+        """Conservation audit plane (ISSUE 14): the full per-stage flow
+        ledger, monotone watermarks, derived lag, and the conservation-
+        equation verdict. A clustered engine fans out to every rank
+        (``ClusterEngine.conservation``); off-loop like every
+        peer-touching (and device-reading) scrape surface."""
+        from sitewhere_tpu.utils.conservation import conservation_payload
+
+        fn = getattr(inst.engine, "conservation", None)
+        if callable(fn):
+            return json_response(await asyncio.to_thread(fn))
+        return json_response(await asyncio.to_thread(
+            conservation_payload, inst.engine, inst.rules))
+
+    r.add_get("/api/instance/conservation", conservation_doc)
+
     async def debug_bundle_doc(request: web.Request):
         """One self-contained JSON snapshot for offline triage: config,
         metrics (dict + strict-0.0.4 exposition), recent flights, the
@@ -1896,14 +1912,28 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 class ServerHandle:
     """Running REST server + background pumps (outbound, analytics)."""
 
-    def __init__(self, runner: web.AppRunner, port: int, tasks):
+    def __init__(self, runner: web.AppRunner, port: int, tasks,
+                 auditor=None, instance=None):
         self.runner = runner
         self.port = port
         self._tasks = list(tasks)
+        self._auditor = auditor
+        self._instance = instance
 
     async def cleanup(self) -> None:
         import asyncio
 
+        if self._auditor is not None:
+            # the conservation auditor belongs to the INSTANCE whenever
+            # its lifecycle is running — tearing down just the web tier
+            # must not kill always-on auditing for a STARTED instance
+            # (on_stop stops it); only an instance that never ran its
+            # lifecycle leaves the thread ours to reap
+            from sitewhere_tpu.utils.lifecycle import LifecycleStatus
+
+            status = getattr(self._instance, "status", None)
+            if status is not LifecycleStatus.STARTED:
+                self._auditor.stop()
         for task in self._tasks:
             task.cancel()
         for task in self._tasks:
@@ -1971,4 +2001,18 @@ async def start_server(instance: SiteWhereTpuInstance, host: str = "127.0.0.1",
         tasks.append(asyncio.create_task(
             instance.analytics.run(interval_s=analytics_interval_s)))
     bound = site._server.sockets[0].getsockname()[1]
-    return ServerHandle(runner, bound, tasks)
+    # conservation audit plane (ISSUE 14): always-on invariant checking
+    # while the server is up — started here so embedded instances that
+    # never run the async lifecycle still get the background auditor.
+    # Ownership: cleanup stops the thread only if THIS call started it;
+    # an auditor the instance lifecycle already runs stays the
+    # instance's to stop (a server rebind must not kill its auditing).
+    auditor = getattr(instance, "conservation_auditor", None)
+    started_here = None
+    if (auditor is not None
+            and getattr(instance.config, "conservation_audit_s", 0)
+            and not auditor.running):
+        auditor.start()
+        started_here = auditor
+    return ServerHandle(runner, bound, tasks, auditor=started_here,
+                        instance=instance)

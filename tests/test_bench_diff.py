@@ -1,0 +1,86 @@
+"""scripts/bench_diff.py — bench-trajectory tooling (ISSUE 14 satellite).
+
+Smoke-invokes the CLI on two synthetic bench JSONs (the BENCH_SCHEMA
+gate/report split): report-field drift never fails the diff, a violated
+hard gate (or a gate that silently dropped out of the new run) always
+does, and the delta table prints for shared numeric fields."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "bench_diff.py"
+
+
+def _base() -> dict:
+    return {
+        "value": 100_000, "latency_p99_ms": 12.5,
+        "trace_overhead_pct": 1.2, "span_overhead_pct": 0.8,
+        "conservation_overhead_pct": 0.5,
+        "conservation_headline_violations": 0,
+        "query_batch_parity": True, "archive_parity": True,
+        "archive_ring_multiple": 11.0, "fairness_admitted_loss": 0,
+    }
+
+
+def _run(old: dict, new: dict, tmp_path):
+    a = tmp_path / "old.json"
+    b = tmp_path / "new.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    return subprocess.run([sys.executable, str(SCRIPT), str(a), str(b)],
+                          capture_output=True, text=True, timeout=60)
+
+
+def test_report_drift_passes_and_prints_deltas(tmp_path):
+    new = _base() | {"value": 70_000, "latency_p99_ms": 30.0}
+    res = _run(_base(), new, tmp_path)
+    assert res.returncode == 0, res.stderr
+    assert "latency_p99_ms" in res.stdout and "-30.0%" in res.stdout
+    assert "no hard-gate regressions" in res.stdout
+
+
+def test_gate_violation_fails(tmp_path):
+    for bad in ({"trace_overhead_pct": 4.7},
+                {"conservation_headline_violations": 2},
+                {"archive_parity": False},
+                {"archive_ring_multiple": 3.0}):
+        res = _run(_base(), _base() | bad, tmp_path)
+        field = next(iter(bad))
+        assert res.returncode == 1, (bad, res.stdout, res.stderr)
+        assert f"GATE {field}" in res.stderr
+
+
+def test_relational_gate_batched_vs_sequential(tmp_path):
+    """BENCH_SCHEMA's relational gate: batched QPS must beat
+    sequential QPS within the SAME run."""
+    ok = _base() | {"query_batched_qps": 900.0,
+                    "query_sequential_qps": 500.0}
+    assert _run(ok, ok, tmp_path).returncode == 0
+    bad = _base() | {"query_batched_qps": 400.0,
+                     "query_sequential_qps": 500.0}
+    res = _run(ok, bad, tmp_path)
+    assert res.returncode == 1
+    assert "GATE query_batched_qps" in res.stderr
+
+
+def test_dropped_gate_is_a_regression(tmp_path):
+    new = _base()
+    del new["query_batch_parity"]
+    res = _run(_base(), new, tmp_path)
+    assert res.returncode == 1
+    assert "ABSENT" in res.stderr
+    # ...but a gate absent from BOTH runs (leg never ran) is fine
+    old = _base()
+    del old["query_batch_parity"]
+    assert _run(old, new, tmp_path).returncode == 0
+
+
+def test_unreadable_input_is_usage_error(tmp_path):
+    res = subprocess.run(
+        [sys.executable, str(SCRIPT), str(tmp_path / "missing.json"),
+         str(tmp_path / "missing2.json")],
+        capture_output=True, text=True, timeout=60)
+    assert res.returncode == 2

@@ -1,0 +1,452 @@
+"""Event conservation ledger & audit plane (ISSUE 14).
+
+Covers the tentpole's contract ends:
+  * the ledger balances (zero violations) on live, shed-then-recover,
+    and kill/recover-replayed engines — the chaos-gated guarantees;
+  * the checker is FALSIFIABLE: a deliberately broken ledger (injected
+    off-by-one per stage) must produce a Violation naming the equation;
+  * the auditor escalates only violations that survive two consecutive
+    audits, into ``swtpu_conservation_violation_total``;
+  * the REST/cluster surfaces serve the ledger document;
+  * the metrics() dispatch-shape equality pin holds with the ledger on.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.utils.conservation import (ConservationAuditor,
+                                              FlowLedger, Violation,
+                                              build_ledger,
+                                              check_conservation,
+                                              conservation_payload)
+
+
+def _cfg(**kw):
+    base = dict(device_capacity=256, token_capacity=512,
+                assignment_capacity=512, store_capacity=4096,
+                batch_capacity=64, channels=4)
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+def _meas(tok: str, seq: int, value: float = 20.0) -> bytes:
+    return json.dumps({
+        "deviceToken": tok, "type": "DeviceMeasurements",
+        "request": {"measurements": {"temp": value}, "eventDate": seq},
+    }).encode()
+
+
+def _pay(lo: int, n: int, devs: int = 7) -> list[bytes]:
+    return [_meas(f"cv-{i % devs}", 1_000_000 + i) for i in range(lo, lo + n)]
+
+
+# ------------------------------------------------------------- balance
+def test_ledger_balances_live_and_quiesced():
+    eng = Engine(_cfg())
+    eng.ingest_json_batch(_pay(0, 150))
+    # mid-flight: the staging equation's slack term (backlog) absorbs
+    # the staged-but-undispatched rows
+    led = build_ledger(eng)
+    assert not check_conservation(led)
+    ing = led["stages"]["ingest"]
+    assert ing["staged_rows"] == 150
+    assert ing["staged_rows"] == ing["dispatched_rows"] + ing["backlog_rows"]
+    eng.flush()
+    led = build_ledger(eng)
+    assert not check_conservation(led)
+    dev = led["stages"]["device"]
+    assert dev["processed"] == 150
+    assert dev["accepted"] + dev["invalid"] == dev["processed"]
+    assert led["lag"]["staged_backlog_rows"] == 0
+    assert led["watermarks"]["dispatched_rows"] == 150
+
+
+def test_ledger_balances_across_dispatch_shapes_and_metrics_pin():
+    """scan_chunk 1 vs 2 over the same stream: both ledgers balance,
+    the flow totals agree (padding lanes never count), and the
+    engine.metrics() equality pin holds with the ledger ON."""
+    a = Engine(_cfg(scan_chunk=1))
+    b = Engine(_cfg(scan_chunk=2))
+    b.epoch = a.epoch
+    for lo in range(0, 192, 64):
+        for e in (a, b):
+            e.ingest_json_batch(_pay(lo, 64))
+    a.flush()
+    b.flush()
+    la, lb = build_ledger(a), build_ledger(b)
+    assert not check_conservation(la) and not check_conservation(lb)
+    assert la["stages"]["ingest"] == lb["stages"]["ingest"]
+    assert a.metrics() == b.metrics()
+
+
+def test_wal_balance_and_watermarks(tmp_path):
+    eng = Engine(_cfg(wal_dir=str(tmp_path / "wal")))
+    eng.ingest_json_batch(_pay(0, 100))
+    eng.flush()
+    led = build_ledger(eng)
+    assert not check_conservation(led)
+    w = led["stages"]["wal"]
+    assert w["appended_seq"] >= 1
+    assert led["watermarks"]["wal_appended"] == w["appended_seq"]
+    assert led["lag"]["wal_durable_lag"] >= 0
+    eng.wal.sync()
+    led = build_ledger(eng)
+    assert led["lag"]["wal_durable_lag"] == 0
+
+
+# ------------------------------------------------------- falsifiability
+def test_injected_off_by_one_produces_violation():
+    """The checker itself must be falsifiable: perturbing each stage of
+    a balanced ledger by one must trip exactly the matching equation."""
+    eng = Engine(_cfg(qos=True, tenant_rates={"t": 10_000.0}))
+    eng.qos.admit("t", 10)
+    eng.ingest_json_batch(_pay(0, 10), tenant="t")
+    eng.flush()
+    base = build_ledger(eng)
+    assert not check_conservation(base)
+
+    def perturbed(mutate):
+        led = json.loads(json.dumps(base))   # deep copy
+        mutate(led["stages"])
+        return [v.equation for v in check_conservation(led)]
+
+    assert "staging-balance" in perturbed(
+        lambda s: s["ingest"].__setitem__(
+            "staged_rows", s["ingest"]["staged_rows"] + 1))
+    assert "device-processed" in perturbed(
+        lambda s: s["device"].__setitem__(
+            "processed", s["device"]["processed"] - 1))
+    assert "device-disposition" in perturbed(
+        lambda s: s["device"].__setitem__(
+            "accepted", s["device"]["accepted"] + 1))
+    assert "edge-admission" in perturbed(
+        lambda s: s["edge"].__setitem__("shed", s["edge"]["shed"] + 1))
+    # a violation carries the evaluated sides for the structured log
+    led = json.loads(json.dumps(base))
+    led["stages"]["ingest"]["staged_rows"] += 1
+    v = check_conservation(led)[0]
+    assert isinstance(v, Violation) and v.lhs == v.rhs + 1
+    assert v.to_dict()["equation"] == "staging-balance"
+
+
+def test_forward_and_replication_equations_pure():
+    """The cross-rank equations evaluate over any ledger document — no
+    engine required (the checker is pure)."""
+    led = {"stages": {
+        "forward": {"spilled_batches": 5, "redelivered_batches": 3,
+                    "deadlettered_batches": 1, "queue_depth": 1,
+                    "open_circuits": 0},
+        "replication": {"feed_seq": 7, "published": 7,
+                        "acked": {"1": 6}, "buffer": 1},
+    }}
+    assert not check_conservation(led)
+    led["stages"]["forward"]["redelivered_batches"] = 2
+    assert [v.equation for v in check_conservation(led)] == [
+        "forward-queue"]
+    led["stages"]["forward"]["redelivered_batches"] = 3
+    led["stages"]["replication"]["acked"]["1"] = 9   # acked past seq
+    assert [v.equation for v in check_conservation(led)] == [
+        "replication-feed"]
+    led["stages"]["replication"]["acked"]["1"] = 6
+    led["stages"]["replication"]["published"] = 6
+    assert [v.equation for v in check_conservation(led)] == [
+        "replication-feed"]
+
+
+def test_archive_spill_equation():
+    led = {"stages": {"archive": {
+        "parts": {"0": {"head": 100, "spilled": 64, "capacity": 128}},
+        "rows": 64, "lost_rows": 0, "expired_rows": 0}}}
+    assert not check_conservation(led)
+    # spill cursor ahead of the ring head = corruption
+    led["stages"]["archive"]["parts"]["0"]["spilled"] = 101
+    assert [v.equation for v in check_conservation(led)] == [
+        "archive-spill"]
+    # unspilled backlog beyond capacity is only legal when counted
+    led["stages"]["archive"]["parts"]["0"].update(spilled=0, head=200)
+    assert [v.equation for v in check_conservation(led)] == [
+        "archive-spill"]
+    led["stages"]["archive"]["lost_rows"] = 72
+    assert not check_conservation(led)
+
+
+# ------------------------------------------------------- chaos: recover
+def test_kill_recover_wal_replay_ledger_balances(tmp_path):
+    """PR-6 discipline, continuously measured: snapshot, ingest through
+    WAL (archive spilling), SIGKILL (del), restore + replay — the
+    recovered engine's ledger must balance over the replayed rows (the
+    restore rebases the device counters the snapshot carried)."""
+    from sitewhere_tpu.utils.checkpoint import (replay_wal_into,
+                                                restore_engine,
+                                                save_engine)
+
+    cfg = _cfg(store_capacity=2048, batch_capacity=32,
+               wal_dir=str(tmp_path / "wal"),
+               archive_dir=str(tmp_path / "arch"),
+               archive_segment_rows=64)
+    eng = Engine(cfg)
+    save_engine(eng, tmp_path / "snap")
+    eng.ingest_json_batch(_pay(0, 300))
+    eng.flush()
+    assert not check_conservation(build_ledger(eng))
+    eng.wal.sync()
+    eng.wal.close()
+    del eng                      # "SIGKILL"
+    r2 = restore_engine(tmp_path / "snap")
+    replay_wal_into(r2, 0, tmp_path / "wal")
+    led = build_ledger(r2)
+    assert not check_conservation(led)
+    ing = led["stages"]["ingest"]
+    assert ing["staged_rows"] == 300 and ing["dispatched_rows"] == 300
+    assert led["stages"]["device"]["processed"] == 300
+    arch = led["stages"]["archive"]
+    assert arch["lost_rows"] == 0
+    for part in arch["parts"].values():
+        assert part["spilled"] <= part["head"]
+
+
+def test_mid_stream_snapshot_restore_rebases(tmp_path):
+    """Restoring a snapshot that already carries device history: the
+    baseline must absorb it, so the recovered ledger balances over what
+    THIS process replayed — not the pre-crash totals."""
+    from sitewhere_tpu.utils.checkpoint import (replay_wal_into,
+                                                restore_engine,
+                                                save_engine)
+
+    eng = Engine(_cfg(wal_dir=str(tmp_path / "wal")))
+    eng.ingest_json_batch(_pay(0, 100))
+    eng.flush()
+    save_engine(eng, tmp_path / "snap")        # snapshot mid-history
+    eng.ingest_json_batch(_pay(100, 60))
+    eng.flush()
+    eng.wal.sync()
+    eng.wal.close()
+    del eng
+    r2 = restore_engine(tmp_path / "snap")
+    assert r2.ledger.baseline["processed"] == 100
+    # replay everything (after_cursor 0 predates the watermark): the
+    # idempotent pipeline re-applies, the ledger counts the replay
+    replay_wal_into(r2, 0, tmp_path / "wal")
+    led = build_ledger(r2)
+    assert not check_conservation(led)
+    assert led["stages"]["ingest"]["staged_rows"] == 160
+
+
+# --------------------------------------------------- chaos: shed cycles
+def test_shed_then_recover_ledger_balances():
+    """PR-9 discipline, continuously measured: a shed/retry cycle shows
+    up in the edge stage (offered == admitted + shed) and never
+    unbalances the staging/device equations."""
+    from sitewhere_tpu.utils.qos import ManualClock
+
+    clk = ManualClock()
+    eng = Engine(_cfg(qos=True))
+    from sitewhere_tpu.utils.qos import AdmissionController
+
+    eng.qos = AdmissionController(tenant_rates={"sv": 40.0},
+                                  burst_s=1.0, clock=clk)
+    frames = [_pay(i * 10, 10) for i in range(12)]
+    backlog = list(frames)
+    sheds = 0
+    rounds = 0
+    while backlog and rounds < 100:
+        rounds += 1
+        still = []
+        for f in backlog:
+            if eng.qos.admit("sv", len(f)).admitted:
+                eng.ingest_json_batch(f, "sv")
+            else:
+                sheds += 1
+                still.append(f)
+        backlog = still
+        clk.advance(0.5)
+    assert not backlog and sheds > 0
+    eng.flush()
+    led = build_ledger(eng)
+    assert not check_conservation(led)
+    edge = led["stages"]["edge"]
+    assert edge["admitted"] == 120
+    assert edge["offered"] == edge["admitted"] + edge["shed"]
+    assert led["stages"]["device"]["accepted"] == 120
+
+
+# ------------------------------------------------------- rules equation
+def test_rules_harvest_equation_balances():
+    from sitewhere_tpu.rules import RuleSet, RulesManager
+
+    eng = Engine(_cfg(channels=8, rule_groups=64, rollup_buckets=8))
+    m = RulesManager(eng)
+    m.load(RuleSet.parse({
+        "name": "cv",
+        "rules": [{"name": "hot", "kind": "threshold", "channel": "temp",
+                   "op": ">", "value": 90.0, "cooldownMs": 1000}],
+        "rollups": [{"name": "r", "channel": "temp", "windowMs": 2000,
+                     "scope": "device"}]}), precompile=False)
+    base = int(eng.epoch.base_unix_s * 1000)
+    eng.ingest_json_batch([
+        json.dumps({"deviceToken": f"rv-{i % 4}",
+                    "type": "DeviceMeasurements",
+                    "request": {"measurements": {
+                        "temp": 95.0 if i % 11 == 0 else 20.0},
+                        "eventDate": base + i * 10}}).encode()
+        for i in range(200)])
+    eng.flush()
+    alerts = m.poll()
+    assert alerts
+    eng.flush()
+    led = build_ledger(eng, m)
+    assert not check_conservation(led)
+    r = led["stages"]["rules"]
+    assert r["harvested"] == r["emitted"] + r["suppressed"] + r["skipped"]
+    assert r["fires"] >= r["harvested"] - r["pending"]
+    assert "rollup_window_id" in led["watermarks"]
+    # falsifiability on the rules equation too
+    led["stages"]["rules"]["emitted"] += 1
+    assert "rules-harvest" in [v.equation
+                               for v in check_conservation(led)]
+
+
+# -------------------------------------------------------------- auditor
+def test_auditor_confirms_on_second_read_and_counts():
+    from sitewhere_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    eng = Engine(_cfg())
+    eng.ingest_json_batch(_pay(0, 64))
+    eng.flush()
+    aud = ConservationAuditor(eng, interval_s=60.0, registry=reg)
+    assert eng.conservation_auditor is aud    # attached for the scrape
+    _, v = aud.audit()
+    assert not v and aud.audits == 1
+    # inject a persistent imbalance straight into the ledger counters
+    eng.ledger.counters["staged_rows"] += 3
+    _, v1 = aud.audit()
+    assert v1 and aud.confirmed_total == 0    # first read: suspect only
+    _, v2 = aud.audit()
+    assert v2 and aud.confirmed_total == 1    # second read: escalated
+    c = reg.counter("swtpu_conservation_violation_total", "")
+    assert c.value(equation="staging-balance") == 1.0
+    # a transient imbalance (gone by the next audit) never escalates
+    eng.ledger.counters["staged_rows"] -= 3
+    _, v3 = aud.audit()
+    assert not v3 and aud.confirmed_total == 1
+
+
+def test_auditor_thread_lifecycle():
+    import time
+
+    eng = Engine(_cfg())
+    eng.ingest_json_batch(_pay(0, 32))
+    eng.flush()
+    aud = ConservationAuditor(eng, interval_s=0.02)
+    aud.start()
+    deadline = time.monotonic() + 5.0
+    while aud.audits < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    aud.stop()
+    assert aud.audits >= 3 and aud.confirmed_total == 0
+    assert aud.last_ledger is not None and not aud.last_violations
+
+
+# ------------------------------------------------------------ surfaces
+def test_conservation_payload_and_flow_export():
+    from sitewhere_tpu.utils.metrics import MetricsRegistry
+    from sitewhere_tpu.utils.conservation import (
+        export_conservation_metrics)
+
+    eng = Engine(_cfg())
+    eng.ingest_json_batch(_pay(0, 64))
+    eng.flush()
+    doc = conservation_payload(eng)
+    assert doc["balanced"] and doc["violations"] == []
+    assert doc["ledger"]["stages"]["ingest"]["staged_rows"] == 64
+    aud = ConservationAuditor(eng, interval_s=60.0)
+    aud.audit()
+    doc = conservation_payload(eng)
+    assert doc["auditor"]["audits"] == 1
+    reg = MetricsRegistry()
+    export_conservation_metrics(eng, reg)
+    lbl = eng.metrics_label
+    g = reg.gauge("swtpu_flow_rows", "")
+    assert g.value(stage="staged", engine=lbl) == 64.0
+    assert g.value(stage="dispatched", engine=lbl) == 64.0
+    assert reg.gauge("swtpu_conservation_violations", "").value(
+        engine=lbl) == 0.0
+
+
+def test_ledger_disabled_engine_skips_counting_checks():
+    eng = Engine(_cfg(conservation=False))
+    assert isinstance(eng.ledger, FlowLedger) and not eng.ledger.enabled
+    eng.ingest_json_batch(_pay(0, 32))
+    eng.flush()
+    led = build_ledger(eng)
+    # counting off: the staging equations are skipped, device-internal
+    # disposition still checks (and balances)
+    assert not check_conservation(led)
+    assert led["stages"]["ingest"]["counting"] is False
+    assert led["stages"]["ingest"]["staged_rows"] == 0
+
+
+def test_rest_conservation_endpoint():
+    """The REST document end to end (aiohttp test client against
+    make_app, the exposition-lint test's instance recipe)."""
+    aiohttp = pytest.importorskip("aiohttp")
+    import asyncio
+
+    from sitewhere_tpu.instance.instance import (InstanceConfig,
+                                                 SiteWhereTpuInstance)
+    from sitewhere_tpu.web.rest import make_app, start_server
+
+    inst = SiteWhereTpuInstance(InstanceConfig(
+        engine=EngineConfig(
+            device_capacity=64, token_capacity=128,
+            assignment_capacity=128, store_capacity=1024,
+            batch_capacity=16, channels=4),
+        conservation_audit_s=0.05))
+    inst.engine.ingest_json_batch(_pay(0, 12, devs=3))
+    inst.engine.flush()
+
+    loop = asyncio.new_event_loop()
+    try:
+        server = loop.run_until_complete(start_server(inst))
+        assert inst.conservation_auditor._thread is not None
+
+        async def fetch():
+            import base64
+
+            async with aiohttp.ClientSession() as s:
+                basic = base64.b64encode(b"admin:password").decode()
+                async with s.get(
+                        f"http://127.0.0.1:{server.port}/api/authapi/jwt",
+                        headers={"Authorization": f"Basic {basic}"}) as r:
+                    token = (await r.json())["token"]
+                url = (f"http://127.0.0.1:{server.port}"
+                       "/api/instance/conservation")
+                async with s.get(url, headers={
+                        "Authorization": f"Bearer {token}"}) as r:
+                    return r.status, await r.json()
+
+        status, doc = loop.run_until_complete(fetch())
+        assert status == 200
+        assert doc["balanced"] is True
+        assert doc["ledger"]["stages"]["ingest"]["staged_rows"] == 12
+        assert "auditor" in doc
+        loop.run_until_complete(server.cleanup())
+        assert inst.conservation_auditor._thread is None
+    finally:
+        loop.close()
+
+
+def test_debug_bundle_carries_conservation_section():
+    from sitewhere_tpu.utils.tracing import debug_bundle
+
+    eng = Engine(_cfg())
+    eng.ingest_json_batch(_pay(0, 16))
+    eng.flush()
+    bundle = debug_bundle(eng)
+    assert bundle["conservation"]["balanced"] is True
+    assert (bundle["conservation"]["ledger"]["stages"]["ingest"]
+            ["staged_rows"] == 16)

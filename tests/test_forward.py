@@ -92,6 +92,18 @@ def test_down_owner_spills_instead_of_raising_and_redelivers(tmp_path):
         for t in both:
             q = c0.query_events(device_token=t)
             assert q["total"] == 2, (t, q)
+        # conservation (ISSUE 14): the forward-queue equation balances
+        # through the spill/redeliver cycle — spilled == redelivered +
+        # deadlettered + depth, and the rest of the sender's ledger too
+        from sitewhere_tpu.utils.conservation import (build_ledger,
+                                                      check_conservation)
+
+        led = build_ledger(c0)
+        assert not check_conservation(led)
+        assert led["stages"]["forward"] == {
+            "spilled_batches": 1, "redelivered_batches": 1,
+            "deadlettered_batches": 0, "queue_depth": 0,
+            "open_circuits": 0}
     finally:
         _close(clusters, regs, host)
 

@@ -51,6 +51,9 @@ def test_offline_modules_import_with_jax_blocked():
     assert scripts, "scripts/ has no modules to check"
     targets = [f"file={p}" for p in scripts]
     targets.append("mod=sitewhere_tpu.utils.metrics")
+    # the conservation checker (ISSUE 14): offline tooling evaluates
+    # ledger documents (bench_diff, debug-bundle triage) without jax
+    targets.append("mod=sitewhere_tpu.utils.conservation")
     res = subprocess.run(
         [sys.executable, "-c", _DRIVER, *targets],
         cwd=REPO, capture_output=True, text=True, timeout=120)

@@ -182,6 +182,53 @@ def test_full_engine_exposition_lints():
     assert 'swtpu_device_mem_bytes{component="ring_store"' in text
     assert "swtpu_xla_programs_live" in text
     assert "swtpu_staged_backlog_hwm_rows" in text
+    # conservation plane (ISSUE 14): the flow ledger's scrape-time
+    # gauges ride the same exposition and stay 0.0.4-clean
+    lbl = eng.metrics_label
+    assert (f'swtpu_flow_rows{{engine="{lbl}",stage="staged"}} 6'
+            in text)
+    assert (f'swtpu_flow_rows{{engine="{lbl}",stage="dispatched"}} 6'
+            in text)
+
+
+def test_rules_counters_export_at_scrape():
+    """ISSUE 14 satellite: the cadence-dependent CEP counters
+    (missed/late/oob fires) export as swtpu_rules_* at SCRAPE time —
+    kept OUT of engine.metrics() (the dispatch-shape pin is asserted by
+    bench + tests/test_rules.py) but no longer invisible without the
+    Python API. An engine with no rule set exports none of them."""
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.rules import RuleSet, RulesManager
+
+    reg = MetricsRegistry()
+    plain = Engine(EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=1024, batch_capacity=16, channels=4))
+    export_engine_metrics(plain, reg)
+    assert "swtpu_rules_missed_total" not in reg.expose_text()
+
+    eng = Engine(EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=1024, batch_capacity=16, channels=8,
+        rule_groups=32, rollup_buckets=8))
+    RulesManager(eng).load(RuleSet.parse({
+        "name": "x",
+        "rules": [{"name": "hot", "kind": "threshold",
+                   "channel": "temp", "op": ">", "value": 90.0,
+                   "cooldownMs": 1000}]}), precompile=False)
+    reg = MetricsRegistry()
+    export_engine_metrics(eng, reg)
+    text = reg.expose_text()
+    lint_prometheus(text)
+    for name in ("swtpu_rules_missed_total", "swtpu_rules_late_total",
+                 "swtpu_rules_oob_groups_total",
+                 "swtpu_rules_fires_total"):
+        assert f"{name} 0" in text, name
+    assert "swtpu_rules_active 1" in text
+    # the dispatch-shape pin's premise: none of these leak into
+    # engine.metrics() (missed/late are harvest-cadence dependent)
+    assert "rule_missed" not in eng.metrics()
+    assert "ruleMissedFires" not in eng.metrics()
 
 
 # --------------------------------------------------------- API separation

@@ -480,5 +480,13 @@ def test_chaos_kill_recover_loop(tmp_path):
             c1.health.record_success(0)   # next read re-probes the owner
         q = c0.query_events(device_token=toks[0])
         assert q["total"] == total and "stale_ms" not in q
+        # conservation (ISSUE 14): after the kill/recover loop both
+        # ranks' flow ledgers must balance — replication publish/ack
+        # and the device counters included
+        from sitewhere_tpu.utils.conservation import (build_ledger,
+                                                      check_conservation)
+
+        for c in clusters:
+            assert not check_conservation(build_ledger(c))
     finally:
         _close(clusters, feeds, host)
