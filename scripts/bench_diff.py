@@ -74,7 +74,44 @@ GATES: dict[str, tuple[str, "float | str | None"]] = {
     "cluster_chaos_no_loss": ("true", None),
     "cluster_scrape_has_slo": ("true", None),
     "devicewatch_ledger_reconciles": ("true", None),
+    # elastic placement (ISSUE 15): the live-handoff chaos leg
+    "placement_overhead_pct": ("max", 3.0),
+    "placement_handoff_no_loss": ("true", None),
+    "placement_no_dual_apply": ("true", None),
+    "placement_victim_isolation_ok": ("true", None),
+    "placement_moves_completed": ("min", 2),
+    "conservation_placement_violations": ("zero", None),
 }
+
+# Every gate the SMOKE bench unconditionally emits (hardware-only legs
+# excluded — today there are none). tests/test_bench_diff.py asserts the
+# COMMITTED BENCH.json covers this set, so a leg silently dropping out
+# of bench.py fails tier-1, not just the next bench run. Keep this an
+# EXPLICIT list: deriving it from GATES would let a deleted gate shrink
+# the guard along with the gate it was guarding.
+SMOKE_GATES = frozenset({
+    "query_batched_qps", "trace_overhead_pct", "span_overhead_pct",
+    "devicewatch_overhead_pct", "rules_overhead_pct",
+    "cluster_obs_overhead_pct", "conservation_overhead_pct",
+    "conservation_audit_duty_pct", "archive_query_p99_ms",
+    "archive_ring_multiple", "fairness_abuser_offered_admitted_ratio",
+    "cluster_events_total", "cluster_scrape_ranks",
+    "devicewatch_excess_retraces", "fairness_admitted_loss",
+    "cluster_steady_recompiles", "conservation_headline_violations",
+    "conservation_fairness_violations", "conservation_rules_violations",
+    "conservation_chaos_violations", "conservation_cluster_violations",
+    "shard_smoke_stores_equal", "groupcommit_smoke_amortized",
+    "groupcommit_smoke_no_loss", "query_batch_parity", "archive_parity",
+    "archive_pruning_fires", "replication_smoke_failover_ok",
+    "replication_smoke_no_loss", "rules_metrics_equal",
+    "rules_alert_parity", "rules_rollup_parity", "rules_chaos_no_loss",
+    "rules_chaos_no_dup", "fairness_isolation_ok",
+    "cluster_chaos_no_loss", "cluster_scrape_has_slo",
+    "devicewatch_ledger_reconciles",
+    "placement_overhead_pct", "placement_handoff_no_loss",
+    "placement_no_dual_apply", "placement_victim_isolation_ok",
+    "placement_moves_completed", "conservation_placement_violations",
+})
 
 
 def gate_passes(kind: str, threshold, value, run: dict | None = None) -> bool:

@@ -72,6 +72,7 @@ import json
 import logging
 import threading
 import time
+from pathlib import Path as pathlib_Path
 from typing import Any
 
 from sitewhere_tpu.core.events import EpochBase
@@ -113,7 +114,17 @@ def owner_rank(token: str, n_ranks: int) -> int:
 
 @dataclasses.dataclass
 class ClusterConfig:
-    """One rank's view of the cluster."""
+    """One rank's view of the cluster. ``n_ranks``/``peers`` are the
+    PROVISIONED rank set (addresses known up front, stateful-set style);
+    which ranks are ACTIVE — own tenant slots — is the placement map's
+    job (ISSUE 15): ``initial_ranks`` narrows the genesis map to a
+    subset so provisioned-but-inactive ranks can JOIN later through the
+    epoch-fenced handoff, and :func:`placement.drain_rank` retires an
+    active rank under live traffic. ``slots_per_rank`` fixes the slot
+    space at genesis (``n_slots = n_ranks * slots_per_rank``); the
+    default map is byte-identical to the legacy ``owner_rank``
+    partitioner. ``placement_dir`` persists the installed map (defaults
+    to ``<wal_dir>/placement`` when the engine journals)."""
 
     rank: int
     n_ranks: int
@@ -124,6 +135,9 @@ class ClusterConfig:
     engine: DistributedConfig = dataclasses.field(
         default_factory=DistributedConfig)
     connect_timeout_s: float = 30.0
+    slots_per_rank: int = 8
+    initial_ranks: "list[int] | None" = None
+    placement_dir: "str | None" = None
 
 
 class _SyncPeer:
@@ -328,8 +342,8 @@ class _MergedDevices:
         self._c = cluster
 
     def values(self):
-        out = list(self._c.local.devices.values())
-        for r in range(self._c.n_ranks):
+        out = _owned_device_infos(self._c.local)
+        for r in self._c._data_ranks():
             if r == self._c.rank:
                 continue
             out.extend(DeviceInfo(**d) for d in
@@ -350,8 +364,8 @@ class _MergedDevices:
         return self._c.local.devices.get(key, default)
 
     def __len__(self) -> int:
-        n = len(self._c.local.devices)
-        for r in range(self._c.n_ranks):
+        n = len(_owned_device_infos(self._c.local))
+        for r in self._c._data_ranks():
             if r != self._c.rank:
                 n += self._c._peer(r).call("Cluster.deviceCount")
         return n
@@ -430,6 +444,24 @@ class ClusterEngine:
         from sitewhere_tpu.parallel.replication import PeerHealth
 
         self.health = PeerHealth()
+        # versioned tenant placement (ISSUE 15): every ownership read on
+        # this rank — facade routing, owner-side guards, fire-over,
+        # replica-ring derivation — resolves through THIS manager's
+        # installed map, so all surfaces agree on one epoch. Attached to
+        # the local engine too (the forward_queue pattern) so cluster
+        # RPC handlers reach it.
+        from sitewhere_tpu.parallel.placement import (PlacementManager,
+                                                      PlacementMap)
+
+        pdir = config.placement_dir
+        if pdir is None and config.engine.wal_dir:
+            pdir = str(pathlib_Path(config.engine.wal_dir) / "placement")
+        self.placement = PlacementManager(
+            self, PlacementMap.initial(config.n_ranks,
+                                       config.slots_per_rank,
+                                       config.initial_ranks),
+            directory=pdir)
+        self.local.placement = self.placement
         self._peers: dict[int, _SyncPeer] = {}
         self._peers_lock = threading.Lock()
         self._fid_seq = 0
@@ -469,7 +501,20 @@ class ClusterEngine:
         return res
 
     def owner(self, token: str) -> int:
-        return owner_rank(token, self.n_ranks)
+        """Owning rank per the installed PLACEMENT map (ISSUE 15): the
+        token hashes into a fixed slot, the epoch-numbered map names
+        the slot's rank. The genesis map reproduces the legacy
+        ``owner_rank(token, n_ranks)`` byte-for-byte."""
+        return self.placement.owner(token)
+
+    def _data_ranks(self) -> list[int]:
+        """Ranks a DATA fan-out must cover: every slot-owning rank plus
+        this one. A drained rank leaves this set at its commit epoch, so
+        its departure (and eventual shutdown) never fails a query; a
+        joining rank enters it with its first owned slot. Health/status
+        surfaces keep fanning over the full provisioned set — operators
+        need to see inactive ranks."""
+        return self.placement.data_ranks()
 
     def _route(self, _token: str, _local_fn, _method: str, **params):
         r = self.owner(_token)
@@ -496,11 +541,19 @@ class ClusterEngine:
         me = self.rank
         from sitewhere_tpu.native.binding import route_payloads
 
-        ranks = route_payloads(payloads, self.n_ranks,
+        # placement-era routing: the native/Python scanners hash the
+        # token into the FIXED slot space (same FNV, n_slots instead of
+        # n_ranks) and the installed map's slot->rank table resolves the
+        # owner — with this rank's fences substituted by their targets,
+        # so mid-handoff payloads head for the new owner's durable queue
+        slot_rank = self.placement.slot_routing()
+        n_slots = len(slot_rank)
+        ranks = route_payloads(payloads, n_slots,
                                binary=(kind == "binary"))
         if ranks is not None:
-            for p, r in zip(payloads, ranks.tolist()):
-                by_rank.setdefault(me if r < 0 else r, []).append(p)
+            for p, s in zip(payloads, ranks.tolist()):
+                by_rank.setdefault(me if s < 0 else slot_rank[s],
+                                   []).append(p)
             return by_rank
         from sitewhere_tpu.native.route_fallback import (route_binary_payload,
                                                          route_json_payload)
@@ -508,8 +561,8 @@ class ClusterEngine:
         route_one = (route_binary_payload if kind == "binary"
                      else route_json_payload)
         for p in payloads:
-            r = route_one(p, self.n_ranks)
-            by_rank.setdefault(me if r < 0 else r, []).append(p)
+            s = route_one(p, n_slots)
+            by_rank.setdefault(me if s < 0 else slot_rank[s], []).append(p)
         return by_rank
 
     def attach_forwarding(self, queue, registry) -> None:
@@ -583,8 +636,29 @@ class ClusterEngine:
         self._fid_seq += 1
         return f"{self.rank}-{time.time_ns()}-{self._fid_seq}"
 
+    def _adopt_redirect_map(self, e, replier: int) -> None:
+        """Converge placement from a ``code=473`` redirect: adopt the
+        replier's attached map when its epoch is newer; when OURS is
+        newer (the replier missed the commit broadcast), push it so the
+        next delivery lands. Either way the higher epoch wins — epochs
+        only move forward."""
+        data = getattr(e, "data", None) or {}
+        peer_map = data.get("map")
+        if peer_map is None:
+            return
+        my_epoch = self.placement.epoch
+        if int(peer_map.get("epoch", 0)) > my_epoch:
+            self.placement.install(peer_map)
+        elif int(peer_map.get("epoch", 0)) < my_epoch:
+            try:
+                self._peer(replier).call(
+                    "Placement.install",
+                    map=self.placement.map().to_dict())
+            except (ConnectionError, TimeoutError):
+                pass
+
     def _forward_batch(self, r: int, kind: str, plist: list[bytes],
-                       tenant: str) -> dict:
+                       tenant: str, _redirected: bool = False) -> dict:
         """One remote sub-batch. With a forward queue attached, delivery
         is durable: tagged for owner-side dedup, spilled on failure
         (returned as {"spilled": n}) instead of raising mid-batch with
@@ -599,17 +673,33 @@ class ClusterEngine:
             # its full byte payload at every recursion level
             mid = len(plist) // 2
             return _merge_counts([
-                self._forward_batch(r, kind, plist[:mid], tenant),
-                self._forward_batch(r, kind, plist[mid:], tenant)])
+                self._forward_batch(r, kind, plist[:mid], tenant,
+                                    _redirected),
+                self._forward_batch(r, kind, plist[mid:], tenant,
+                                    _redirected)])
         hop = _cluster_instruments()["forward_hop"]
         if self.forward_queue is None:
+            from sitewhere_tpu.parallel.placement import REDIRECT_CODE
+
             method = ("Cluster.ingestJson" if kind == "json"
                       else "Cluster.ingestBinary")
             with self.local.tracer.begin("forward.hop", dst=r,
                                          payloads=len(plist)):
                 t0 = time.perf_counter()
-                res = self._peer(r).call(method, lens=lens, tenant=tenant,
-                                         _attachment=b"".join(plist))
+                try:
+                    res = self._peer(r).call(method, lens=lens,
+                                             tenant=tenant,
+                                             _attachment=b"".join(plist))
+                except RpcError as e:
+                    if (getattr(e, "code", None) != REDIRECT_CODE
+                            or _redirected):
+                        raise
+                    # ownership moved under us (no durable queue to
+                    # spill into): adopt the replier's map and re-route
+                    # the sub-batch once through the normal partitioner
+                    self._adopt_redirect_map(e, r)
+                    return self._ingest_routed(plist, tenant, kind,
+                                               _redirected=True)
                 hop.observe(time.perf_counter() - t0, dst=str(r))
             return res
         fid = self._next_fid()
@@ -645,6 +735,48 @@ class ClusterEngine:
                                          payloads=plist)
                 return {"spilled": len(plist)}
             except RpcError as e:
+                from sitewhere_tpu.parallel.placement import REDIRECT_CODE
+
+                if getattr(e, "code", None) == REDIRECT_CODE:
+                    # ownership redirect (ISSUE 15). MOVED (map
+                    # attached): adopt the newer epoch and spill each
+                    # payload group toward its CURRENT owner — the
+                    # mid-flight re-route. FENCED (commit in flight):
+                    # spill back to the same rank with the owner's
+                    # short defer; the post-commit redelivery gets the
+                    # map and re-routes then.
+                    self._adopt_redirect_map(e, r)
+                    data = getattr(e, "data", None) or {}
+                    if data.get("fenced"):
+                        hop_sp.annotate(error="fence_473", spilled=True)
+                        self.forward_queue.spill(
+                            r, kind, tenant, fid, payloads=plist,
+                            defer_s=getattr(e, "retry_after_s", None)
+                            or 0.05)
+                        return {"spilled": len(plist),
+                                "fence_deferred": len(plist)}
+                    hop_sp.annotate(error="redirect_473", spilled=True)
+                    out = {"redirected": len(plist)}
+                    local_ingest = (self.local.ingest_json_batch
+                                    if kind == "json"
+                                    else self.local.ingest_binary_batch)
+                    for r2, pl2 in self._partition_payloads(
+                            plist, kind=kind).items():
+                        if r2 == self.rank:
+                            # a drain moved the slot TO this rank: the
+                            # redirected share is ours now — apply it
+                            # (under the ingest gate, so a fence racing
+                            # in cannot slip this apply past its tail)
+                            with self.placement.ingest_gate():
+                                out = _merge_counts(
+                                    [out, local_ingest(pl2, tenant)])
+                        else:
+                            self.forward_queue.spill(
+                                r2, kind, tenant, self._next_fid(),
+                                payloads=pl2)
+                            out["spilled"] = (out.get("spilled", 0)
+                                              + len(pl2))
+                    return out
                 if getattr(e, "code", None) == 429:
                     # owner-side load shed (ISSUE 9): the batch is
                     # already accepted at THIS edge, so it spills for
@@ -677,7 +809,7 @@ class ClusterEngine:
                 return {"spilled": len(plist)}
 
     def _ingest_routed(self, payloads: list[bytes], tenant: str,
-                       kind: str) -> dict:
+                       kind: str, _redirected: bool = False) -> dict:
         """Shared facade ingest: ONE trace spans the partition, the local
         sub-batch, and every cross-rank forward. The route record lives in
         the local rank's flight recorder; owner-side records join the same
@@ -690,44 +822,86 @@ class ClusterEngine:
 
         from sitewhere_tpu.utils.qos import ShedError
 
+        if self.placement.has_fences:
+            # a fence window is short (WAL-tail flush + verify): a batch
+            # that actually TOUCHES a fenced slot waits the fence out
+            # here — costing those payloads the fence DURATION, not a
+            # spill/redeliver round trip — while unrelated traffic sails
+            # through. On timeout the partitioner's fence-target
+            # substitution takes over and the durable queue converges
+            # the stragglers.
+            from sitewhere_tpu.parallel.placement import _payload_slots
+
+            fenced = set(self.placement.fenced_slots())
+            if fenced:
+                touched = fenced.intersection(_payload_slots(
+                    payloads, kind, self.placement.map().n_slots))
+                if touched:
+                    self.placement.wait_unfenced(list(touched),
+                                                 timeout_s=2.0)
+                    if (self.forward_queue is None
+                            and set(self.placement.fenced_slots())
+                            & touched):
+                        # no durable queue to park the frame in: answer
+                        # the caller with the typed retryable shed (REST
+                        # maps it to 429 + Retry-After) instead of a
+                        # doomed redirect loop — the handoff target
+                        # cannot accept until the commit epoch lands
+                        from sitewhere_tpu.utils.qos import ShedError
+
+                        raise ShedError(
+                            f"tenant {tenant!r}: slots {sorted(touched)}"
+                            " are mid-handoff and no durable forward "
+                            "queue is attached — retry shortly",
+                            tenant=tenant, retry_after_s=0.1,
+                            reason="handoff_fence")
         tp = current_traceparent() or new_traceparent(self.rank)
         route_rec = self.local.flight.begin(
             "route", tenant=tenant, n_payloads=len(payloads),
             traceparent=tp)
         with bind_traceparent(tp):
-            by_rank = self._partition_payloads(payloads, kind=kind)
-            route_rec.mark("commit")   # partition decided
-            local_ingest = (self.local.ingest_json_batch if kind == "json"
-                            else self.local.ingest_binary_batch)
-            qos = getattr(self.local, "qos", None)
-            local_plist = by_rank.get(self.rank)
-            if qos is not None and local_plist:
-                # the facade IS the edge for its own sub-batch, and it
-                # decides BEFORE any forward leaves this rank: a local
-                # shed refuses the whole call with a typed ShedError
-                # (REST answers 429 + Retry-After) while nothing has
-                # been applied, forwarded, or spilled yet — the caller
-                # retries the full batch. A shed decided mid-call would
-                # instead silently drop the local payloads next to
-                # remote-owned ones the forward queue durably redelivers.
-                d = qos.admit(tenant, len(local_plist))
-                if not d.admitted:
-                    raise ShedError(
-                        f"tenant {tenant!r} shed at facade "
-                        f"({d.reason}): retry after "
-                        f"{d.retry_after_s:.3f}s", tenant=tenant,
-                        retry_after_s=d.retry_after_s,
-                        reason=d.reason or "shed")
+            # the ingest gate (placement.py) spans the fence check —
+            # the partitioner — and the LOCAL engine apply: a fence
+            # registered mid-batch waits for this batch's WAL append
+            # before capturing its tail extents. Forwards run OUTSIDE
+            # the gate (they apply at their owner, under ITS gate).
             summaries = []
+            with self.placement.ingest_gate():
+                by_rank = self._partition_payloads(payloads, kind=kind)
+                route_rec.mark("commit")   # partition decided
+                local_ingest = (self.local.ingest_json_batch
+                                if kind == "json"
+                                else self.local.ingest_binary_batch)
+                qos = getattr(self.local, "qos", None)
+                local_plist = by_rank.get(self.rank)
+                if qos is not None and local_plist:
+                    # the facade IS the edge for its own sub-batch, and
+                    # it decides BEFORE any forward leaves this rank: a
+                    # local shed refuses the whole call with a typed
+                    # ShedError (REST answers 429 + Retry-After) while
+                    # nothing has been applied, forwarded, or spilled
+                    # yet — the caller retries the full batch. A shed
+                    # decided mid-call would instead silently drop the
+                    # local payloads next to remote-owned ones the
+                    # forward queue durably redelivers.
+                    d = qos.admit(tenant, len(local_plist))
+                    if not d.admitted:
+                        raise ShedError(
+                            f"tenant {tenant!r} shed at facade "
+                            f"({d.reason}): retry after "
+                            f"{d.retry_after_s:.3f}s", tenant=tenant,
+                            retry_after_s=d.retry_after_s,
+                            reason=d.reason or "shed")
+                if local_plist:
+                    summaries.append(local_ingest(local_plist, tenant,
+                                                  traceparent=tp))
             forwarded = 0
             for r, plist in by_rank.items():
                 if r == self.rank:
-                    summaries.append(local_ingest(plist, tenant,
-                                                  traceparent=tp))
-                else:
-                    forwarded += len(plist)
-                    summaries.append(self._forward_batch(r, kind, plist,
-                                                         tenant))
+                    continue
+                forwarded += len(plist)
+                summaries.append(self._forward_batch(
+                    r, kind, plist, tenant, _redirected))
             if forwarded:
                 route_rec.add("forwarded", forwarded)
                 route_rec.add("forward_ranks",
@@ -756,16 +930,59 @@ class ClusterEngine:
                             tenant: str = "default") -> dict:
         return self._ingest_routed(payloads, tenant, kind="binary")
 
-    def process(self, req) -> None:
-        r = self.owner(req.device_token)
+    def process(self, req, _redirected: bool = False) -> None:
+        tok = req.device_token
+        if self.placement.has_fences:
+            slot = self.placement.slot_of(tok)
+            self.placement.wait_unfenced([slot], timeout_s=2.0)
+            fences = self.placement.fenced_slots()
+            if slot in fences:
+                # fence outlived the wait. With a durable queue, park
+                # the envelope for the handoff TARGET with a short defer
+                # — it owns the slot at the commit epoch and the pump
+                # converges via redirects either way. Without one, the
+                # target's guard would deterministically refuse until
+                # commit, so answer the caller with the typed retryable
+                # shed instead of a doomed redirect loop.
+                if self.forward_queue is not None:
+                    from sitewhere_tpu.ingest.decoders import (
+                        envelope_from_request)
+
+                    self.forward_queue.spill(
+                        fences[slot], "envelope", req.tenant,
+                        self._next_fid(),
+                        envelope=envelope_from_request(req),
+                        defer_s=0.1)
+                    return
+                from sitewhere_tpu.utils.qos import ShedError
+
+                raise ShedError(
+                    f"device {tok!r}: slot {slot} is mid-handoff and "
+                    "no durable forward queue is attached — retry "
+                    "shortly", tenant=req.tenant, retry_after_s=0.1,
+                    reason="handoff_fence")
+            r = self.owner(tok)
+        else:
+            r = self.owner(tok)
         if r == self.rank:
-            return self.local.process(req)
+            with self.placement.ingest_gate():
+                return self.local.process(req)
+        from sitewhere_tpu.parallel.placement import REDIRECT_CODE
+        from sitewhere_tpu.rpc.protocol import RpcError
+
         from sitewhere_tpu.ingest.decoders import envelope_from_request
 
         env = envelope_from_request(req)
         if self.forward_queue is None:
-            self._peer(r).call("Cluster.processEnvelope", envelope=env,
-                               tenant=req.tenant)
+            try:
+                self._peer(r).call("Cluster.processEnvelope", envelope=env,
+                                   tenant=req.tenant)
+            except RpcError as e:
+                if (getattr(e, "code", None) != REDIRECT_CODE
+                        or _redirected):
+                    raise
+                self._adopt_redirect_map(e, r)
+                return self.process(req, _redirected=True)
             return
         fid = self._next_fid()
         if self.forward_queue.circuit_open(r):
@@ -779,6 +996,15 @@ class ClusterEngine:
             self.forward_queue.trip(r)
             self.forward_queue.spill(r, "envelope", req.tenant, fid,
                                      envelope=env)
+        except RpcError as e:
+            if getattr(e, "code", None) != REDIRECT_CODE or _redirected:
+                raise
+            # ownership redirect on the synchronous single-request path:
+            # adopt the newer map and re-route once, keeping the
+            # all-or-nothing contract (a deterministic refusal at the
+            # NEW owner still reaches the caller)
+            self._adopt_redirect_map(e, r)
+            return self.process(req, _redirected=True)
         # an owner-side application error (RpcError) RAISES here, unlike
         # the batch path's spill: this is the synchronous all-or-nothing
         # single-request contract — a deterministic validation refusal
@@ -787,16 +1013,19 @@ class ClusterEngine:
         # that head-of-line blocks the peer's queue until dead-letter
 
     def _fanout_keyed(self, local_result, method: str,
-                      tolerant: bool = False, **params) -> dict:
+                      tolerant: bool = False, ranks=None,
+                      **params) -> dict:
         """Local result + the same call on every peer, keyed by rank —
         the one idiom behind flush/metrics/sweeps/status; timeout,
         parallelism, and down-peer policy live here once. ``tolerant``
         marks an unreachable peer with a ``PeerDown`` sentinel (checking
         the forward circuit first, so a known-dead peer costs nothing)
         instead of raising — the scrape surfaces must degrade, queries
-        must stay loud."""
+        must stay loud. ``ranks`` narrows the sweep (data surfaces pass
+        ``_data_ranks()`` so a drained rank's departure never fails a
+        query; status surfaces keep the full provisioned set)."""
         out = {self.rank: local_result}
-        for r in range(self.n_ranks):
+        for r in (range(self.n_ranks) if ranks is None else ranks):
             if r == self.rank:
                 continue
             if (tolerant and self.forward_queue is not None
@@ -811,15 +1040,18 @@ class ClusterEngine:
                 out[r] = PeerDown(str(e))
         return out
 
-    def _fanout(self, local_result, method: str, **params) -> list:
+    def _fanout(self, local_result, method: str, ranks=None,
+                **params) -> list:
         """List form of ``_fanout_keyed`` (local first, then peers)."""
-        return list(self._fanout_keyed(local_result, method,
+        return list(self._fanout_keyed(local_result, method, ranks=ranks,
                                        **params).values())
 
     def flush(self) -> dict:
-        """Flush every rank — after this, queries anywhere see everything
-        accepted anywhere (the test/REST consistency point)."""
-        out = self._fanout(self.local.flush(), "Cluster.flush")
+        """Flush every DATA rank — after this, queries anywhere see
+        everything accepted anywhere (the test/REST consistency
+        point)."""
+        out = self._fanout(self.local.flush(), "Cluster.flush",
+                           ranks=self._data_ranks())
         return _merge_counts([s for s in out if s])
 
     # ---------------------------------------------------------------- admin
@@ -871,7 +1103,8 @@ class ClusterEngine:
             return [a if isinstance(a, AssignmentInfo) else
                     AssignmentInfo(**a) for a in res]
         parts = self._fanout(self.local.list_assignments(None, **kw),
-                             "Cluster.listAssignments", token=None, **kw)
+                             "Cluster.listAssignments",
+                             ranks=self._data_ranks(), token=None, **kw)
         return [a if isinstance(a, AssignmentInfo) else AssignmentInfo(**a)
                 for part in parts for a in part]
 
@@ -935,7 +1168,7 @@ class ClusterEngine:
         if self.local.get_assignment(token) is not None:
             self._cache_assignment_rank(token, self.rank)
             return self.rank
-        for r in range(self.n_ranks):
+        for r in self._data_ranks():
             if r != self.rank and self._peer(r).call(
                     "Cluster.getAssignment", token=token) is not None:
                 self._cache_assignment_rank(token, r)
@@ -954,7 +1187,7 @@ class ClusterEngine:
         if a is not None:
             self._cache_assignment_rank(token, self.rank)
             return a
-        for r in range(self.n_ranks):
+        for r in self._data_ranks():
             if r != self.rank:
                 d = self._peer(r).call("Cluster.getAssignment", token=token)
                 if d is not None:
@@ -1001,8 +1234,9 @@ class ClusterEngine:
         return self._peer(r).call("Cluster.deleteAssignment", token=token)
 
     def search_device_states(self, **kw) -> list[dict]:
-        out = list(self.local.search_device_states(**kw))
-        for r in range(self.n_ranks):
+        out = self.placement.filter_rows(
+            list(self.local.search_device_states(**kw)), key="device")
+        for r in self._data_ranks():
             if r == self.rank:
                 continue
             part, err = None, None
@@ -1038,9 +1272,9 @@ class ClusterEngine:
                 "aux0/aux1 are rank-local interner ids and mean different "
                 "strings on other ranks — use command_responses() or "
                 "alternate_id instead")
-        results = [self.local.query_events(**kw)]
+        results = [_placement_filtered_query(self.local, kw)]
         stale_ms = None
-        for r in range(self.n_ranks):
+        for r in self._data_ranks():
             if r == self.rank:
                 continue
             res, err = None, None
@@ -1151,7 +1385,8 @@ class ClusterEngine:
         per-rank BACKGROUND loop should sweep its local engine only —
         N ranks each fanning out would sweep N^2 times per interval."""
         return [t for part in self._fanout(
-            self.local.presence_sweep(), "Cluster.presenceSweep")
+            self.local.presence_sweep(), "Cluster.presenceSweep",
+            ranks=self._data_ranks())
             for t in part]
 
     def presence_sweep_local(self) -> list[str]:
@@ -1184,8 +1419,8 @@ class ClusterEngine:
 
         parts = self._fanout(
             local_command_responses(self.local, invocation_id, limit),
-            "Cluster.commandResponses", invocationId=invocation_id,
-            limit=limit)
+            "Cluster.commandResponses", ranks=self._data_ranks(),
+            invocationId=invocation_id, limit=limit)
         docs = [d for part in parts for d in part]
         docs.sort(key=event_order_key)
         return docs[:limit]
@@ -1249,11 +1484,13 @@ class ClusterEngine:
         as complete."""
         if self.search_index is None:
             return None
+        data_ranks = self._data_ranks()
         parts = self._fanout(
             self.search_index.search(query, max_results,
                                      order="eventDate"),
-            "Cluster.searchEvents", query=query, maxResults=max_results)
-        for r, part in zip([self.rank] + [r for r in range(self.n_ranks)
+            "Cluster.searchEvents", ranks=data_ranks, query=query,
+            maxResults=max_results)
+        for r, part in zip([self.rank] + [r for r in data_ranks
                                           if r != self.rank], parts):
             if part is None:
                 raise RuntimeError(
@@ -1299,7 +1536,8 @@ class ClusterEngine:
         cover the same corpus as the rank=\"all\" counters on the same
         page. Down peers degrade like metrics()."""
         keyed = self._fanout_keyed(self.local.tenant_metrics(),
-                                   "Cluster.tenantMetrics", tolerant=True)
+                                   "Cluster.tenantMetrics", tolerant=True,
+                                   ranks=self._data_ranks())
         merged: dict[str, dict[str, int]] = {}
         for res in keyed.values():
             if isinstance(res, PeerDown):
@@ -1350,7 +1588,8 @@ class ClusterEngine:
         from sitewhere_tpu.utils.conservation import conservation_payload
 
         keyed = self._fanout_keyed(conservation_payload(self),
-                                   "Cluster.conservation", tolerant=True)
+                                   "Cluster.conservation", tolerant=True,
+                                   ranks=self._data_ranks())
         by_rank: dict[str, dict] = {}
         violations = 0
         for r, res in keyed.items():
@@ -1369,7 +1608,7 @@ class ClusterEngine:
         rank's reachability + device count, and the durability gauges.
         A peer with an OPEN forward circuit reports DOWN without paying
         a connect timeout on the scrape."""
-        keyed = self._fanout_keyed(len(self.local.devices),
+        keyed = self._fanout_keyed(len(_owned_device_infos(self.local)),
                                    "Cluster.deviceCount", tolerant=True)
         ranks: dict[str, dict] = {}
         for r, res in keyed.items():
@@ -1382,7 +1621,9 @@ class ClusterEngine:
         out = {"clustered": self.n_ranks > 1, "rank": self.rank,
                "nRanks": self.n_ranks,
                "peers": list(self.cluster_config.peers), "ranks": ranks,
-               "owned_devices": len(self.local.devices)}
+               "activeRanks": self.placement.map().active_ranks(),
+               "placementEpoch": self.placement.epoch,
+               "owned_devices": len(_owned_device_infos(self.local))}
         if self.forward_queue is not None:
             out["forwarding"] = self.forward_queue.metrics()
         rep = getattr(self, "entity_replicator", None)
@@ -1492,6 +1733,44 @@ def local_rank_metrics(engine) -> dict:
     return m
 
 
+def _placement_filtered_query(engine, kw: dict) -> dict:
+    """Event query with the placement read-side filter applied (ISSUE
+    15): after a slot moves away, this rank's dead copies must not
+    double-count in fan-out merges. A device-token query for a
+    not-owned token short-circuits to an empty page (exact); a global
+    query filters its page rows and subtracts them from the total
+    (best-effort — the device-side total cannot cheaply exclude dead
+    rows, so post-move global totals are an upper bound until the
+    source compacts). Zero-cost until the first move ever lands."""
+    pm = getattr(engine, "placement", None)
+    if pm is None or not pm.ever_moved:
+        return engine.query_events(**kw)
+    tok = kw.get("device_token")
+    if tok is not None and not pm.owns_token(tok):
+        return {"total": 0, "events": []}
+    res = engine.query_events(**kw)
+    events = pm.filter_rows(res.get("events", []))
+    dropped = len(res.get("events", [])) - len(events)
+    if dropped:
+        res = dict(res, events=events,
+                   total=max(0, int(res.get("total", 0)) - dropped))
+    return res
+
+
+def _owned_device_infos(engine) -> list:
+    """This rank's device mirror restricted to tokens it still OWNS
+    (the moved-away entries stay in the mirror as dead records until
+    compaction; listing them would double-count against the new
+    owner's copy)."""
+    infos = list(engine.devices.values())
+    pm = getattr(engine, "placement", None)
+    if pm is None or not pm.ever_moved:
+        return infos
+    m = pm.map()
+    me = pm.cluster.rank
+    return [i for i in infos if m.owner(i.token) == me]
+
+
 def _owned_invocation(engine, invocation_id: int):
     """The owner-side invocation lookup (one copy for the facade's local
     branch and the Cluster.getInvocation RPC handler)."""
@@ -1582,17 +1861,47 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
                 f"after {d.retry_after_s:.3f}s", 429,
                 retry_after_s=d.retry_after_s)
 
+    def _guard_payloads(plist: list, kind: str) -> None:
+        """Owner-side placement guard (ISSUE 15): a batch containing
+        any slot this rank does not currently own (or is fencing)
+        redirects WHOLE with a typed code=473 BEFORE anything applies
+        — the no-dual-ownership half of the handoff protocol. Runs
+        before admission so a redirected batch burns no tokens."""
+        pm = getattr(engine, "placement", None)
+        if pm is not None:
+            pm.guard_payloads(plist, kind)
+
+    def _guard_tokens(tokens) -> None:
+        pm = getattr(engine, "placement", None)
+        if pm is not None:
+            pm.guard_tokens(tokens)
+
+    import contextlib
+
+    def _gate():
+        """The owner-side ingest gate (placement.py): the guard check
+        and the engine apply happen under one in-flight token, so a
+        fence registered between them waits for this batch's WAL
+        append before shipping its tail."""
+        pm = getattr(engine, "placement", None)
+        return pm.ingest_gate() if pm is not None \
+            else contextlib.nullcontext()
+
     def ingest_json(payloads: list = None, tenant: str = "default",
                     lens: list = None, _attachment: bytes = None):
         plist = _wire_payloads(payloads, lens, _attachment)
-        _admit(tenant, len(plist))
-        return engine.ingest_json_batch(plist, tenant)
+        with _gate():
+            _guard_payloads(plist, "json")
+            _admit(tenant, len(plist))
+            return engine.ingest_json_batch(plist, tenant)
 
     def ingest_binary(payloads: list = None, tenant: str = "default",
                       lens: list = None, _attachment: bytes = None):
         plist = _wire_payloads(payloads, lens, _attachment)
-        _admit(tenant, len(plist))
-        return engine.ingest_binary_batch(plist, tenant)
+        with _gate():
+            _guard_payloads(plist, "binary")
+            _admit(tenant, len(plist))
+            return engine.ingest_binary_batch(plist, tenant)
 
     def ingest_forward(fid: str, payloads: list = None,
                        tenant: str = "default", encoding: str = "json",
@@ -1620,11 +1929,13 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
                                  for p in plist]})
                 return {"stale_forward": len(plist)}
         plist = _wire_payloads(payloads, lens, _attachment)
-        _admit(tenant, len(plist))
-        if encoding == "binary":
-            summary = engine.ingest_binary_batch(plist, tenant)
-        else:
-            summary = engine.ingest_json_batch(plist, tenant)
+        with _gate():
+            _guard_payloads(plist, encoding)
+            _admit(tenant, len(plist))
+            if encoding == "binary":
+                summary = engine.ingest_binary_batch(plist, tenant)
+            else:
+                summary = engine.ingest_json_batch(plist, tenant)
         if reg is not None:
             reg.record(fid)
         return summary
@@ -1634,8 +1945,10 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
 
         req = request_from_envelope(envelope)
         req.tenant = tenant
-        _admit(tenant, 1)
-        engine.process(req)
+        with _gate():
+            _guard_tokens([req.device_token])
+            _admit(tenant, 1)
+            engine.process(req)
         return {"accepted": True}
 
     def forward_envelope(fid: str, envelope: dict,
@@ -1657,12 +1970,14 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def register_device(token: str, deviceType: str = None,
                         tenant: str = "default", area: str = None,
                         customer: str = None, metadata: dict = None):
+        _guard_tokens([token])
         engine.register_device(token, deviceType, tenant, area, customer,
                                metadata)
         return {"registered": True}
 
     def update_device(token: str, deviceType: str = None, area: str = None,
                       customer: str = None, metadata: dict = None):
+        _guard_tokens([token])
         try:
             engine.update_device(token, deviceType, area, customer, metadata)
         except KeyError:
@@ -1670,6 +1985,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         return {"updated": True}
 
     def delete_device(token: str):
+        _guard_tokens([token])
         return engine.delete_device(token)
 
     def get_device(token: str):
@@ -1686,6 +2002,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
     def create_assignment(deviceToken: str, token: str = None,
                           asset: str = None, area: str = None,
                           customer: str = None, metadata: dict = None):
+        _guard_tokens([deviceToken])
         return dataclasses.asdict(engine.create_assignment(
             deviceToken, token, asset, area, customer, metadata))
 
@@ -1708,19 +2025,23 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         return engine.delete_assignment(token)
 
     def search_device_states(**kw):
-        return engine.search_device_states(**kw)
+        rows = engine.search_device_states(**kw)
+        pm = getattr(engine, "placement", None)
+        if pm is not None:
+            rows = pm.filter_rows(rows, key="device")
+        return rows
 
     def query_events(**kw):
-        return engine.query_events(**kw)
+        return _placement_filtered_query(engine, kw)
 
     def get_event(eventId: int, tenant: str = None):
         return engine.get_event(eventId, tenant=tenant)
 
     def list_device_infos():
-        return [dataclasses.asdict(i) for i in engine.devices.values()]
+        return [dataclasses.asdict(i) for i in _owned_device_infos(engine)]
 
     def device_count():
-        return len(engine.devices)
+        return len(_owned_device_infos(engine))
 
     def metrics():
         return local_rank_metrics(engine)
@@ -1849,4 +2170,7 @@ def build_cluster_rpc(engine: DistributedEngine, secret: str):
     jwt = JwtService(secret=secret.encode(), expiration_s=24 * 3600)
     srv = RpcServer(authenticator=jwt.validate)
     register_cluster_rpc(srv, engine)
+    from sitewhere_tpu.parallel.placement import register_placement_rpc
+
+    register_placement_rpc(srv, engine)
     return srv

@@ -29,15 +29,25 @@ snapshot dirs (``run_rank(snapshot_dir=...)`` with fresh WALs), then
 ``replay_wal_tails`` the old post-snapshot WAL tails through the live
 cluster. Pruned WALs are fine — snapshot + archive carry everything the
 pruned span held.
+
+Since ISSUE 15 this OFFLINE path is the DISASTER-RECOVERY route, not
+the day-to-day one: live rank join/drain and tenant rebalancing run
+through ``parallel/placement.py`` (epoch-fenced online handoff, zero
+downtime). Reach for this module when the slot space itself must change
+(``slots_per_rank`` regrets), when WALs were pruned past what an online
+move may replay, or when the cluster is down anyway.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import pathlib
 import types
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from sitewhere_tpu.core.types import NULL_ID, EventType
 from sitewhere_tpu.parallel.cluster import owner_rank
@@ -689,15 +699,61 @@ def replay_wal_tails(cluster, old_snap_dirs, old_wal_dirs) -> int:
     ``replay_wal_through``, a pruned WAL is fine here: everything at or
     below the snapshot watermark is already carried by the migrated
     snapshot + archive, so only records past the watermark replay (and a
-    pruned-away span below it was, by definition, snapshot-covered)."""
+    pruned-away span below it was, by definition, snapshot-covered).
+
+    Fails LOUDLY BUT GRACEFULLY on bad inputs: every (snapshot, WAL)
+    pair is validated BEFORE the first record replays, so a missing
+    snapshot manifest or a missing/unreadable WAL directory raises with
+    nothing applied — never mid-loop with earlier ranks' tails already
+    in the new cluster (a half-applied migration the operator cannot
+    safely re-run). A WAL directory that EXISTS but holds no segments
+    (pruned to nothing after the snapshot — a supported state) is a
+    zero-record tail: it logs a warning and replays nothing."""
     from sitewhere_tpu.utils.checkpoint import replay_records
     from sitewhere_tpu.utils.ingestlog import IngestLog
 
+    # materialize ONCE: generator arguments must not be exhausted by the
+    # length check (a silently-empty zip afterwards would be exactly the
+    # dropped-tail failure this validation exists to prevent)
+    old_snap_dirs = list(old_snap_dirs)
+    old_wal_dirs = list(old_wal_dirs)
+    if len(old_snap_dirs) != len(old_wal_dirs):
+        raise ValueError(
+            f"{len(old_snap_dirs)} snapshot dirs vs "
+            f"{len(old_wal_dirs)} WAL dirs — one WAL tail per "
+            "old rank")
+    # validate EVERYTHING up front: a failure here strands nothing
+    pairs = []
+    for i, (snap_dir, wal_dir) in enumerate(zip(old_snap_dirs,
+                                                old_wal_dirs)):
+        manifest = pathlib.Path(snap_dir) / "host_distributed.json"
+        try:
+            host = json.loads(manifest.read_text())
+        except OSError as e:
+            raise ValueError(
+                f"old rank {i}: snapshot manifest {manifest} "
+                f"unreadable ({e}) — nothing was replayed") from e
+        if wal_dir is None:
+            raise ValueError(
+                f"old rank {i}: WAL dir is None — pass the rank's WAL "
+                "directory (an empty one is fine; a missing one is "
+                "not). Nothing was replayed")
+        wpath = pathlib.Path(wal_dir)
+        if not wpath.is_dir():
+            raise ValueError(
+                f"old rank {i}: WAL dir {wpath} does not exist — a "
+                "wrong path here would silently drop the rank's "
+                "post-snapshot tail. Nothing was replayed")
+        if not sorted(wpath.glob("segment-*.log")):
+            logger.warning(
+                "old rank %d: WAL dir %s holds no segments (pruned to "
+                "nothing after the snapshot) — zero-record tail", i,
+                wpath)
+        pairs.append((host, wpath))
+
     total = 0
-    for snap_dir, wal_dir in zip(old_snap_dirs, old_wal_dirs):
-        host = json.loads((pathlib.Path(snap_dir) /
-                           "host_distributed.json").read_text())
-        wal = IngestLog(wal_dir, readonly=True)
+    for host, wpath in pairs:
+        wal = IngestLog(wpath, readonly=True)
         try:
             total += replay_records(wal, cluster.ingest_json_batch,
                                     cluster.ingest_binary_batch,

@@ -287,11 +287,11 @@ class EntityReplicator:
         # this with the failure-aware predicate (dead owner -> first
         # live follower fires, with fencing).
         if self.cluster.n_ranks > 1:
-            from sitewhere_tpu.parallel.cluster import owner_rank
-
+            # ownership through the facade's PLACEMENT map (ISSUE 15) —
+            # the same epoch the ingest router and fire-over read, so a
+            # moved schedule token fires at exactly one rank
             inst.scheduler.fire_filter = (
-                lambda tok: owner_rank(tok, self.cluster.n_ranks)
-                == self.rank)
+                lambda tok: self.cluster.owner(tok) == self.rank)
             # replicate fired state (fired_count/last_fired_ms) so a
             # recovered owner never re-fires a window its follower
             # already covered

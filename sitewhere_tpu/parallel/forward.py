@@ -221,7 +221,13 @@ class ForwardQueue:
                          "redelivered_batches": 0, "deadlettered_batches": 0,
                          "retry_failures": 0, "retry_app_rejects": 0,
                          "retry_transport_failures": 0,
-                         "deadlettered_poison": 0}
+                         "deadlettered_poison": 0,
+                         # placement redirects (ISSUE 15): 473 replies
+                         # seen by the pump, and originals CONSUMED by a
+                         # re-route (their payloads re-spill toward the
+                         # new owner — a legal terminal disposition in
+                         # the conservation forward-queue equation)
+                         "retry_redirects": 0, "rerouted_batches": 0}
         self._seq = 0
         self._lock = threading.Lock()
         self._thread: threading.Thread | None = None
@@ -364,6 +370,32 @@ class ForwardQueue:
                     self._deliver(rank, rec)
                     self.reset(rank)
                 except RpcError as e:
+                    from sitewhere_tpu.parallel.placement import (
+                        REDIRECT_CODE)
+
+                    if getattr(e, "code", None) == REDIRECT_CODE:
+                        # placement redirect (ISSUE 15): the owner moved
+                        # (or is fencing) while this frame sat spilled.
+                        # A MOVED redirect carries the replier's map —
+                        # adopt it and RE-ROUTE the frame toward the
+                        # current owner(s); a FENCED redirect defers
+                        # like a 429 (the commit lands within the fence
+                        # window, and the next pass gets the map).
+                        # Never the poison budget: the batch is fine,
+                        # the address changed.
+                        self.counters["retry_redirects"] += 1
+                        data = getattr(e, "data", None) or {}
+                        adopt = getattr(self.cluster,
+                                        "_adopt_redirect_map", None)
+                        if adopt is not None:
+                            adopt(e, rank)
+                        if data.get("fenced") or "map" not in data:
+                            ra = (getattr(e, "retry_after_s", None)
+                                  or self.retry_interval_s)
+                            self._defer[path.name] = time.monotonic() + ra
+                            continue
+                        self._reroute(path, rec)
+                        continue
                     self.counters["retry_failures"] += 1
                     self.counters["retry_app_rejects"] += 1
                     if getattr(e, "code", None) == 429:
@@ -402,6 +434,48 @@ class ForwardQueue:
                 redelivered += 1
                 self.counters["redelivered_batches"] += 1
         return redelivered
+
+    def _reroute(self, path: pathlib.Path, rec: dict) -> None:
+        """Re-route one spilled frame to its CURRENT owner(s) per the
+        facade's installed placement map (ISSUE 15): payload batches
+        re-partition (a mixed batch may split across owners — each
+        share re-spills as a fresh durable record with a fresh forward
+        id), envelopes route by their device token. The original file
+        is CONSUMED by the re-route (``rerouted_batches``), never
+        silently dropped — the conservation forward-queue equation
+        counts re-route as a legal terminal disposition alongside
+        redelivery and dead-letter."""
+        from sitewhere_tpu.utils.tracing import bind_traceparent
+
+        cluster = self.cluster
+        with bind_traceparent(rec.get("tp")):
+            if rec["kind"] == "envelope":
+                tok = (rec.get("envelope") or {}).get("deviceToken")
+                owner = (cluster.owner(tok) if tok else None)
+                if owner is None:
+                    # unroutable: dead-letter preserves it (an acked
+                    # frame must never silently vanish)
+                    self._deadletter(path)
+                    return
+                # owner == this rank (a drain moved the slot HERE) is
+                # fine: the self-spill redelivers over the loopback
+                # Cluster.forwardEnvelope exactly like the batch branch
+                self.spill(owner, "envelope", rec["tenant"],
+                           cluster._next_fid(), envelope=rec["envelope"])
+            else:
+                payloads = [base64.b64decode(p) for p in rec["payloads"]]
+                for r2, pl2 in cluster._partition_payloads(
+                        payloads, kind=rec["kind"]).items():
+                    self.spill(r2, rec["kind"], rec["tenant"],
+                               cluster._next_fid(), payloads=pl2)
+        self._attempts.pop(path.name, None)
+        self._defer.pop(path.name, None)
+        path.unlink()
+        self.counters["rerouted_batches"] += 1
+        logger.info("spilled forward %s re-routed per placement epoch "
+                    "%d", path.name,
+                    getattr(getattr(cluster, "placement", None),
+                            "epoch", -1))
 
     def _deadletter(self, path: pathlib.Path) -> None:
         dl = self.dir / "deadletter"

@@ -949,13 +949,15 @@ def install_fireover(scheduler, cluster) -> None:
     alive, at its first live follower while it is dead (with missed-
     window catch-up), and never at both (fencing + replicated fired
     state)."""
-    from sitewhere_tpu.parallel.cluster import owner_rank
-
-    n = cluster.n_ranks
     me = cluster.rank
 
     def fire_filter(token: str) -> bool:
-        owner = owner_rank(token, n)
+        # ownership resolves through the PLACEMENT map (ISSUE 15), the
+        # same epoch every other surface reads — a moved schedule token
+        # fires at its new owner from the commit epoch on, and never at
+        # both (the map is installed atomically per rank and a lower
+        # epoch is never adopted)
+        owner = cluster.owner(token)
         if owner == me:
             feed = cluster.replica_feed
             return feed is None or feed.can_fire()
@@ -963,7 +965,7 @@ def install_fireover(scheduler, cluster) -> None:
         return applier is not None and applier.should_fire_over(owner)
 
     def catchup_filter(token: str) -> bool:
-        owner = owner_rank(token, n)
+        owner = cluster.owner(token)
         applier = cluster.replica_applier
         return (owner != me and applier is not None
                 and applier.in_catchup(owner))

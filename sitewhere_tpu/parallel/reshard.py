@@ -22,6 +22,12 @@ Notes:
     refers to the old cursor line and is preserved in the host manifest,
     so recovery replays the same tail, but new watermarks should not be
     appended to the old log.
+  * Since ISSUE 15 the OFFLINE snapshot paths (this module and
+    cluster_reshard.py) are the DISASTER-RECOVERY route: live topology
+    changes — rank join/drain, tenant rebalancing — run online through
+    parallel/placement.py with zero downtime. Use the offline route when
+    the cluster is down anyway, or when pruned WALs rule out the online
+    handoff's replay-based catch-up.
 """
 
 from __future__ import annotations

@@ -79,7 +79,8 @@ class RpcClient:
                 if "error" in frame:
                     fut.set_exception(
                         RpcError(frame["error"], frame.get("code", 500),
-                                 retry_after_s=frame.get("retryAfterS")))
+                                 retry_after_s=frame.get("retryAfterS"),
+                                 data=frame.get("data")))
                 else:
                     fut.set_result(frame.get("result"))
         except asyncio.CancelledError:

@@ -44,13 +44,18 @@ class RpcError(Exception):
     ``retry_after_s`` rides error frames as ``retryAfterS`` for
     ``code=429`` load-shed rejects (ISSUE 9): the sender's retry
     machinery honors the OWNER's backoff hint instead of inventing its
-    own."""
+    own. ``data`` is an optional JSON-serializable payload riding error
+    frames as ``data`` — the placement plane (ISSUE 15) uses it to ship
+    the replier's placement map on ``code=473`` ownership redirects so a
+    stale sender can re-route mid-flight without another round trip."""
 
     def __init__(self, message: str, code: int = 500,
-                 retry_after_s: float | None = None):
+                 retry_after_s: float | None = None,
+                 data: dict | None = None):
         super().__init__(message)
         self.code = code
         self.retry_after_s = retry_after_s
+        self.data = data
 
 
 def _default(o):

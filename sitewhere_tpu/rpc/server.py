@@ -218,6 +218,8 @@ class RpcServer:
             resp = {"id": rid, "error": str(e), "code": e.code}
             if getattr(e, "retry_after_s", None) is not None:
                 resp["retryAfterS"] = e.retry_after_s
+            if getattr(e, "data", None) is not None:
+                resp["data"] = e.data
         except ShedError as e:
             # typed load shed from an admission edge or an arena-stall
             # translation: the RPC form of REST's 429 + Retry-After —
@@ -643,6 +645,15 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         return await asyncio.to_thread(conservation_payload, inst.engine,
                                        inst.rules)
 
+    async def placement():
+        """Elastic-placement posture (ISSUE 15) — the RPC twin of GET
+        /api/instance/placement. Off-loop: the payload takes the
+        manager lock."""
+        pm = getattr(inst.engine, "placement", None)
+        if pm is None:
+            return {"clustered": False}
+        return await asyncio.to_thread(pm.payload)
+
     # --- streaming rules & rollups (ISSUE 13; RPC twins of /api/rules) ----
     async def rules_status():
         return await asyncio.to_thread(inst.rules.status)
@@ -716,6 +727,7 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         "Instance.clusterMetrics": cluster_metrics,
         "Instance.deviceMemory": device_memory,
         "Instance.conservation": conservation,
+        "Instance.placement": placement,
         "Rules.getStatus": rules_status,
         "Rules.setRuleSet": rules_set,
         "Rules.poll": rules_poll,

@@ -52,9 +52,16 @@ Conservation equations (the contract future PRs must keep balanced):
                         to the total
   wal-durability        0 <= durable_seq <= appended_seq (the group
                         commit window is the only legal gap)
-  forward-queue         spilled == redelivered + deadlettered + depth
-                        (dead-letter is the ONLY legal sink; a spilled
-                        batch never just disappears)
+  forward-queue         spilled == redelivered + deadlettered +
+                        rerouted + depth (dead-letter and placement
+                        re-route are the ONLY legal sinks; a spilled
+                        batch never just disappears. Re-route — ISSUE
+                        15 — consumes the original and re-spills its
+                        payloads toward the new owner, so the re-spills
+                        count as fresh ``spilled`` while the consumed
+                        original lands in ``rerouted``: the handoff
+                        slack term that keeps the equation balanced
+                        across a live migration)
   replication-feed      published == feed_seq and every follower's
                         acked <= feed_seq (slack: un-acked in-flight
                         publications; an un-resynced standby gap shows
@@ -65,6 +72,12 @@ Conservation equations (the contract future PRs must keep balanced):
                         only legal when the archive counted them)
   rules-harvest         harvested == emitted + suppressed + skipped,
                         and device missed <= fires, pending >= 0
+  placement-handoff     moves_started == moves_completed +
+                        moves_aborted + moves_in_flight (ISSUE 15: a
+                        handoff always terminates in exactly one of
+                        commit/abort; the in-flight term is the only
+                        legal slack and is read in the same
+                        lock-consistent snapshot)
 """
 
 from __future__ import annotations
@@ -81,6 +94,7 @@ EQUATIONS = (
     "staging-balance", "device-processed", "device-disposition",
     "edge-admission", "wal-durability", "forward-queue",
     "replication-feed", "archive-spill", "rules-harvest",
+    "placement-handoff",
 )
 
 
@@ -251,9 +265,14 @@ def build_ledger(engine, rules_manager=None) -> dict:
                 "redelivered_batches": fm["forward_redelivered_batches"],
                 "deadlettered_batches":
                     fm["forward_deadlettered_batches"],
+                "rerouted_batches":
+                    fm.get("forward_rerouted_batches", 0),
                 "queue_depth": fm["forward_queue_depth"],
                 "open_circuits": fm["forward_open_circuits"],
             }
+        pm = getattr(eng, "placement", None)
+        if pm is not None:
+            stages["placement"] = pm.ledger_stage()
         feed = getattr(eng, "replica_feed", None)
         applier = getattr(eng, "replica_applier", None)
         if feed is not None or applier is not None:
@@ -316,6 +335,11 @@ def build_ledger(engine, rules_manager=None) -> dict:
     if "rules" in stages and "rollup_window_id" in stages["rules"]:
         watermarks["rollup_window_id"] = stages["rules"][
             "rollup_window_id"]
+    if "placement" in stages:
+        # the placement epoch is a monotone watermark like every other:
+        # a rank observed at a LOWER epoch than its peers is lagging
+        # the commit broadcast (redirects converge it)
+        watermarks["placement_epoch"] = stages["placement"]["epoch"]
 
     return {
         "generatedMs": int(time.time() * 1000),
@@ -402,14 +426,16 @@ def check_conservation(ledger: dict) -> list[Violation]:
             wal["durable_seq"], wal["appended_seq"])
     fwd = st.get("forward")
     if fwd:
+        rerouted = fwd.get("rerouted_batches", 0)
         rhs = (fwd["redelivered_batches"] + fwd["deadlettered_batches"]
-               + fwd["queue_depth"])
+               + rerouted + fwd["queue_depth"])
         if fwd["spilled_batches"] != rhs:
             bad("forward-queue",
                 f"spilled {fwd['spilled_batches']} != redelivered "
                 f"{fwd['redelivered_batches']} + deadlettered "
-                f"{fwd['deadlettered_batches']} + depth "
-                f"{fwd['queue_depth']}", fwd["spilled_batches"], rhs,
+                f"{fwd['deadlettered_batches']} + rerouted {rerouted} "
+                f"+ depth {fwd['queue_depth']}",
+                fwd["spilled_batches"], rhs,
                 slack=fwd["queue_depth"])
     rep = st.get("replication")
     if rep and "feed_seq" in rep:
@@ -437,6 +463,22 @@ def check_conservation(ledger: dict) -> list[Violation]:
                     f"{v['capacity']} + counted losses {lost}",
                     v["head"] - v["spilled"], v["capacity"] + lost,
                     slack=v["capacity"] + lost)
+    pl = st.get("placement")
+    if pl:
+        rhs = (pl["moves_completed"] + pl["moves_aborted"]
+               + pl["moves_in_flight"])
+        if pl["moves_started"] != rhs:
+            bad("placement-handoff",
+                f"moves_started {pl['moves_started']} != completed "
+                f"{pl['moves_completed']} + aborted "
+                f"{pl['moves_aborted']} + in_flight "
+                f"{pl['moves_in_flight']}", pl["moves_started"], rhs,
+                slack=pl["moves_in_flight"])
+        if pl.get("fenced_slots", 0) and not pl["moves_in_flight"]:
+            bad("placement-handoff",
+                f"{pl['fenced_slots']} fenced slot(s) with no move in "
+                "flight (a fence must belong to a live handoff)",
+                pl["fenced_slots"], 0)
     rules = st.get("rules")
     if rules:
         if "harvested" in rules:

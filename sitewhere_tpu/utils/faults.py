@@ -20,7 +20,16 @@ Supported rules:
   * ``drop(src, dst, prob, method_prefix)`` — the call raises
     ``ConnectionError`` with probability ``prob`` (lossy partition);
   * ``delay(src, dst, delay_s, prob, method_prefix)`` — the call sleeps
-    before proceeding (congested link / slow peer).
+    before proceeding (congested link / slow peer);
+  * ``partition(a, b, prob, method_prefix)`` — SYMMETRIC drop between a
+    rank pair: calls in EITHER direction raise ``ConnectionError`` (the
+    network-partition view, vs ``drop``'s one-directional loss) — the
+    handoff chaos matrix (ISSUE 15) partitions coordinator/source/
+    target pairs with it;
+  * ``delay_jitter(src, dst, base_s, jitter_s, prob, method_prefix)`` —
+    sleeps ``base_s`` plus a seeded draw in ``[0, jitter_s)`` (jittery
+    congested link). The jitter draws from the SAME seeded stream as
+    the probabilistic rules, so a chaos run replays exactly.
 """
 
 from __future__ import annotations
@@ -35,17 +44,26 @@ _ANY = -1
 
 @dataclasses.dataclass
 class _Rule:
-    kind: str                      # "drop" | "delay"
+    kind: str          # "drop" | "delay" | "partition" | "delay_jitter"
     src: int = _ANY
     dst: int = _ANY
     prob: float = 1.0
     delay_s: float = 0.0
+    jitter_s: float = 0.0
     method_prefix: str = ""
 
     def matches(self, src: int, dst: int, method: str) -> bool:
+        if not method.startswith(self.method_prefix):
+            return False
+        if self.kind == "partition":
+            # symmetric: the (a, b) pair matches either direction
+            fwd = ((self.src == _ANY or self.src == src)
+                   and (self.dst == _ANY or self.dst == dst))
+            rev = ((self.src == _ANY or self.src == dst)
+                   and (self.dst == _ANY or self.dst == src))
+            return fwd or rev
         return ((self.src == _ANY or self.src == src)
-                and (self.dst == _ANY or self.dst == dst)
-                and method.startswith(self.method_prefix))
+                and (self.dst == _ANY or self.dst == dst))
 
 
 class FaultPlan:
@@ -76,6 +94,26 @@ class FaultPlan:
                                 method_prefix=method_prefix))
         return self
 
+    def partition(self, a: int, b: int, prob: float = 1.0,
+                  method_prefix: str = "") -> "FaultPlan":
+        """Symmetric drop between ranks ``a`` and ``b``: every call in
+        either direction fails like a severed link (ISSUE 15 chaos
+        matrix; ``drop`` stays one-directional)."""
+        self.rules.append(_Rule("partition", a, b, prob,
+                                method_prefix=method_prefix))
+        return self
+
+    def delay_jitter(self, src: int = _ANY, dst: int = _ANY,
+                     base_s: float = 0.02, jitter_s: float = 0.05,
+                     prob: float = 1.0,
+                     method_prefix: str = "") -> "FaultPlan":
+        """Seeded jittery delay: ``base_s`` plus a deterministic draw
+        in ``[0, jitter_s)`` from the plan's RNG stream — same seed,
+        same sleep sequence."""
+        self.rules.append(_Rule("delay_jitter", src, dst, prob, base_s,
+                                jitter_s, method_prefix=method_prefix))
+        return self
+
 
 class FaultInjector:
     """Evaluates a plan on the peer-call path. Thread-safe: the RNG draw
@@ -86,7 +124,8 @@ class FaultInjector:
         self.plan = plan
         self._rng = random.Random(plan.seed)
         self._lock = threading.Lock()
-        self.counters = {"dropped": 0, "delayed": 0, "killed_refused": 0}
+        self.counters = {"dropped": 0, "delayed": 0, "killed_refused": 0,
+                         "partitioned": 0, "jitter_delayed": 0}
 
     def _draw(self) -> float:
         with self._lock:
@@ -108,9 +147,17 @@ class FaultInjector:
                 raise ConnectionError(
                     f"fault injection: dropped {method} "
                     f"rank {src}->{dst}")
+            if rule.kind == "partition":
+                self.counters["partitioned"] += 1
+                raise ConnectionError(
+                    f"fault injection: partition {rule.src}<->{rule.dst} "
+                    f"severed {method} rank {src}->{dst}")
             if rule.kind == "delay":
                 self.counters["delayed"] += 1
                 time.sleep(rule.delay_s)
+            if rule.kind == "delay_jitter":
+                self.counters["jitter_delayed"] += 1
+                time.sleep(rule.delay_s + self._draw() * rule.jitter_s)
             return   # first match wins
 
 

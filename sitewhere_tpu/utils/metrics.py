@@ -503,6 +503,51 @@ def replication_metrics(registry: MetricsRegistry | None = None) -> dict:
     }
 
 
+def placement_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """Elastic-placement instruments (ISSUE 15). Kept OUT of
+    engine.metrics() (dispatch-shape equality) like every plane before
+    it; the federated scrape re-labels them per rank:
+
+      swtpu_placement_epoch            the rank's installed map epoch
+                                       (a lagging rank is visible as a
+                                       lower epoch than its peers)
+      swtpu_placement_moves_total      handoffs by terminal state,
+                                       labeled started|completed|aborted
+      swtpu_placement_redirects_total  fenced-write + stale-sender 473
+                                       redirects served by this rank's
+                                       owner-side guard, labeled by kind
+      swtpu_placement_fenced_slots     slots currently fenced here
+                                       (nonzero only mid-handoff)
+    """
+    reg = registry or REGISTRY
+    return {
+        "epoch": reg.gauge(
+            "swtpu_placement_epoch",
+            "installed placement map epoch on this rank"),
+        "moves": reg.counter(
+            "swtpu_placement_moves_total",
+            "placement handoffs by state (started/completed/aborted)"),
+        "redirects": reg.counter(
+            "swtpu_placement_redirects_total",
+            "fenced-write and stale-sender ownership redirects served"),
+        "fenced": reg.gauge(
+            "swtpu_placement_fenced_slots",
+            "slots currently fenced on this rank (mid-handoff only)"),
+    }
+
+
+def export_placement_metrics(engine, registry: MetricsRegistry | None
+                             = None) -> None:
+    """Scrape-time export of the placement posture gauges (the move /
+    redirect counters increment live on their paths)."""
+    pm = getattr(engine, "placement", None)
+    if pm is None:
+        return
+    inst = placement_metrics(registry)
+    inst["epoch"].set(pm.epoch)
+    inst["fenced"].set(len(pm.fenced_slots()))
+
+
 def slo_metrics(registry: MetricsRegistry | None = None) -> dict:
     """The SLO latency plane (ISSUE 7): per-tenant end-to-end ingest
     latency harvested from flight-recorder lifecycle records at SCRAPE
@@ -756,6 +801,7 @@ def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
     for key in stale:
         g.set(0, **dict(key))
     export_observability_metrics(engine, reg)
+    export_placement_metrics(engine, reg)
 
 
 def export_observability_metrics(engine, registry: MetricsRegistry | None

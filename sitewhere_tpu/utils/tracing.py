@@ -706,6 +706,15 @@ def debug_bundle(engine) -> dict:
     fq = getattr(engine, "forward_queue", None)
     if fq is not None:
         bundle["forward"] = fq.metrics()
+    # elastic placement (ISSUE 15): the installed map epoch, per-range
+    # handoff state, and the guard counters — the first stop when "why
+    # did this write redirect" comes up mid-migration
+    pm = getattr(engine, "placement", None)
+    if pm is not None:
+        try:
+            bundle["placement"] = pm.payload()
+        except Exception as e:
+            bundle["placement"] = {"error": repr(e)}
     qos = getattr(engine, "qos", None)
     if qos is not None:
         bundle["qos"] = {"shedThreshold": qos.shed_threshold,

@@ -379,6 +379,45 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_get("/api/instance/conservation", conservation_doc)
 
+    async def placement_doc(request: web.Request):
+        """Elastic-placement posture (ISSUE 15): the installed map
+        (epoch, slot assignment, active ranks), this rank's fences and
+        in-flight handoffs, and the guard counters. 404s on a
+        non-clustered engine — placement is a cluster concept."""
+        pm = getattr(inst.engine, "placement", None)
+        if pm is None:
+            raise web.HTTPNotFound(text="engine is not clustered")
+        return json_response(await asyncio.to_thread(pm.payload))
+
+    async def placement_move(request: web.Request):
+        """Operator move: ``{"slots": [..], "target": rank}`` runs the
+        full epoch-fenced handoff (catch-up, fence, verify, commit)
+        and returns its per-move stats. ``{"drain": rank}`` hands off
+        EVERY slot the rank owns; ``{"join": rank}`` moves a
+        provisioned-but-inactive rank an even share. Off-loop: a
+        handoff replays WAL history."""
+        from sitewhere_tpu.parallel.placement import (drain_rank,
+                                                      join_rank,
+                                                      move_slots)
+
+        pm = getattr(inst.engine, "placement", None)
+        if pm is None:
+            raise web.HTTPNotFound(text="engine is not clustered")
+        body = await request.json()
+        if "drain" in body:
+            return json_response(await asyncio.to_thread(
+                drain_rank, inst.engine, int(body["drain"])))
+        if "join" in body:
+            return json_response(await asyncio.to_thread(
+                join_rank, inst.engine, int(body["join"]),
+                body.get("share")))
+        return json_response(await asyncio.to_thread(
+            move_slots, inst.engine, list(body["slots"]),
+            int(body["target"])))
+
+    r.add_get("/api/instance/placement", placement_doc)
+    r.add_post("/api/instance/placement/move", placement_move)
+
     async def debug_bundle_doc(request: web.Request):
         """One self-contained JSON snapshot for offline triage: config,
         metrics (dict + strict-0.0.4 exposition), recent flights, the

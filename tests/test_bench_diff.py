@@ -84,3 +84,30 @@ def test_unreadable_input_is_usage_error(tmp_path):
          str(tmp_path / "missing2.json")],
         capture_output=True, text=True, timeout=60)
     assert res.returncode == 2
+
+
+def test_committed_bench_covers_every_smoke_gate():
+    """CI guard (ISSUE 15 satellite): the COMMITTED BENCH.json must
+    (a) pass a self-diff — every hard gate it carries still holds —
+    and (b) cover the full SMOKE_GATES set, so a gate silently dropped
+    from bench.py fails the tier-1 suite, not just the next bench run."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench_diff", SCRIPT)
+    bd = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bd)
+    bench = json.loads((REPO / "BENCH.json").read_text())
+    missing = sorted(bd.SMOKE_GATES - set(bench))
+    assert not missing, (
+        f"committed BENCH.json is missing smoke gate(s) {missing} — a "
+        "bench leg was dropped (or BENCH.json was not regenerated "
+        "after adding a gate)")
+    failures = bd.check_gates(bench, bench)
+    assert not failures, failures
+    assert bd.SMOKE_GATES <= set(bd.GATES), \
+        "SMOKE_GATES names a gate the GATES table no longer evaluates"
+    # negative control: dropping a passing gate from the 'new' run is a
+    # regression the tool itself reports
+    trimmed = dict(bench)
+    trimmed.pop("cluster_chaos_no_loss")
+    assert any("ABSENT" in f for f in bd.check_gates(bench, trimmed))

@@ -241,3 +241,82 @@ def test_pruned_wal_snapshot_archive_migration_identical_queries(tmp_path):
             for c in news:
                 c.close()
             new_host.close()
+
+
+def test_replay_wal_tails_validates_everything_before_replaying(tmp_path):
+    """Satellite regression (ISSUE 15): a missing/None WAL dir (or an
+    unreadable snapshot manifest) must fail LOUDLY with NOTHING
+    replayed — never mid-loop with earlier ranks' tails already in the
+    new cluster — while a WAL dir pruned to zero segments is a legal
+    zero-record tail."""
+
+    class _Probe:
+        """Stands in for the live cluster: records every replay call."""
+
+        def __init__(self):
+            self.calls = []
+
+        def ingest_json_batch(self, payloads, tenant="default"):
+            self.calls.append(("json", len(payloads), tenant))
+            return {"staged": len(payloads)}
+
+        def ingest_binary_batch(self, payloads, tenant="default"):
+            self.calls.append(("binary", len(payloads), tenant))
+            return {"staged": len(payloads)}
+
+        def flush(self):
+            self.calls.append(("flush",))
+            return {}
+
+    def _snap(i, cursor=0):
+        d = tmp_path / f"snap-{i}"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "host_distributed.json").write_text(
+            json.dumps({"store_cursor": cursor}))
+        return d
+
+    def _wal_with_records(i, payloads):
+        """A real (tiny) WAL so the happy path replays records."""
+        from sitewhere_tpu.engine import WAL_JSON
+        from sitewhere_tpu.utils.ingestlog import IngestLog
+
+        d = tmp_path / f"wal-{i}"
+        wal = IngestLog(d)
+        for p in payloads:
+            wal.append(WAL_JSON + b"default\x00" + p)
+        wal.flush()
+        wal.close()
+        return d
+
+    probe = _Probe()
+    good_wal = _wal_with_records(0, [b'{"deviceToken":"a"}'] * 3)
+    empty_wal = tmp_path / "wal-empty"
+    empty_wal.mkdir()
+
+    # missing WAL dir: raises up front, rank 0's perfectly good tail is
+    # NOT half-applied first
+    with pytest.raises(ValueError, match="does not exist"):
+        replay_wal_tails(probe, [_snap(0), _snap(1)],
+                         [good_wal, tmp_path / "nope"])
+    assert probe.calls == []
+    # a None entry is refused the same way
+    with pytest.raises(ValueError, match="None"):
+        replay_wal_tails(probe, [_snap(0)], [None])
+    assert probe.calls == []
+    # unreadable snapshot manifest: same contract
+    bare = tmp_path / "snap-bare"
+    bare.mkdir()
+    with pytest.raises(ValueError, match="manifest"):
+        replay_wal_tails(probe, [bare], [good_wal])
+    assert probe.calls == []
+    # count mismatch is a usage error, not a silent zip-truncation
+    with pytest.raises(ValueError, match="one WAL tail per"):
+        replay_wal_tails(probe, [_snap(0), _snap(1)], [good_wal])
+
+    # happy path: a pruned-to-nothing (empty) dir is a zero-record
+    # tail and the good rank's records replay
+    n = replay_wal_tails(probe, [_snap(0), _snap(1)],
+                         [good_wal, empty_wal])
+    assert n == 3
+    assert ("flush",) in probe.calls
+    assert sum(c[1] for c in probe.calls if c[0] == "json") == 3

@@ -102,8 +102,8 @@ def test_down_owner_spills_instead_of_raising_and_redelivers(tmp_path):
         assert not check_conservation(led)
         assert led["stages"]["forward"] == {
             "spilled_batches": 1, "redelivered_batches": 1,
-            "deadlettered_batches": 0, "queue_depth": 0,
-            "open_circuits": 0}
+            "deadlettered_batches": 0, "rerouted_batches": 0,
+            "queue_depth": 0, "open_circuits": 0}
     finally:
         _close(clusters, regs, host)
 
