@@ -1754,6 +1754,50 @@ def main() -> None:
     }
 
     # ------------------------------------------------------------------
+    # Multi-chip SPMD store leg (ISSUE 16): the REAL engine sharded over
+    # the mesh (parallel.sharded.SpmdEngine) vs a single-chip reference
+    # over the same stream. Runs in a SUBPROCESS — this process already
+    # initialized its JAX backend, and the leg needs a multi-device mesh
+    # (virtual CPU devices in smoke, the real slice on hardware).
+    # Parity/zero-recompile/conservation are smoke gates; N-chip ingest
+    # ev/s and fused cross-shard query QPS are reports.
+    # Smoke always; opt-in on hardware via BENCH_CLUSTER=1.
+    # ------------------------------------------------------------------
+    sp: dict = {}
+    if smoke or _os.environ.get("BENCH_CLUSTER") == "1":
+        import pathlib as _sppath
+        import subprocess as _spproc
+
+        _sp_script = str(_sppath.Path(__file__).resolve().parent
+                         / "scripts" / "bench_spmd.py")
+        _sp_env = dict(_os.environ)
+        if smoke:
+            _sp_env["BENCH_SMOKE"] = "1"
+        _sp_env.setdefault("PYTHONPATH",
+                           str(_sppath.Path(__file__).resolve().parent))
+        try:
+            _sp_out = _spproc.run(
+                [sys.executable, _sp_script], env=_sp_env,
+                capture_output=True, text=True, timeout=1200)
+            if _sp_out.returncode == 0:
+                sp = json.loads(_sp_out.stdout.strip().splitlines()[-1])
+                log(f"SPMD leg: shards={sp['spmd_shards']} "
+                    f"ingest={sp['spmd_ingest_events_per_s']:,} ev/s "
+                    f"query={sp['spmd_query_qps']} qps "
+                    f"store_parity={sp['spmd_store_parity']} "
+                    f"query_parity={sp['spmd_query_parity']} "
+                    f"metrics_equal={sp['spmd_metrics_equal']} "
+                    f"rules_parity={sp['spmd_rules_parity']} "
+                    f"recompiles={sp['spmd_steady_recompiles']} "
+                    f"violations={sp['conservation_spmd_violations']}")
+            else:
+                log(f"SPMD leg subprocess failed rc={_sp_out.returncode}: "
+                    f"{_sp_out.stderr[-2000:]}")
+        except (OSError, _spproc.TimeoutExpired, ValueError,
+                IndexError) as e:
+            log(f"SPMD leg did not run: {e}")
+
+    # ------------------------------------------------------------------
     # Query path (ISSUE 5): shared-scan batched query engine.
     #  * kernel level: ONE fused multi-predicate program vs Q sequential
     #    query_store programs over the SAME store — parity is a smoke
@@ -2575,6 +2619,11 @@ def main() -> None:
                 # zero-loss/no-dual, victim isolation, move count,
                 # plane overhead, and ledger balance are smoke gates
                 **pl,
+                # multi-chip SPMD store leg (ISSUE 16): store/query/
+                # metrics/rules parity, zero steady recompiles, and
+                # ledger balance are smoke gates; N-chip ingest ev/s
+                # and fused query QPS report
+                **sp,
             }
     )
     print(json.dumps(result))
@@ -2754,6 +2803,45 @@ def main() -> None:
                 f"{pl['placement_moves_completed']} handoff(s) — the "
                 "join + drain scenario did not run")
             sys.exit(1)
+    if smoke and not sp:
+        log("FAIL: SPMD leg did not produce results in smoke mode "
+            "(subprocess failed — see log above)")
+        sys.exit(1)
+    if smoke and sp:
+        if sp["spmd_shards"] < 2:
+            log(f"FAIL: SPMD leg ran on {sp['spmd_shards']} shard(s) "
+                "< 2 — the mesh scenario did not run")
+            sys.exit(1)
+        for _sp_gate, _sp_msg in (
+                ("spmd_store_parity",
+                 "sharded store bytes diverge from the per-shard "
+                 "substream references"),
+                ("spmd_query_parity",
+                 "fused cross-shard query pages diverge from "
+                 "single-chip"),
+                ("spmd_metrics_equal",
+                 "engine.metrics() differs between the SPMD engine and "
+                 "single-chip over the same stream"),
+                ("spmd_rules_parity",
+                 "merged SPMD rule-fire keys diverge from single-chip")):
+            if not sp[_sp_gate]:
+                log(f"FAIL: {_sp_msg}")
+                sys.exit(1)
+        if sp["spmd_steady_recompiles"] != 0:
+            log(f"FAIL: {sp['spmd_steady_recompiles']} XLA compile(s) "
+                "during the steady-state SPMD run — the fused program "
+                "churned shapes")
+            sys.exit(1)
+        if sp["spmd_excess_retraces"] != 0:
+            log(f"FAIL: {sp['spmd_excess_retraces']} excess retrace(s) "
+                "in the SPMD families beyond the declared budget")
+            sys.exit(1)
+        if sp["conservation_spmd_violations"]:
+            log(f"FAIL: conservation ledger did not balance through the "
+                f"sharded staging lanes "
+                f"({sp['conservation_spmd_violations']} violation(s))")
+            sys.exit(1)
+    if smoke and pl:
         if pl["placement_overhead_pct"] > 3.0:
             log(f"FAIL: placement plane costs "
                 f"{pl['placement_overhead_pct']}% > 3% of ingest "

@@ -81,6 +81,15 @@ GATES: dict[str, tuple[str, "float | str | None"]] = {
     "placement_victim_isolation_ok": ("true", None),
     "placement_moves_completed": ("min", 2),
     "conservation_placement_violations": ("zero", None),
+    # multi-chip SPMD store (ISSUE 16): the mesh-sharded engine leg
+    "spmd_shards": ("min", 2),
+    "spmd_store_parity": ("true", None),
+    "spmd_query_parity": ("true", None),
+    "spmd_metrics_equal": ("true", None),
+    "spmd_rules_parity": ("true", None),
+    "spmd_steady_recompiles": ("zero", None),
+    "spmd_excess_retraces": ("zero", None),
+    "conservation_spmd_violations": ("zero", None),
 }
 
 # Every gate the SMOKE bench unconditionally emits (hardware-only legs
@@ -111,6 +120,9 @@ SMOKE_GATES = frozenset({
     "placement_overhead_pct", "placement_handoff_no_loss",
     "placement_no_dual_apply", "placement_victim_isolation_ok",
     "placement_moves_completed", "conservation_placement_violations",
+    "spmd_shards", "spmd_store_parity", "spmd_query_parity",
+    "spmd_metrics_equal", "spmd_rules_parity", "spmd_steady_recompiles",
+    "spmd_excess_retraces", "conservation_spmd_violations",
 })
 
 

@@ -1,0 +1,212 @@
+#!/usr/bin/env python3
+"""Multi-chip SPMD store bench leg (ISSUE 16), run as a SUBPROCESS of
+bench.py: the parent process initializes JAX before the leg runs, so a
+multi-device mesh (virtual CPU devices in smoke, the real slice on
+hardware) must be configured in a fresh interpreter.
+
+Drives the mesh-sharded real engine (parallel.sharded.SpmdEngine) next
+to a single-chip reference over the SAME wire stream and emits ONE JSON
+line on stdout:
+
+  * parity gates — sharded store bytes vs per-shard substreams, fused
+    query pages, metrics dict (rules on), merged rule-fire keys;
+  * devicewatch gates — zero excess retraces, zero steady-state
+    recompiles for the ``sharded.*`` families;
+  * conservation — the flow ledger balances through the sharded lanes;
+  * reported rates — N-chip ingest ev/s and fused cross-shard query QPS.
+
+Env: BENCH_SPMD_SHARDS (default 2 smoke / all devices on hardware),
+BENCH_SMOKE=1 for reduced sizes. Everything before the jax import is
+stdlib-only so the import-hygiene sweep can load this module cheaply.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    smoke = os.environ.get("BENCH_SMOKE") == "1"
+    if smoke or os.environ.get("JAX_PLATFORMS") == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import time
+
+    import jax
+    import numpy as np
+
+    from sitewhere_tpu.core.events import EpochBase
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.parallel.placement import shard_for_token
+    from sitewhere_tpu.parallel.sharded import SpmdEngine
+    from sitewhere_tpu.rules import RulesManager
+    from sitewhere_tpu.utils.conservation import (build_ledger,
+                                                  check_conservation)
+    from sitewhere_tpu.utils.devicewatch import WATCH
+
+    n_devices = len(jax.devices())
+    n_shards = int(os.environ.get(
+        "BENCH_SPMD_SHARDS", 2 if smoke else max(2, n_devices)))
+    n_shards = min(n_shards, n_devices)
+
+    class FixedEpoch(EpochBase):
+        def __init__(self, now_ms=500_000):
+            super().__init__(0.0)
+            self._now = now_ms
+
+        def now_ms(self):
+            return self._now
+
+    DEVS = 32 if smoke else 256
+    BATCH = 256 if smoke else 4096
+    FRAMES = 24 if smoke else 64
+    cfg = dict(device_capacity=max(64, DEVS * 2),
+               token_capacity=max(128, DEVS * 2),
+               assignment_capacity=max(128, DEVS * 2),
+               store_capacity=1 << (14 if smoke else 18),
+               batch_capacity=BATCH, channels=4,
+               rule_groups=max(64, DEVS * 2), rollup_buckets=8,
+               use_native=False)
+    RULESET = {
+        "name": "spmd-bench",
+        "rules": [
+            {"name": "hot", "kind": "threshold", "channel": "temp",
+             "op": ">", "value": 90.0, "cooldownMs": 1000},
+        ],
+        "rollups": [],
+    }
+
+    def wire_frame(f):
+        out = []
+        for i in range(BATCH):
+            d = (f * BATCH + i) % DEVS
+            ts = 1_000 + (f * BATCH + i) * 3
+            v = 96.5 if (f * BATCH + i) % 17 == 0 else 25.0 + (i % 50)
+            out.append(json.dumps({
+                "deviceToken": f"bs-{d}", "type": "DeviceMeasurement",
+                "request": {"name": "temp", "value": v,
+                            "eventDate": ts}}).encode())
+        return out
+
+    ref = Engine(EngineConfig(**cfg))
+    spmd = SpmdEngine(EngineConfig(**cfg), n_shards=n_shards)
+    for e in (ref, spmd):
+        e.epoch = FixedEpoch()
+    mref, mspmd = RulesManager(ref), RulesManager(spmd)
+    mref.load(RULESET)
+    mspmd.load(RULESET, precompile=False)
+
+    frames = [wire_frame(f) for f in range(FRAMES)]
+    # warm both engines (compile outside the timed window)
+    for e in (ref, spmd):
+        e.ingest_json_batch(frames[0])
+        e.flush()
+        e.query_events(device_token="bs-1", limit=64)
+
+    pre_compiles = WATCH.compile_totals()
+    pre_excess = WATCH.excess_total()
+
+    t0 = time.perf_counter()
+    for fr in frames[1:]:
+        spmd.ingest_json_batch(fr)
+        spmd.flush_async()
+    spmd.barrier()
+    spmd.drain()
+    spmd_ingest_s = time.perf_counter() - t0
+    for fr in frames[1:]:
+        ref.ingest_json_batch(fr)
+        ref.flush_async()
+    ref.barrier()
+    ref.drain()
+
+    n_events = (len(frames) - 1) * BATCH
+    spmd_eps = n_events / max(spmd_ingest_s, 1e-9)
+
+    # fused cross-shard query rounds (steady-state: one compiled program)
+    t0 = time.perf_counter()
+    Q = 40 if smoke else 200
+    for q in range(Q):
+        spmd.query_events(device_token=f"bs-{q % DEVS}", limit=64)
+    query_qps = Q / max(time.perf_counter() - t0, 1e-9)
+
+    steady_recompiles = sum(
+        (WATCH.compile_totals().get(k, 0) - v)
+        for k, v in pre_compiles.items())
+    excess_retraces = WATCH.excess_total() - pre_excess
+
+    # --- parity gates ----------------------------------------------------
+    def page(eng, **kw):
+        out = eng.query_events(**kw)
+        return out["total"], [
+            {k: v for k, v in ev.items() if k != "assignmentId"}
+            for ev in out["events"]]
+
+    query_parity = all(
+        page(ref, **kw) == page(spmd, **kw) for kw in (
+            dict(limit=200),
+            dict(device_token="bs-3", limit=64),
+            dict(device_token="bs-7", since_ms=2_000, limit=64),
+        ))
+
+    a, b = ref.metrics(), spmd.metrics()
+    metric_keys = ("processed", "found", "missed", "registered",
+                   "persisted", "reg_overflow", "channel_collisions",
+                   "staged", "rule_fires", "rules_active")
+    metrics_equal = all(a[k] == b[k] for k in metric_keys)
+
+    rules_parity = ({x["alternateId"] for x in mref.poll()}
+                    == {x["alternateId"] for x in mspmd.poll()})
+
+    # store bytes: each shard vs a single-chip engine fed its substream
+    all_events = []
+    for f, fr in enumerate(frames):
+        for payload in fr:
+            env = json.loads(payload)
+            all_events.append((env["deviceToken"], payload))
+    store_parity = True
+    for s in range(n_shards):
+        sub = Engine(EngineConfig(**cfg))
+        sub.epoch = FixedEpoch()
+        lane = [p for tok, p in all_events
+                if shard_for_token(tok, n_shards) == s]
+        for lo in range(0, len(lane), BATCH):
+            sub.ingest_json_batch(lane[lo:lo + BATCH])
+            sub.flush()
+        sub.barrier()
+        sub.drain()
+        ref_leaves = jax.tree_util.tree_leaves(
+            jax.device_get(sub.state.store))
+        spmd_leaves = jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+            lambda x, _s=s: jax.device_get(x[_s]), spmd.state.store))
+        for x, y in zip(ref_leaves, spmd_leaves):
+            if not np.array_equal(np.asarray(x), np.asarray(y)):
+                store_parity = False
+
+    spmd.flush()
+    violations = [v.to_dict() if hasattr(v, "to_dict") else str(v)
+                  for v in check_conservation(build_ledger(spmd, mspmd))]
+
+    print(json.dumps({
+        "spmd_shards": n_shards,
+        "spmd_store_parity": store_parity,
+        "spmd_query_parity": query_parity,
+        "spmd_metrics_equal": metrics_equal,
+        "spmd_rules_parity": rules_parity,
+        "spmd_steady_recompiles": steady_recompiles,
+        "spmd_excess_retraces": excess_retraces,
+        "conservation_spmd_violations": len(violations),
+        "spmd_ingest_events_per_s": round(spmd_eps),
+        "spmd_query_qps": round(query_qps, 1),
+        "spmd_events_total": n_events,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

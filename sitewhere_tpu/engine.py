@@ -1329,12 +1329,19 @@ class QueryBatcher:
                                 - decoded0)
         with eng.tracer.begin("query.round.fetch", trace_id=round_trace):
             for entries, res in launched:
-                host = _fetch_query_result(res)
-                for q, entry in enumerate(entries):
-                    entry["result"] = type(host)(*(col[q] for col in host))
-                    entry["cursors"] = cursors
-                    entry["q"] = len(entries)
-                    entry["event"].set()
+                self._unpack_round(entries, res, cursors)
+
+    def _unpack_round(self, entries: list[dict], res, cursors) -> None:
+        """Fetch one launched program's result and hand each entry its
+        per-query row. Overridden by the SPMD batcher, whose program
+        returns per-SHARD pages that merge on the host before rows are
+        handed out."""
+        host = _fetch_query_result(res)
+        for q, entry in enumerate(entries):
+            entry["result"] = type(host)(*(col[q] for col in host))
+            entry["cursors"] = cursors
+            entry["q"] = len(entries)
+            entry["event"].set()
 
 
 # rule/rollup PARAMETER columns (ops/rules.py table halves): a swap that
@@ -3384,14 +3391,26 @@ class Engine(IngestHostMixin):
                 rb = rs.rules
                 f, m, l, o = jax.device_get(
                     (rb.fires, rb.missed, rb.late, rb.oob))
-                out.update(ruleFires=int(f), ruleMissedFires=int(m),
-                           ruleLateEvents=int(l), ruleOobGroups=int(o),
+                out.update(ruleFires=int(np.sum(f)),
+                           ruleMissedFires=int(np.sum(m)),
+                           ruleLateEvents=int(np.sum(l)),
+                           ruleOobGroups=int(np.sum(o)),
                            rulesActive=int(rb.n_rules))
             if rs is not None and rs.rollups is not None:
                 out.update(
-                    rollupLateEvents=int(jax.device_get(rs.rollups.late)),
+                    rollupLateEvents=int(np.sum(
+                        jax.device_get(rs.rollups.late))),
                     rollupsActive=int(rs.rollups.n_rollups))
             return out
+
+    def _rollup_tables(self, p: int, scope: str):
+        """One rollup's materialized tables as host arrays
+        ``(wid, cnt, vsum, vmin, vmax)``, each ``[G, B]`` — the seam the
+        rules manager reads through (the SPMD engine overrides this to
+        fold its per-shard tables into the same single-chip layout)."""
+        ro = self.state.rules.rollups
+        return tuple(np.asarray(a) for a in jax.device_get(
+            (ro.wid[p], ro.cnt[p], ro.vsum[p], ro.vmin[p], ro.vmax[p])))
 
     def tenant_pipeline_counters(self) -> dict[str, dict[str, int]]:
         """The device-side per-tenant counter grid (accepted /
@@ -3402,19 +3421,27 @@ class Engine(IngestHostMixin):
         with self.lock:
             grid = np.asarray(jax.device_get(
                 self.state.metrics.tenant_counters))
+            if grid.ndim == 3:        # SPMD stacked state: sum over shards
+                grid = grid.sum(axis=0)
             return format_tenant_counter_grid(grid, self.tenants)
 
     def metrics(self) -> dict:
         m = self.state.metrics
+        # np.sum-style casts: on the single-chip engine every counter is
+        # 0-d (sum is identity); an SPMD engine's stacked [S] counters
+        # total over shards, keeping the metrics dict shape identical
+        def tot(x) -> int:
+            return int(np.asarray(jax.device_get(x)).sum())
+
         return {
             # host_counters first: a counter can never shadow a core key
             **self.host_counters,
-            "processed": int(m.processed),
-            "found": int(m.found),
-            "missed": int(m.missed),
-            "registered": int(m.registered),
-            "persisted": int(m.persisted),
-            "reg_overflow": int(m.reg_overflow),
+            "processed": tot(m.processed),
+            "found": tot(m.found),
+            "missed": tot(m.missed),
+            "registered": tot(m.registered),
+            "persisted": tot(m.persisted),
+            "reg_overflow": tot(m.reg_overflow),
             "channel_collisions": self.channel_map.collisions,
             "staged": len(self._buf),
             **({"arena_pool_waits": self._arena_pool.waits,
@@ -3433,7 +3460,7 @@ class Engine(IngestHostMixin):
             # a pure function of the event stream; missed/late depend on
             # harvest cadence and live in rule_counters() instead), so
             # metrics() equality across dispatch shapes holds WITH rules
-            **({"rule_fires": int(self.state.rules.rules.fires),
+            **({"rule_fires": tot(self.state.rules.rules.fires),
                 "rules_active": self.state.rules.rules.n_rules}
                if self.state.rules is not None
                and self.state.rules.rules is not None else {}),

@@ -436,9 +436,12 @@ class OpenLoopResult:
 def run_open_loop(engine, schedule: list[ScheduledOp], *,
                   checkpoint_frames: int = 4,
                   time_scale: float = 1.0) -> OpenLoopResult:
-    """Replay a schedule against a live engine (Engine, DistributedEngine
-    or ClusterEngine — anything with ingest_json_batch / query_events /
-    flush). Ops fire at their scheduled time; a late driver fires
+    """Replay a schedule against a live engine (Engine, DistributedEngine,
+    ClusterEngine or the mesh-sharded SpmdEngine — anything with
+    ingest_json_batch / query_events / flush; the driver never looks
+    inside, so the SPMD router's slot fan-out is exercised exactly as
+    production traffic would). Ops fire at their scheduled time; a late
+    driver fires
     immediately and the lateness lands in the measured latency (open
     loop). Completion checkpoints every ``checkpoint_frames`` ingest
     frames call ``engine.flush()`` — on a cluster facade that fans out,
@@ -639,13 +642,24 @@ def main() -> None:
                     help="open-loop arrival rate (events/s)")
     ap.add_argument("--duration", type=float, default=2.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shards", type=int, default=0,
+                    help="drive the mesh-sharded SPMD engine with N "
+                         "shards instead of a single-chip engine "
+                         "(0 = single-chip; requires >= N attached "
+                         "devices)")
     args = ap.parse_args()
 
-    engine = Engine(EngineConfig(
+    cfg = EngineConfig(
         device_capacity=max(1 << 15, 1 << (args.devices - 1).bit_length()),
         token_capacity=1 << 17, assignment_capacity=1 << 17,
         store_capacity=1 << 18, batch_capacity=args.batch_size,
-    ))
+    )
+    if args.shards:
+        from sitewhere_tpu.parallel.sharded import SpmdEngine
+
+        engine = SpmdEngine(cfg, n_shards=args.shards)
+    else:
+        engine = Engine(cfg)
     if args.open_loop:
         # warm OUTSIDE the measured schedule: the first flush pays the
         # fused-step jit compile (seconds), which would otherwise land

@@ -223,6 +223,46 @@ def query_store(
     )
 
 
+def merge_shard_pages(pages: QueryResult, limit: int) -> QueryResult:
+    """Merge per-shard top-``limit`` pages into the global page (host
+    side, numpy). ``pages`` is a :class:`QueryResult` of HOST arrays with
+    a leading shard axis (``ts_ms`` is ``[S, limit]``; ``n``/``total``
+    are ``[S]``). The merge key is ``(-ts, shard, in-page rank)`` —
+    newest first, shard-ascending then rank-ascending on ts ties. Within
+    one shard the page already carries the single-chip store-index
+    tie-order, so the merged page is byte-identical to the single-chip
+    engine whenever ts ties do not span shards (the sharded tie
+    contract; see README "Multi-chip SPMD store"). Per-shard top-k is
+    sufficient: any global top-``limit`` row is inside its own shard's
+    top-``limit``."""
+    n = np.asarray(pages.n).astype(np.int64)            # [S]
+    ts_all = np.asarray(pages.ts_ms)
+    page_len = ts_all.shape[1]
+    s_idx, i_idx = np.nonzero(
+        np.arange(page_len)[None, :] < n[:, None])
+    order = np.lexsort(
+        (i_idx, s_idx,
+         -ts_all[s_idx, i_idx].astype(np.int64)))[: int(limit)]
+    gs, gi = s_idx[order], i_idx[order]
+    k = len(order)
+    total = int(np.asarray(pages.total).sum())
+
+    def gather(col):
+        col = np.asarray(col)
+        out = np.zeros((int(limit),) + col.shape[2:], col.dtype)
+        out[:k] = col[gs, gi]
+        return out
+
+    return QueryResult(
+        n=np.int32(min(total, int(limit))), total=np.int32(total),
+        etype=gather(pages.etype), device=gather(pages.device),
+        assignment=gather(pages.assignment), tenant=gather(pages.tenant),
+        area=gather(pages.area), customer=gather(pages.customer),
+        ts_ms=gather(pages.ts_ms), received_ms=gather(pages.received_ms),
+        values=gather(pages.values), vmask=gather(pages.vmask),
+        aux=gather(pages.aux))
+
+
 # devicewatch (ISSUE 11): both query kernels report compiles/shape keys
 # under the query families. Passthrough shims — dispatch, ``.lower``
 # (the QueryBatcher's AOT seam, which records its own exact compile

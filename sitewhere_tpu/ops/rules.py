@@ -650,3 +650,71 @@ def harvest_fires(rules_state: RulesState):
     cleared = dataclasses.replace(rb, pend_h=rb.pend_w)
     return (dataclasses.replace(rules_state, rules=cleared),
             rb.pend_key, rb.pend_val, rb.pend_w, rb.pend_h)
+
+
+def merge_shard_harvests(pend_key, pend_val, pend_w, pend_h,
+                         layout, device_cap):
+    """Fold an SPMD engine's per-shard harvest (stacked ``[S, R, G, K]``
+    rings and ``[S, R, G]`` cursors from a vmapped :func:`harvest_fires`)
+    into the single-chip decode layout, SCOPE-aware per rule:
+
+    * device scope — group ids are shard-LOCAL device ids, and a device
+      lives on exactly one shard, so shard ``s``'s ring for local group
+      ``g`` lands whole at global group ``s * device_cap + g`` (the
+      engine's shard-qualified device-id space, so the host fire decode's
+      ``devices.get(g)`` resolves unchanged);
+    * area/tenant scope — group ids are GLOBAL interner ids replicated on
+      every shard, so the per-shard rings for the same group fold into
+      one ring: entries merge key-ascending (event-time-deterministic
+      keys), newest ``K`` kept, cursors rebuilt to the ring contract
+      (``n = min(w - h, K)`` newest, oldest-first at ``(w-n .. w-1) % K``).
+
+    Host arrays in, host arrays out (numpy); output group axis is
+    ``max(S * device_cap, G)``."""
+    import numpy as np
+
+    pk = np.asarray(pend_key)                   # [S, R, G, K]
+    pv = np.asarray(pend_val)
+    pw = np.asarray(pend_w)                     # [S, R, G]
+    ph = np.asarray(pend_h)
+    s_n, r_n, g_n, depth = pk.shape
+    g_out = max(s_n * device_cap, g_n)
+    mk = np.zeros((r_n, g_out, depth), pk.dtype)
+    mv = np.zeros((r_n, g_out, depth), pv.dtype)
+    mw = np.zeros((r_n, g_out), pw.dtype)
+    mh = np.zeros((r_n, g_out), ph.dtype)
+
+    def pending(s, r, g):
+        """(key, val) pairs of shard s's un-harvested ring, oldest-first."""
+        n = min(int(pw[s, r, g] - ph[s, r, g]), depth)
+        w = int(pw[s, r, g])
+        return [(int(pk[s, r, g, (w - n + j) % depth]),
+                 float(pv[s, r, g, (w - n + j) % depth]))
+                for j in range(n)]
+
+    for r, (kind, scope, *_rest) in enumerate(layout):
+        if scope == SCOPE_DEVICE:
+            # whole-ring relocation: local device g -> s*device_cap + g
+            span = min(g_n, device_cap)
+            for s in range(s_n):
+                lo = s * device_cap
+                mk[r, lo:lo + span] = pk[s, r, :span]
+                mv[r, lo:lo + span] = pv[s, r, :span]
+                mw[r, lo:lo + span] = pw[s, r, :span]
+                mh[r, lo:lo + span] = ph[s, r, :span]
+        else:
+            for g in range(g_n):
+                entries = [e for s in range(s_n) for e in pending(s, r, g)]
+                if not entries:
+                    continue
+                entries.sort(key=lambda e: e[0])
+                total = len(entries)
+                keep = entries[-depth:]
+                w = total
+                for j, (k, v) in enumerate(keep):
+                    slot = (w - len(keep) + j) % depth
+                    mk[r, g, slot] = k
+                    mv[r, g, slot] = v
+                mw[r, g] = w
+                mh[r, g] = w - len(keep)
+    return mk, mv, mw, mh

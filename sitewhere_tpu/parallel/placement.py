@@ -117,6 +117,19 @@ def _slot_of(token: str, n_slots: int) -> int:
     return owner_rank(token, n_slots)
 
 
+def shard_for_token(token: str, n_shards: int,
+                    slots_per_rank: int = DEFAULT_SLOTS_PER_RANK) -> int:
+    """THE slot -> shard map of the SPMD store (ISSUE 16): tokens hash
+    into the same fixed slot space as cluster placement (``n_slots =
+    n_shards * slots_per_rank``) and shards take the genesis assignment
+    ``slot % n_shards``. Because ``n_shards`` divides ``n_slots`` this
+    is byte-identical to the legacy ``owner_rank(token, n_shards)``
+    partitioner — a token lands on the same index whether "index" means
+    a cluster rank or an SPMD mesh shard, so placement tooling and the
+    conservation ledger carry over unmodified."""
+    return _slot_of(token, n_shards * slots_per_rank) % n_shards
+
+
 @dataclasses.dataclass(frozen=True)
 class PlacementMap:
     """Immutable, epoch-numbered slot->rank directory. ``n_slots`` is

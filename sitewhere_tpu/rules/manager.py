@@ -332,8 +332,6 @@ class RulesManager:
         if p is None:
             raise KeyError(f"rollup {name!r} not found")
         m = metas[p]
-        import jax
-
         with eng.lock:
             eng._sync_mirrors()
             rs = eng.state.rules
@@ -343,9 +341,7 @@ class RulesManager:
                 return {"rollup": name, "windowMs": m.window_ms,
                         "scope": m.scope, "channel": m.channel,
                         "buckets": []}
-            ro = rs.rollups
-            arrs = jax.device_get((ro.wid[p], ro.cnt[p], ro.vsum[p],
-                                   ro.vmin[p], ro.vmax[p]))
+            arrs = eng._rollup_tables(p, m.scope)
             gid = None
             if group is not None:
                 gid = self._group_id(m.scope, group)

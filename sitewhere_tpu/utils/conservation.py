@@ -164,6 +164,9 @@ def _backlog_rows(eng) -> int:
         n += int(np.sum(fill.valid[:fill.cursor]))
     for b in getattr(eng, "_staged_batches", ()):
         n += int(np.sum(b.valid))
+    # SPMD engine (ISSUE 16): per-shard staging buffers
+    for b in getattr(eng, "_shard_bufs", ()):
+        n += len(b)
     return n
 
 
@@ -181,10 +184,13 @@ def _rules_stage(eng, rules_manager) -> dict | None:
         f, m, l, o, pw, ph, wid = jax.device_get(
             (rb.fires, rb.missed, rb.late, rb.oob, rb.pend_w, rb.pend_h,
              rb.acc_wid))
-        out.update(fires=int(f), missed=int(m), late=int(l), oob=int(o),
+        # np.sum casts keep this correct for an SPMD engine's STACKED
+        # rules block ([S, ...] leaves): totals sum over every shard
+        out.update(fires=int(np.sum(f)), missed=int(np.sum(m)),
+                   late=int(np.sum(l)), oob=int(np.sum(o)),
                    pending=int(np.sum(np.minimum(
                        np.asarray(pw) - np.asarray(ph),
-                       rb.pend_key.shape[2]))),
+                       rb.pend_key.shape[-1]))),
                    max_window_id=int(np.max(wid)))
     if rs.rollups is not None:
         wid = np.asarray(jax.device_get(rs.rollups.wid))

@@ -317,10 +317,17 @@ class WatchedProgram:
         watch = self.scope.watch
         if not watch.enabled:
             return self.fn(*args, **kwargs)
-        key = abstract_key(args, kwargs, self._statics(args, kwargs))
+        statics = self._statics(args, kwargs)
+        key = abstract_key(args, kwargs, statics)
         if key is None:      # tracer-staged: inlining into an outer jit
             return self.fn(*args, **kwargs)
-        verdict = self.scope.observe(key, self.bucket, fn_id=id(self.fn))
+        bucket = self.bucket
+        if bucket is BY_STATICS:
+            # one budget bucket per static-argument tuple: a family whose
+            # static (e.g. ``limit``) legitimately takes several values
+            # gets one program per value, not one program total
+            bucket = ("statics",) + tuple(repr(s) for s in statics)
+        verdict = self.scope.observe(key, bucket, fn_id=id(self.fn))
         if verdict != "compile":
             return self.fn(*args, **kwargs)
         cost = None
@@ -410,6 +417,10 @@ class DeviceWatch:
 
 WATCH = DeviceWatch()
 
+# Bucket sentinel: derive the budget bucket per call from the watched
+# program's STATIC argument values (see WatchedProgram.__call__).
+BY_STATICS = object()
+
 
 def watched_jit(fn, family: str, static_argnames: tuple = (),
                 bucket: Any = None, cost: bool = False,
@@ -456,11 +467,12 @@ class EngineWatch:
         self._wrapped: dict[str, WatchedProgram] = {}
         self._aot: dict[str, WatchScope] = {}
 
-    def wrap(self, fn, family: str, cost: bool = False):
+    def wrap(self, fn, family: str, cost: bool = False,
+             static_argnames: tuple = (), bucket: Any = "program"):
         if not self.enabled:
             return fn
-        w = WatchedProgram(fn, WATCH.scope(family), bucket="program",
-                           cost=cost)
+        w = WatchedProgram(fn, WATCH.scope(family), bucket=bucket,
+                           cost=cost, static_argnames=static_argnames)
         self._wrapped[family] = w
         return w
 

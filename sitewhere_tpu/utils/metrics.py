@@ -548,6 +548,63 @@ def export_placement_metrics(engine, registry: MetricsRegistry | None
     inst["fenced"].set(len(pm.fenced_slots()))
 
 
+def spmd_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """Multi-chip SPMD store instruments (ISSUE 16). Kept OUT of
+    engine.metrics() (dispatch-shape equality — and the SPMD engine's
+    metrics() dict is pinned equal to single-chip) like every plane
+    before it. All scrape-time gauges synced from the router's host
+    mirrors; every series carries the exporting engine's ``engine=e<n>``
+    label, the per-lane series a ``shard`` label on top:
+
+      swtpu_spmd_shards          shards in the engine's device mesh
+                                 (the fixed slot-space partition count)
+      swtpu_shard_staged_rows    staged ingest rows per shard lane —
+                                 router skew shows up as one lane
+                                 filling (and forcing flushes) while
+                                 its siblings idle
+      swtpu_shard_devices        devices registered per shard (local
+                                 device-id high-water mark)
+      swtpu_shard_assignments    assignments created per shard
+    """
+    reg = registry or REGISTRY
+    return {
+        "shards": reg.gauge(
+            "swtpu_spmd_shards",
+            "shards in the engine's SPMD device mesh"),
+        "staged": reg.gauge(
+            "swtpu_shard_staged_rows",
+            "staged ingest rows per shard lane (pre-dispatch)"),
+        "devices": reg.gauge(
+            "swtpu_shard_devices",
+            "devices registered per shard (local id high-water mark)"),
+        "assignments": reg.gauge(
+            "swtpu_shard_assignments",
+            "assignments created per shard (local id high-water mark)"),
+    }
+
+
+def export_spmd_metrics(engine, registry: MetricsRegistry | None
+                        = None) -> None:
+    """Scrape-time export of the SPMD router's per-shard posture. Duck
+    typing, like every other plane: anything carrying per-shard staging
+    lanes (the mesh-sharded SpmdEngine) exports; single-chip engines
+    export nothing."""
+    bufs = getattr(engine, "_shard_bufs", None)
+    if bufs is None:
+        return
+    inst = spmd_metrics(registry)
+    lbl = getattr(engine, "metrics_label", "e?")
+    inst["shards"].set(len(bufs), engine=lbl)
+    devices = getattr(engine, "_next_local_device", None)
+    assigns = getattr(engine, "_next_local_assignment", None)
+    for s, buf in enumerate(bufs):
+        inst["staged"].set(len(buf), engine=lbl, shard=str(s))
+        if devices is not None:
+            inst["devices"].set(devices[s], engine=lbl, shard=str(s))
+        if assigns is not None:
+            inst["assignments"].set(assigns[s], engine=lbl, shard=str(s))
+
+
 def slo_metrics(registry: MetricsRegistry | None = None) -> dict:
     """The SLO latency plane (ISSUE 7): per-tenant end-to-end ingest
     latency harvested from flight-recorder lifecycle records at SCRAPE
@@ -802,6 +859,7 @@ def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
         g.set(0, **dict(key))
     export_observability_metrics(engine, reg)
     export_placement_metrics(engine, reg)
+    export_spmd_metrics(engine, reg)
 
 
 def export_observability_metrics(engine, registry: MetricsRegistry | None

@@ -78,6 +78,39 @@ def test_dropped_gate_is_a_regression(tmp_path):
     assert _run(old, new, tmp_path).returncode == 0
 
 
+def test_spmd_gates_enforced(tmp_path):
+    """ISSUE 16: the multi-chip SPMD leg's parity/zero/min gates fail
+    the diff when violated, and a silently dropped SPMD gate is a
+    regression like any other leg's."""
+    ok = _base() | {
+        "spmd_shards": 2, "spmd_store_parity": True,
+        "spmd_query_parity": True, "spmd_metrics_equal": True,
+        "spmd_rules_parity": True, "spmd_steady_recompiles": 0,
+        "spmd_excess_retraces": 0, "conservation_spmd_violations": 0,
+        "spmd_ingest_events_per_s": 7500.0,
+    }
+    assert _run(ok, ok, tmp_path).returncode == 0
+    # report-field drift (ingest rate) never gates
+    res = _run(ok, ok | {"spmd_ingest_events_per_s": 3000.0}, tmp_path)
+    assert res.returncode == 0, res.stderr
+    for bad in ({"spmd_store_parity": False},
+                {"spmd_query_parity": False},
+                {"spmd_rules_parity": False},
+                {"spmd_steady_recompiles": 3},
+                {"conservation_spmd_violations": 1},
+                {"spmd_shards": 1}):
+        res = _run(ok, ok | bad, tmp_path)
+        field = next(iter(bad))
+        assert res.returncode == 1, (bad, res.stdout, res.stderr)
+        assert f"GATE {field}" in res.stderr
+    dropped = dict(ok)
+    del dropped["spmd_store_parity"]
+    res = _run(ok, dropped, tmp_path)
+    assert res.returncode == 1
+    assert "GATE spmd_store_parity" in res.stderr
+    assert "ABSENT" in res.stderr
+
+
 def test_unreadable_input_is_usage_error(tmp_path):
     res = subprocess.run(
         [sys.executable, str(SCRIPT), str(tmp_path / "missing.json"),

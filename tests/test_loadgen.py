@@ -249,6 +249,36 @@ def test_open_loop_historical_queries_hit_the_archive_tier(tmp_path):
     assert time.perf_counter() - t0 < 60
 
 
+def test_open_loop_drives_sharded_engine():
+    """ISSUE 16 satellite: the open-loop driver accepts the mesh-sharded
+    SPMD engine as a target — ingest frames fan out over the shard lanes,
+    queries traverse the fused cross-shard round, and mutations land on
+    their owner shards, all through the same duck-typed surface."""
+    from sitewhere_tpu.parallel.sharded import SpmdEngine
+
+    (OpenLoopSpec, TenantLoad, build, run, _fp) = _open_loop_imports()
+    eng = SpmdEngine(EngineConfig(
+        device_capacity=256, token_capacity=512, assignment_capacity=512,
+        store_capacity=8192, batch_capacity=128, channels=8,
+        use_native=False), n_shards=2)
+    run_engine_load(eng, n_batches=1, batch_size=32, n_devices=8,
+                    warmup_batches=1)                      # warm compile
+    spec = OpenLoopSpec(
+        tenants=(TenantLoad("alpha", 2500.0, n_devices=8, query_every=3,
+                            mutate_every=4),),
+        duration_s=0.3, frame_size=32, seed=5)
+    sched = build(spec)
+    expected = sum(len(op.payloads) for op in sched if op.kind == "ingest")
+    res = run(eng, sched, checkpoint_frames=2)
+    assert res.events == expected
+    assert res.queries > 0 and res.query_p99_ms is not None
+    assert res.mutations > 0
+    eng.flush()
+    assert eng.metrics()["persisted"] >= expected
+    # the stream actually spanned the mesh: both shard lanes own devices
+    assert all(eng._next_local_device[s] > 0 for s in range(2))
+
+
 def test_open_loop_backlog_latency_includes_queueing_delay():
     """THE open-loop property: when the engine is artificially slowed
     below the offered rate, recorded wire->state latency GROWS with the

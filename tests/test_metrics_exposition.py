@@ -231,6 +231,49 @@ def test_rules_counters_export_at_scrape():
     assert "ruleMissedFires" not in eng.metrics()
 
 
+def test_spmd_series_export_at_scrape_and_lint():
+    """ISSUE 16 satellite: the mesh-sharded engine exports its per-shard
+    posture (swtpu_spmd_* / swtpu_shard_* gauges) at SCRAPE time — kept
+    OUT of engine.metrics(), whose dict is pinned equal to single-chip.
+    A single-chip engine exports none of them."""
+    import json as _json
+
+    from sitewhere_tpu.engine import Engine, EngineConfig
+    from sitewhere_tpu.parallel.sharded import SpmdEngine
+
+    cfg = EngineConfig(
+        device_capacity=64, token_capacity=128, assignment_capacity=128,
+        store_capacity=1024, batch_capacity=16, channels=4,
+        use_native=False)
+    reg = MetricsRegistry()
+    export_engine_metrics(Engine(cfg), reg)
+    assert "swtpu_spmd_shards" not in reg.expose_text()
+
+    eng = SpmdEngine(cfg, n_shards=2)
+    eng.ingest_json_batch([_json.dumps(
+        {"deviceToken": f"sx-{i}", "type": "DeviceMeasurement",
+         "request": {"name": "t", "value": float(i), "eventDate": 1000}}
+        ).encode() for i in range(6)])
+    eng.flush()
+    reg = MetricsRegistry()
+    export_engine_metrics(eng, reg)
+    text = reg.expose_text()
+    lint_prometheus(text)
+    lbl = eng.metrics_label
+    assert f'swtpu_spmd_shards{{engine="{lbl}"}} 2' in text
+    for s in ("0", "1"):
+        assert (f'swtpu_shard_staged_rows{{engine="{lbl}",shard="{s}"}}'
+                in text)
+        assert (f'swtpu_shard_devices{{engine="{lbl}",shard="{s}"}}'
+                in text)
+        assert (f'swtpu_shard_assignments{{engine="{lbl}",shard="{s}"}}'
+                in text)
+    # devices landed on BOTH shards and the per-shard counts sum to the
+    # registered total
+    devs = {s: eng._next_local_device[s] for s in range(2)}
+    assert sum(devs.values()) == 6 and all(v > 0 for v in devs.values())
+
+
 # --------------------------------------------------------- API separation
 def test_counter_has_no_set_and_rejects_decrease():
     c = Counter("c_total", "")

@@ -1,0 +1,359 @@
+"""Multi-chip SPMD store (ISSUE 16): the real engine sharded over the mesh.
+
+The contract pinned here, on the virtual 8-device CPU mesh:
+
+  * **store byte-identity** — each shard's event ring is byte-identical to
+    a single-chip engine fed only that shard's substream (the slot router
+    is the only difference between the two executions);
+  * **query parity** — fused cross-shard query pages equal the single-chip
+    pages (same rows, same order — including ts ties, which break by
+    (shard, ring-position), matching single-chip arrival order because
+    the router preserves per-device arrival order and a device lives on
+    exactly one shard);
+  * **metrics parity** — ``engine.metrics()`` dict-equal to single-chip
+    with qos + devicewatch + tracing + rules all on;
+  * **rule-fire parity** — the merged harvest emits exactly the
+    single-chip alert key set (device-scoped rules; a group lives on one
+    shard);
+  * **zero steady-state recompiles / excess retraces** for the
+    ``sharded.*`` SPMD families once warm;
+  * **conservation** — the flow ledger balances through the sharded
+    staging lanes.
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from sitewhere_tpu.core.events import EpochBase
+from sitewhere_tpu.engine import Engine, EngineConfig
+from sitewhere_tpu.parallel.placement import shard_for_token
+from sitewhere_tpu.parallel.sharded import SpmdEngine
+from sitewhere_tpu.rules import RulesManager
+from sitewhere_tpu.rules import oracle as rules_oracle
+from sitewhere_tpu.utils.conservation import build_ledger, check_conservation
+from sitewhere_tpu.utils.devicewatch import WATCH
+
+CFG = dict(device_capacity=64, token_capacity=128, assignment_capacity=128,
+           store_capacity=2048, batch_capacity=32, channels=4,
+           rule_groups=64, rollup_buckets=8, use_native=False)
+
+RULESET = {
+    "name": "spmd",
+    "rules": [
+        {"name": "hot", "kind": "threshold", "channel": "temp",
+         "op": ">", "value": 90.0, "cooldownMs": 1000},
+        {"name": "burst", "kind": "window", "agg": "count",
+         "channel": "temp", "op": ">=", "value": 3, "windowMs": 2000,
+         "where": {"channel": "temp", "op": ">", "value": 50.0}},
+    ],
+    "rollups": [{"name": "temp-1s", "channel": "temp",
+                 "windowMs": 1000, "scope": "device"}],
+}
+
+
+class FixedEpoch(EpochBase):
+    """Deterministic received_ms so both executions stamp identical rows."""
+
+    def __init__(self, now_ms: int = 500_000):
+        super().__init__(0.0)
+        self._now = now_ms
+
+    def now_ms(self) -> int:
+        return self._now
+
+
+def _meas(tok, value, ts, name="temp"):
+    return json.dumps({
+        "deviceToken": tok, "type": "DeviceMeasurement",
+        "request": {"name": name, "value": value, "eventDate": ts},
+    }).encode()
+
+
+def _stream(n=120, devs=8, ties=False):
+    """Deterministic stream. With ``ties=True`` every frame of ``devs``
+    events shares one timestamp (exercises the cross-shard merge-tie
+    contract); otherwise timestamps are unique (byte-exact page parity)."""
+    out = []
+    for i in range(n):
+        d = i % devs
+        ts = 1_000 + ((i // devs) * 100 if ties else i * 10)
+        v = 96.5 if i % 11 == 0 else 20.0 + (i % 40) * 0.5
+        if i % 23 == 0:
+            v = 2.5
+        out.append((f"sp-{d}", v, ts))
+    return out
+
+
+def _engines(n_shards, **kw):
+    ref = Engine(EngineConfig(**{**CFG, **kw}))
+    spmd = SpmdEngine(EngineConfig(**{**CFG, **kw}), n_shards=n_shards)
+    for e in (ref, spmd):
+        e.epoch = FixedEpoch()
+    return ref, spmd
+
+
+def _run(engines, events, chunk=32):
+    for lo in range(0, len(events), chunk):
+        wire = [_meas(t, v, ts) for t, v, ts in events[lo:lo + chunk]]
+        for e in engines:
+            e.ingest_json_batch(wire)
+            e.flush()
+
+
+def _page(eng, **kw):
+    """A query page with the shard-qualified assignment id canonicalized
+    (different id spaces; the assignment is identified by its device)."""
+    out = eng.query_events(**kw)
+    return out["total"], [
+        {k: v for k, v in ev.items() if k != "assignmentId"}
+        for ev in out["events"]
+    ]
+
+
+# --- store byte-identity ----------------------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_store_byte_identical_to_per_shard_substreams(n_shards):
+    _, spmd = _engines(n_shards)
+    events = _stream()
+    _run([spmd], events)
+    spmd.barrier()
+    spmd.drain()
+    for s in range(n_shards):
+        sub = [ev for ev in events
+               if shard_for_token(ev[0], n_shards) == s]
+        ref = Engine(EngineConfig(**CFG))
+        ref.epoch = FixedEpoch()
+        _run([ref], sub)
+        ref.barrier()
+        ref.drain()
+        ref_store = jax.device_get(ref.state.store)
+        spmd_store = jax.tree_util.tree_map(
+            lambda x, _s=s: jax.device_get(x[_s]), spmd.state.store)
+        for a, b in zip(jax.tree_util.tree_leaves(ref_store),
+                        jax.tree_util.tree_leaves(spmd_store)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_single_shard_is_the_identity():
+    ref, spmd = _engines(1)
+    events = _stream(64)
+    _run([ref, spmd], events)
+    for e in (ref, spmd):
+        e.barrier()
+        e.drain()
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ref.state.store)),
+                    jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                        lambda x: jax.device_get(x[0]), spmd.state.store))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- query parity -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [1, 2, 4])
+def test_query_pages_match_single_chip(n_shards):
+    ref, spmd = _engines(n_shards)
+    _run([ref, spmd], _stream())
+    for kw in (
+            dict(limit=200),                       # full page
+            dict(limit=7),                         # truncated page
+            dict(device_token="sp-3", limit=20),   # device filter
+            dict(device_token="sp-3", since_ms=1_200, until_ms=1_800,
+                 limit=20),                        # time window
+            dict(limit=20, since_ms=1_300),
+    ):
+        assert _page(ref, **kw) == _page(spmd, **kw), kw
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_query_tie_order_is_the_documented_merge_contract(n_shards):
+    """Cross-shard ts TIES: single-chip breaks ties by global arrival
+    order, which the shards cannot reconstruct; the SPMD page contract is
+    the deterministic merge key ``(-ts, shard, ring-rank)`` — within one
+    timestamp, shard-major, each shard's rows in its local arrival order.
+    Same row SET per timestamp as single-chip, pinned order."""
+    ref, spmd = _engines(n_shards)
+    events = _stream(ties=True)
+    _run([ref, spmd], events)
+    t_ref, page_ref = _page(ref, limit=200)
+    t_spmd, page_spmd = _page(spmd, limit=200)
+    assert t_ref == t_spmd == len(events)
+    # per-timestamp row multisets match single-chip exactly
+    def by_ts(page):
+        out = {}
+        for ev in page:
+            out.setdefault(ev["eventDateMs"], []).append(
+                tuple(sorted((k, str(v)) for k, v in ev.items())))
+        return {ts: sorted(rows) for ts, rows in out.items()}
+    assert by_ts(page_ref) == by_ts(page_spmd)
+    # pinned order: newest-first frames; within a frame shard-major, and
+    # within a shard the stream's arrival order
+    expected = []
+    frames = sorted({ts for _, _, ts in events}, reverse=True)
+    for ts in frames:
+        for s in range(n_shards):
+            expected.extend(
+                tok for tok, _, ts2 in events
+                if ts2 == ts and shard_for_token(tok, n_shards) == s)
+    assert [ev["deviceToken"] for ev in page_spmd] == expected
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_device_state_and_tenant_metrics_match(n_shards):
+    ref, spmd = _engines(n_shards)
+    _run([ref, spmd], _stream())
+    for d in range(8):
+        assert (ref.get_device_state(f"sp-{d}")
+                == spmd.get_device_state(f"sp-{d}"))
+    assert ref.tenant_metrics() == spmd.tenant_metrics()
+    assert ref.tenant_pipeline_counters() == spmd.tenant_pipeline_counters()
+
+
+# --- metrics parity with every observability plane on -----------------------
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_metrics_dict_equal_with_qos_tracing_rules_on(n_shards):
+    ref, spmd = _engines(n_shards, qos=True, devicewatch=True,
+                         span_sample=1.0)
+    mgr_ref = RulesManager(ref)
+    mgr_spmd = RulesManager(spmd)
+    mgr_ref.load(RULESET)
+    mgr_spmd.load(RULESET, precompile=False)
+    _run([ref, spmd], _stream())
+    a, b = ref.metrics(), spmd.metrics()
+    # host-side flush cadence differs by construction (per-shard lanes
+    # emit fixed [S, B] batches), so dispatch-shape counters are not part
+    # of the parity contract — everything event-count-shaped is
+    for k in ("processed", "found", "missed", "registered", "persisted",
+              "reg_overflow", "channel_collisions", "staged",
+              "rule_fires", "rules_active"):
+        assert a[k] == b[k], (k, a[k], b[k])
+    assert ({x["alternateId"] for x in mgr_ref.poll()}
+            == {x["alternateId"] for x in mgr_spmd.poll()})
+
+
+# --- rule-fire parity vs single-chip and the host oracle --------------------
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_rule_fires_match_single_chip_and_oracle(n_shards):
+    ref, spmd = _engines(n_shards)
+    mgr_ref = RulesManager(ref)
+    mgr_spmd = RulesManager(spmd)
+    mgr_ref.load(RULESET)
+    mgr_spmd.load(RULESET, precompile=False)
+    events = _stream()
+    _run([ref, spmd], events)
+    keys_ref = {a["alternateId"] for a in mgr_ref.poll()}
+    keys_spmd = {a["alternateId"] for a in mgr_spmd.poll()}
+    assert keys_ref == keys_spmd
+    assert ref.metrics()["rule_fires"] == spmd.metrics()["rule_fires"]
+    # and both equal the sequential host oracle
+    ev = [{"ts": ts, "group": t, "value": v, "value_b": v}
+          for t, v, ts in events]
+    expected = set()
+    for g, w in rules_oracle.threshold_fire_keys(ev, op=0, value=90.0,
+                                                 cooldown_ms=1000):
+        expected.add(f"swr:hot:{g}:{w}")
+    for g, w in rules_oracle.window_fire_keys(ev, agg="count", op=1,
+                                              value=3, window_ms=2000,
+                                              where=(0, 50.0)):
+        expected.add(f"swr:burst:{g}:{w}")
+    assert keys_ref == expected
+    # rollup read path folds per-shard tables to the same buckets
+    ru_ref = mgr_ref.read_rollup("temp-1s", limit=100)
+    ru_spmd = mgr_spmd.read_rollup("temp-1s", limit=100)
+    assert sorted(map(tuple, (sorted(b.items()) for b in ru_ref["buckets"]))) \
+        == sorted(map(tuple, (sorted(b.items())
+                              for b in ru_spmd["buckets"])))
+
+
+# --- devicewatch: zero excess retraces, zero steady-state recompiles --------
+
+
+def test_spmd_families_zero_steady_state_recompiles():
+    _, spmd = _engines(4)
+    events = _stream(64)
+    _run([spmd], events)
+    spmd.query_events(device_token="sp-1", limit=20)   # warm the AOT round
+    spmd.presence_sweep()
+    pre = WATCH.compile_totals()
+    pre_excess = WATCH.excess_total()
+    _run([spmd], _stream(64))
+    spmd.query_events(device_token="sp-2", limit=20)
+    spmd.presence_sweep()
+    assert WATCH.compile_totals() == pre
+    assert WATCH.excess_total() == pre_excess
+
+
+# --- conservation -----------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_shards", [2, 4])
+def test_conservation_ledger_balances(n_shards):
+    _, spmd = _engines(n_shards)
+    _run([spmd], _stream())
+    spmd.flush()
+    ledger = build_ledger(spmd)
+    assert check_conservation(ledger) == []
+
+
+# --- admin plane over shards ------------------------------------------------
+
+
+def test_admin_paths_shard_qualified():
+    _, spmd = _engines(4)
+    dids = [spmd.register_device(f"adm-{i}", tenant="acme")
+            for i in range(12)]
+    assert len(set(dids)) == 12
+    info = spmd.create_assignment("adm-0", token="asn-1", asset="truck")
+    assert info.device_token == "adm-0"
+    spmd.update_assignment("asn-1", area="north")
+    assert spmd.get_assignment("asn-1").area == "north"
+    spmd.release_assignment("asn-1")
+    assert spmd.get_assignment("asn-1").status == "RELEASED"
+    spmd.update_device("adm-0", device_type="gateway")
+    assert spmd.get_device("adm-0").device_type == "gateway"
+    # same-shard parenting works; cross-shard is refused loudly
+    by_shard: dict[int, list[str]] = {}
+    for i in range(12):
+        by_shard.setdefault(shard_for_token(f"adm-{i}", 4),
+                            []).append(f"adm-{i}")
+    groups = [g for g in by_shard.values() if len(g) >= 2]
+    if groups:
+        a, b = groups[0][0], groups[0][1]
+        assert spmd.map_device(a, b).metadata["parentToken"] == b
+    two = [g[0] for g in by_shard.values()]
+    if len(two) >= 2:
+        with pytest.raises(ValueError, match="share a shard"):
+            spmd.map_device(two[0], two[1])
+
+
+def test_presence_sweep_parity():
+    ref, spmd = _engines(2)
+    _run([ref, spmd], _stream(32))
+    missing_at = 500_000 + int(EngineConfig(**CFG).presence_missing_s
+                               * 1000) + 10_000
+    for e in (ref, spmd):
+        e.epoch._now = missing_at
+    assert sorted(ref.presence_sweep()) == sorted(spmd.presence_sweep())
+    assert ref.presence_sweep() == spmd.presence_sweep() == []
+
+
+def test_unsupported_configs_are_refused():
+    with pytest.raises(ValueError, match="archive"):
+        SpmdEngine(EngineConfig(**{**CFG, "archive_dir": "/tmp/x"}),
+                   n_shards=2)
+    with pytest.raises(ValueError, match="scan_chunk"):
+        SpmdEngine(EngineConfig(**{**CFG, "scan_chunk": 2}), n_shards=2)
+    eng = SpmdEngine(EngineConfig(**CFG), n_shards=2)
+    with pytest.raises(NotImplementedError):
+        eng.search_device_states()
+    with pytest.raises(NotImplementedError):
+        eng.get_event("x")
