@@ -1783,13 +1783,17 @@ def main() -> None:
                 sp = json.loads(_sp_out.stdout.strip().splitlines()[-1])
                 log(f"SPMD leg: shards={sp['spmd_shards']} "
                     f"ingest={sp['spmd_ingest_events_per_s']:,} ev/s "
+                    f"(rowrouter {sp['spmd_rowrouter_events_per_s']:,}) "
                     f"query={sp['spmd_query_qps']} qps "
                     f"store_parity={sp['spmd_store_parity']} "
+                    f"arena_identical={sp['spmd_arena_store_identical']} "
+                    f"host_copies/batch={sp['host_copies_per_batch']} "
                     f"query_parity={sp['spmd_query_parity']} "
                     f"metrics_equal={sp['spmd_metrics_equal']} "
                     f"rules_parity={sp['spmd_rules_parity']} "
                     f"recompiles={sp['spmd_steady_recompiles']} "
-                    f"violations={sp['conservation_spmd_violations']}")
+                    f"violations={sp['conservation_spmd_violations']} "
+                    f"stages={sp['spmd_stage_medians']}")
             else:
                 log(f"SPMD leg subprocess failed rc={_sp_out.returncode}: "
                     f"{_sp_out.stderr[-2000:]}")
@@ -2823,10 +2827,21 @@ def main() -> None:
                  "engine.metrics() differs between the SPMD engine and "
                  "single-chip over the same stream"),
                 ("spmd_rules_parity",
-                 "merged SPMD rule-fire keys diverge from single-chip")):
+                 "merged SPMD rule-fire keys diverge from single-chip"),
+                ("spmd_arena_store_identical",
+                 "arena-path stacked store bytes diverge from the v1 "
+                 "row-router over the same stream"),
+                ("spmd_arena_ge_rowrouter",
+                 "arena-path SPMD ingest is slower than the v1 per-row "
+                 "router contrast")):
             if not sp[_sp_gate]:
                 log(f"FAIL: {_sp_msg}")
                 sys.exit(1)
+        if sp["host_copies_per_batch"] != 0:
+            log(f"FAIL: arena ingest made "
+                f"{sp['host_copies_per_batch']} host staging copies "
+                "per batch — the zero-copy scatter path was bypassed")
+            sys.exit(1)
         if sp["spmd_steady_recompiles"] != 0:
             log(f"FAIL: {sp['spmd_steady_recompiles']} XLA compile(s) "
                 "during the steady-state SPMD run — the fused program "

@@ -8,12 +8,17 @@ Drives the mesh-sharded real engine (parallel.sharded.SpmdEngine) next
 to a single-chip reference over the SAME wire stream and emits ONE JSON
 line on stdout:
 
-  * parity gates — sharded store bytes vs per-shard substreams, fused
+  * parity gates — sharded store bytes vs per-shard substreams AND vs
+    the v1 per-row router (the arena-path byte-identity oracle), fused
     query pages, metrics dict (rules on), merged rule-fire keys;
   * devicewatch gates — zero excess retraces, zero steady-state
-    recompiles for the ``sharded.*`` families;
+    recompiles for the ``sharded.*`` families with ``scan_chunk = 2``;
+  * arena-path gates — ``host_copies_per_batch == 0`` and arena ingest
+    throughput >= the row-router contrast;
   * conservation — the flow ledger balances through the sharded lanes;
-  * reported rates — N-chip ingest ev/s and fused cross-shard query QPS.
+  * reported rates — N-chip ingest ev/s (arena and row-router), fused
+    cross-shard query QPS, per-stage medians (decode / route / wal /
+    dispatch_wait / device).
 
 Env: BENCH_SPMD_SHARDS (default 2 smoke / all devices on hardware),
 BENCH_SMOKE=1 for reduced sizes. Everything before the jax import is
@@ -95,7 +100,10 @@ def main() -> int:
         return out
 
     ref = Engine(EngineConfig(**cfg))
-    spmd = SpmdEngine(EngineConfig(**cfg), n_shards=n_shards)
+    # the headline engine runs the full arena path: packed 2-chunk scan
+    # per dispatch, pipelined arena pool (ingest_arenas auto-depth > 1)
+    spmd = SpmdEngine(EngineConfig(**cfg, scan_chunk=2),
+                      n_shards=n_shards)
     for e in (ref, spmd):
         e.epoch = FixedEpoch()
     mref, mspmd = RulesManager(ref), RulesManager(spmd)
@@ -111,14 +119,20 @@ def main() -> int:
 
     pre_compiles = WATCH.compile_totals()
     pre_excess = WATCH.excess_total()
+    copies_before = spmd.host_counters.get("staged_copy_rows", 0)
 
+    # no per-frame flush: the arena packs scan_chunk device batches per
+    # dispatch and auto-dispatches when its lanes fill
     t0 = time.perf_counter()
     for fr in frames[1:]:
         spmd.ingest_json_batch(fr)
-        spmd.flush_async()
+    spmd.flush_async()
     spmd.barrier()
     spmd.drain()
     spmd_ingest_s = time.perf_counter() - t0
+    host_copies_per_batch = (
+        (spmd.host_counters.get("staged_copy_rows", 0) - copies_before)
+        / max(1, len(frames) - 1))
     for fr in frames[1:]:
         ref.ingest_json_batch(fr)
         ref.flush_async()
@@ -127,6 +141,36 @@ def main() -> int:
 
     n_events = (len(frames) - 1) * BATCH
     spmd_eps = n_events / max(spmd_ingest_s, 1e-9)
+
+    # per-stage medians over the timed window's batch records (SPMD mark
+    # order: decode -> wal_append -> route -> arena_fill -> commit ->
+    # dispatch -> device_ready)
+    def _stage_medians(recs):
+        def deltas(lows, b):
+            out = []
+            for r in recs:
+                st = r.get("stagesUs", {})
+                hi = st.get(b)
+                if hi is None:
+                    continue
+                # first present lower bound wins (WAL marks are absent
+                # when no wal_dir is configured)
+                lo = next((st[a] for a in lows if st.get(a) is not None),
+                          0.0 if None in lows else None)
+                if lo is not None:
+                    out.append(max(0.0, (hi - lo) / 1000.0))
+            return round(float(np.median(out)), 3) if out else None
+
+        return {
+            "decode_ms": deltas([None], "decode"),
+            "wal_ms": deltas(["decode"], "wal_append"),
+            "route_ms": deltas(["wal_append", "decode"], "route"),
+            "dispatch_wait_ms": deltas(["commit"], "dispatch"),
+            "device_ms": deltas(["dispatch"], "device_ready"),
+        }
+
+    stage_medians = _stage_medians(
+        spmd.flight.recent(limit=len(frames), kind="ingest"))
 
     # fused cross-shard query rounds (steady-state: one compiled program)
     t0 = time.perf_counter()
@@ -139,6 +183,28 @@ def main() -> int:
         (WATCH.compile_totals().get(k, 0) - v)
         for k, v in pre_compiles.items())
     excess_retraces = WATCH.excess_total() - pre_excess
+
+    # --- v1 row-router contrast (same stream, per-row host routing) ------
+    router = SpmdEngine(EngineConfig(**cfg), n_shards=n_shards,
+                        arena=False)
+    router.epoch = FixedEpoch()
+    router.ingest_json_batch(frames[0])
+    router.flush()
+    t0 = time.perf_counter()
+    for fr in frames[1:]:
+        router.ingest_json_batch(fr)
+        router.flush_async()
+    router.barrier()
+    router.drain()
+    router_eps = n_events / max(time.perf_counter() - t0, 1e-9)
+
+    # arena-path store bytes == row-router store bytes (the ISSUE 17
+    # acceptance oracle), checked on the full stacked store
+    arena_store_identical = all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(
+            jax.tree_util.tree_leaves(jax.device_get(spmd.state.store)),
+            jax.tree_util.tree_leaves(jax.device_get(router.state.store))))
 
     # --- parity gates ----------------------------------------------------
     def page(eng, **kw):
@@ -202,6 +268,11 @@ def main() -> int:
         "spmd_excess_retraces": excess_retraces,
         "conservation_spmd_violations": len(violations),
         "spmd_ingest_events_per_s": round(spmd_eps),
+        "spmd_rowrouter_events_per_s": round(router_eps),
+        "spmd_arena_store_identical": arena_store_identical,
+        "spmd_arena_ge_rowrouter": bool(spmd_eps >= router_eps),
+        "host_copies_per_batch": round(host_copies_per_batch, 3),
+        "spmd_stage_medians": stage_medians,
         "spmd_query_qps": round(query_qps, 1),
         "spmd_events_total": n_events,
     }))

@@ -129,20 +129,100 @@ class StagingArena:
         self.traces = []
 
 
+class ShardedStagingArena:
+    """Stacked ``[n_shards, rows]`` staging arena for the SPMD engine.
+
+    Each shard owns one contiguous lane of ``rows`` slots (C-order, so a
+    lane is one flat memcpy-able slab) with its own fill ``cursors[s]``;
+    the batch-decode path scatters routed rows into the lanes and
+    ``view_batch()`` hands the SAME arrays to the shard_mapped fused step
+    as a stacked EventBatch whose leading axis matches the mesh sharding.
+    With ``lanes`` (= scan_chunk) K > 1 each shard's lane is consumed as
+    K scan chunks of ``rows // K`` by the packed sharded scan step.
+
+    No decoder scratch columns: the SPMD path runs the commit transforms
+    on the decoder's flat SoA output BEFORE the scatter, so only final
+    EventBatch columns live here."""
+
+    __slots__ = ("n_shards", "rows", "channels", "lanes", "cursors",
+                 "traces", "valid", "etype", "token_id", "tenant_id",
+                 "ts_ms", "received_ms", "values", "vmask", "aux", "seq")
+
+    def __init__(self, n_shards: int, rows: int, channels: int,
+                 lanes: int = 1):
+        if rows % max(1, lanes):
+            raise ValueError(f"arena rows {rows} not divisible by "
+                             f"{lanes} scan lanes")
+        self.n_shards = n_shards
+        self.rows = rows
+        self.channels = channels
+        self.lanes = max(1, lanes)
+        self.cursors = np.zeros(n_shards, np.int64)
+        self.traces: list = []
+        s = n_shards
+        self.valid = np.zeros((s, rows), np.bool_)
+        self.etype = np.zeros((s, rows), np.int32)
+        self.token_id = np.full((s, rows), NULL_ID, np.int32)
+        self.tenant_id = np.full((s, rows), NULL_ID, np.int32)
+        self.ts_ms = np.zeros((s, rows), np.int32)
+        self.received_ms = np.zeros((s, rows), np.int32)
+        self.values = np.zeros((s, rows, channels), np.float32)
+        self.vmask = np.zeros((s, rows, channels), np.uint8)
+        self.aux = np.full((s, rows, AUX_LANES), NULL_ID, np.int32)
+        self.seq = np.tile(
+            np.tile(np.arange(rows // self.lanes, dtype=np.int32),
+                    self.lanes), (s, 1))
+
+    @property
+    def cursor(self) -> int:
+        """Total staged rows across every shard lane (the single-arena
+        ``cursor`` seam: flush/quiesce callers only test truthiness)."""
+        return int(self.cursors.sum())
+
+    @property
+    def nbytes(self) -> int:
+        return sum(v.nbytes for name in self.__slots__
+                   if isinstance((v := getattr(self, name)), np.ndarray))
+
+    def view_batch(self) -> EventBatch:
+        """The stacked ``[n_shards, rows]`` EventBatch over the arena's
+        arrays (no copies; lanes past each shard's cursor must already be
+        masked invalid by the dispatcher)."""
+        return EventBatch(
+            valid=self.valid,
+            etype=self.etype,
+            token_id=self.token_id,
+            tenant_id=self.tenant_id,
+            ts_ms=self.ts_ms,
+            received_ms=self.received_ms,
+            values=self.values,
+            vmask=self.vmask.view(np.bool_),
+            aux=self.aux,
+            seq=self.seq,
+        )
+
+    def reset(self) -> None:
+        self.cursors[:] = 0
+        self.valid[:] = False
+        self.traces = []
+
+
 class ArenaPool:
     """Fixed pool of staging arenas rotating through in-flight dispatches.
 
     Not thread-safe by itself — the engine serializes acquire/retire
     under its lock (the same discipline as every other staging mutation).
-    """
+    ``factory`` swaps the arena type (the SPMD engine pools
+    :class:`ShardedStagingArena`); the pool itself only needs ``reset()``
+    and ``nbytes`` from its arenas."""
 
     def __init__(self, n_arenas: int, rows: int, channels: int,
-                 lanes: int = 1):
+                 lanes: int = 1, factory=None):
         if n_arenas < 1:
             raise ValueError("arena pool needs at least one arena")
         self.n_arenas = n_arenas
-        self._free: list[StagingArena] = [
-            StagingArena(rows, channels, lanes) for _ in range(n_arenas)]
+        make = factory or (lambda: StagingArena(rows, channels, lanes))
+        self._free: list = [make() for _ in range(n_arenas)]
         # (arena, ticket): ticket is any array from the dispatch that fed
         # on the arena; ticket-ready implies the transfer out of the
         # arena's host buffers has completed
@@ -181,7 +261,7 @@ class ArenaPool:
             self._occupancy_hwm = current
         return hwm
 
-    def acquire(self, timeout_s: float | None = None) -> StagingArena:
+    def acquire(self, timeout_s: float | None = None):
         """A fillable arena; blocks on the oldest in-flight dispatch when
         every arena is tied up (ingest backpressure). With ``timeout_s``
         the block is BOUNDED: a dispatch that never completes (wedged
@@ -199,7 +279,7 @@ class ArenaPool:
             self._occupancy_hwm = occupied
         return arena
 
-    def retire(self, arena: StagingArena, ticket, traces: list = ()) -> None:
+    def retire(self, arena, ticket, traces: list = ()) -> None:
         """Hand a dispatched arena back; it recycles once ``ticket`` is
         ready. ``traces`` are the flight records of the batches it
         carried — the recycle wait already observes the step output, so

@@ -586,7 +586,9 @@ class DecodeWorkerPool:
             eng._wal_append(WAL_JSON, payloads, tenant)
             # _ingest_decoded routes through the engine's staging arenas
             # when they exist: ONE vectorized shm->arena copy replaces
-            # the DecodedArrays copies + HostEventBuffer staging pass
+            # the DecodedArrays copies + HostEventBuffer staging pass.
+            # On an SpmdEngine the same seam scatters the shm columns
+            # into the stacked per-shard arena lanes instead.
             return eng._ingest_decoded(res, payloads, tenant,
                                        JsonDeviceRequestDecoder())
 

@@ -428,6 +428,13 @@ class OpenLoopResult:
     # histograms would otherwise launder into "one slow frame". None
     # when devicewatch is unavailable/disabled.
     compile_counts: dict | None = None
+    # ingest-path provenance (ISSUE 17): host_counters deltas over the
+    # run — ``arena_rows`` (rows scattered zero-copy into staging
+    # arenas) vs ``staged_copy_rows`` (rows that took a per-row host
+    # copy). On an SpmdEngine in its default arena mode every measured
+    # event should land in arena_rows, pinning that open-loop --shards
+    # numbers exercise the batch ingest edge, not per-event staging.
+    ingest_path: dict | None = None
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -472,6 +479,7 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
             compiles0 = compile_totals()
     except ImportError:
         pass
+    hc0 = dict(getattr(engine, "host_counters", None) or {})
     t0 = time.perf_counter()
 
     def checkpoint():
@@ -575,6 +583,9 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
             fam: n - compiles0.get(fam, 0)
             for fam, n in compile_totals().items()
             if n - compiles0.get(fam, 0)}
+    hc1 = getattr(engine, "host_counters", None) or {}
+    ingest_path = {k: int(hc1.get(k, 0)) - int(hc0.get(k, 0))
+                   for k in ("arena_rows", "staged_copy_rows")}
     qp = _pcts(qlat)
     hp = _pcts(hlat)
     return OpenLoopResult(
@@ -586,7 +597,8 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
         history_queries=len(hlat), history_p99_ms=hp["p99_ms"],
         mutations=mutations, max_lateness_s=round(max_late, 4),
         per_tenant=per_tenant, shed_events=sum(shed.values()),
-        trace_coverage=coverage, compile_counts=compile_counts)
+        trace_coverage=coverage, compile_counts=compile_counts,
+        ingest_path=ingest_path)
 
 
 async def run_rest_load(base_url: str, jwt: str, n_workers: int = 5,
@@ -646,7 +658,10 @@ def main() -> None:
                     help="drive the mesh-sharded SPMD engine with N "
                          "shards instead of a single-chip engine "
                          "(0 = single-chip; requires >= N attached "
-                         "devices)")
+                         "devices). Wire frames go through the batch "
+                         "ingest edge (arena scatter), never per-event "
+                         "staging — the result's ingest_path counters "
+                         "pin it")
     args = ap.parse_args()
 
     cfg = EngineConfig(

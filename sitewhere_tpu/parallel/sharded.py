@@ -418,6 +418,7 @@ from sitewhere_tpu.parallel.placement import shard_for_token  # noqa: E402
 SPMD_FAMILY_STEP = "sharded.step"
 SPMD_FAMILY_QUERY = "sharded.query"
 SPMD_FAMILY_SWEEP = "sharded.sweep"
+SPMD_FAMILY_SCAN = "sharded.scan_step"
 
 
 def _make_spmd_step(mesh, config: PipelineConfig):
@@ -434,6 +435,42 @@ def _make_spmd_step(mesh, config: PipelineConfig):
         return (
             jax.tree_util.tree_map(lambda x: x[None], new_state),
             jax.tree_util.tree_map(lambda x: x[None], out),
+        )
+
+    fused = shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+        check_vma=False,
+    )
+    return jax.jit(fused, donate_argnums=(0,))
+
+
+def _make_spmd_scan_step(mesh, config: PipelineConfig, capacity: int,
+                         k: int):
+    """K-chunk packed variant of :func:`_make_spmd_step`: each shard's
+    ``[k * capacity]`` arena lane reshapes to ``[k, capacity]`` INSIDE the
+    jitted program and consumes as one ``lax.scan`` — K single-chip steps
+    per shard in ONE dispatch (one transfer group + one program launch,
+    the remote-chip amortizer, now fused across the mesh). Only the state
+    donates; the stacked batch rides in whole, exactly the single-chip
+    ``make_arena_scan_step`` donation discipline."""
+    from sitewhere_tpu.compat import shard_map
+
+    def local_step(state_blk, batch_blk):
+        lstate = jax.tree_util.tree_map(lambda x: x[0], state_blk)
+        lbatch = jax.tree_util.tree_map(lambda x: x[0], batch_blk)
+        chunks = jax.tree_util.tree_map(
+            lambda col: col.reshape((k, capacity) + col.shape[1:]), lbatch)
+
+        def body(st, one):
+            return pipeline_step(st, one, config)
+
+        new_state, outs = jax.lax.scan(body, lstate, chunks)
+        return (
+            jax.tree_util.tree_map(lambda x: x[None], new_state),
+            jax.tree_util.tree_map(lambda x: x[None], outs),
         )
 
     fused = shard_map(
@@ -578,10 +615,20 @@ class SpmdEngine(Engine):
       1-D mesh; PR 15's fixed slot space is the sharding axis —
       ``shard_for_token(token, N)`` routes exactly where the cluster's
       genesis ``owner_rank`` map would place the token.
-    - The host router (:meth:`_stage_row`) splits the wire batch by slot
-      into per-shard staging lanes; one dispatch feeds ALL lanes to one
-      ``shard_map``-fused ``pipeline_step`` program (WAL/fsync-before-
-      dispatch, donation, dispatch-depth pipelining all preserved).
+    - Batch ingest decodes the wire batch ONCE (native scanner when
+      available, else the vectorized numpy decode path), routes every row
+      to its placement slot's shard vectorized, and scatters rows into the
+      per-shard lanes of a stacked ``[n_shards, rows]`` staging arena
+      whose device transfer matches the mesh sharding — zero host copies
+      per batch, same discipline as the single-chip arena path. The
+      per-row host router (:meth:`_stage_row`) survives as the slow path
+      for admin/registration rows and ``arena=False`` contrast runs; one
+      dispatch feeds ALL lanes to one ``shard_map``-fused
+      ``pipeline_step`` program (WAL/fsync-before-dispatch, donation,
+      dispatch-depth pipelining all preserved). ``scan_chunk > 1`` packs
+      K chunks per shard into one ``lax.scan`` program per flush, and
+      ``ingest_arenas`` depth > 1 overlaps decode of batch N+1 with
+      device execution of batch N under arena-recycle backpressure.
     - Queries run per-shard top-k fused in one program per round
       (SpmdQueryBatcher) and merge on the host, byte-identical to the
       single-chip page whenever ts ties do not span shards.
@@ -594,29 +641,32 @@ class SpmdEngine(Engine):
     store rows carry local ids on device.
 
     v1 limits (explicit): no archive tier, no analytics window, no
-    native decode path, no fair_tenancy/arena ingest, scan_chunk == 1,
-    single-shard device parenting, no precompiled rule swap, and
-    ``search_device_states``/``get_event``/outbound feeds are not yet
-    shard-aware."""
+    fair_tenancy, tenant_arenas == 1, single-shard device parenting, no
+    precompiled rule swap, and ``search_device_states``/``get_event``/
+    outbound feeds are not yet shard-aware."""
 
     def __init__(self, config: EngineConfig | None = None,
-                 n_shards: int | None = None):
+                 n_shards: int | None = None, arena: bool = True):
         cfg0 = config or EngineConfig()
         for bad, why in (
                 (cfg0.archive_dir, "archive tier"),
                 (cfg0.analytics_devices, "analytics window"),
                 (cfg0.tenant_arenas != 1, "tenant_arenas != 1"),
-                (cfg0.scan_chunk != 1, "scan_chunk != 1"),
                 (cfg0.fair_tenancy, "fair_tenancy"),
                 (cfg0.autotune, "autotune")):
             if bad:
                 raise ValueError(f"SpmdEngine does not support {why} (v1)")
         mesh = make_mesh(n_shards)
         n = mesh.devices.size
+        # arena=False keeps the per-row host router on the batch path —
+        # the byte-identity oracle and bench contrast baseline
+        self._spmd_arena = bool(arena) and cfg0.ingest_arenas >= 0
         # the interner spans every shard's tokens; everything else in the
         # base constructor is host machinery the SPMD engine keeps as-is
+        # (the stacked arena pool is built at the END of __init__, once
+        # the mesh exists — _build_arena_machinery defers until then)
         super().__init__(dataclasses.replace(
-            cfg0, use_native=False,
+            cfg0, use_native=cfg0.use_native and self._spmd_arena,
             token_capacity=cfg0.token_capacity * n))
         c = self.config
         self.mesh = mesh
@@ -644,6 +694,12 @@ class SpmdEngine(Engine):
                             for _ in range(n)]
         self._shard_tokens: list[list[int]] = [[] for _ in range(n)]
         self._tid_route: dict[int, tuple[int, int]] = {}
+        # vectorized mirrors of _tid_route for the arena scatter: the
+        # batch path gathers shard/ltid for EVERY row in two indexed
+        # loads instead of a per-row dict probe (c.token_capacity is
+        # already the global, xN space)
+        self._route_shard = np.full(c.token_capacity, -1, np.int32)
+        self._route_ltid = np.full(c.token_capacity, -1, np.int32)
         self._next_local_device = [0] * n
         self._next_local_assignment = [0] * n
         self._admin_spmd: dict[int, object] = {}
@@ -652,8 +708,35 @@ class SpmdEngine(Engine):
         self._query_batcher = SpmdQueryBatcher(self,
                                                max_batch=c.query_coalesce)
         self._query_batcher._wfq = old._wfq
+        # stacked arena pool + packed scan step (deferred from the base
+        # constructor: both need the mesh)
+        if self._spmd_arena:
+            self._build_arena_machinery(max(1, c.scan_chunk))
 
     # ------------------------------------------------------------- routing
+    def _build_arena_machinery(self, k: int) -> None:
+        if not hasattr(self, "mesh"):
+            # called from the base constructor before the mesh exists;
+            # the SPMD pool is built at the end of __init__ instead
+            return
+        from sitewhere_tpu.ingest.arena import ArenaPool, ShardedStagingArena
+
+        c = self.config
+        n_arenas = c.ingest_arenas or max(1, c.dispatch_depth) + 2
+        rows = c.batch_capacity * k
+        self._arena_pool = ArenaPool(
+            n_arenas, rows, c.channels, lanes=k,
+            factory=lambda: ShardedStagingArena(
+                self.n_shards, rows, c.channels, lanes=k))
+        self._arena_step = None
+        if k > 1:
+            # fresh watch scope per rebuild: a scan-chunk retune is a
+            # DECLARED program change, not shape churn
+            self._arena_step = self.devicewatch.wrap(
+                _make_spmd_scan_step(self.mesh, PipelineConfig(
+                    auto_register=c.auto_register, default_device_type=0),
+                    c.batch_capacity, k),
+                SPMD_FAMILY_SCAN, cost=True)
     def _route_token(self, token_id: int) -> tuple[int, int]:
         """(shard, local_token_id) for a global interned token — the slot
         space of parallel/placement decides the shard, local ids allocate
@@ -670,7 +753,24 @@ class SpmdEngine(Engine):
             locs.append(token_id)
             route = (shard, ltid)
             self._tid_route[token_id] = route
+            if token_id < len(self._route_shard):
+                self._route_shard[token_id] = route[0]
+                self._route_ltid[token_id] = route[1]
         return route
+
+    def _route_rows(self, tids: np.ndarray):
+        """Vectorized (shard, local_tid) for a whole batch of global token
+        ids. Unseen tokens route through :meth:`_route_token` in FIRST-
+        OCCURRENCE order so local ids allocate exactly as the per-row
+        router would — the store byte-identity invariant."""
+        sh = self._route_shard[tids]
+        if (sh < 0).any():
+            miss = tids[sh < 0]
+            _, first = np.unique(miss, return_index=True)
+            for t in miss[np.sort(first)]:
+                self._route_token(int(t))
+            sh = self._route_shard[tids]
+        return sh, self._route_ltid[tids]
 
     # -------------------------------------------------------------- ingest
     def _stage_row(self, et, token_id, tenant_id, ts, now, values, mask,
@@ -691,6 +791,295 @@ class SpmdEngine(Engine):
         if buf.full:
             self.flush_async()
 
+    def _ingest_batch_inner(self, payloads, tenant, tag, dec, native_fn,
+                            binary, rec, gate_ctx=None) -> dict:
+        """Batch skeleton with the SPMD arena path swapped in: the wire
+        batch decodes ONCE (native scanner, else the vectorized numpy
+        fallback) and scatters into the stacked per-shard arena lanes.
+        Branch order and lock/WAL discipline mirror the base skeleton
+        verbatim; ``arena=False`` engines fall straight through to the
+        per-row router path."""
+        import contextlib
+
+        if gate_ctx is None:
+            gate_ctx = contextlib.nullcontext()
+        if self._arena_pool is None:
+            return super()._ingest_batch_inner(payloads, tenant, tag, dec,
+                                               native_fn, binary, rec,
+                                               gate_ctx)
+        if native_fn is None:
+            with gate_ctx, self.lock:
+                try:
+                    res = self._decode_batch_py(payloads, dec)
+                    if res is None:
+                        # mixed/stream envelopes: whole batch takes the
+                        # per-request path (exact single-chip semantics)
+                        predecoded = self._strict_predecode(payloads, dec)
+                        self._wal_append(tag, payloads, tenant)
+                        summary = self._ingest_python_fallback(
+                            payloads, tenant, dec, predecoded)
+                        rec.mark("decode")
+                        rec.mark("commit")
+                        return summary
+                    rec.mark("decode")
+                    self._wal_append(tag, payloads, tenant)
+                    return self._ingest_decoded_spmd(res, payloads, tenant,
+                                                     dec, rec)
+                finally:
+                    self._clear_now_pin()
+        if self.config.strict_channels:
+            with gate_ctx, self.lock:
+                try:
+                    names_before = len(self.channel_map.names)
+                    res = native_fn(payloads)
+                    rec.mark("decode")
+                    self._check_strict_native(res, names_before)
+                    self._wal_append(tag, payloads, tenant)
+                    return self._ingest_decoded_spmd(res, payloads, tenant,
+                                                     dec, rec)
+                finally:
+                    self._clear_now_pin()
+        # lenient fast path: native decode OUTSIDE the lock (and the WFQ
+        # turn) so concurrent receivers decode in parallel
+        res = native_fn(payloads)
+        rec.mark("decode")
+        with gate_ctx, self.lock:
+            try:
+                self._wal_append(tag, payloads, tenant)
+                return self._ingest_decoded_spmd(res, payloads, tenant,
+                                                 dec, rec)
+            finally:
+                self._clear_now_pin()
+
+    def _decode_batch_py(self, payloads, dec):
+        """Vectorized-fallback decode: one pass turns a uniform wire batch
+        into the native decoder's SoA ``DecodedArrays`` layout so the
+        arena scatter runs identically with or without the C++ scanner.
+        Interning happens in strict payload order (token, then the row's
+        string fields, then alternate id — exactly :meth:`process`), so
+        every interner id matches the per-request path byte for byte.
+        Returns None when any payload is not a single mappable request
+        (stream envelopes, multi-request frames) — the caller falls back
+        to the per-request path for the whole batch. Caller holds the
+        lock."""
+        from sitewhere_tpu.ingest.fast_decode import (
+            RT_ACK,
+            RT_ALERT,
+            RT_MAP,
+            RT_MEASUREMENT,
+            RT_REGISTER,
+            RT_STATE_CHANGE,
+            RTYPE_TO_ETYPE,
+            DecodedArrays,
+        )
+        from sitewhere_tpu.ingest.fast_decode import RT_LOCATION
+        from sitewhere_tpu.ingest.requests import RequestType
+
+        rt_of = {
+            RequestType.REGISTER_DEVICE: RT_REGISTER,
+            RequestType.DEVICE_MEASUREMENT: RT_MEASUREMENT,
+            RequestType.DEVICE_LOCATION: RT_LOCATION,
+            RequestType.DEVICE_ALERT: RT_ALERT,
+            RequestType.DEVICE_STATE_CHANGE: RT_STATE_CHANGE,
+            RequestType.ACKNOWLEDGE: RT_ACK,
+            RequestType.MAP_DEVICE: RT_MAP,
+        }
+        n = len(payloads)
+        reqs: list = []
+        names: list[str] = []
+        for p in payloads:
+            try:
+                decoded = dec.decode(p, {})
+            except Exception:
+                reqs.append(None)   # failed row (rtype -1)
+                continue
+            if len(decoded) != 1 or decoded[0].type not in rt_of:
+                return None
+            reqs.append(decoded[0])
+            if decoded[0].measurements:
+                names.extend(decoded[0].measurements)
+        if self.channel_map.strict:
+            # reject BEFORE interning/WAL so a refused batch leaks nothing
+            self.channel_map.validate(names)
+        c = self.config.channels
+        rtype = np.full(n, -1, np.int32)
+        token_id = np.full(n, -1, np.int32)
+        ts64 = np.full(n, -1, np.int64)
+        values = np.zeros((n, c), np.float32)
+        chmask = np.zeros((n, c), np.bool_)
+        aux0 = np.full(n, NULL_ID, np.int32)
+        aux1 = np.full(n, NULL_ID, np.int32)
+        level = np.zeros(n, np.int32)
+        for i, req in enumerate(reqs):
+            if req is None:
+                continue
+            try:
+                rt = rt_of[req.type]
+                token_id[i] = self.tokens.intern(req.device_token)
+                if req.event_ts_ms is not None:
+                    ts64[i] = req.event_ts_ms
+                et = RTYPE_TO_ETYPE[rt]
+                if et == int(EventType.MEASUREMENT) and req.measurements:
+                    for name, val in req.measurements.items():
+                        ch = self.channel_map.channel_of(name)
+                        values[i, ch] = val
+                        chmask[i, ch] = True
+                elif et == int(EventType.LOCATION):
+                    if (req.latitude is not None
+                            and req.longitude is not None):
+                        values[i, 0] = req.latitude
+                        values[i, 1] = req.longitude
+                        values[i, 2] = req.elevation or 0.0
+                        chmask[i, :3] = True
+                elif et == int(EventType.ALERT):
+                    level[i] = int(req.alert_level)
+                    chmask[i, 0] = True
+                    aux0[i] = self.alert_types.intern(
+                        req.alert_type or "alert")
+                elif (et == int(EventType.COMMAND_RESPONSE)
+                        and req.originating_event_id):
+                    aux0[i] = self.event_ids.intern(
+                        req.originating_event_id)
+                elif (et == int(EventType.STATE_CHANGE)
+                        and (req.attribute or req.state_type)):
+                    aux0[i] = self.event_ids.intern(
+                        f"{req.attribute or ''}:{req.state_type or ''}")
+                if rt not in (RT_REGISTER, RT_MAP) \
+                        and req.alternate_id is not None:
+                    aux1[i] = self.event_ids.intern(req.alternate_id)
+                rtype[i] = rt
+            except Exception:
+                rtype[i] = -1   # row-level failure, same as native
+        return DecodedArrays(
+            n_ok=int(np.sum(rtype >= 0)), rtype=rtype, token_id=token_id,
+            ts_ms64=ts64, values=values, chmask=chmask, aux0=aux0,
+            aux1=aux1, level=level, collisions=0)
+
+    def _ingest_decoded(self, res, payloads, tenant, reg_decoder) -> dict:
+        # the decode-worker-pool absorb seam: externally decoded SoA
+        # batches take the same stacked-arena scatter as in-process decode
+        if self._arena_pool is None:
+            return super()._ingest_decoded(res, payloads, tenant,
+                                           reg_decoder)
+        return self._ingest_decoded_spmd(res, payloads, tenant,
+                                         reg_decoder, self.flight.current())
+
+    def _ingest_decoded_spmd(self, res, payloads, tenant, reg_decoder,
+                             rec) -> dict:
+        """Scatter a decoded SoA batch into the per-shard lanes of the
+        stacked fill arena: shard/local-id routing is two indexed loads
+        over the whole batch, the scatter is one fancy-indexed store per
+        column — no per-row Python on the batch path. Registration/map/
+        ack envelopes re-route through the per-request slow path exactly
+        like single-chip (:meth:`Engine._decode_prologue`); their tokens
+        pre-route in payload order so local token ids allocate exactly as
+        the per-row router would — the store byte-identity invariant."""
+        from sitewhere_tpu.ingest.fast_decode import RT_MAP
+
+        rec.add("path", "arena")
+        with self.lock:
+            now = self.epoch.now_ms()
+            base_ms = int(self.epoch.base_unix_s * 1000)
+            tids = res.token_id
+            # route every token the row-router would route, in payload
+            # order: event + ack rows (staged) and register rows (routed
+            # by register_device). MAP rows never allocate a route.
+            routable = (tids >= 0) & (res.rtype != RT_MAP)
+            sh = np.full(len(tids), -1, np.int32)
+            ltid = np.full(len(tids), -1, np.int32)
+            if routable.any():
+                sh[routable], ltid[routable] = \
+                    self._route_rows(tids[routable])
+            rec.mark("route")
+            etype, ok, ts_rel, values, failed, n_reg_ok = \
+                self._decode_prologue(res, payloads, tenant, reg_decoder,
+                                      now, base_ms)
+            idxs = np.nonzero(ok)[0]
+            tenant_id = self.tenants.intern(tenant)
+            staged = 0
+            rem = idxs
+            while rem.size:
+                arena = self._arena_fill
+                if arena is None:
+                    arena = self._arena_fill = \
+                        self._acquire_arena(tenant, int(rem.size))
+                rs = sh[rem]
+                # per-shard running offsets within this chunk (<= n_shards
+                # bincount-style groups, never per-row Python)
+                cum = np.empty(rem.size, np.int64)
+                for s in np.unique(rs):
+                    m = rs == s
+                    cum[m] = np.arange(int(m.sum()))
+                dst = arena.cursors[rs] + cum
+                fit = dst < arena.rows
+                rows_f, rs_f, dst_f = rem[fit], rs[fit], dst[fit]
+                arena.etype[rs_f, dst_f] = etype[rows_f]
+                arena.token_id[rs_f, dst_f] = ltid[rows_f]
+                arena.tenant_id[rs_f, dst_f] = tenant_id
+                arena.ts_ms[rs_f, dst_f] = ts_rel[rows_f]
+                arena.received_ms[rs_f, dst_f] = now
+                arena.values[rs_f, dst_f] = values[rows_f]
+                arena.vmask[rs_f, dst_f] = res.chmask[rows_f]
+                arena.aux[rs_f, dst_f, 0] = res.aux0[rows_f]
+                arena.aux[rs_f, dst_f, 1] = res.aux1[rows_f]
+                arena.valid[rs_f, dst_f] = True
+                arena.cursors += np.bincount(rs_f,
+                                             minlength=self.n_shards)
+                staged += int(rows_f.size)
+                rec.mark("arena_fill")
+                if rec.trace_id is not None and (
+                        not arena.traces or arena.traces[-1] is not rec):
+                    arena.traces.append(rec)
+                if rows_f.size < rem.size:
+                    # a shard lane overflowed: dispatch and re-scatter the
+                    # remainder into a fresh arena
+                    self._dispatch_arena()
+                    rem = rem[~fit]
+                else:
+                    rem = rem[:0]
+            rec.mark("commit")
+            arena = self._arena_fill
+            if arena is not None and \
+                    int(arena.cursors.min()) >= arena.rows:
+                self._dispatch_arena()   # every lane exactly full
+            self.channel_map.collisions += res.collisions
+            self.host_counters["arena_rows"] = \
+                self.host_counters.get("arena_rows", 0) + staged
+            self.ledger.add("staged_rows", staged)
+        return {"decoded": staged + n_reg_ok, "failed": failed,
+                "staged": staged}
+
+    def _dispatch_arena(self) -> None:
+        """Dispatch the stacked fill arena: mask lanes past each shard's
+        cursor invalid (free padding), gate on WAL durability, place the
+        ``[S, rows]`` batch over the mesh and run the fused step —
+        packed ``lax.scan`` program when ``scan_chunk > 1``. Caller holds
+        the lock."""
+        arena = self._arena_fill
+        if arena is None or not arena.cursors.any():
+            return
+        arena.valid &= (np.arange(arena.rows)[None, :]
+                        < arena.cursors[:, None])
+        self.ledger.add("dispatched_rows", int(np.sum(arena.valid)))
+        traces, arena.traces = arena.traces, []
+        self._wal_gate(traces)
+        for rec in traces:
+            rec.mark("dispatch")
+        batch = arena.view_batch()
+        batch = jax.device_put(batch, stack_sharding(self.mesh, batch))
+        step = self._arena_step or self._step
+        self.state, out = step(self.state, batch)
+        self._enqueue_out(out, traces)
+        # the recycle wait that proves the transfer completed ALSO proves
+        # the device program ran: device_ready harvests there, free
+        self._arena_pool.retire(arena, out.n_persisted, traces)
+        self._archive_account(arena.cursor * MAX_ACTIVE_ASSIGNMENTS)
+        self._arena_fill = None
+        self._arena_dispatches += 1
+        self._last_flush = time.monotonic()
+        if self._autotuner is not None:
+            self._autotuner.note_dispatch()
+
     def flush_async(self) -> None:
         """One SPMD dispatch: emit EVERY shard lane (empty lanes ride as
         all-invalid rows — the program shape never changes), stack to
@@ -699,6 +1088,11 @@ class SpmdEngine(Engine):
             staged = self.staged_count
             if staged > self._backlog_hwm:
                 self._backlog_hwm = staged
+            # arena rows precede copy-staged rows within one flush; a
+            # partially filled arena flushes too — but never mid-commit
+            if (self._arena_fill is not None and self._arena_fill.cursor
+                    and not self._arena_committing):
+                self._dispatch_arena()
             n_staged = sum(len(b) for b in self._shard_bufs)
             if not n_staged:
                 return
@@ -718,10 +1112,17 @@ class SpmdEngine(Engine):
     @property
     def staged_count(self) -> int:
         return (sum(len(b) for b in self._shard_bufs) + len(self._buf)
-                + self._fair_queued)
+                + self._fair_queued
+                + (self._arena_fill.cursor
+                   if self._arena_fill is not None else 0))
+
+    def _arena_backlogged(self) -> bool:
+        return (self._arena_fill is not None and self._arena_fill.cursor
+                and not self._arena_committing)
 
     def _sync_mirrors(self) -> None:
-        while any(len(b) for b in self._shard_bufs):
+        while (any(len(b) for b in self._shard_bufs)
+               or self._arena_backlogged()):
             self.flush_async()
         if self._pending_outs:
             self.drain()
@@ -730,7 +1131,8 @@ class SpmdEngine(Engine):
         with self.lock:
             expired = (time.monotonic() - self._last_flush
                        >= self.config.flush_interval_s)
-            if any(len(b) for b in self._shard_bufs) and expired:
+            if (any(len(b) for b in self._shard_bufs)
+                    or self._arena_backlogged()) and expired:
                 return self.flush()
             if self._pending_outs and expired:
                 return _merge_summaries(self.drain())
@@ -738,7 +1140,8 @@ class SpmdEngine(Engine):
 
     def barrier(self) -> None:
         with self.lock:
-            while any(len(b) for b in self._shard_bufs):
+            while (any(len(b) for b in self._shard_bufs)
+                   or self._arena_backlogged()):
                 self.flush_async()
             if self._pending_outs:
                 jax.block_until_ready(self._pending_outs[-1].n_persisted)
@@ -764,8 +1167,16 @@ class SpmdEngine(Engine):
                 for shard in range(self.n_shards):
                     sub = jax.tree_util.tree_map(
                         lambda x, _s=shard: x[_s], out)
-                    summaries.append(self._absorb_shard(
-                        shard, sub, *(int(x[shard]) for x in s)))
+                    if np.ndim(s[0]) == 1:        # [S] single-step out
+                        summaries.append(self._absorb_shard(
+                            shard, sub, *(int(x[shard]) for x in s)))
+                    else:                          # [S, K] packed scan out
+                        for kk in range(np.shape(s[0])[1]):
+                            subk = jax.tree_util.tree_map(
+                                lambda x, _k=kk: x[_k], sub)
+                            summaries.append(self._absorb_shard(
+                                shard, subk,
+                                *(int(x[shard, kk]) for x in s)))
             return summaries
 
     def _absorb_shard(self, shard: int, out: StepOutput, n_found: int,

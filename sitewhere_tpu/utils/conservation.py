@@ -161,7 +161,13 @@ def _backlog_rows(eng) -> int:
     n += int(fq.sum()) if hasattr(fq, "sum") else int(fq)
     fill = getattr(eng, "_arena_fill", None)
     if fill is not None:
-        n += int(np.sum(fill.valid[:fill.cursor]))
+        cursors = getattr(fill, "cursors", None)
+        if cursors is not None:
+            # SPMD stacked arena: [S, rows] lanes with per-shard cursors
+            for s, cnt in enumerate(cursors):
+                n += int(np.sum(fill.valid[s, :int(cnt)]))
+        else:
+            n += int(np.sum(fill.valid[:fill.cursor]))
     for b in getattr(eng, "_staged_batches", ()):
         n += int(np.sum(b.valid))
     # SPMD engine (ISSUE 16): per-shard staging buffers

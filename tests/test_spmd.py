@@ -95,6 +95,15 @@ def _engines(n_shards, **kw):
     return ref, spmd
 
 
+def _spmd(n_shards, scan_chunk=1, depth=0, arena=True, **kw):
+    eng = SpmdEngine(
+        EngineConfig(**{**CFG, "scan_chunk": scan_chunk,
+                        "ingest_arenas": depth, **kw}),
+        n_shards=n_shards, arena=arena)
+    eng.epoch = FixedEpoch()
+    return eng
+
+
 def _run(engines, events, chunk=32):
     for lo in range(0, len(events), chunk):
         wire = [_meas(t, v, ts) for t, v, ts in events[lo:lo + chunk]]
@@ -292,6 +301,120 @@ def test_spmd_families_zero_steady_state_recompiles():
     assert WATCH.excess_total() == pre_excess
 
 
+# --- arena ingest: cartesian parity matrix (ISSUE 17) -----------------------
+#
+# The stacked-arena batch path must be byte-identical to the v1 per-row
+# router for every (mesh size, scan_chunk packing, pipeline depth) combo:
+# same store bytes, same query pages and tie order, same event-count
+# metrics, balanced conservation on every shard. Heavy combos are -m slow.
+
+_MATRIX = [(n, k, d) for n in (1, 2, 4) for k in (1, 2) for d in (1, 2)]
+_LIGHT = {(2, 1, 1), (2, 2, 2)}
+
+
+@pytest.mark.parametrize(
+    "n_shards,scan_chunk,depth",
+    [pytest.param(*combo,
+                  marks=() if combo in _LIGHT else pytest.mark.slow)
+     for combo in _MATRIX])
+def test_arena_matrix_byte_identity_and_parity(n_shards, scan_chunk, depth):
+    arena = _spmd(n_shards, scan_chunk, depth)
+    router = _spmd(n_shards, arena=False)      # v1 per-row router oracle
+    ref = Engine(EngineConfig(**CFG))
+    ref.epoch = FixedEpoch()
+    events = _stream()
+    _run([arena, router, ref], events)
+    for e in (arena, router, ref):
+        e.barrier()
+        e.drain()
+    # store byte-identity: every leaf of the stacked store
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(arena.state.store)),
+            jax.tree_util.tree_leaves(jax.device_get(router.state.store))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the batch path never takes the copy-staging router
+    assert arena.host_counters.get("staged_copy_rows", 0) == 0
+    assert arena.host_counters.get("arena_rows", 0) == len(events)
+    # query-page parity vs single-chip (full, truncated, filtered)
+    for kw in (dict(limit=200), dict(limit=7),
+               dict(device_token="sp-3", limit=20)):
+        assert _page(ref, **kw) == _page(arena, **kw), kw
+    # metrics parity on everything event-count-shaped
+    a, b = arena.metrics(), router.metrics()
+    for k in ("processed", "found", "missed", "registered", "persisted",
+              "reg_overflow", "channel_collisions", "staged"):
+        assert a[k] == b[k], (k, a[k], b[k])
+    # conservation balances through the stacked arena lanes
+    assert check_conservation(build_ledger(arena)) == []
+
+
+@pytest.mark.parametrize("n_shards,scan_chunk,depth",
+                         [(2, 1, 1), pytest.param(2, 2, 2,
+                                                  marks=pytest.mark.slow)])
+def test_arena_tie_order_matches_router(n_shards, scan_chunk, depth):
+    arena = _spmd(n_shards, scan_chunk, depth)
+    router = _spmd(n_shards, arena=False)
+    events = _stream(ties=True)
+    _run([arena, router], events)
+    assert _page(arena, limit=200) == _page(router, limit=200)
+
+
+def test_arena_scan_chunk_retune_stays_byte_identical():
+    arena = _spmd(2, scan_chunk=1, depth=2)
+    router = _spmd(2, arena=False)
+    events = _stream()
+    half = len(events) // 2
+    _run([arena, router], events[:half])
+    applied = arena.set_ingest_tuning(scan_chunk=2)
+    assert applied["scan_chunk"] == 2
+    _run([arena, router], events[half:])
+    for e in (arena, router):
+        e.barrier()
+        e.drain()
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(arena.state.store)),
+            jax.tree_util.tree_leaves(jax.device_get(router.state.store))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_qos_shed_then_recover_no_loss_on_spmd():
+    """Per-tenant admission at the SPMD ingest edge: a flood sheds at the
+    rate limiter, the client retries after Retry-After, and afterwards
+    the persisted count equals the admitted count exactly — nothing an
+    arena dispatch saw is lost or double-applied."""
+    from sitewhere_tpu.utils.qos import AdmissionController, ManualClock
+
+    spmd = _spmd(2, scan_chunk=2, depth=2, qos=True)
+    clk = ManualClock()
+    spmd.qos = AdmissionController(tenant_rates={"sr-t": 40.0},
+                                   burst_s=1.0, clock=clk)
+    frames = [[_meas(f"sp-{j}", 20.0 + i, 1_000 + i * 10 + j)
+               for j in range(10)] for i in range(12)]
+    admitted = sheds = 0
+    backlog = list(frames)
+    rounds = 0
+    while backlog and rounds < 100:
+        rounds += 1
+        still = []
+        for f in backlog:
+            d = spmd.qos.admit("sr-t", len(f))
+            if d.admitted:
+                spmd.ingest_json_batch(f, "sr-t")
+                admitted += len(f)
+            else:
+                sheds += 1
+                still.append(f)
+        backlog = still
+        clk.advance(0.5)
+    assert not backlog and sheds > 0      # the cycle actually shed
+    spmd.flush()
+    assert admitted == 120
+    counters = spmd.tenant_pipeline_counters().get("sr-t", {})
+    assert counters.get("accepted") == 120          # no loss
+    assert counters.get("dedup_dropped", 0) == 0    # no double-apply
+    assert spmd.host_counters.get("staged_copy_rows", 0) == 0
+
+
 # --- conservation -----------------------------------------------------------
 
 
@@ -350,8 +473,12 @@ def test_unsupported_configs_are_refused():
     with pytest.raises(ValueError, match="archive"):
         SpmdEngine(EngineConfig(**{**CFG, "archive_dir": "/tmp/x"}),
                    n_shards=2)
-    with pytest.raises(ValueError, match="scan_chunk"):
-        SpmdEngine(EngineConfig(**{**CFG, "scan_chunk": 2}), n_shards=2)
+    with pytest.raises(ValueError, match="fair_tenancy"):
+        SpmdEngine(EngineConfig(**{**CFG, "fair_tenancy": True}),
+                   n_shards=2)
+    # scan_chunk > 1 is SUPPORTED since the packed arena path (ISSUE 17)
+    assert SpmdEngine(EngineConfig(**{**CFG, "scan_chunk": 2}),
+                      n_shards=2).config.scan_chunk == 2
     eng = SpmdEngine(EngineConfig(**CFG), n_shards=2)
     with pytest.raises(NotImplementedError):
         eng.search_device_states()
