@@ -1794,6 +1794,15 @@ def main() -> None:
                     f"recompiles={sp['spmd_steady_recompiles']} "
                     f"violations={sp['conservation_spmd_violations']} "
                     f"stages={sp['spmd_stage_medians']}")
+                log(f"SPMD heat leg: top1_tenant="
+                    f"{sp['spmd_heat_top1_hot_tenant']} "
+                    f"top1_slot={sp['spmd_heat_top1_hot_slot']} "
+                    f"(slot {sp['spmd_hot_slot']}, shard "
+                    f"{sp['spmd_hot_shard']}) "
+                    f"overhead={sp['spmd_heat_overhead_pct']}% "
+                    f"recompiles={sp['spmd_heat_steady_recompiles']} "
+                    f"skew={sp['spmd_skew_index']} "
+                    f"flow_balanced={sp['spmd_shard_flow_balanced']}")
             else:
                 log(f"SPMD leg subprocess failed rc={_sp_out.returncode}: "
                     f"{_sp_out.stderr[-2000:]}")
@@ -2855,6 +2864,31 @@ def main() -> None:
             log(f"FAIL: conservation ledger did not balance through the "
                 f"sharded staging lanes "
                 f"({sp['conservation_spmd_violations']} violation(s))")
+            sys.exit(1)
+        # shard heat & skew plane (ISSUE 18)
+        if not sp["spmd_heat_top1_hot_tenant"]:
+            log("FAIL: the heat map's hottest (shard, tenant) cell is "
+                "not the seeded hot tenant — the plane cannot attribute "
+                "a known hotspot")
+            sys.exit(1)
+        if not sp["spmd_heat_top1_hot_slot"]:
+            log("FAIL: the top-1 hot slot is not the seeded hot "
+                "device's placement slot — slot heat cannot drive "
+                "rebalance decisions")
+            sys.exit(1)
+        if sp["spmd_heat_overhead_pct"] > 3.0:
+            log(f"FAIL: shard heat plane costs "
+                f"{sp['spmd_heat_overhead_pct']}% > 3% of SPMD ingest "
+                "throughput")
+            sys.exit(1)
+        if sp["spmd_heat_steady_recompiles"] != 0:
+            log(f"FAIL: {sp['spmd_heat_steady_recompiles']} XLA "
+                "compile(s) during the heat-instrumented steady-state "
+                "run — the plane added device work")
+            sys.exit(1)
+        if not sp["spmd_shard_flow_balanced"]:
+            log("FAIL: per-shard conservation breakdown did not "
+                "balance on the hotspot leg")
             sys.exit(1)
     if smoke and pl:
         if pl["placement_overhead_pct"] > 3.0:

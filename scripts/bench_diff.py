@@ -90,6 +90,12 @@ GATES: dict[str, tuple[str, "float | str | None"]] = {
     "spmd_steady_recompiles": ("zero", None),
     "spmd_excess_retraces": ("zero", None),
     "conservation_spmd_violations": ("zero", None),
+    # shard heat & skew observability plane (ISSUE 18): the hotspot leg
+    "spmd_heat_top1_hot_tenant": ("true", None),
+    "spmd_heat_top1_hot_slot": ("true", None),
+    "spmd_heat_overhead_pct": ("max", 3.0),
+    "spmd_heat_steady_recompiles": ("zero", None),
+    "spmd_shard_flow_balanced": ("true", None),
 }
 
 # Every gate the SMOKE bench unconditionally emits (hardware-only legs
@@ -123,6 +129,9 @@ SMOKE_GATES = frozenset({
     "spmd_shards", "spmd_store_parity", "spmd_query_parity",
     "spmd_metrics_equal", "spmd_rules_parity", "spmd_steady_recompiles",
     "spmd_excess_retraces", "conservation_spmd_violations",
+    "spmd_heat_top1_hot_tenant", "spmd_heat_top1_hot_slot",
+    "spmd_heat_overhead_pct", "spmd_heat_steady_recompiles",
+    "spmd_shard_flow_balanced",
 })
 
 

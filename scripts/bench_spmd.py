@@ -258,6 +258,102 @@ def main() -> int:
     violations = [v.to_dict() if hasattr(v, "to_dict") else str(v)
                   for v in check_conservation(build_ledger(spmd, mspmd))]
 
+    # --- shard heat & skew hotspot leg (ISSUE 18) ------------------------
+    # A seeded hotspot stream: a broad background tenant plus a "hot"
+    # tenant whose abusive extra stream is pinned onto ONE device (the
+    # loadgen hotspot knob), concentrating the burst on one placement
+    # slot / shard lane. Gates: the heat plane's top-1 (shard, tenant)
+    # cell and top-1 slot name the seeded target, the per-dispatch
+    # accounting costs <= 3% (interleaved on/off contrast, min of 3
+    # sessions — the placement-plane discipline), zero steady-state
+    # recompiles with live harvests, and the per-shard conservation
+    # breakdown balances.
+    import statistics
+
+    from sitewhere_tpu.loadgen import (OpenLoopSpec, TenantLoad,
+                                       build_open_loop_schedule)
+    from sitewhere_tpu.parallel.placement import slot_for_token
+    from sitewhere_tpu.pipeline import TENANT_COUNTER_BUCKETS
+
+    HOT_DEV = 0
+    hot_spec = OpenLoopSpec(
+        tenants=(
+            TenantLoad("bg", rate_eps=(1500.0 if smoke else 12000.0),
+                       n_devices=DEVS),
+            TenantLoad("hot", rate_eps=(300.0 if smoke else 2400.0),
+                       n_devices=8, abusive_mult=8.0,
+                       abusive_device=HOT_DEV),
+        ),
+        duration_s=1.0 if smoke else 2.0,
+        frame_size=max(64, BATCH // 2), seed=18)
+    hot_frames = [(op.tenant, op.payloads)
+                  for op in build_open_loop_schedule(hot_spec)
+                  if op.kind == "ingest"]
+
+    heng = SpmdEngine(EngineConfig(**cfg, scan_chunk=2),
+                      n_shards=n_shards)
+    heng.epoch = FixedEpoch()
+    # warm: compile (and register both tenants' devices) outside the
+    # timed window, with two harvests priming the EWMA baselines
+    h_clock = 0.0
+    for tenant, payloads in hot_frames[:4]:
+        heng.ingest_json_batch(payloads, tenant)
+    heng.flush()
+    heng.drain()
+    heng.harvest_shard_heat(now_s=h_clock)
+    hot_pre_compiles = WATCH.compile_totals()
+
+    # one continuous stream, per-batch plane toggle with alternating
+    # phase per session; harvests run live (injected clock — the EWMA
+    # maps are deterministic) so the recompile gate covers them
+    overheads = []
+    for sess in range(3):
+        on: list[float] = []
+        off: list[float] = []
+        for k, (tenant, payloads) in enumerate(hot_frames):
+            heng.shard_heat.enabled = bool((k + sess) % 2)
+            t0 = time.perf_counter()
+            heng.ingest_json_batch(payloads, tenant)
+            dt = time.perf_counter() - t0
+            (on if heng.shard_heat.enabled else off).append(dt)
+            if k % 8 == 7:
+                h_clock += 0.25
+                heng.harvest_shard_heat(now_s=h_clock)
+        heng.flush_async()
+        heng.barrier()
+        med_on = statistics.median(on)
+        med_off = statistics.median(off)
+        overheads.append(max(0.0, (med_on - med_off) / med_off * 100.0))
+    heng.shard_heat.enabled = True
+    heng.drain()
+    heat_overhead_pct = round(min(overheads), 2)
+
+    h_clock += 0.25
+    tr = heng.harvest_shard_heat(now_s=h_clock)
+    heat_recompiles = sum(
+        (WATCH.compile_totals().get(k, 0) - v)
+        for k, v in hot_pre_compiles.items())
+
+    hot_bucket = None
+    for tid in range(len(heng.tenants)):
+        if heng.tenants.token(tid) == "hot":
+            hot_bucket = tid % TENANT_COUNTER_BUCKETS
+    hs, hb = np.unravel_index(int(np.argmax(tr.heat_grid)),
+                              tr.heat_grid.shape)
+    hot_slot = slot_for_token(f"hot-dev-{HOT_DEV}", n_shards)
+    top = tr.top_slots(k=1)
+    top1_tenant = hot_bucket is not None and int(hb) == hot_bucket
+    top1_slot = bool(top) and top[0][0] == hot_slot
+
+    heng.flush()
+    hot_violations = check_conservation(build_ledger(heng))
+    flow = heng.shard_flow()
+    flow_balanced = (not hot_violations
+                     and "spmd" in build_ledger(heng)["stages"]
+                     and sum(r["accepted"] + r["invalid"]
+                             for r in flow["perShard"])
+                     == sum(r["processed"] for r in flow["perShard"]))
+
     print(json.dumps({
         "spmd_shards": n_shards,
         "spmd_store_parity": store_parity,
@@ -275,6 +371,14 @@ def main() -> int:
         "spmd_stage_medians": stage_medians,
         "spmd_query_qps": round(query_qps, 1),
         "spmd_events_total": n_events,
+        "spmd_heat_top1_hot_tenant": bool(top1_tenant),
+        "spmd_heat_top1_hot_slot": bool(top1_slot),
+        "spmd_heat_overhead_pct": heat_overhead_pct,
+        "spmd_heat_steady_recompiles": heat_recompiles,
+        "spmd_shard_flow_balanced": bool(flow_balanced),
+        "spmd_skew_index": round(float(tr.skew_index), 3),
+        "spmd_hot_slot": int(hot_slot),
+        "spmd_hot_shard": int(hs),
     }))
     return 0
 

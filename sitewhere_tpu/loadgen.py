@@ -179,6 +179,17 @@ class TenantLoad:
     abusive_period_s: float = 0.0      # burst window period; 0 (with
                                        # mult > 1) = the whole horizon
     abusive_burst_s: float = 0.0       # burst length within each period
+    abusive_device: int | None = None  # hotspot knob (ISSUE 18): pin
+                                       # every EXTRA (abusive-stream)
+                                       # event onto this one device
+                                       # index, concentrating the burst
+                                       # on a single placement slot /
+                                       # shard lane so the heat plane
+                                       # has a known-hot target. None
+                                       # (default) keeps the extra
+                                       # stream's device picks from the
+                                       # base RNG — byte-identical to
+                                       # pre-knob schedules
     rule_trigger_eps: float = 0.0      # rule-trigger traffic (ISSUE 13):
                                        # a SEPARATE seeded Poisson stream
                                        # of threshold-crossing
@@ -275,8 +286,25 @@ def build_open_loop_schedule(spec: OpenLoopSpec) -> list[ScheduledOp]:
             if tl.abusive_period_s > 0 and tl.abusive_burst_s > 0:
                 xarr = xarr[(xarr % tl.abusive_period_s)
                             < tl.abusive_burst_s]
-            arr = np.sort(np.concatenate([arr, xarr]), kind="stable")
+            # stable argsort == np.sort(kind="stable") on the times,
+            # while also carrying WHICH rows came from the extra stream
+            # (the hotspot knob needs the provenance; the merged arrival
+            # array is byte-identical either way)
+            n_base = len(arr)
+            both = np.concatenate([arr, xarr])
+            order = np.argsort(both, kind="stable")
+            arr = both[order]
+            abusive_at = order >= n_base
+        else:
+            abusive_at = None
         picks = rng.integers(0, tl.n_devices, len(arr))
+        if abusive_at is not None and tl.abusive_device is not None:
+            # hotspot: the extra stream's events all land on one device
+            # (one slot, one shard). picks is drawn BEFORE this with the
+            # same count either way, so base-stream devices — and every
+            # abusive_device=None schedule — keep their fingerprints
+            picks = picks.copy()
+            picks[abusive_at] = int(tl.abusive_device) % tl.n_devices
         is_rule = np.zeros(len(arr), bool)
         if tl.rule_trigger_eps > 0:
             # rule-trigger traffic (ISSUE 13): threshold-crossing

@@ -1603,6 +1603,29 @@ class ClusterEngine:
                 "byRank": by_rank, "violations": violations,
                 "balanced": violations == 0}
 
+    def spmd_heat(self) -> dict:
+        """Cluster-wide shard heat & skew (ISSUE 18): every live rank's
+        heat document under its rank key (rank-labeled federation, the
+        conservation() shape). Heat maps never merge — each rank's
+        shards are its own mesh; a DOWN rank degrades to an
+        ``unreachable`` entry."""
+        from sitewhere_tpu.utils.shardobs import spmd_heat_payload
+
+        keyed = self._fanout_keyed(spmd_heat_payload(self),
+                                   "Cluster.spmdHeat", tolerant=True,
+                                   ranks=self._data_ranks())
+        by_rank: dict[str, dict] = {}
+        spmd_any = False
+        for r, res in keyed.items():
+            if isinstance(res, PeerDown):
+                by_rank[str(r)] = {"unreachable": True,
+                                   "reason": res.reason}
+            else:
+                by_rank[str(r)] = res
+                spmd_any = spmd_any or bool(res.get("spmd"))
+        return {"clustered": self.n_ranks > 1, "rank": self.rank,
+                "spmd": spmd_any, "byRank": by_rank}
+
     def cluster_status(self) -> dict:
         """The operator's cluster page: this rank's identity, every
         rank's reachability + device count, and the durability gauges.
@@ -2106,6 +2129,15 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
 
         return conservation_payload(engine)
 
+    def spmd_heat():
+        """This rank's shard heat & skew document (ISSUE 18) — the
+        facade's ``spmd_heat()`` fans these out into one by-rank
+        document; heat maps never merge (each rank's shards are its
+        OWN mesh)."""
+        from sitewhere_tpu.utils.shardobs import spmd_heat_payload
+
+        return spmd_heat_payload(engine)
+
     def trace_get(traceId: str):
         return engine.flight.records_of(traceId)
 
@@ -2155,6 +2187,7 @@ def register_cluster_rpc(srv, engine: DistributedEngine) -> None:
         "Cluster.traceRecent": trace_recent,
         "Cluster.traceTimeline": trace_timeline,
         "Cluster.conservation": conservation,
+        "Cluster.spmdHeat": spmd_heat,
         "Cluster.flush": flush,
     }.items():
         srv.register(name, fn)

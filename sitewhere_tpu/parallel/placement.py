@@ -117,6 +117,15 @@ def _slot_of(token: str, n_slots: int) -> int:
     return owner_rank(token, n_slots)
 
 
+def slot_for_token(token: str, n_shards: int,
+                   slots_per_rank: int = DEFAULT_SLOTS_PER_RANK) -> int:
+    """The placement SLOT a token hashes into in an SPMD store's slot
+    space (``n_slots = n_shards * slots_per_rank``) — the identity the
+    shard heat plane (ISSUE 18) attributes routed rows to, and the unit
+    ``decide_balance`` moves."""
+    return _slot_of(token, n_shards * slots_per_rank)
+
+
 def shard_for_token(token: str, n_shards: int,
                     slots_per_rank: int = DEFAULT_SLOTS_PER_RANK) -> int:
     """THE slot -> shard map of the SPMD store (ISSUE 16): tokens hash
@@ -127,7 +136,7 @@ def shard_for_token(token: str, n_shards: int,
     partitioner — a token lands on the same index whether "index" means
     a cluster rank or an SPMD mesh shard, so placement tooling and the
     conservation ledger carry over unmodified."""
-    return _slot_of(token, n_shards * slots_per_rank) % n_shards
+    return slot_for_token(token, n_shards, slots_per_rank) % n_shards
 
 
 @dataclasses.dataclass(frozen=True)
@@ -1194,7 +1203,8 @@ def drain_rank(cluster, rank: int) -> dict:
 def decide_balance(tenant_p99_ms: dict, tenant_rank: dict,
                    tenant_slots: dict, pmap: PlacementMap,
                    p99_target_ms: float,
-                   max_moves: int = 1) -> list[tuple[int, int]]:
+                   max_moves: int = 1,
+                   slot_heat: dict | None = None) -> list[tuple[int, int]]:
     """PURE balancing policy (unit-testable like autotune.decide): given
     each tenant's worst e2e p99, its dominant owner rank, and the slots
     its devices hash into, propose up to ``max_moves`` (slot, target)
@@ -1202,7 +1212,13 @@ def decide_balance(tenant_p99_ms: dict, tenant_rank: dict,
     the active rank with the fewest slots. No proposal when nothing
     breaches the target, when the hot tenant's rank is already the
     lightest, or when the hot slot is the rank's only slot (moving it
-    would just relocate the problem)."""
+    would just relocate the problem).
+
+    ``slot_heat`` (ISSUE 18) is an optional ``{slot: events/s}`` map —
+    the SPMD shard heat plane's slot EWMA — used to pick the ACTUAL
+    busiest of the tenant's slots instead of the first. ``None`` keeps
+    the decision byte-identical to the pre-heat policy (pure-function
+    pin in tests/test_shardobs.py)."""
     breaches = sorted(((p, t) for t, p in tenant_p99_ms.items()
                        if p is not None and p > p99_target_ms),
                       reverse=True)
@@ -1223,7 +1239,13 @@ def decide_balance(tenant_p99_ms: dict, tenant_rank: dict,
                      key=lambda r: load[r], default=None)
         if target is None or load[target] >= load[src]:
             continue
-        slot = slots[0]
+        if slot_heat:
+            # hottest of the tenant's slots by measured events/s; ties
+            # (and unmeasured slots, heat 0.0) break toward the lowest
+            # slot id, which is slots[0] when nothing is measured
+            slot = max(slots, key=lambda s: (slot_heat.get(s, 0.0), -s))
+        else:
+            slot = slots[0]
         moves.append((slot, target))
         load[src] -= 1
         load[target] += 1
@@ -1231,13 +1253,20 @@ def decide_balance(tenant_p99_ms: dict, tenant_rank: dict,
 
 
 def propose_moves(cluster, p99_target_ms: float = 250.0,
-                  max_moves: int = 1) -> list[tuple[int, int]]:
+                  max_moves: int = 1,
+                  heat: dict | None = None) -> list[tuple[int, int]]:
     """Gather the live inputs for :func:`decide_balance` from the SLO
     plane (the per-tenant ``swtpu_ingest_e2e_seconds`` histograms, PR
     7/9) and this rank's device registry, and return proposed
     ``(slot, target)`` moves. Advisory: the operator (or an autonomous
     loop) applies them through :func:`move_slots` — placement changes
-    always ride the fenced protocol, never a side door."""
+    always ride the fenced protocol, never a side door.
+
+    ``heat`` (ISSUE 18) is an optional ``{slot: events/s}`` map — feed
+    it the SPMD heat plane's top-K slot document (``spmd_heat_payload``
+    "slots"/"topK", or a tracker's ``top_slots()``) so the hot tenant's
+    ACTUAL busiest slot moves. ``None`` (the default) is byte-identical
+    to the PR-15 policy."""
     from sitewhere_tpu.utils.metrics import REGISTRY, slo_metrics
 
     hist = slo_metrics(REGISTRY)["ingest_e2e"]
@@ -1260,7 +1289,8 @@ def propose_moves(cluster, p99_target_ms: float = 250.0,
                    for t, v in tenant_rank_votes.items() if v}
     return decide_balance(tenant_p99, tenant_rank,
                           {t: sorted(s) for t, s in tenant_slots.items()},
-                          m, p99_target_ms, max_moves=max_moves)
+                          m, p99_target_ms, max_moves=max_moves,
+                          slot_heat=heat)
 
 
 # --------------------------------------------------------------------------

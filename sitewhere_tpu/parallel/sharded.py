@@ -410,7 +410,11 @@ from sitewhere_tpu.engine import (  # noqa: E402
     tenant_cap,
     tenant_counts_dict,
 )
-from sitewhere_tpu.parallel.placement import shard_for_token  # noqa: E402
+from sitewhere_tpu.parallel.placement import (  # noqa: E402
+    DEFAULT_SLOTS_PER_RANK,
+    slot_for_token,
+)
+from sitewhere_tpu.utils.shardobs import ShardHeatTracker  # noqa: E402
 
 # budgeted per-engine scope names for the fused SPMD programs (distinct
 # from the unbudgeted module-global shims above: an SpmdEngine dispatches
@@ -702,6 +706,20 @@ class SpmdEngine(Engine):
         self._route_ltid = np.full(c.token_capacity, -1, np.int32)
         self._next_local_device = [0] * n
         self._next_local_assignment = [0] * n
+        # shard observability plane (ISSUE 18): host-side per-shard flow
+        # counters piggyback on the exact sites the conservation ledger
+        # already counts (same ledger.enabled gate, so the per-shard
+        # breakdown sums to the folded staging equations by
+        # construction); the token->slot route mirror and the heat/skew
+        # tracker carry the EXTRA accounting (slot bincount, dispatch
+        # skew note, staged HWM) that shard_heat.enabled toggles for the
+        # bench on/off overhead contrast
+        self._shard_rows_routed = np.zeros(n, np.int64)
+        self._shard_rows_dispatched = np.zeros(n, np.int64)
+        self._shard_staged_hwm = np.zeros(n, np.int64)
+        self._route_slot = np.full(c.token_capacity, -1, np.int32)
+        self._slot_rows = np.zeros(n * DEFAULT_SLOTS_PER_RANK, np.int64)
+        self.shard_heat = ShardHeatTracker(n, n * DEFAULT_SLOTS_PER_RANK)
         self._admin_spmd: dict[int, object] = {}
         # shard-aware query plane (keeps any WFQ the base ctor attached)
         old = self._query_batcher
@@ -744,8 +762,9 @@ class SpmdEngine(Engine):
         single-chip engine fed this shard's substream)."""
         route = self._tid_route.get(token_id)
         if route is None:
-            shard = shard_for_token(self.tokens.token(token_id),
-                                    self.n_shards)
+            slot = slot_for_token(self.tokens.token(token_id),
+                                  self.n_shards)
+            shard = slot % self.n_shards     # == shard_for_token(token, N)
             locs = self._shard_tokens[shard]
             ltid = len(locs)
             if ltid >= self._token_cap:
@@ -756,6 +775,7 @@ class SpmdEngine(Engine):
             if token_id < len(self._route_shard):
                 self._route_shard[token_id] = route[0]
                 self._route_ltid[token_id] = route[1]
+                self._route_slot[token_id] = slot
         return route
 
     def _route_rows(self, tids: np.ndarray):
@@ -779,6 +799,10 @@ class SpmdEngine(Engine):
             self.host_counters.get("staged_copy_rows", 0) + 1
         self.ledger.add("staged_rows", 1)
         shard, ltid = self._route_token(token_id)
+        if self.ledger.enabled:
+            self._shard_rows_routed[shard] += 1
+        if self.shard_heat.enabled and token_id < len(self._route_slot):
+            self._slot_rows[self._route_slot[token_id]] += 1
         buf = self._shard_bufs[shard]
         i = len(buf)
         if not buf.append(et, ltid, tenant_id, ts, now, (), aux0, aux1):
@@ -1023,8 +1047,14 @@ class SpmdEngine(Engine):
                 arena.aux[rs_f, dst_f, 0] = res.aux0[rows_f]
                 arena.aux[rs_f, dst_f, 1] = res.aux1[rows_f]
                 arena.valid[rs_f, dst_f] = True
-                arena.cursors += np.bincount(rs_f,
-                                             minlength=self.n_shards)
+                binc = np.bincount(rs_f, minlength=self.n_shards)
+                arena.cursors += binc
+                if self.ledger.enabled:
+                    self._shard_rows_routed += binc
+                if self.shard_heat.enabled and rows_f.size:
+                    self._slot_rows += np.bincount(
+                        self._route_slot[tids[rows_f]],
+                        minlength=self._slot_rows.size)
                 staged += int(rows_f.size)
                 rec.mark("arena_fill")
                 if rec.trace_id is not None and (
@@ -1058,13 +1088,27 @@ class SpmdEngine(Engine):
         arena = self._arena_fill
         if arena is None or not arena.cursors.any():
             return
+        if self.shard_heat.enabled:
+            self._shard_staged_hwm = np.maximum(
+                self._shard_staged_hwm, self._shard_staged_now())
         arena.valid &= (np.arange(arena.rows)[None, :]
                         < arena.cursors[:, None])
-        self.ledger.add("dispatched_rows", int(np.sum(arena.valid)))
+        per_shard = arena.valid.sum(axis=1)
+        self.ledger.add("dispatched_rows", int(per_shard.sum()))
+        if self.ledger.enabled:
+            self._shard_rows_dispatched += per_shard
+        skew = (self.shard_heat.note_dispatch(per_shard)
+                if self.shard_heat.enabled else None)
         traces, arena.traces = arena.traces, []
         self._wal_gate(traces)
         for rec in traces:
             rec.mark("dispatch")
+            if skew is not None:
+                # straggler attribution on the trace itself: which lane
+                # carried how much of the batch this record rode in
+                rec.add("shard_rows",
+                        "/".join(str(int(x)) for x in per_shard))
+                rec.add("skew", round(skew, 3))
         batch = arena.view_batch()
         batch = jax.device_put(batch, stack_sharding(self.mesh, batch))
         step = self._arena_step or self._step
@@ -1093,9 +1137,16 @@ class SpmdEngine(Engine):
             if (self._arena_fill is not None and self._arena_fill.cursor
                     and not self._arena_committing):
                 self._dispatch_arena()
-            n_staged = sum(len(b) for b in self._shard_bufs)
+            lens = np.array([len(b) for b in self._shard_bufs], np.int64)
+            n_staged = int(lens.sum())
             if not n_staged:
                 return
+            if self.ledger.enabled:
+                self._shard_rows_dispatched += lens
+            if self.shard_heat.enabled:
+                self._shard_staged_hwm = np.maximum(
+                    self._shard_staged_hwm, lens)
+                self.shard_heat.note_dispatch(lens)
             batches = [b.emit() for b in self._shard_bufs]
             batch = jax.tree_util.tree_map(lambda *xs: np.stack(xs),
                                            *batches)
@@ -1592,9 +1643,97 @@ class SpmdEngine(Engine):
         return tenant_counts_dict(counts, self.tenants, n_tenants)
 
     def metrics(self) -> dict:
+        # heat/skew series stay OUT of this dict (dispatch-shape
+        # equality pin — the SPMD metrics() dict is pinned equal to
+        # single-chip); they live on shard_flow/spmd_heat and the
+        # swtpu_shard_* exposition only
         out = super().metrics()
         out["staged"] = sum(len(b) for b in self._shard_bufs)
         return out
+
+    # ------------------------------------------------- shard observability
+    def _shard_staged_now(self) -> np.ndarray:
+        """Rows currently staged per shard lane (host bufs + the fill
+        arena's cursors). Caller holds the lock."""
+        lens = np.array([len(b) for b in self._shard_bufs], np.int64)
+        fill = self._arena_fill
+        if fill is not None:
+            lens = lens + np.asarray(fill.cursors, np.int64)
+        return lens
+
+    def take_shard_staged_hwm(self, reset: bool = True) -> list[int]:
+        """Worst per-shard staged-rows backlog since the last take —
+        RESET on scrape (the PR-11 arena-HWM discipline), so each
+        sample reads "worst one-lane pileup this scrape window". Fixes
+        the swtpu_shard_staged_rows blind spot: a transient pileup that
+        drained before the scrape is visible after the fact."""
+        with self.lock:
+            now = self._shard_staged_now()
+            hwm = np.maximum(self._shard_staged_hwm, now)
+            if reset:
+                self._shard_staged_hwm = now
+            return [int(x) for x in hwm]
+
+    def shard_flow(self) -> dict:
+        """Per-shard flow breakdown (ISSUE 18): the device tenant
+        counter grid read UNFOLDED — a plain device_get of the already
+        materialized ``[S, T, lanes]`` stack; ``_spmd_tenant_counts``
+        folds the shard axis away for the single-chip-shaped surfaces,
+        this is the shard-axis view, no new program — plus the host
+        router's routed/dispatched/backlog counters. The conservation
+        ledger embeds this as its "spmd" stage; per-shard lanes sum
+        EXACTLY to the folded device stage (no new slack)."""
+        from sitewhere_tpu.pipeline import TENANT_COUNTER_LANES
+
+        with self.lock:
+            grid = np.asarray(jax.device_get(
+                self.state.metrics.tenant_counters))       # [S, T, L]
+            proc = np.asarray(jax.device_get(
+                self.state.metrics.processed))             # [S]
+            routed = self._shard_rows_routed.copy()
+            dispatched = self._shard_rows_dispatched.copy()
+            backlog = np.array([len(b) for b in self._shard_bufs],
+                               np.int64)
+            fill = self._arena_fill
+            if fill is not None:
+                # valid rows only, exactly conservation._backlog_rows
+                for s, cnt in enumerate(fill.cursors):
+                    backlog[s] += int(np.sum(fill.valid[s, :int(cnt)]))
+            counting = self.ledger.enabled
+        lanes = grid.sum(axis=1)                           # [S, L]
+        per = []
+        for s in range(self.n_shards):
+            row = {"shard": s, "processed": int(proc[s]),
+                   "routed_rows": int(routed[s]),
+                   "dispatched_rows": int(dispatched[s]),
+                   "backlog_rows": int(backlog[s])}
+            row.update({lane: int(lanes[s, i])
+                        for i, lane in enumerate(TENANT_COUNTER_LANES)})
+            per.append(row)
+        return {"shards": self.n_shards, "counting": counting,
+                "perShard": per}
+
+    def harvest_shard_heat(self, now_s: float | None = None):
+        """Scrape-seam heat harvest: device_get the unfolded counter
+        grid (already materialized by the fused step — no new program,
+        so the zero-steady-state-recompile gate holds with the plane
+        on) and EWMA-update the tracker from the counter deltas.
+        Returns the tracker. ``now_s`` injects a clock for the
+        determinism tests; None reads time.monotonic()."""
+        t = time.monotonic() if now_s is None else float(now_s)
+        with self.lock:
+            grid = np.asarray(jax.device_get(
+                self.state.metrics.tenant_counters))
+            self.shard_heat.harvest(grid, self._slot_rows, t)
+        return self.shard_heat
+
+    def spmd_heat(self) -> dict:
+        """The heat/skew document (shardobs.spmd_heat_payload) — same
+        name the ClusterEngine facade fans out, so REST/RPC duck-type
+        one attribute for both shapes."""
+        from sitewhere_tpu.utils.shardobs import spmd_heat_payload
+
+        return spmd_heat_payload(self)
 
     # ------------------------------------------------------- zones & rules
     def set_geofence_zones(self, polygons, max_vertices: int = 16) -> None:

@@ -645,6 +645,17 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         return await asyncio.to_thread(conservation_payload, inst.engine,
                                        inst.rules)
 
+    async def spmd_heat():
+        """Shard heat & skew posture (ISSUE 18) — the RPC twin of GET
+        /api/instance/spmd/heat. Off-loop: the harvest reads the device
+        counter grid (and a cluster facade fans out)."""
+        from sitewhere_tpu.utils.shardobs import spmd_heat_payload
+
+        fn = getattr(inst.engine, "spmd_heat", None)
+        if callable(fn):
+            return await asyncio.to_thread(fn)
+        return await asyncio.to_thread(spmd_heat_payload, inst.engine)
+
     async def placement():
         """Elastic-placement posture (ISSUE 15) — the RPC twin of GET
         /api/instance/placement. Off-loop: the payload takes the
@@ -727,6 +738,7 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         "Instance.clusterMetrics": cluster_metrics,
         "Instance.deviceMemory": device_memory,
         "Instance.conservation": conservation,
+        "Instance.spmdHeat": spmd_heat,
         "Instance.placement": placement,
         "Rules.getStatus": rules_status,
         "Rules.setRuleSet": rules_set,

@@ -78,6 +78,13 @@ Conservation equations (the contract future PRs must keep balanced):
                         commit/abort; the in-flight term is the only
                         legal slack and is read in the same
                         lock-consistent snapshot)
+  spmd-shard-flow       per shard s: accepted[s] + invalid[s] ==
+                        processed[s], and routed_rows[s] ==
+                        dispatched_rows[s] + backlog_rows[s]; every
+                        per-shard lane sums EXACTLY to the folded
+                        device-stage lane (ISSUE 18: the unfolded
+                        counter grid is the same grid, read before the
+                        fold — no new slack term anywhere)
 """
 
 from __future__ import annotations
@@ -94,7 +101,7 @@ EQUATIONS = (
     "staging-balance", "device-processed", "device-disposition",
     "edge-admission", "wal-durability", "forward-queue",
     "replication-feed", "archive-spill", "rules-harvest",
-    "placement-handoff",
+    "placement-handoff", "spmd-shard-flow",
 )
 
 
@@ -262,6 +269,14 @@ def build_ledger(engine, rules_manager=None) -> dict:
             **{lane: n - base.get(f"grid_{lane}", 0)
                for lane, n in grid.items()},
         }
+        # SPMD shard plane (ISSUE 18): the per-shard breakdown of the
+        # device stage. Skipped when a restore baseline is active — the
+        # device stage above is baseline-SUBTRACTED while the unfolded
+        # grid is cumulative, and splitting the baseline per shard
+        # would manufacture slack the equations don't have.
+        sf = getattr(eng, "shard_flow", None)
+        if callable(sf) and not base:
+            stages["spmd"] = sf()
         wal = getattr(eng, "wal", None)
         if wal is not None:
             with wal._lock:
@@ -491,6 +506,43 @@ def check_conservation(ledger: dict) -> list[Violation]:
                 f"{pl['fenced_slots']} fenced slot(s) with no move in "
                 "flight (a fence must belong to a live handoff)",
                 pl["fenced_slots"], 0)
+    sp = st.get("spmd")
+    if sp:
+        per = sp.get("perShard", [])
+        for row in per:
+            s = row["shard"]
+            lhs = row["accepted"] + row["invalid"]
+            if lhs != row["processed"]:
+                bad("spmd-shard-flow",
+                    f"shard {s}: accepted {row['accepted']} + invalid "
+                    f"{row['invalid']} != processed {row['processed']}",
+                    lhs, row["processed"])
+            if sp.get("counting"):
+                rhs = row["dispatched_rows"] + row["backlog_rows"]
+                if row["routed_rows"] != rhs:
+                    bad("spmd-shard-flow",
+                        f"shard {s}: routed_rows {row['routed_rows']} "
+                        f"!= dispatched_rows {row['dispatched_rows']} "
+                        f"+ backlog {row['backlog_rows']}",
+                        row["routed_rows"], rhs,
+                        slack=row["backlog_rows"])
+        # the unfolded grid is the SAME grid the device stage folds:
+        # every per-shard lane must sum EXACTLY to the folded total
+        for lane in ("processed", "accepted", "invalid",
+                     "dedup_dropped", "geofence_hit"):
+            if lane not in dev:
+                continue
+            total = sum(row.get(lane, 0) for row in per)
+            if total != dev[lane]:
+                bad("spmd-shard-flow",
+                    f"per-shard {lane} sum {total} != device {lane} "
+                    f"{dev[lane]}", total, dev[lane])
+        if sp.get("counting") and ing and ing.get("counting"):
+            routed = sum(row["routed_rows"] for row in per)
+            if routed != ing["staged_rows"]:
+                bad("spmd-shard-flow",
+                    f"per-shard routed sum {routed} != staged_rows "
+                    f"{ing['staged_rows']}", routed, ing["staged_rows"])
     rules = st.get("rules")
     if rules:
         if "harvested" in rules:

@@ -561,10 +561,40 @@ def spmd_metrics(registry: MetricsRegistry | None = None) -> dict:
       swtpu_shard_staged_rows    staged ingest rows per shard lane —
                                  router skew shows up as one lane
                                  filling (and forcing flushes) while
-                                 its siblings idle
+                                 its siblings idle — and
+      swtpu_shard_staged_rows_hwm  its per-lane high-water mark, RESET
+                                 on scrape (PR-11 arena-HWM
+                                 discipline): a transient one-lane
+                                 pileup that drained before the scrape
+                                 is visible after the fact (ISSUE 18
+                                 blind-spot fix)
       swtpu_shard_devices        devices registered per shard (local
                                  device-id high-water mark)
       swtpu_shard_assignments    assignments created per shard
+
+    Shard heat & skew plane (ISSUE 18), synced at scrape from the
+    unfolded device counter grid (one ``device_get`` of data the fused
+    step already materialized — no new program, no extra dispatch):
+
+      swtpu_shard_flow_rows      per-shard flow breakdown, labeled
+                                 shard + lane (processed | accepted |
+                                 invalid | dedup_dropped | geofence_hit
+                                 | routed_rows | dispatched_rows |
+                                 backlog_rows)
+      swtpu_shard_heat           decayed-EWMA events/s per
+                                 (shard, tenant bucket); quiet cells
+                                 retained away
+      swtpu_slot_heat_topk       the K hottest placement slots' EWMA
+                                 events/s, labeled by slot id — the
+                                 signal placement.propose_moves reads
+      swtpu_spmd_skew            last dispatch's max/mean routed-rows
+                                 imbalance (1.0 = perfectly balanced;
+                                 the mesh runs at ~1/k throughput at k)
+      swtpu_spmd_skew_hwm        worst skew since the last scrape
+                                 (reset on scrape)
+      swtpu_spmd_skew_sustained_total  sustained-skew escalations (two
+                                 consecutive scrape-audits over the
+                                 threshold, PR-13 confirmation rule)
     """
     reg = registry or REGISTRY
     return {
@@ -574,12 +604,36 @@ def spmd_metrics(registry: MetricsRegistry | None = None) -> dict:
         "staged": reg.gauge(
             "swtpu_shard_staged_rows",
             "staged ingest rows per shard lane (pre-dispatch)"),
+        "staged_hwm": reg.gauge(
+            "swtpu_shard_staged_rows_hwm",
+            "per-shard staged-rows high-water mark since last scrape "
+            "(reset on scrape)"),
         "devices": reg.gauge(
             "swtpu_shard_devices",
             "devices registered per shard (local id high-water mark)"),
         "assignments": reg.gauge(
             "swtpu_shard_assignments",
             "assignments created per shard (local id high-water mark)"),
+        "flow": reg.gauge(
+            "swtpu_shard_flow_rows",
+            "per-shard flow breakdown from the unfolded device counter "
+            "grid + host route table, per shard + lane"),
+        "heat": reg.gauge(
+            "swtpu_shard_heat",
+            "decayed-EWMA events/s per (shard, tenant)"),
+        "slot_heat": reg.gauge(
+            "swtpu_slot_heat_topk",
+            "EWMA events/s of the hottest placement slots"),
+        "skew": reg.gauge(
+            "swtpu_spmd_skew",
+            "per-dispatch max/mean routed-rows imbalance index"),
+        "skew_hwm": reg.gauge(
+            "swtpu_spmd_skew_hwm",
+            "worst dispatch skew since last scrape (reset on scrape)"),
+        "skew_sustained": reg.counter(
+            "swtpu_spmd_skew_sustained_total",
+            "sustained-skew escalations (two-consecutive-audit "
+            "confirmation)"),
     }
 
 
@@ -597,12 +651,48 @@ def export_spmd_metrics(engine, registry: MetricsRegistry | None
     inst["shards"].set(len(bufs), engine=lbl)
     devices = getattr(engine, "_next_local_device", None)
     assigns = getattr(engine, "_next_local_assignment", None)
+    take_hwm = getattr(engine, "take_shard_staged_hwm", None)
+    hwms = take_hwm() if callable(take_hwm) else None
     for s, buf in enumerate(bufs):
         inst["staged"].set(len(buf), engine=lbl, shard=str(s))
+        if hwms is not None:
+            inst["staged_hwm"].set(hwms[s], engine=lbl, shard=str(s))
         if devices is not None:
             inst["devices"].set(devices[s], engine=lbl, shard=str(s))
         if assigns is not None:
             inst["assignments"].set(assigns[s], engine=lbl, shard=str(s))
+    # shard heat & skew plane (ISSUE 18): the scrape IS the harvest
+    # seam AND the skew-audit cadence (mirrors the conservation
+    # auditor's scrape-synced posture)
+    sf = getattr(engine, "shard_flow", None)
+    if callable(sf):
+        for row in sf()["perShard"]:
+            s = str(row["shard"])
+            for lane, n in row.items():
+                if lane != "shard":
+                    inst["flow"].set(n, engine=lbl, shard=s, lane=lane)
+    harvest = getattr(engine, "harvest_shard_heat", None)
+    if callable(harvest):
+        from sitewhere_tpu.utils.shardobs import heat_map_doc
+
+        tracker = harvest()
+        written = set()
+        for s, cells in heat_map_doc(tracker, engine.tenants).items():
+            for tenant, eps in cells.items():
+                labels = {"engine": lbl, "shard": s, "tenant": tenant}
+                inst["heat"].set(eps, **labels)
+                written.add(tuple(sorted(labels.items())))
+        inst["heat"].retain(written, engine=lbl)
+        written = set()
+        for slot, eps in tracker.top_slots():
+            labels = {"engine": lbl, "slot": str(slot)}
+            inst["slot_heat"].set(eps, **labels)
+            written.add(tuple(sorted(labels.items())))
+        inst["slot_heat"].retain(written, engine=lbl)
+        inst["skew"].set(tracker.skew_index, engine=lbl)
+        inst["skew_hwm"].set(tracker.take_skew_hwm(), engine=lbl)
+        if tracker.audit_skew():
+            inst["skew_sustained"].inc(engine=lbl)
 
 
 def slo_metrics(registry: MetricsRegistry | None = None) -> dict:

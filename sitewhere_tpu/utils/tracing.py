@@ -439,13 +439,25 @@ class SpanTracer:
 
 # flight-record stage marks -> child-span intervals, per record kind.
 # Each entry: (span name, start stage or None for record start, end
-# stage). Stages a record never visited produce no span (same tolerance
-# as utils/flight.stage_durations).
+# stage[, require stage]). Stages a record never visited produce no
+# span (same tolerance as utils/flight.stage_durations); an entry with
+# a 4th element only applies to records that visited the require stage
+# — the SPMD ingest path marks "route" between WAL append and the arena
+# scatter (decode -> wal_append -> route -> arena_fill -> commit), so
+# its spans key on it, while the single-chip entries self-suppress on
+# SPMD records because their start refs resolve AFTER their ends.
+# An SPMD record's root event also carries the dispatch skew tags
+# ("shard_rows", "skew") the router stamps per dispatch — the Perfetto
+# straggler-attribution breadcrumbs (ISSUE 18).
 _FLIGHT_SPANS = {
     "ingest": (("decode", None, "decode"),
                ("arena_fill", "decode", "arena_fill"),
                ("wal_append", ("arena_fill", "decode"), "wal_append"),
                ("commit", ("wal_append", "arena_fill", "decode"), "commit"),
+               ("spmd.wal", "decode", "wal_append", "route"),
+               ("spmd.route", ("wal_append", "decode"), "route", "route"),
+               ("spmd.scatter", "route", "arena_fill", "route"),
+               ("spmd.commit", "arena_fill", "commit", "route"),
                ("wal_gate", "commit", "wal_durable"),
                ("dispatch_wait", ("wal_durable", "commit"), "dispatch"),
                ("device", "dispatch", "device_ready"),
@@ -487,7 +499,10 @@ def _flight_events(record: dict) -> list[dict]:
             return None
         return stages.get(ref)
 
-    for name, start_ref, end_ref in _FLIGHT_SPANS.get(kind, ()):
+    for entry in _FLIGHT_SPANS.get(kind, ()):
+        name, start_ref, end_ref = entry[:3]
+        if len(entry) > 3 and entry[3] not in stages:
+            continue        # span only for records that visited the gate
         t1 = stages.get(end_ref)
         if t1 is None:
             continue
@@ -728,6 +743,15 @@ def debug_bundle(engine) -> dict:
         bundle["conservation"] = conservation_payload(engine)
     except Exception as e:
         bundle["conservation"] = {"error": repr(e)}
+    # shard heat & skew plane (ISSUE 18): per-shard flow, the heat
+    # maps, and the skew posture — a non-SPMD engine answers
+    # {"spmd": False}. Never takes the bundle down with it.
+    try:
+        from sitewhere_tpu.utils.shardobs import spmd_heat_payload
+
+        bundle["spmd"] = spmd_heat_payload(engine)
+    except Exception as e:
+        bundle["spmd"] = {"error": repr(e)}
     # device plane (ISSUE 11): the memory-ledger breakdown (a PEEK —
     # high-watermarks stay armed for the next scrape) plus per-family
     # compile posture, so one bundle answers "what is resident and what

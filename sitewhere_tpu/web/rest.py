@@ -379,6 +379,23 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_get("/api/instance/conservation", conservation_doc)
 
+    async def spmd_heat_doc(request: web.Request):
+        """Shard heat & skew plane (ISSUE 18): per-shard flow counters,
+        the (shard, tenant) heat map, top-K hot slots, and the skew
+        posture. A clustered engine fans out to every rank
+        (``ClusterEngine.spmd_heat``); a non-SPMD engine answers
+        ``{"spmd": false}``. Off-loop — the harvest reads the device
+        counter grid."""
+        from sitewhere_tpu.utils.shardobs import spmd_heat_payload
+
+        fn = getattr(inst.engine, "spmd_heat", None)
+        if callable(fn):
+            return json_response(await asyncio.to_thread(fn))
+        return json_response(await asyncio.to_thread(
+            spmd_heat_payload, inst.engine))
+
+    r.add_get("/api/instance/spmd/heat", spmd_heat_doc)
+
     async def placement_doc(request: web.Request):
         """Elastic-placement posture (ISSUE 15): the installed map
         (epoch, slot assignment, active ranks), this rank's fences and

@@ -58,6 +58,9 @@ def test_offline_modules_import_with_jax_blocked():
     # the conservation checker (ISSUE 14): offline tooling evaluates
     # ledger documents (bench_diff, debug-bundle triage) without jax
     targets.append("mod=sitewhere_tpu.utils.conservation")
+    # the shard heat tracker (ISSUE 18): heat/skew documents are
+    # numpy + stdlib — the engine hands in plain host arrays
+    targets.append("mod=sitewhere_tpu.utils.shardobs")
     res = subprocess.run(
         [sys.executable, "-c", _DRIVER, *targets],
         cwd=REPO, capture_output=True, text=True, timeout=120)
