@@ -2390,6 +2390,294 @@ def main() -> None:
     _rshutil.rmtree(rdir, ignore_errors=True)
 
     # ------------------------------------------------------------------
+    # Fleet-scale historical analytics (ISSUE 19): archive->device
+    # batched scoring over spilled history.
+    #  * score parity: the job's emitted scores must match a host numpy
+    #    rebuild of the same newest-W windows pushed through the SAME
+    #    model bundle — over an uncompressed AND a per-column-compressed
+    #    archive — smoke gates
+    #  * ingest interference: headline ingest with a duty-paced scoring
+    #    job streaming concurrently vs idle, paired halves per session,
+    #    min of sessions (the PR-3 estimator) — smoke gate <= 3%
+    #  * zero steady recompiles: a repeat job over the same shapes
+    #    compiles nothing (window_fill + scorer families) — smoke gate
+    #  * rollup-spill parity/idempotence through the archive + ledger
+    #    balance on every leg engine — smoke gates
+    # devices scored/s and archive->device bytes/s report (BENCH_SCHEMA)
+    # ------------------------------------------------------------------
+    from sitewhere_tpu.models.analytics import AnalyticsManager
+
+    AN_W = 8
+    AN_M = 8 if smoke else 32         # batch_devices (one shape family)
+    AN_DEVS = 16 if smoke else 128    # multiple of AN_M: full batches
+    AN_PER = 32                       # rows/device (> W: all overfilled)
+    AN_SEG = 128                      # AN_SEG | AN_N: no hot tail, every
+    AN_N = AN_DEVS * AN_PER           # measurement row spools
+
+    def _an_event(i: int):
+        """ONE deterministic formula for row i: (device, ts_rel,
+        [(value, present)] per channel) — shared by the payload builder
+        and the host oracle so the two views can never drift. Values are
+        exact halves (f32/JSON-lossless); row 0 presents every channel
+        so the engine interns c0..c7 in lane order."""
+        d = i % AN_DEVS
+        lanes = [((((i * 7 + k * 13) % 31) - 15) / 2.0,
+                  i == 0 or (i + 3 * k) % 5 != 0) for k in range(8)]
+        return d, 1000 + i, lanes
+
+    def _an_pay(i: int, base: int) -> bytes:
+        d, ts, lanes = _an_event(i)
+        return json.dumps({
+            "deviceToken": f"an-{d}", "type": "DeviceMeasurements",
+            "request": {"measurements": {f"c{k}": v for k, (v, p)
+                                         in enumerate(lanes) if p},
+                        "eventDate": base + ts}}).encode()
+
+    def _an_engine(compress: bool, tag: str):
+        d = _tempfile.mkdtemp(prefix=f"swtpu-bench-an-{tag}-")
+        e = Engine(EngineConfig(
+            device_capacity=256, token_capacity=1 << 10,
+            assignment_capacity=1 << 10, store_capacity=2048,
+            batch_capacity=256, channels=8, archive_dir=d,
+            archive_segment_rows=AN_SEG, archive_compress=compress))
+        base = int(e.epoch.base_unix_s * 1000)
+        for lo in range(0, AN_N, 256):
+            e.ingest_json_batch([_an_pay(i, base)
+                                 for i in range(lo, lo + 256)])
+            e.flush()
+        return e, d
+
+    def _an_spy(e) -> dict:
+        """alternateId -> '%.3f' score map of every DeviceAlert the
+        manager emits (message word 3 carries the formatted score)."""
+        sent: dict[str, str] = {}
+        orig = e.ingest_json_batch
+
+        def spy(payloads, tenant="default", **kw):
+            for p in payloads:
+                env = json.loads(p)
+                if env.get("type") == "DeviceAlert":
+                    req = env["request"]
+                    sent[req["alternateId"]] = req["message"].split()[3]
+            return orig(payloads, tenant, **kw)
+
+        e.ingest_json_batch = spy
+        return sent
+
+    def _an_oracle(mgr, name: str) -> dict:
+        """Expected alternateId -> '%.3f': per-device Python rebuild of
+        the newest-W snapshot windows (masked lanes zeroed, right-
+        aligned) scored through the SAME jitted bundle in the SAME [M]
+        batch grouping — bit-identical floats format identically."""
+        import jax.numpy as jnp
+        model, params, score_fn = mgr._model_bundle(AN_W, 8)
+        per: dict[int, list] = {}
+        for i in range(AN_N):
+            d, ts, lanes = _an_event(i)
+            per.setdefault(d, []).append((ts, lanes))
+        data = np.zeros((AN_DEVS, AN_W, 8), np.float32)
+        ends = np.zeros(AN_DEVS, np.int64)
+        for d, rws in per.items():
+            rws.sort()
+            tail = rws[-AN_W:]
+            ends[d] = tail[-1][0]
+            for j, (_ts, lanes) in enumerate(tail):
+                for k, (v, p) in enumerate(lanes):
+                    data[d, AN_W - len(tail) + j, k] = v if p else 0.0
+        filled = np.full(AN_DEVS, AN_W, np.int32)
+        exp: dict[str, str] = {}
+        for lo in range(0, AN_DEVS, AN_M):
+            scores, _valid, _ = score_fn(
+                model, params, jnp.asarray(data[lo:lo + AN_M]),
+                jnp.asarray(filled[lo:lo + AN_M]), jnp.int32(1))
+            s = np.asarray(scores)
+            for j in range(AN_M):
+                d = lo + j
+                exp[f"swa:{name}:an-{d}:{int(ends[d])}"] = \
+                    f"{float(s[j]):.3f}"
+        return exp
+
+    # (a) score parity vs the host oracle, uncompressed AND compressed
+    an_engines = {}
+    an_parity = {}
+    for _compress in (False, True):
+        _tag = "c" if _compress else "u"
+        ae, ad = _an_engine(_compress, _tag)
+        amgr = AnalyticsManager(ae)
+        sent = _an_spy(ae)
+        _nm = f"an-par-{_tag}"
+        ajob = amgr.run_job(dict(window=AN_W, batch_devices=AN_M,
+                                 min_fill=1, threshold=-1e9, name=_nm))
+        exp = _an_oracle(amgr, _nm)
+        ok = (sent == exp and ajob["scored"] == AN_DEVS
+              and ajob["state"] == "done")
+        if _compress:
+            ok &= all(s.stats["enc_bytes"] < s.stats["bytes"]
+                      for s in ae.archive.segments)
+        if not ok:
+            _miss = {k: (exp.get(k), sent.get(k))
+                     for k in set(exp) ^ set(sent) | {
+                         k for k in exp if sent.get(k) != exp[k]}}
+            log(f"analytics PARITY MISMATCH compress={_compress}: "
+                f"{len(sent)}/{len(exp)} emitted, diff={_miss}")
+        an_parity[_compress] = ok
+        an_engines[_compress] = (ae, amgr, ad)
+    an_score_parity = an_parity[False]
+    an_compressed_parity = an_parity[True]
+    log(f"analytics score parity vs host oracle: uncompressed="
+        f"{an_score_parity} compressed={an_compressed_parity} "
+        f"({AN_DEVS} devices x {AN_PER} rows, W={AN_W}, M={AN_M})")
+
+    # (b) steady-state throughput + zero recompiles: a second identical-
+    # shape job must compile NOTHING (the first paid the family costs)
+    _ae_u, _amgr_u, _ = an_engines[False]
+    _an_ct0 = dict(compile_totals())
+    an_tjob = _amgr_u.run_job(dict(window=AN_W, batch_devices=AN_M,
+                                   min_fill=1, threshold=-1e9,
+                                   emit=False, name="an-th"))
+    an_steady_recompiles = (sum(compile_totals().values())
+                            - sum(_an_ct0.values()))
+    an_devices_per_s = float(an_tjob["devices_per_s"])
+    an_bytes_per_s = float(an_tjob["bytes_per_s"])
+    an_windows_scored = int(an_tjob["scored"])
+    an_rows_streamed = int(an_tjob["rows"])
+    log(f"analytics steady job: {an_devices_per_s:,.1f} devices/s, "
+        f"{an_bytes_per_s:,.0f} archive->device B/s "
+        f"(stream {an_tjob['stream_s'] * 1e3:.1f}ms + score "
+        f"{an_tjob['score_s'] * 1e3:.1f}ms over {an_rows_streamed} rows,"
+        f" {an_tjob['segments']} segments), "
+        f"recompiles={an_steady_recompiles}")
+
+    # (c) ingest-headline interference: paired halves per session (idle
+    # vs a duty-paced background job streaming the primed history),
+    # median per half, min of sessions; half order alternates across
+    # sessions. duty=0.02 is the production posture for background
+    # scoring — full-speed foreground jobs are a REST wait=1 choice.
+    _an_idir = _tempfile.mkdtemp(prefix="swtpu-bench-an-i-")
+    ieng = Engine(EngineConfig(**HEADLINE_CFG, channels=8,
+                               archive_dir=_an_idir,
+                               archive_segment_rows=AN_SEG))
+    _an_ibase = int(ieng.epoch.base_unix_s * 1000)
+    for lo in range(0, AN_N, 256):
+        ieng.ingest_json_batch([_an_pay(i, _an_ibase)
+                                for i in range(lo, lo + 256)])
+        ieng.flush()
+    with ieng.lock:   # the headline ring is far from its spool trigger:
+        ieng._spool()  # force the primed history out so jobs have work
+    imgr = AnalyticsManager(ieng)
+    _an_bg = dict(window=AN_W, batch_devices=AN_M, min_fill=1,
+                  emit=False, duty=0.02, until_ms=999 + AN_N,
+                  name="an-bg")
+    imgr.run_job(dict(_an_bg, duty=None, name="an-warm"))  # compile warm
+    _AN_UNIQ = 4
+    _an_ibatches = [[_an_pay(AN_N + b * SZ_BATCH + i, _an_ibase)
+                     for i in range(SZ_BATCH)] for b in range(_AN_UNIQ)]
+    for b in _an_ibatches:            # warm the ingest programs
+        ieng.ingest_json_batch(b)
+        if ieng.staged_count:
+            ieng.flush_async()
+    ieng.barrier()
+    _AN_K = 20 if smoke else 48
+
+    def _an_half() -> float:
+        ts_ = []
+        for k in range(_AN_K):
+            b = _an_ibatches[k % _AN_UNIQ]
+            t1 = time.perf_counter()
+            ieng.ingest_json_batch(b)
+            if ieng.staged_count:
+                ieng.flush_async()
+            ts_.append(time.perf_counter() - t1)
+        ieng.barrier()
+        return _tstats.median(ts_)
+
+    def _an_session(on_first: bool):
+        meds = {}
+        for scoring in ((True, False) if on_first else (False, True)):
+            if scoring:
+                _stop = _threading.Event()
+
+                def _scorer():
+                    while not _stop.is_set():
+                        imgr.run_job(dict(_an_bg))
+
+                th = _threading.Thread(target=_scorer, daemon=True)
+                th.start()
+                meds[True] = _an_half()
+                _stop.set()
+                for _jid in list(imgr.jobs):   # wake the pacer now
+                    imgr.cancel(_jid)
+                th.join()
+            else:
+                meds[False] = _an_half()
+        return (max(0.0, (meds[True] - meds[False]) / meds[False] * 100),
+                SZ_BATCH / meds[True], SZ_BATCH / meds[False])
+
+    an_sessions = [_an_session(bool(s % 2)) for s in range(3)]
+    an_interference_pct, an_eps_on, an_eps_off = min(an_sessions)
+    log(f"analytics interference: sessions "
+        f"{[round(s[0], 2) for s in an_sessions]}% -> "
+        f"{an_interference_pct:.2f}% (idle={an_eps_off:,.0f} "
+        f"scoring={an_eps_on:,.0f} ev/s, duty=0.02)")
+
+    # (d) rollup-ring spill through the archive: spilled history ==
+    # the closed live windows, respill is a no-op, segments compress
+    _an_rdir = _tempfile.mkdtemp(prefix="swtpu-bench-an-ro-")
+    roe = Engine(EngineConfig(
+        device_capacity=256, token_capacity=512, assignment_capacity=512,
+        store_capacity=4096, batch_capacity=64, channels=8,
+        rule_groups=64, rollup_buckets=8, archive_dir=_an_rdir,
+        archive_segment_rows=32, archive_compress=True))
+    rom = RulesManager(roe)
+    rom.load({"name": "an-ro", "rules": [],
+              "rollups": [{"name": "temp-1s", "channel": "temp",
+                           "windowMs": 1000, "scope": "device"}]})
+    _ro_base = int(roe.epoch.base_unix_s * 1000)
+    _ro_n = 96 if smoke else 384
+    _ro_pays = [json.dumps({
+        "deviceToken": f"ro-{i % 4}", "type": "DeviceMeasurement",
+        "request": {"name": "temp", "value": 10.0 + (i % 7) * 0.5,
+                    "eventDate": _ro_base + i * 250}}).encode()
+        for i in range(_ro_n)]
+    for lo in range(0, _ro_n, 32):
+        roe.ingest_json_batch(_ro_pays[lo:lo + 32])
+        roe.flush()
+    _ro_live = rom.read_rollup("temp-1s", limit=1000)
+    _ro_lmap = {(b["group"], b["windowStartMs"]):
+                (b["count"], b["sum"], b["min"], b["max"])
+                for b in _ro_live["buckets"]}
+    _ro_new = max(ws for _, ws in _ro_lmap)
+    an_rollup_spilled = rom.spill_rollups(lag=1)["spilled"]
+    _ro_re = rom.spill_rollups(lag=1)["spilled"]
+    _ro_hist = rom.read_rollup_history("temp-1s", limit=1000)
+    _ro_hmap = {(b["group"], b["windowStartMs"]):
+                (b["count"], b["sum"], b["min"], b["max"])
+                for b in _ro_hist["buckets"]}
+    _ro_closed = {k: v for k, v in _ro_lmap.items()
+                  if k[1] <= _ro_new - 1000}
+    _ro_arch = rom.rollup_archive()
+    an_rollup_parity = (an_rollup_spilled > 0 and _ro_re == 0
+                        and bool(_ro_closed) and _ro_hmap == _ro_closed
+                        and all(s.stats["enc_bytes"] < s.stats["bytes"]
+                                for s in _ro_arch.segments))
+    log(f"analytics rollup spill: {an_rollup_spilled} windows spilled, "
+        f"respill={_ro_re}, history==closed-live={an_rollup_parity}")
+
+    # (e) the analytics-windows equation balances on EVERY leg engine
+    # (incl. the interference engine's mid-run-cancelled jobs)
+    ieng.flush()
+    _cv_an = [v.to_dict()
+              for e_ in (an_engines[False][0], an_engines[True][0], ieng)
+              for v in check_conservation(build_ledger(e_))]
+    conservation_analytics_violations = len(_cv_an)
+    log(f"conservation (analytics leg, 3 engines): "
+        f"{conservation_analytics_violations} violation(s)"
+        + (f" {_cv_an}" if _cv_an else ""))
+    for _d in (an_engines[False][2], an_engines[True][2], _an_idir,
+               _an_rdir):
+        _rshutil.rmtree(_d, ignore_errors=True)
+
+    # ------------------------------------------------------------------
     # Conservation audits (ISSUE 14): the ledger must balance to ZERO
     # violations at the end of the headline, QoS-fairness, and rules
     # legs (the kill/recover and cluster legs audited above, in place).
@@ -2560,6 +2848,28 @@ def main() -> None:
                 "rules_chaos_no_dup": rules_chaos_no_dup,
                 "rules_fires": rules_fires_total,
                 "rules_alerts_emitted": len(al_a),
+                # fleet-scale historical analytics (ISSUE 19): score
+                # parity vs the host-oracle window rebuild (uncompressed
+                # AND per-column-compressed archives), ingest headline
+                # interference with a duty-paced concurrent job (gate
+                # <= 3%), zero steady recompiles, rollup-spill parity,
+                # and ledger balance are smoke gates; devices scored/s
+                # and archive->device bytes/s report (BENCH_SCHEMA.md)
+                "analytics_score_parity": an_score_parity,
+                "analytics_compressed_parity": an_compressed_parity,
+                "analytics_devices_per_s": round(an_devices_per_s, 1),
+                "analytics_bytes_per_s": round(an_bytes_per_s),
+                "analytics_windows_scored": an_windows_scored,
+                "analytics_rows_streamed": an_rows_streamed,
+                "analytics_interference_pct":
+                    round(an_interference_pct, 2),
+                "analytics_ingest_events_per_s_scoring": round(an_eps_on),
+                "analytics_ingest_events_per_s_idle": round(an_eps_off),
+                "analytics_steady_recompiles": an_steady_recompiles,
+                "analytics_rollup_spill_parity": an_rollup_parity,
+                "analytics_rollup_spilled": an_rollup_spilled,
+                "conservation_analytics_violations":
+                    conservation_analytics_violations,
                 # conservation ledger & audit plane (ISSUE 14): counting
                 # cost (gate <= 3%), and the ledger must balance to ZERO
                 # violations at the end of the headline / kill-recover /
@@ -2717,6 +3027,30 @@ def main() -> None:
     if smoke and not (rules_chaos_no_loss and rules_chaos_no_dup):
         log("FAIL: kill/recover rule re-evaluation lost or duplicated "
             "alert events (dedup key discipline broken)")
+        sys.exit(1)
+    if smoke and not (an_score_parity and an_compressed_parity):
+        log("FAIL: historical scoring diverged from the host-oracle "
+            f"window rebuild (uncompressed={an_score_parity} "
+            f"compressed={an_compressed_parity})")
+        sys.exit(1)
+    if smoke and an_interference_pct > 3.0:
+        log(f"FAIL: a concurrent duty-paced scoring job moved the "
+            f"ingest headline {an_interference_pct:.2f}% (> 3%)")
+        sys.exit(1)
+    if smoke and an_steady_recompiles != 0:
+        log(f"FAIL: a repeat scoring job compiled "
+            f"{an_steady_recompiles} program(s) — analytics batch "
+            "shapes churned after the warm job")
+        sys.exit(1)
+    if smoke and not an_rollup_parity:
+        log("FAIL: spilled rollup history diverged from the closed "
+            "live windows (or respill was not idempotent / segments "
+            "did not compress)")
+        sys.exit(1)
+    if smoke and conservation_analytics_violations:
+        log(f"FAIL: conservation ledger did not balance on the "
+            f"analytics leg ({conservation_analytics_violations} "
+            "violation(s)) — the analytics-windows equation is leaking")
         sys.exit(1)
     if smoke and conservation_overhead_pct > 3.0:
         log(f"FAIL: conservation ledger overhead "

@@ -96,6 +96,14 @@ GATES: dict[str, tuple[str, "float | str | None"]] = {
     "spmd_heat_overhead_pct": ("max", 3.0),
     "spmd_heat_steady_recompiles": ("zero", None),
     "spmd_shard_flow_balanced": ("true", None),
+    # fleet-scale historical analytics (ISSUE 19): archive->device
+    # batched scoring leg
+    "analytics_score_parity": ("true", None),
+    "analytics_compressed_parity": ("true", None),
+    "analytics_interference_pct": ("max", 3.0),
+    "analytics_steady_recompiles": ("zero", None),
+    "analytics_rollup_spill_parity": ("true", None),
+    "conservation_analytics_violations": ("zero", None),
 }
 
 # Every gate the SMOKE bench unconditionally emits (hardware-only legs
@@ -132,6 +140,9 @@ SMOKE_GATES = frozenset({
     "spmd_heat_top1_hot_tenant", "spmd_heat_top1_hot_slot",
     "spmd_heat_overhead_pct", "spmd_heat_steady_recompiles",
     "spmd_shard_flow_balanced",
+    "analytics_score_parity", "analytics_compressed_parity",
+    "analytics_interference_pct", "analytics_steady_recompiles",
+    "analytics_rollup_spill_parity", "conservation_analytics_violations",
 })
 
 

@@ -697,6 +697,12 @@ class EngineConfig:
                                        # lookups, and feed replay (one
                                        # np.load per segment per working
                                        # set, not per call)
+    archive_compress: bool = False     # per-column codecs on spilled
+                                       # segments (ISSUE 19): delta+zigzag
+                                       # packed ints / packbits bools /
+                                       # deflated floats; decode cost is
+                                       # charged in the planner, query
+                                       # results stay byte-identical
     scan_chunk: int = 1                # >1: dispatch K emitted batches as
                                        # ONE lax.scan program (amortizes
                                        # dispatch/transfer per chunk; adds
@@ -1587,7 +1593,8 @@ class Engine(IngestHostMixin):
                 max_rows_per_part=c.archive_max_rows,
                 topology=single_topology(c.tenant_arenas),
                 max_age_ms=c.archive_max_age_ms,
-                cache_segments=c.archive_cache_segments)
+                cache_segments=c.archive_cache_segments,
+                compress=c.archive_compress)
             # spool whenever any arena could be halfway to overwrite; with
             # the worst case of every staged row landing in one arena this
             # keeps backlog + one batch < arena capacity

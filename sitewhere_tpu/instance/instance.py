@@ -164,6 +164,17 @@ class SiteWhereTpuInstance(LifecycleComponent):
 
             self.analytics = AnalyticsService(self.engine)
 
+        # fleet-scale historical analytics (ISSUE 19): archive->device
+        # batched scoring jobs. Host-side manager is always constructed
+        # (jax-free module; jobs fail fast without an archive) so the
+        # REST/RPC job surface, the swtpu_analytics_* scrape series, and
+        # the analytics-windows conservation stage exist on every
+        # instance; it reuses the live service's model when one is up.
+        from sitewhere_tpu.models.analytics import AnalyticsManager
+
+        self.analytics_jobs = AnalyticsManager(self.engine,
+                                               service=self.analytics)
+
         # versioned tenant scripts (Instance.java scripting REST family);
         # activation rewrites active.py, which scripted components bind
         # through the hot-reloading ScriptManager

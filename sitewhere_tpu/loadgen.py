@@ -169,6 +169,16 @@ class TenantLoad:
                                        # engine serves it from the tiered
                                        # (ring + disk) read path
     history_age_ms: int = 60_000       # how far behind "now" the range ends
+    analytics_every: int = 0           # one historical SCORING JOB per N
+                                       # frames (ISSUE 19): a deterministic
+                                       # marker, mirror of history_every —
+                                       # the schedule stays a pure function
+                                       # of the spec (the driver resolves
+                                       # it against engine.analytics_jobs
+                                       # at fire time; engines without the
+                                       # manager skip it), and with the
+                                       # knob OFF the schedule is
+                                       # byte-identical to pre-knob runs
     abusive_mult: float = 1.0          # noisy-neighbor knob (ISSUE 9):
                                        # during burst windows the tenant
                                        # offers rate_eps * abusive_mult.
@@ -236,9 +246,10 @@ class ScheduledOp:
     arrivals: tuple | None = None
     query: dict | None = None
     mutate: tuple | None = None        # (op, token, metadata)
+    analytics: dict | None = None      # AnalyticsJobSpec kwargs (ISSUE 19)
 
 
-_KIND_ORDER = {"ingest": 0, "query": 1, "mutate": 2}
+_KIND_ORDER = {"ingest": 0, "query": 1, "mutate": 2, "analytics": 3}
 
 
 def build_open_loop_schedule(spec: OpenLoopSpec) -> list[ScheduledOp]:
@@ -370,6 +381,19 @@ def build_open_loop_schedule(spec: OpenLoopSpec) -> list[ScheduledOp]:
                     q["device_token"] = f"{prefix}-{int(picks[lo])}"
                 ops.append(ScheduledOp(t_s=frame_t, kind="query",
                                        tenant=tl.tenant, query=q))
+            if tl.analytics_every and n_frames % tl.analytics_every == 0:
+                # deterministic scoring-job MARKER (ISSUE 19), the
+                # history_every mirror: a pure function of the spec — the
+                # driver resolves it into an archive->device batched
+                # scoring job at fire time. emit=False keeps the measured
+                # ingest stream closed (scores don't feed back into the
+                # event counts the run asserts on); the name pins the
+                # job's dedup-key lineage per marker
+                j = n_frames // tl.analytics_every
+                a = {"window": 8, "min_fill": 1, "batch_devices": 8,
+                     "emit": False, "name": f"lg-{tl.tenant}-{j}"}
+                ops.append(ScheduledOp(t_s=frame_t, kind="analytics",
+                                       tenant=tl.tenant, analytics=a))
             if tl.mutate_every and n_frames % tl.mutate_every == 0:
                 j = n_frames // tl.mutate_every
                 token = f"{prefix}-m{j % 8}"
@@ -399,6 +423,8 @@ def schedule_fingerprint(schedule: list[ScheduledOp]) -> str:
             h.update(json.dumps(op.query, sort_keys=True).encode())
         if op.mutate is not None:
             h.update(repr(op.mutate).encode())
+        if op.analytics is not None:
+            h.update(json.dumps(op.analytics, sort_keys=True).encode())
     return h.hexdigest()
 
 
@@ -441,6 +467,9 @@ class OpenLoopResult:
     query_p99_ms: float | None
     history_queries: int
     history_p99_ms: float | None
+    scoring_jobs: int
+    scoring_p50_ms: float | None
+    scoring_p99_ms: float | None
     mutations: int
     max_lateness_s: float
     per_tenant: dict
@@ -485,6 +514,7 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
     per: dict[str, tuple[list, list]] = {}
     qlat: list[float] = []
     hlat: list[float] = []
+    alat: list[float] = []
     epoch = getattr(engine, "epoch", None)
     # the driver is an ingest EDGE: with QoS on, every frame faces the
     # engine's admission controller here — shed frames count per tenant
@@ -565,6 +595,16 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
             engine.query_events(**q)
             (hlat if age is not None
              else qlat).append((time.perf_counter() - t1) * 1e3)
+        elif op.kind == "analytics":
+            # archive->device scoring-job marker (ISSUE 19): resolved
+            # against the engine's job manager at fire time; engines
+            # without the manager (or without an archive to stream from)
+            # skip it, so plain-store schedules replay unchanged
+            aj = getattr(engine, "analytics_jobs", None)
+            if aj is not None and getattr(engine, "archive", None) is not None:
+                t1 = time.perf_counter()
+                aj.run_job(dict(op.analytics, tenant=op.tenant))
+                alat.append((time.perf_counter() - t1) * 1e3)
         else:
             kind, token, md = op.mutate
             if kind == "register":
@@ -616,6 +656,7 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
                    for k in ("arena_rows", "staged_copy_rows")}
     qp = _pcts(qlat)
     hp = _pcts(hlat)
+    ap = _pcts(alat)
     return OpenLoopResult(
         wall_s=round(wall, 3), events=events,
         events_per_s=round(events / wall, 1) if wall else 0.0,
@@ -623,6 +664,8 @@ def run_open_loop(engine, schedule: list[ScheduledOp], *,
         if horizon else 0.0,
         queries=len(qlat), query_p99_ms=qp["p99_ms"],
         history_queries=len(hlat), history_p99_ms=hp["p99_ms"],
+        scoring_jobs=len(alat), scoring_p50_ms=ap["p50_ms"],
+        scoring_p99_ms=ap["p99_ms"],
         mutations=mutations, max_lateness_s=round(max_late, 4),
         per_tenant=per_tenant, shed_events=sum(shed.values()),
         trace_coverage=coverage, compile_counts=compile_counts,

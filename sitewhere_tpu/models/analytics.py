@@ -1,0 +1,615 @@
+"""Fleet-scale historical analytics: archive->device batched scoring
+(ISSUE 19 tentpole).
+
+The live analytics tier (models/service.py) scores only the HBM-resident
+windows; everything older lives in the PR-8 columnar archive. This
+module is the batch driver that puts the MXU on that history:
+
+  plan   one :class:`~sitewhere_tpu.utils.archive.SegmentPlanner` pass
+         per streaming round prunes segments by zone maps + blooms
+         (etype/tenant/time pushdown) and prices each survivor with the
+         planner's decode-cost table (compressed segments charge
+         decode bytes too);
+  load   rounds pack segments up to a cost budget; only the columns the
+         job touches decode (lazy per-column loads through the shared
+         LRU SegmentCache);
+  fill   surviving measurement rows trim to the newest W per device on
+         host (vectorized — no per-device Python loops) and rebuild
+         [M, W, C] snapshot-form windows ON DEVICE
+         (ops/window_fill.fill_windows);
+  score  the existing fused feature + anomaly stack
+         (ops/window_features.py, models/anomaly.py) runs in [M]
+         batches; batches are DOUBLE-BUFFERED — the jitted program for
+         device-batch k is submitted asynchronously, the host prepares
+         batch k+1's columns while it runs, and batch k-1 is harvested
+         after submission, so host decode/transfer overlaps device
+         compute without threads;
+  emit   threshold crossings re-enter the pipeline as ordinary
+         DeviceAlert envelopes via ``ingest_json_batch`` — WAL-carried,
+         queryable, CEP-visible, replicated — deduplicated by
+         ``swa:<job>:<device>:<windowEnd>`` alternate ids exactly like
+         the PR-12 rule-alert discipline: the event-id interner is the
+         durable key registry, ``resync_emitted()`` replays it, and
+         kill/recover or standby promotion re-emits exactly the scores
+         the previous owner never shipped.
+
+Conservation (ISSUE 14): every window entering a scoring batch lands in
+exactly one sink — ``windows_planned == windows_scored +
+windows_skipped_underfilled + windows_cancelled`` — committed in ONE
+manager-lock block per batch so a concurrent audit only ever reads
+pre- or post-batch totals (the new ``analytics-windows`` equation in
+utils/conservation.py).
+
+Import hygiene: module level is numpy + stdlib only (the hygiene sweep
+pins it importable with jax blocked); jax, the ops, and the model stack
+import lazily inside the job thread.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+SCORE_KEY_PREFIX = "swa:"
+
+_MEASUREMENT = 0        # core.types.EventType.MEASUREMENT (jax-free pin)
+_JOB_COLUMNS = ("valid", "etype", "device", "tenant", "ts_ms",
+                "values", "vmask")
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalyticsJobSpec:
+    """One scoring job over the archived history of a tenant (or the
+    whole fleet). ``name`` defaults to a content hash of the spec, so a
+    re-run after kill/recover derives the SAME dedup keys and suppresses
+    against the replayed alerts."""
+
+    tenant: str | None = "default"
+    since_ms: int | None = None       # event-time range (engine epoch-
+    until_ms: int | None = None       # relative ms, archive ts domain)
+    batch_devices: int = 256          # M — devices per scoring batch
+    window: int | None = None         # W; default analytics_window
+    min_fill: int | None = None       # rows required to score; default W
+    threshold: float = 3.0            # absolute score threshold (kept
+                                      # deterministic across re-runs —
+                                      # no adaptive baseline here)
+    emit: bool = True                 # emit threshold crossings
+    round_cost_bytes: int = 8 << 20   # planner-cost budget per round
+    max_rounds: int | None = None     # stream at most this many rounds
+    max_batches: int | None = None    # score at most this many device
+                                      # batches (ops/test knob: a killed
+                                      # owner is a job that stopped
+                                      # mid-batch)
+    duty: float | None = None         # background duty cycle in (0, 1):
+                                      # after each streaming round /
+                                      # scoring batch the job sleeps so
+                                      # its busy share stays <= duty —
+                                      # the knob that keeps a concurrent
+                                      # job off the ingest headline
+                                      # (identity-neutral: not hashed
+                                      # into resolved_name, pacing does
+                                      # not change what a job scores)
+    name: str = ""
+
+    def resolved_name(self) -> str:
+        if self.name:
+            return self.name
+        h = hashlib.sha256(json.dumps(
+            [self.tenant, self.since_ms, self.until_ms,
+             self.batch_devices, self.window, self.min_fill,
+             self.threshold, self.round_cost_bytes],
+            sort_keys=True).encode()).hexdigest()[:12]
+        return f"hist-{h}"
+
+
+class AnalyticsManager:
+    """Job lifecycle + score-alert emission for one engine's archive.
+
+    Mirrors the RulesManager disciplines: dedup-keyed emission through
+    ``ingest_json_batch``, incremental interner resync, leader-only
+    emission (``active=False`` standbys run nothing and promotion
+    resyncs before the next job emits), and single-lock counter commits
+    for the audit plane."""
+
+    def __init__(self, engine, service=None, active: bool = True):
+        self.engine = engine
+        self.service = service            # optional live AnalyticsService
+        self.active = active
+        self._mu = threading.Lock()       # counters + job table
+        self._run_lock = threading.Lock()  # one executing job at a time
+        self._emitted: set[str] = set()
+        self._scan_pos = 0
+        self._seq = 0
+        self.jobs: dict[str, dict] = {}
+        # conservation counters (analytics-windows equation)
+        self.windows_planned = 0
+        self.windows_scored = 0
+        self.windows_skipped_underfilled = 0
+        self.windows_cancelled = 0
+        # observability counters (swtpu_analytics_* at scrape)
+        self.jobs_started = 0
+        self.jobs_completed = 0
+        self.jobs_cancelled = 0
+        self.jobs_failed = 0
+        self.rounds_streamed = 0
+        self.segments_streamed = 0
+        self.bytes_streamed = 0           # planner decode-cost bytes
+        self.rows_streamed = 0
+        self.alerts_emitted = 0
+        self.alerts_suppressed = 0
+        # the conservation plane, metrics exporter, REST/RPC surfaces and
+        # loadgen all find the manager here
+        engine.analytics_jobs = self
+
+    # ---------------------------------------------------------- emission
+    def resync_emitted(self) -> int:
+        """Register every score-alert dedup key the engine has ever seen
+        (interner scan — append-only, survives snapshot restore, WAL
+        replay, standby apply). Incremental like the rules manager's."""
+        ids = self.engine.event_ids
+        n = len(ids)
+        added = 0
+        with self._mu:
+            for i in range(self._scan_pos, n):
+                tok = ids.token(i)
+                if tok.startswith(SCORE_KEY_PREFIX) \
+                        and tok not in self._emitted:
+                    self._emitted.add(tok)
+                    added += 1
+            self._scan_pos = n
+        return added
+
+    def promote(self) -> int:
+        """Standby -> owner: enable emission; the next job run emits
+        exactly the score alerts the old owner never shipped."""
+        self.active = True
+        return self.resync_emitted()
+
+    # --------------------------------------------------------- lifecycle
+    def start_job(self, spec: "AnalyticsJobSpec | dict") -> dict:
+        """Launch a job on a worker thread; returns its status row
+        immediately (poll :meth:`status`, or join via the thread in
+        ``_threads``)."""
+        job = self._register(spec)
+        t = threading.Thread(target=self._execute, args=(job,),
+                             name=f"swtpu-analytics-{job['id']}",
+                             daemon=True)
+        job["_thread"] = t
+        t.start()
+        return self._public(job)
+
+    def run_job(self, spec: "AnalyticsJobSpec | dict") -> dict:
+        """Synchronous entry (tests/bench): execute to completion and
+        return the final status row."""
+        job = self._register(spec)
+        self._execute(job)
+        return self._public(job)
+
+    def _register(self, spec) -> dict:
+        if isinstance(spec, dict):
+            spec = AnalyticsJobSpec(**spec)
+        with self._mu:
+            self._seq += 1
+            job = {
+                "id": f"aj-{self._seq}", "spec": spec,
+                "name": spec.resolved_name(), "state": "pending",
+                "error": None, "cancel": threading.Event(),
+                "rounds": 0, "segments": 0, "bytes": 0, "rows": 0,
+                "planned": 0, "scored": 0, "skipped_underfilled": 0,
+                "cancelled": 0, "emitted": 0, "suppressed": 0,
+                "devices": 0, "stream_s": 0.0, "score_s": 0.0,
+                "devices_per_s": 0.0, "bytes_per_s": 0.0,
+            }
+            self.jobs[job["id"]] = job
+            self.jobs_started += 1
+        return job
+
+    def cancel(self, job_id: str) -> bool:
+        with self._mu:
+            job = self.jobs.get(job_id)
+        if job is None or job["state"] in ("done", "failed", "cancelled"):
+            return False
+        job["cancel"].set()
+        return True
+
+    def status(self, job_id: str | None = None) -> dict:
+        with self._mu:
+            if job_id is not None:
+                job = self.jobs.get(job_id)
+                if job is None:
+                    raise KeyError(f"analytics job {job_id!r} not found")
+                return self._public(job)
+            return {
+                "active": self.active,
+                "jobs": [self._public(j) for j in self.jobs.values()],
+                **self.ledger_stage(locked=True),
+            }
+
+    def _public(self, job: dict) -> dict:
+        out = {k: v for k, v in job.items()
+               if not k.startswith("_") and k != "cancel"}
+        out["spec"] = dataclasses.asdict(job["spec"])
+        return out
+
+    def ledger_stage(self, locked: bool = False) -> dict:
+        """The conservation/metrics counter snapshot. ``locked=True``
+        when the caller already holds ``_mu``."""
+        if not locked:
+            with self._mu:
+                return self.ledger_stage(locked=True)
+        return {
+            "planned": self.windows_planned,
+            "scored": self.windows_scored,
+            "skipped_underfilled": self.windows_skipped_underfilled,
+            "cancelled": self.windows_cancelled,
+            "jobs_started": self.jobs_started,
+            "jobs_completed": self.jobs_completed,
+            "jobs_cancelled": self.jobs_cancelled,
+            "jobs_failed": self.jobs_failed,
+            "rounds": self.rounds_streamed,
+            "segments": self.segments_streamed,
+            "bytes": self.bytes_streamed,
+            "rows": self.rows_streamed,
+            "alerts_emitted": self.alerts_emitted,
+            "alerts_suppressed": self.alerts_suppressed,
+        }
+
+    # --------------------------------------------------------- execution
+    def _execute(self, job: dict) -> None:
+        with self._run_lock:
+            job["state"] = "running"
+            try:
+                self._run(job)
+            except Exception as e:          # noqa: BLE001 — job boundary
+                job["state"] = "failed"
+                job["error"] = f"{type(e).__name__}: {e}"
+                with self._mu:
+                    self.jobs_failed += 1
+                logger.exception("analytics job %s failed", job["id"])
+                return
+            if job["state"] == "running":
+                job["state"] = "done"
+                with self._mu:
+                    self.jobs_completed += 1
+
+    def _model_bundle(self, w: int, c: int):
+        """(model, params, jitted scorer) — the live service's when one
+        is attached and shapes agree, else a deterministic default
+        (init key 0, so host-oracle parity and kill/recover re-runs see
+        the identical model)."""
+        from sitewhere_tpu.models.service import _score_windows
+
+        svc = self.service
+        if svc is not None and svc.cfg.window == w and \
+                svc.cfg.sensors == c:
+            with svc._lock:
+                return svc.model, svc.params, _score_windows
+        import jax
+
+        from sitewhere_tpu.models.anomaly import AnomalyConfig, AnomalyModel
+        cached = getattr(self, "_default_bundle", None)
+        if cached is not None and cached[0] == (w, c):
+            return cached[1], cached[2], _score_windows
+        cfg = AnomalyConfig(sensors=c, window=w, hidden=256,
+                            lstm_hidden=256, latent=32)
+        model = AnomalyModel(cfg)
+        import jax.numpy as jnp
+        rng = np.random.default_rng(0)
+        x0 = jnp.asarray(rng.standard_normal((2, w, c)), jnp.float32)
+        params = model.init(jax.random.key(0), x0)
+        self._default_bundle = ((w, c), model, params)
+        return model, params, _score_windows
+
+    @staticmethod
+    def _pace(job, busy_s: float) -> None:
+        """Duty-cycle throttle (``spec.duty``): after ``busy_s`` of work
+        the job blocks long enough that its busy share stays at the
+        requested duty, so a concurrent background job cannot move the
+        ingest headline. The wait rides the cancel event — pacing never
+        delays a cancel. Pacing is identity-neutral (the same windows
+        score either way); a paced job's ``bytes_per_s`` reports the
+        paced rate by design."""
+        duty = job["spec"].duty
+        if not duty or duty >= 1.0 or busy_s <= 0:
+            return
+        job["cancel"].wait(busy_s * (1.0 - duty) / duty)
+
+    def _run(self, job: dict) -> None:
+        eng = self.engine
+        spec: AnalyticsJobSpec = job["spec"]
+        arch = getattr(eng, "archive", None)
+        if arch is None:
+            raise RuntimeError("engine has no archive "
+                               "(set EngineConfig.archive_dir)")
+        w = int(spec.window or eng.config.analytics_window)
+        c = int(eng.config.channels)
+        m = int(spec.batch_devices)
+        min_fill = int(spec.min_fill if spec.min_fill is not None else w)
+        tid = None
+        if spec.tenant is not None:
+            tid = eng.tenants.lookup(spec.tenant)
+            if tid < 0:
+                job["devices"] = 0
+                return                  # unknown tenant: empty job
+        tracer = getattr(eng, "tracer", None)
+        from sitewhere_tpu.ops.query import host_filter_mask
+
+        def span(name, **tags):
+            if tracer is None:
+                import contextlib
+                return contextlib.nullcontext()
+            return tracer.begin(name, job=job["name"], **tags)
+
+        self.resync_emitted()
+        # ---------------- stream: planner-batched rounds, newest-first.
+        # Per-device reservoir of the newest <= w matching rows, merged
+        # vectorized after each round (dtype int64 positions keep the
+        # (ts, archive position) tie order exact).
+        r_dev = np.empty(0, np.int64)
+        r_ts = np.empty(0, np.int64)
+        r_pos = np.empty(0, np.int64)
+        r_vals = np.empty((0, c), np.float32)
+        r_mask = np.empty((0, c), bool)
+        seen: set[str] = set()
+        t0 = time.monotonic()
+        while True:
+            t_round = time.monotonic()
+            if job["cancel"].is_set():
+                job["state"] = "cancelled"
+                with self._mu:
+                    self.jobs_cancelled += 1
+                return
+            with span("analytics.plan", round=job["rounds"]):
+                plan_rows, _ = arch.planner.plan(
+                    etype=_MEASUREMENT, tenant=tid,
+                    since_ms=spec.since_ms, until_ms=spec.until_ms)
+                fresh = [(i, seg) for i, seg, _f, _hi, _cap in plan_rows
+                         if seg.path not in seen]
+            if not fresh:
+                break
+            # pack one round by planner decode cost (always >= 1 seg)
+            round_segs: list = []
+            cost = 0
+            for i, seg in fresh:
+                seg_cost = arch.planner.cost_of(i)
+                if round_segs and cost + seg_cost > spec.round_cost_bytes:
+                    break
+                round_segs.append(seg)
+                cost += seg_cost
+            with span("analytics.load", round=job["rounds"],
+                      segments=len(round_segs)):
+                parts = []
+                for seg in round_segs:
+                    seen.add(seg.path)
+                    cols = arch._cols_or_drop(seg, _JOB_COLUMNS)
+                    if cols is None:
+                        continue        # quarantined mid-job
+                    msk = cols["valid"].astype(bool) & host_filter_mask(
+                        cols, device=None, etype=_MEASUREMENT,
+                        tenant=tid, assignment=None, aux0=None,
+                        aux1=None, area=None, customer=None,
+                        since_ms=spec.since_ms, until_ms=spec.until_ms)
+                    idx = np.nonzero(msk)[0]
+                    if not idx.size:
+                        continue
+                    parts.append((
+                        cols["device"][idx].astype(np.int64),
+                        cols["ts_ms"][idx].astype(np.int64),
+                        seg.start + idx.astype(np.int64),
+                        cols["values"][idx].astype(np.float32),
+                        cols["vmask"][idx].astype(bool)))
+            if parts:
+                r_dev = np.concatenate([r_dev] + [p[0] for p in parts])
+                r_ts = np.concatenate([r_ts] + [p[1] for p in parts])
+                r_pos = np.concatenate([r_pos] + [p[2] for p in parts])
+                r_vals = np.concatenate([r_vals] + [p[3] for p in parts])
+                r_mask = np.concatenate([r_mask] + [p[4] for p in parts])
+                rows = int(sum(p[0].size for p in parts))
+                # trim to newest w per device (vectorized)
+                order = np.lexsort((r_pos, r_ts, r_dev))
+                r_dev, r_ts, r_pos = r_dev[order], r_ts[order], r_pos[order]
+                r_vals, r_mask = r_vals[order], r_mask[order]
+                _, starts, counts = np.unique(
+                    r_dev, return_index=True, return_counts=True)
+                rank = np.arange(r_dev.size) - np.repeat(starts, counts)
+                keep = rank >= np.repeat(counts, counts) - w
+                r_dev, r_ts, r_pos = r_dev[keep], r_ts[keep], r_pos[keep]
+                r_vals, r_mask = r_vals[keep], r_mask[keep]
+            else:
+                rows = 0
+            job["rounds"] += 1
+            job["segments"] += len(round_segs)
+            job["bytes"] += cost
+            job["rows"] += rows
+            with self._mu:
+                self.rounds_streamed += 1
+                self.segments_streamed += len(round_segs)
+                self.bytes_streamed += cost
+                self.rows_streamed += rows
+            if spec.max_rounds is not None \
+                    and job["rounds"] >= spec.max_rounds:
+                break
+            self._pace(job, time.monotonic() - t_round)
+        job["stream_s"] = time.monotonic() - t0
+        devs, starts, counts = np.unique(r_dev, return_index=True,
+                                         return_counts=True)
+        job["devices"] = int(devs.size)
+        if not devs.size:
+            return
+        # per-device window end (reservoir is (dev, ts, pos)-sorted, so
+        # the last row of each run carries the max ts) — the dedup key's
+        # window identity
+        dev_end_ts = r_ts[starts + counts - 1]
+        dev_idx = np.searchsorted(devs, r_dev)   # row -> dense device ix
+        job["score_s"] = time.monotonic()        # reused as t1 below
+        self._score_pass(job, devs, dev_end_ts, dev_idx,
+                         (r_ts, r_pos, r_vals, r_mask),
+                         m=m, w=w, c=c, min_fill=min_fill, span=span)
+        job["score_s"] = time.monotonic() - job["score_s"]
+        if job["stream_s"] > 0:
+            job["bytes_per_s"] = job["bytes"] / job["stream_s"]
+        if job["score_s"] > 0:
+            job["devices_per_s"] = job["planned"] / job["score_s"]
+
+    def _score_pass(self, job, devs, dev_end_ts, dev_idx, rows,
+                    *, m, w, c, min_fill, span) -> None:
+        """Pipelined device-batch scoring: submit the jitted program for
+        batch k, prepare batch k+1 on host, harvest batch k-1 — JAX
+        async dispatch gives the host->device transfer / compute overlap
+        without threads. Fixed shapes ([m*w] rows, [m] windows) per
+        batch -> zero retraces."""
+        import jax.numpy as jnp
+
+        from sitewhere_tpu.ops.window_fill import fill_windows
+
+        eng = self.engine
+        spec: AnalyticsJobSpec = job["spec"]
+        model, params, score_fn = self._model_bundle(w, c)
+        r_ts, r_pos, r_vals, r_mask = rows
+        n_fixed = m * w
+        n_batches = (devs.size + m - 1) // m
+        if spec.max_batches is not None:
+            n_batches = min(n_batches, int(spec.max_batches))
+        batch_of_row = dev_idx // m
+        min_fill_j = jnp.int32(min_fill)
+
+        def prepare(k):
+            sel = np.nonzero(batch_of_row == k)[0]   # (dev,ts,pos)-ordered
+            n = sel.size                              # <= m*w after trim
+            slot = np.full(n_fixed, -1, np.int32)
+            ts = np.zeros(n_fixed, np.int32)
+            seq = np.arange(n_fixed, dtype=np.int32)  # preserves order
+            vals = np.zeros((n_fixed, c), np.float32)
+            mask = np.zeros((n_fixed, c), bool)
+            slot[:n] = (dev_idx[sel] - k * m).astype(np.int32)
+            ts[:n] = r_ts[sel].astype(np.int32)
+            vals[:n] = r_vals[sel]
+            mask[:n] = r_mask[sel]
+            lo = k * m
+            batch_devs = devs[lo:lo + m]
+            return (slot, ts, seq, vals, mask,
+                    batch_devs, dev_end_ts[lo:lo + m])
+
+        def submit(arrays):
+            slot, ts, seq, vals, mask = (jnp.asarray(a)
+                                         for a in arrays[:5])
+            with span("analytics.transfer"):
+                data, filled = fill_windows(slot, ts, seq, vals, mask,
+                                            m=m, w=w)
+            with span("analytics.score"):
+                scores, valid, _ = score_fn(model, params, data, filled,
+                                            min_fill_j)
+            return scores, valid
+
+        def harvest(pend):
+            (scores, valid), batch_devs, ends = pend
+            scores = np.asarray(scores)[:batch_devs.size]
+            valid = np.asarray(valid)[:batch_devs.size]
+            scored = int(valid.sum())
+            self._emit_batch(job, batch_devs, ends, scores, valid,
+                             spec.threshold, span)
+            with self._mu:      # ONE commit: planned lands with sinks
+                self.windows_planned += batch_devs.size
+                self.windows_scored += scored
+                self.windows_skipped_underfilled += \
+                    batch_devs.size - scored
+            job["planned"] += batch_devs.size
+            job["scored"] += scored
+            job["skipped_underfilled"] += batch_devs.size - scored
+
+        pending = None
+        done = 0
+        t_batch = time.monotonic()
+        for k in range(n_batches):
+            if job["cancel"].is_set():
+                break
+            arrays = prepare(k)
+            out = submit(arrays)                 # async dispatch
+            if pending is not None:
+                harvest(pending)
+                done += 1
+            pending = (out, arrays[5], arrays[6])
+            self._pace(job, time.monotonic() - t_batch)
+            t_batch = time.monotonic()
+        if pending is not None:
+            harvest(pending)
+            done += 1
+        if done < n_batches or job["cancel"].is_set():
+            # cancelled mid-pass: the remaining planned-but-unscored
+            # windows land in the cancelled sink, planned alongside —
+            # the equation stays exact at every instant. Scope is the
+            # batches this job would have run (max_batches caps it).
+            in_scope = min(n_batches * m, int(devs.size))
+            rest = max(in_scope - done * m, 0)
+            with self._mu:
+                self.windows_planned += rest
+                self.windows_cancelled += rest
+                self.jobs_cancelled += 1
+            job["planned"] += rest
+            job["cancelled"] += rest
+            job["state"] = "cancelled"
+
+    def _emit_batch(self, job, batch_devs, ends, scores, valid,
+                    threshold, span) -> None:
+        """Threshold crossings -> DeviceAlert envelopes through the
+        normal ingest path, dedup-keyed per (job, device, window end).
+        Inactive (standby) managers emit nothing; promotion resyncs and
+        the next run ships only what the old owner never did."""
+        eng = self.engine
+        spec: AnalyticsJobSpec = job["spec"]
+        if not spec.emit or not self.active:
+            return
+        hits = np.nonzero(valid & (scores > threshold))[0]
+        if not hits.size:
+            return
+        base_ms = int(eng.epoch.base_unix_s * 1000)
+        by_tenant: dict[str, list[bytes]] = {}
+        emitted = suppressed = 0
+        with span("analytics.emit", hits=int(hits.size)):
+            for i in hits:
+                did = int(batch_devs[i])
+                info = eng.devices.get(did)
+                if info is None:
+                    continue
+                end_ms = int(ends[i])
+                dedup = (f"{SCORE_KEY_PREFIX}{job['name']}:"
+                         f"{info.token}:{end_ms}")
+                with self._mu:
+                    if dedup in self._emitted:
+                        suppressed += 1
+                        continue
+                    self._emitted.add(dedup)
+                envelope = {
+                    "deviceToken": info.token, "type": "DeviceAlert",
+                    "tenant": info.tenant,
+                    "request": {
+                        "type": "analytics.history",
+                        "level": "Warning",
+                        "message": (f"historical anomaly score "
+                                    f"{float(scores[i]):.3f} > "
+                                    f"{threshold:g} (job {job['name']})"),
+                        "eventDate": base_ms + end_ms,
+                        "alternateId": dedup,
+                    },
+                }
+                by_tenant.setdefault(info.tenant, []).append(
+                    json.dumps(envelope, sort_keys=True).encode())
+                emitted += 1
+            for tenant, payloads in by_tenant.items():
+                eng.ingest_json_batch(payloads, tenant)
+        with self._mu:
+            self.alerts_emitted += emitted
+            self.alerts_suppressed += suppressed
+        job["emitted"] += emitted
+        job["suppressed"] += suppressed
+        if emitted:
+            eng.host_counters["analytics_alerts"] = \
+                eng.host_counters.get("analytics_alerts", 0) + emitted

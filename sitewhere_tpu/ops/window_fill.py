@@ -1,0 +1,72 @@
+"""Vectorized archive->window fill for historical analytics (ISSUE 19).
+
+Rebuilds per-device telemetry windows [M, W, C] from a flat batch of
+archived measurement rows — the device-side half of the batched
+archive->device scoring pipeline (models/analytics.py). The live-window
+path (models/windows.py) appends each ingest batch into per-device rings;
+here an entire streamed round of historical rows lands in one shot, so
+the op sorts rows by (device slot, ts, seq), ranks them within each
+device run, keeps only the newest W per device, and scatters them into
+the snapshot layout the scoring stack consumes: newest row at index W-1,
+zeros padding the front of underfilled windows — exactly the shape
+``snapshot_windows`` yields for a live ring, so ``_score_windows``
+(models/service.py) runs unchanged over either source.
+
+Keeping only the newest W rows per device (``rank >= count - W``) is
+what makes the scatter deterministic: every surviving row owns a UNIQUE
+(device, slot) destination, so no two rows race for a slot — the
+duplicate-destination nondeterminism a naive modular ring scatter would
+reintroduce. No per-device Python loops anywhere; everything is one
+static-shape program (fixed N and M per analytics round -> zero
+retraces, watched under its own devicewatch family).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from sitewhere_tpu.ops.segment import lex_argsort, segment_ranks
+
+
+@functools.partial(jax.jit, static_argnames=("m", "w"))
+def fill_windows(
+    dev_slot: jax.Array,   # int32[N] dense batch-device slot, -1 = drop
+    ts: jax.Array,         # int32[N] event time (window order key)
+    seq: jax.Array,        # int32[N] tie-break (absolute archive pos)
+    values: jax.Array,     # float32[N, C]
+    vmask: jax.Array,      # bool[N, C] valid channel lanes
+    *, m: int, w: int,
+) -> tuple[jax.Array, jax.Array]:
+    """-> (data float32[m, w, C] snapshot-form, filled int32[m] total
+    matching rows per slot — may exceed ``w``; older rows spill off)."""
+    vals = jnp.where(vmask, values, 0.0)
+    take = (dev_slot >= 0) & (dev_slot < m)
+    dev_key = jnp.where(take, dev_slot, m)
+    sorted_keys, perm = lex_argsort([dev_key, ts, seq])
+    s_dev = sorted_keys[0]
+    s_vals = vals[perm]
+    rank, _ = segment_ranks(s_dev)
+    live = s_dev < m
+    counts = jnp.zeros((m,), jnp.int32).at[
+        jnp.where(live, s_dev, m)].add(live.astype(jnp.int32), mode="drop")
+    cnt_row = counts.at[jnp.where(live, s_dev, m)].get(
+        mode="fill", fill_value=0)
+    slot = rank + w - cnt_row          # right-align: newest lands at w-1
+    keep = live & (slot >= 0)          # only the newest w rows per device
+    d_w = jnp.where(keep, s_dev, m)
+    c = values.shape[1]
+    data = jnp.zeros((m, w, c), jnp.float32).at[d_w, slot].set(
+        s_vals, mode="drop")
+    return data, counts
+
+
+# devicewatch (ISSUE 11 discipline): the analytics fill runs at fixed
+# (N, M, W) per job round — any shape churn is a bug and shows up under
+# this family instead of as silent recompile stalls.
+from sitewhere_tpu.utils.devicewatch import watched_jit  # noqa: E402
+
+fill_windows = watched_jit(fill_windows, family="window_fill",
+                           static_argnames=("m", "w"))

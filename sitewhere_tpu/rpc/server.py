@@ -684,6 +684,26 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         return await asyncio.to_thread(inst.rules.read_rollup, name,
                                        group, clamp_page_size(pageSize))
 
+    async def analytics(action: str = "status", jobId: str = None,
+                        spec: dict = None, wait: bool = False):
+        """Historical scoring jobs (ISSUE 19) — the RPC twin of the
+        /api/analytics family. ``action``: "status" (all jobs, or one
+        when ``jobId`` is given), "score" (start a job from ``spec`` —
+        AnalyticsJobSpec field names; ``wait`` runs it to completion),
+        or "cancel". Off-loop: a waited job streams the archive."""
+        aj = inst.analytics_jobs
+        if action == "status":
+            return await asyncio.to_thread(aj.status, jobId)
+        if action == "score":
+            fn = aj.run_job if wait else aj.start_job
+            return await asyncio.to_thread(fn, dict(spec or {}))
+        if action == "cancel":
+            if not jobId:
+                raise ValueError("cancel requires jobId")
+            return {"cancelled": bool(
+                await asyncio.to_thread(aj.cancel, jobId))}
+        raise ValueError(f"unknown analytics action {action!r}")
+
     families: dict[str, Handler] = {
         "DeviceManagement.getDeviceByToken": get_device_by_token,
         "DeviceManagement.createDevice": create_device,
@@ -740,6 +760,7 @@ def build_instance_rpc(instance, require_auth: bool = True) -> RpcServer:
         "Instance.conservation": conservation,
         "Instance.spmdHeat": spmd_heat,
         "Instance.placement": placement,
+        "Instance.analytics": analytics,
         "Rules.getStatus": rules_status,
         "Rules.setRuleSet": rules_set,
         "Rules.poll": rules_poll,

@@ -35,6 +35,7 @@ import json
 import logging
 import pathlib
 import threading
+from types import SimpleNamespace
 
 import numpy as np
 
@@ -73,6 +74,13 @@ class RulesManager:
         # audit plane checks harvested == emitted + suppressed + skipped
         self.fires_harvested = 0
         self.harvest_skipped = 0
+        # rollup ring -> archive spill (ISSUE 19; the PR-12 leftover):
+        # closed [P, G, NB] windows age out to columnar segments under
+        # <archive>/rollups so months-long dashboards read the archive,
+        # not the ring
+        self._rollup_arch = None
+        self.rollup_windows_spilled = 0
+        self.rollup_spill_calls = 0
         self._inst = rules_metrics()
 
     # ----------------------------------------------------------- install
@@ -376,6 +384,151 @@ class RulesManager:
         interner = eng.areas if scope == "area" else eng.tenants
         gid = interner.lookup(token)
         return gid if gid >= 0 else None
+
+    # ----------------------------------------------------- rollup spill
+    def rollup_archive(self):
+        """The rollup retention tier: a second :class:`EventArchive`
+        under ``<archive dir>/rollups`` (lazy; partition = rollup index,
+        compression follows the engine knob). ``None`` without a main
+        archive — spill is then a no-op and dashboards read the ring
+        only."""
+        eng = self.engine
+        arch = getattr(eng, "archive", None)
+        if arch is None:
+            return None
+        if self._rollup_arch is None:
+            from sitewhere_tpu.utils.archive import EventArchive
+            self._rollup_arch = EventArchive(
+                arch.dir / "rollups", segment_rows=arch.segment_rows,
+                cache_segments=2, compress=arch.compress)
+        return self._rollup_arch
+
+    def spill_rollups(self, lag: int = 1) -> dict:
+        """Age CLOSED rollup windows out of the device-resident
+        ``[P, G, NB]`` rings into the rollup archive. A window is closed
+        once the rollup's newest live window id exceeds it by ``lag``
+        (still-accumulating windows never spill). Idempotent: the spill
+        watermark per rollup is recovered from the segments' ``aux0``
+        (= window id) zone maps, so re-spooling after restart re-writes
+        nothing. Row mapping — one archive row per non-empty closed
+        (group, window): device=group id, assignment=rollup index,
+        ts_ms=window start (relative ms, the ``windowStartMs`` domain),
+        received_ms=window end, values lanes=[count, sum, min, max],
+        aux=[window id, bucket]."""
+        eng = self.engine
+        ra = self.rollup_archive()
+        out = {"spilled": 0, "rollups": 0}
+        if ra is None:
+            return out
+        with self._mu:
+            metas = list(self.rollup_meta)
+            self.rollup_spill_calls += 1
+        c = int(eng.config.channels)
+        nlan = min(4, c)
+        for p, m in enumerate(metas):
+            with eng.lock:
+                eng._sync_mirrors()
+                rs = eng.state.rules
+                if rs is None or rs.rollups is None:
+                    break
+                arrs = eng._rollup_tables(p, m.scope)
+            wid, cnt, vsum, vmin, vmax = (np.asarray(a) for a in arrs)
+            live = cnt > 0
+            if not live.any():
+                continue
+            newest = int(wid[live].max())
+            mark = max((s.stats["z"]["aux0"][1] for s in ra.segments
+                        if s.part == p and s.stats
+                        and "aux0" in s.stats.get("z", {})), default=-1)
+            gs, bs = np.nonzero(live & (wid <= newest - lag)
+                                & (wid > mark))
+            if not gs.size:
+                continue
+            w_sel = wid[gs, bs]
+            order = np.lexsort((gs, w_sel))
+            gs, bs, w_sel = gs[order], bs[order], w_sel[order]
+            n = gs.size
+            vals = np.zeros((n, c), np.float32)
+            stats_rows = np.stack([cnt[gs, bs], vsum[gs, bs],
+                                   vmin[gs, bs], vmax[gs, bs]],
+                                  axis=1)
+            vals[:, :nlan] = stats_rows[:, :nlan]
+            vmask = np.zeros((n, c), bool)
+            vmask[:, :nlan] = True
+            tenant = np.zeros(n, np.int64)
+            if m.scope == "tenant":
+                tenant[:] = gs
+            elif m.scope == "device":
+                for i, g in enumerate(gs):      # cold path, small n
+                    info = eng.devices.get(int(g))
+                    if info is not None:
+                        tenant[i] = max(eng.tenants.lookup(info.tenant), 0)
+            sl = SimpleNamespace(
+                etype=np.zeros(n, np.int64),    # MEASUREMENT
+                device=gs.astype(np.int64),
+                assignment=np.full(n, p, np.int64),
+                tenant=tenant,
+                area=gs.astype(np.int64) if m.scope == "area"
+                else np.full(n, -1, np.int64),
+                customer=np.full(n, -1, np.int64),
+                asset=np.full(n, -1, np.int64),
+                ts_ms=w_sel.astype(np.int64) * m.window_ms,
+                received_ms=(w_sel.astype(np.int64) + 1) * m.window_ms,
+                values=vals, vmask=vmask,
+                aux=np.stack([w_sel.astype(np.int64),
+                              bs.astype(np.int64)], axis=1),
+                valid=np.ones(n, bool))
+            ra.append_segment(p, ra.spilled(p), sl)
+            out["spilled"] += n
+            out["rollups"] += 1
+        with self._mu:
+            self.rollup_windows_spilled += out["spilled"]
+        if out["spilled"]:
+            eng.host_counters["rollup_windows_spilled"] = \
+                eng.host_counters.get("rollup_windows_spilled", 0) \
+                + out["spilled"]
+        return out
+
+    def read_rollup_history(self, name: str, group: str | None = None,
+                            since_ms: int | None = None,
+                            until_ms: int | None = None,
+                            limit: int = 100) -> dict:
+        """Months-long dashboard read: serve one rollup's SPILLED windows
+        from the rollup archive through the normal pushdown query path
+        (zone maps prune by time, blooms by group) — the ring only ever
+        holds the hot tail, :meth:`read_rollup` serves that."""
+        eng = self.engine
+        with self._mu:
+            metas = list(self.rollup_meta)
+        p = next((i for i, m in enumerate(metas) if m.name == name), None)
+        if p is None:
+            raise KeyError(f"rollup {name!r} not found")
+        m = metas[p]
+        base = {"rollup": name, "windowMs": m.window_ms, "scope": m.scope,
+                "channel": m.channel, "buckets": []}
+        ra = self.rollup_archive()
+        if ra is None:
+            return base
+        gid = None
+        if group is not None:
+            gid = self._group_id(m.scope, group)
+            if gid is None:
+                return base
+        _total, rows = ra.query(assignment=p, device=gid,
+                                since_ms=since_ms, until_ms=until_ms,
+                                limit=limit)
+        nlan = min(4, int(eng.config.channels))
+        for r in rows:
+            v = np.asarray(r["values"], np.float64)
+            stats = [float(v[i]) if i < nlan else 0.0 for i in range(4)]
+            base["buckets"].append({
+                "group": self._group_token(m.scope, int(r["device"]))
+                or int(r["device"]),
+                "windowStartMs": int(r["ts_ms"]),
+                "count": int(stats[0]), "sum": stats[1],
+                "min": stats[2], "max": stats[3],
+            })
+        return base
 
 
 def dataclass_dict(m) -> dict:

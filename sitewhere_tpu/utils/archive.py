@@ -47,6 +47,7 @@ import dataclasses
 import json
 import pathlib
 import zipfile
+import zlib
 
 import numpy as np
 
@@ -133,6 +134,106 @@ def _compute_stats(cols: dict) -> dict:
     return st
 
 
+# --------------------------------------------------------------- codecs
+# Per-column compression for spilled segments (PR-8 leftover). A
+# compressed segment stores ``<col>__packed`` uint8 blobs plus one
+# ``codec_json`` member instead of the plain column members; the scalar
+# stats members (seg_nrows/seg_ts_min/seg_ts_max/stats_json) stay plain,
+# so index rebuilds and the planner never touch a codec. Decoding is
+# exact (bit-for-bit round trip, pinned in tests): integer columns are
+# delta-coded along axis 0, zigzagged, packed to the minimal uint width
+# and deflated; bool columns packbits + deflate; float payloads deflate
+# raw. All stdlib — no new dependencies.
+
+_PACK_WIDTHS = ((np.uint8, 0xFF), (np.uint16, 0xFFFF),
+                (np.uint32, 0xFFFFFFFF))
+
+
+def _encode_column(a: np.ndarray) -> tuple[np.ndarray, dict]:
+    """(uint8 blob, meta) for one column. Meta is JSON-serializable and
+    self-contained: kind + dtype + shape (+ pack width for ints)."""
+    a = np.ascontiguousarray(a)
+    meta: dict = {"dtype": str(a.dtype), "shape": list(a.shape)}
+    if a.dtype == np.bool_:
+        meta["kind"] = "bits"
+        raw = np.packbits(a.reshape(-1)).tobytes()
+    elif np.issubdtype(a.dtype, np.integer):
+        meta["kind"] = "delta"
+        v = a.astype(np.int64)
+        d = np.empty_like(v)
+        d[:1] = v[:1]
+        if v.shape[0] > 1:
+            d[1:] = v[1:] - v[:-1]
+        with np.errstate(over="ignore"):
+            u = (d.astype(np.uint64) << np.uint64(1)) \
+                ^ (d >> np.int64(63)).astype(np.uint64)
+        hi = int(u.max()) if u.size else 0
+        for w, cap in _PACK_WIDTHS:
+            if hi <= cap:
+                u = u.astype(w)
+                break
+        meta["width"] = u.dtype.itemsize
+        raw = u.tobytes()
+    else:
+        meta["kind"] = "raw"
+        raw = a.tobytes()
+    blob = np.frombuffer(zlib.compress(raw, 6), np.uint8)
+    return blob, meta
+
+
+def _decode_column(blob: np.ndarray, meta: dict) -> np.ndarray:
+    """Exact inverse of :func:`_encode_column`."""
+    raw = zlib.decompress(np.ascontiguousarray(blob).tobytes())
+    dtype = np.dtype(meta["dtype"])
+    shape = tuple(meta["shape"])
+    kind = meta["kind"]
+    if kind == "bits":
+        n = int(np.prod(shape)) if shape else 1
+        return np.unpackbits(np.frombuffer(raw, np.uint8),
+                             count=n).astype(bool).reshape(shape)
+    if kind == "delta":
+        w = np.dtype(f"uint{8 * int(meta['width'])}")
+        u = np.frombuffer(raw, w).astype(np.uint64)
+        d = ((u >> np.uint64(1))
+             ^ (np.uint64(0) - (u & np.uint64(1)))).astype(np.int64)
+        d = d.reshape(shape)
+        with np.errstate(over="ignore"):
+            v = np.cumsum(d, axis=0, dtype=np.int64) if d.size else d
+        return v.astype(dtype)
+    return np.frombuffer(raw, dtype).reshape(shape)
+
+
+def _segment_members(part: int, start: int, topology: "str | None",
+                     cols: dict, count: int, ts_min: int, ts_max: int,
+                     stats: dict, compress: bool) -> tuple[dict, dict]:
+    """The np.savez member dict for one segment file (shared by
+    :meth:`EventArchive.append_segment` and :meth:`EventArchive.compact`)
+    plus the stats dict as persisted — stats gain ``bytes`` (decoded
+    column bytes) and ``enc_bytes`` (on-disk encoded bytes), the
+    planner's decompression-cost inputs."""
+    raw_bytes = int(sum(np.asarray(v).nbytes for v in cols.values()))
+    members: dict = {"part": np.int64(part), "start": np.int64(start),
+                     "topology": np.str_(topology or ""),
+                     "seg_nrows": np.int64(count),
+                     "seg_ts_min": np.int64(ts_min),
+                     "seg_ts_max": np.int64(ts_max)}
+    if compress:
+        codec: dict = {}
+        enc = 0
+        for c in _COLUMNS:
+            blob, meta = _encode_column(np.asarray(cols[c]))
+            members[c + "__packed"] = blob
+            codec[c] = meta
+            enc += int(blob.nbytes)
+        members["codec_json"] = np.str_(json.dumps(codec))
+        stats = dict(stats, bytes=raw_bytes, enc_bytes=enc)
+    else:
+        members.update(cols)
+        stats = dict(stats, bytes=raw_bytes, enc_bytes=raw_bytes)
+    members["stats_json"] = np.str_(json.dumps(stats))
+    return members, stats
+
+
 def mesh_topology(n_shards: int, arenas: int) -> str:
     """Canonical topology stamp of a mesh engine's archive — ONE producer
     for the stamp the engine writes, recovery matches, and migration
@@ -182,10 +283,19 @@ class SegmentCache:
     @property
     def nbytes(self) -> int:
         """Host bytes held by decoded segment columns — the memory
-        ledger's segment-cache component (ISSUE 11)."""
-        return sum(col.nbytes for entry in self._entries.values()
-                   for col in entry.values()
-                   if hasattr(col, "nbytes"))
+        ledger's segment-cache component (ISSUE 11). Counted at RESIDENT
+        (decoded) size: a column decoded from a compressed segment costs
+        its full numpy footprint, not its on-disk encoded size, so
+        ``devicewatch_ledger_reconciles`` stays a true gate (ISSUE 19
+        satellite); raw byte buffers are counted by length."""
+        total = 0
+        for entry in self._entries.values():
+            for col in entry.values():
+                if hasattr(col, "nbytes"):
+                    total += int(col.nbytes)
+                elif isinstance(col, (bytes, bytearray, memoryview)):
+                    total += len(col)
+        return total
 
     def columns(self, directory: pathlib.Path, path: str,
                 names: tuple) -> dict:
@@ -199,7 +309,20 @@ class SegmentCache:
         else:
             missing = list(names)
         with np.load(directory / path) as z:
-            fresh = {c: np.asarray(z[c]) for c in missing}
+            fresh = {}
+            codec = None
+            for c in missing:
+                if c in z.files:
+                    fresh[c] = np.asarray(z[c])
+                    continue
+                # compressed segment: the plain member is absent and the
+                # column decodes from its packed blob — the ONE hook all
+                # read paths (query/get_row/read_rows/compact) share, so
+                # decoded columns land in the cache at resident size
+                if codec is None:
+                    codec = json.loads(str(z["codec_json"]))
+                fresh[c] = _decode_column(np.asarray(z[c + "__packed"]),
+                                          codec[c])
         self.loads += 1
         if entry is None:
             entry = self._entries[path] = {}
@@ -251,6 +374,11 @@ class SegmentPlanner:
         arch = self.archive
         if self._gen == arch._generation:
             return
+        # capture the generation BEFORE snapshotting: if a concurrent
+        # append lands mid-build we record the OLD generation, so the
+        # next plan() rebuilds and sees the tail (never a stale table
+        # stamped with a fresh generation)
+        gen = arch._generation
         # lazy back-fill: segments adopted from a pre-pushdown manifest
         # carry no stats; compute them once (predicate columns only) and
         # persist, so the cost is paid on first plan, not every plan
@@ -263,9 +391,13 @@ class SegmentPlanner:
                 dirty = True
         if dirty:
             arch._save_index()
-        segs = arch.segments           # (part, start)-sorted == scan order
+        # snapshot AGAIN: a concurrent spool (analytics job planning
+        # while the ingest thread appends segments) must not grow the
+        # list under the array builds below — the fresh tail is picked
+        # up by the next generation bump
+        segs = list(arch.segments)     # (part, start)-sorted == scan order
         n = len(segs)
-        self._segs = list(segs)
+        self._segs = segs
         self._part = np.fromiter((s.part for s in segs), np.int64, n)
         self._start = np.fromiter((s.start for s in segs), np.int64, n)
         self._count = np.fromiter((s.count for s in segs), np.int64, n)
@@ -305,7 +437,27 @@ class SegmentPlanner:
                 elif known[i]:
                     mat[i] = 0           # zero valid rows: nothing matches
             self._bloom[c] = mat
-        self._gen = arch._generation
+        # per-segment decode-cost table (ISSUE 19): resident column bytes
+        # plus, for compressed segments, the encoded bytes that must flow
+        # through the codec — so a round packer budgeting by cost charges
+        # decompression, not just materialization. Segments written
+        # before cost stats existed fall back to a per-row estimate.
+        self._cost = np.empty(n, np.int64)
+        for i, s in enumerate(segs):
+            st = s.stats or {}
+            if "bytes" in st:
+                self._cost[i] = (int(st["bytes"])
+                                 + int(st.get("enc_bytes", st["bytes"])))
+            else:
+                self._cost[i] = s.count * 128
+        self._gen = gen
+
+    def cost_of(self, scan_order: int) -> int:
+        """Decode cost (bytes) of the segment a plan row named by its
+        ``scan_order`` index — valid until the index generation moves,
+        i.e. for the plan the caller just received."""
+        self._refresh()
+        return int(self._cost[scan_order])
 
     # ------------------------------------------------------------ plan
     def plan(self, *, max_pos=None, device=None, etype=None, tenant=None,
@@ -416,10 +568,15 @@ class EventArchive:
                  max_rows_per_part: int | None = None,
                  topology: str | None = None,
                  max_age_ms: int | None = None,
-                 cache_segments: int = 8):
+                 cache_segments: int = 8,
+                 compress: bool = False):
         self.dir = pathlib.Path(directory)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.segment_rows = int(segment_rows)
+        # per-column compression for NEWLY written segments (existing
+        # files are read as-is either way — the decode hook keys off each
+        # file's own members, so mixed archives work)
+        self.compress = bool(compress)
         # partition-topology stamp: segment `part` indices are only
         # meaningful for the exact engine layout that wrote them — after an
         # elastic reshard (or a single<->mesh migration with equal
@@ -717,17 +874,14 @@ class EventArchive:
         ts_min = int(ts.min()) if ts.size else 0
         ts_max = int(ts.max()) if ts.size else 0
         stats = _compute_stats(cols)
+        members, stats = _segment_members(
+            part, start, self.topology, cols, count, ts_min, ts_max,
+            stats, self.compress)
         # temp name must NOT match the seg-*.npz recovery glob (write via a
         # file handle — np.savez would append .npz to a bare path)
         tmp = path.with_name(path.name + ".tmp")
         with open(tmp, "wb") as f:
-            np.savez(f, part=np.int64(part), start=np.int64(start),
-                     topology=np.str_(self.topology or ""),
-                     seg_nrows=np.int64(count),
-                     seg_ts_min=np.int64(ts_min),
-                     seg_ts_max=np.int64(ts_max),
-                     stats_json=np.str_(json.dumps(stats)),
-                     **cols)
+            np.savez(f, **members)
         tmp.replace(path)
         self.segments.append(_Segment(
             part=part, start=start, count=count,
@@ -812,16 +966,13 @@ class EventArchive:
                 ts_min = int(ts.min()) if ts.size else 0
                 ts_max = int(ts.max()) if ts.size else 0
                 stats = _compute_stats(merged)
+                members, stats = _segment_members(
+                    part, start, self.topology, merged, total, ts_min,
+                    ts_max, stats, self.compress)
                 name = f"seg-p{part:04d}-o{start:014d}-n{total}.npz"
                 tmp = self.dir / (name + ".tmp")
                 with open(tmp, "wb") as f:
-                    np.savez(f, part=np.int64(part), start=np.int64(start),
-                             topology=np.str_(self.topology or ""),
-                             seg_nrows=np.int64(total),
-                             seg_ts_min=np.int64(ts_min),
-                             seg_ts_max=np.int64(ts_max),
-                             stats_json=np.str_(json.dumps(stats)),
-                             **merged)
+                    np.savez(f, **members)
                 tmp.replace(self.dir / name)
                 new_seg = _Segment(
                     part=part, start=start, count=total,
@@ -995,6 +1146,13 @@ class EventArchive:
                 device_parts=device_parts,
                 assignment_parts=assignment_parts)}],
             max_pos=max_pos)[0]
+
+    @property
+    def planner(self) -> SegmentPlanner:
+        """The shared planner — the analytics driver (models/analytics)
+        plans its streaming rounds through the same vectorized tables the
+        query path uses, cost accounting included."""
+        return self._planner
 
     @property
     def planner_calls(self) -> int:

@@ -85,6 +85,13 @@ Conservation equations (the contract future PRs must keep balanced):
                         device-stage lane (ISSUE 18: the unfolded
                         counter grid is the same grid, read before the
                         fold — no new slack term anywhere)
+  analytics-windows     windows_planned == windows_scored +
+                        windows_skipped_underfilled + windows_cancelled
+                        (ISSUE 19: every device window a scoring batch
+                        plans lands in exactly one sink; the manager
+                        commits planned ALONGSIDE its sinks in one lock
+                        block per batch, so there is no in-flight slack
+                        term — the equation is exact at every audit)
 """
 
 from __future__ import annotations
@@ -101,7 +108,7 @@ EQUATIONS = (
     "staging-balance", "device-processed", "device-disposition",
     "edge-admission", "wal-durability", "forward-queue",
     "replication-feed", "archive-spill", "rules-harvest",
-    "placement-handoff", "spmd-shard-flow",
+    "placement-handoff", "spmd-shard-flow", "analytics-windows",
 )
 
 
@@ -332,6 +339,12 @@ def build_ledger(engine, rules_manager=None) -> dict:
         rules = _rules_stage(eng, rules_manager)
         if rules is not None:
             stages["rules"] = rules
+        aj = getattr(eng, "analytics_jobs", None)
+        if aj is not None:
+            # one consistent read under the manager lock (the scoring
+            # pass commits planned + sinks in a single _mu block, so
+            # this only ever observes pre- or post-batch totals)
+            stages["analytics"] = aj.ledger_stage()
 
     watermarks: dict = {"dispatched_rows": ing["dispatched_rows"]}
     lag: dict = {"staged_backlog_rows": ing["backlog_rows"]}
@@ -563,6 +576,16 @@ def check_conservation(ledger: dict) -> list[Violation]:
             bad("rules-harvest",
                 f"negative pending ring depth {rules['pending']}",
                 rules["pending"], 0)
+    an = st.get("analytics")
+    if an and "planned" in an:
+        rhs = (an.get("scored", 0) + an.get("skipped_underfilled", 0)
+               + an.get("cancelled", 0))
+        if an["planned"] != rhs:
+            bad("analytics-windows",
+                f"windows planned {an['planned']} != scored "
+                f"{an.get('scored', 0)} + skipped_underfilled "
+                f"{an.get('skipped_underfilled', 0)} + cancelled "
+                f"{an.get('cancelled', 0)}", an["planned"], rhs)
     return out
 
 

@@ -461,6 +461,67 @@ def archive_metrics(registry: MetricsRegistry | None = None) -> dict:
     }
 
 
+def analytics_metrics(registry: MetricsRegistry | None = None) -> dict:
+    """The ``swtpu_analytics_*`` gauges for the fleet-scale historical
+    scoring tier (ISSUE 19). Registered here — NOT in engine.metrics()
+    (dispatch-shape equality) — like every plane before it; all synced
+    at scrape time from the AnalyticsManager's own counters (committed
+    under the manager lock, read without the engine lock):
+
+      swtpu_analytics_jobs_total          jobs, labeled by terminal state
+                                          (started|completed|cancelled|
+                                          failed)
+      swtpu_analytics_rounds_total        planner-batched streaming
+                                          rounds executed
+      swtpu_analytics_segments_streamed_total
+                                          archive segments decoded into
+                                          scoring rounds
+      swtpu_analytics_bytes_streamed_total
+                                          archive->device planner-cost
+                                          bytes streamed (decode cost of
+                                          compressed columns included)
+      swtpu_analytics_rows_streamed_total measurement rows surviving the
+                                          host predicate filter
+      swtpu_analytics_windows_total       device windows, labeled by
+                                          conservation sink (planned|
+                                          scored|skipped_underfilled|
+                                          cancelled)
+      swtpu_analytics_alerts_total        score alerts, labeled
+                                          emitted|suppressed
+      swtpu_analytics_rollup_spilled_windows_total
+                                          rollup ring windows aged out to
+                                          the rollup archive (the PR-12
+                                          leftover this tier pays for)
+    """
+    reg = registry or REGISTRY
+    return {
+        "jobs": reg.gauge(
+            "swtpu_analytics_jobs_total",
+            "historical scoring jobs, labeled by state"),
+        "rounds": reg.gauge(
+            "swtpu_analytics_rounds_total",
+            "planner-batched archive streaming rounds executed"),
+        "segments": reg.gauge(
+            "swtpu_analytics_segments_streamed_total",
+            "archive segments decoded into scoring rounds"),
+        "bytes": reg.gauge(
+            "swtpu_analytics_bytes_streamed_total",
+            "archive->device planner-cost bytes streamed"),
+        "rows": reg.gauge(
+            "swtpu_analytics_rows_streamed_total",
+            "measurement rows surviving the host predicate filter"),
+        "windows": reg.gauge(
+            "swtpu_analytics_windows_total",
+            "device windows, labeled by conservation sink"),
+        "alerts": reg.gauge(
+            "swtpu_analytics_alerts_total",
+            "historical score alerts, labeled emitted|suppressed"),
+        "rollup_spilled": reg.gauge(
+            "swtpu_analytics_rollup_spilled_windows_total",
+            "rollup ring windows aged out to the rollup archive"),
+    }
+
+
 def replication_metrics(registry: MetricsRegistry | None = None) -> dict:
     """The ``swtpu_replication_*`` instruments for the event-plane
     replica feed (ISSUE 6). Registered here — NOT in engine.metrics(),
@@ -1047,6 +1108,28 @@ def export_observability_metrics(engine, registry: MetricsRegistry | None
         inst["corrupt"].set(arch.corrupt_segments)
         inst["lost_rows"].set(arch.lost_rows)
         inst["expired_rows"].set(arch.expired_rows)
+
+    # fleet analytics tier (ISSUE 19): the scoring-job manager's own
+    # counter snapshot — one consistent read under its lock, never the
+    # engine lock
+    aj = getattr(engine, "analytics_jobs", None)
+    if aj is not None:
+        inst = analytics_metrics(reg)
+        s = aj.ledger_stage()
+        for state in ("started", "completed", "cancelled", "failed"):
+            inst["jobs"].set(s[f"jobs_{state}"], state=state)
+        inst["rounds"].set(s["rounds"])
+        inst["segments"].set(s["segments"])
+        inst["bytes"].set(s["bytes"])
+        inst["rows"].set(s["rows"])
+        for sink in ("planned", "scored", "skipped_underfilled",
+                     "cancelled"):
+            inst["windows"].set(s[sink], sink=sink)
+        inst["alerts"].set(s["alerts_emitted"], disposition="emitted")
+        inst["alerts"].set(s["alerts_suppressed"],
+                           disposition="suppressed")
+        hc = getattr(engine, "host_counters", None) or {}
+        inst["rollup_spilled"].set(hc.get("rollup_windows_spilled", 0))
 
     fq = getattr(engine, "forward_queue", None)
     if fq is not None:

@@ -713,12 +713,91 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
             raise EntityNotFound(str(e)) from None
         return json_response(doc)
 
+    async def read_rollup_history(request: web.Request):
+        q = request.query
+        try:
+            since = int(q["sinceMs"]) if "sinceMs" in q else None
+            until = int(q["untilMs"]) if "untilMs" in q else None
+        except ValueError:
+            return json_response({"error": "bad sinceMs/untilMs"},
+                                 status=400)
+        try:
+            doc = await asyncio.to_thread(
+                inst.rules.read_rollup_history,
+                request.match_info["name"], q.get("group"),
+                since, until, _page_size(q))
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return json_response(doc)
+
+    async def spill_rollups(request: web.Request):
+        return json_response(
+            await asyncio.to_thread(inst.rules.spill_rollups))
+
     r.add_get("/api/rules", get_rules)
     r.add_post("/api/rules", _admin(put_rules))
     r.add_delete("/api/rules", _admin(delete_rules))
     r.add_post("/api/rules/poll", _admin(poll_rules))
     r.add_get("/api/rules/rollups", list_rollups)
+    r.add_post("/api/rules/rollups/spill", _admin(spill_rollups))
     r.add_get("/api/rules/rollups/{name}", read_rollup)
+    r.add_get("/api/rules/rollups/{name}/history", read_rollup_history)
+
+    # --- fleet-scale historical analytics (ISSUE 19): archive->device
+    # batched scoring jobs ------------------------------------------------
+    _SPEC_KEYS = {
+        "tenant": "tenant", "sinceMs": "since_ms", "untilMs": "until_ms",
+        "batchDevices": "batch_devices", "window": "window",
+        "minFill": "min_fill", "threshold": "threshold", "emit": "emit",
+        "roundCostBytes": "round_cost_bytes", "maxRounds": "max_rounds",
+        "maxBatches": "max_batches", "duty": "duty", "name": "name",
+    }
+
+    async def start_score_job(request: web.Request):
+        body = (await request.json()
+                if request.content_length else {})
+        if not isinstance(body, dict):
+            return json_response({"error": "JSON object body required"},
+                                 status=400)
+        unknown = set(body) - set(_SPEC_KEYS)
+        if unknown:
+            return json_response(
+                {"error": f"unknown fields: {sorted(unknown)}"},
+                status=400)
+        spec = {snake: body[camel]
+                for camel, snake in _SPEC_KEYS.items() if camel in body}
+        wait = request.query.get("wait") in ("1", "true")
+        fn = (inst.analytics_jobs.run_job if wait
+              else inst.analytics_jobs.start_job)
+        try:
+            return json_response(
+                await asyncio.to_thread(fn, spec), status=202)
+        except TypeError as e:
+            return json_response({"error": str(e)}, status=400)
+
+    async def list_score_jobs(request: web.Request):
+        return json_response(
+            await asyncio.to_thread(inst.analytics_jobs.status))
+
+    async def get_score_job(request: web.Request):
+        try:
+            doc = await asyncio.to_thread(
+                inst.analytics_jobs.status, request.match_info["jobId"])
+        except KeyError as e:
+            raise EntityNotFound(str(e)) from None
+        return json_response(doc)
+
+    async def cancel_score_job(request: web.Request):
+        ok = await asyncio.to_thread(
+            inst.analytics_jobs.cancel, request.match_info["jobId"])
+        return json_response({"cancelled": bool(ok)},
+                             status=200 if ok else 409)
+
+    r.add_post("/api/analytics/score", _admin(start_score_job))
+    r.add_get("/api/analytics/jobs", list_score_jobs)
+    r.add_get("/api/analytics/jobs/{jobId}", get_score_job)
+    r.add_post("/api/analytics/jobs/{jobId}/cancel",
+               _admin(cancel_score_job))
 
     # --- devices ----------------------------------------------------------
     async def create_device(request: web.Request):

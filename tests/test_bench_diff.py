@@ -111,6 +111,42 @@ def test_spmd_gates_enforced(tmp_path):
     assert "ABSENT" in res.stderr
 
 
+def test_analytics_gates_enforced(tmp_path):
+    """ISSUE 19: the historical-analytics leg's parity/interference/
+    recompile/ledger gates fail the diff when violated; throughput
+    fields (devices/s, bytes/s) trend as reports and never gate."""
+    ok = _base() | {
+        "analytics_score_parity": True,
+        "analytics_compressed_parity": True,
+        "analytics_interference_pct": 0.9,
+        "analytics_steady_recompiles": 0,
+        "analytics_rollup_spill_parity": True,
+        "conservation_analytics_violations": 0,
+        "analytics_devices_per_s": 5000.0,
+        "analytics_bytes_per_s": 8.0e6,
+    }
+    assert _run(ok, ok, tmp_path).returncode == 0
+    res = _run(ok, ok | {"analytics_devices_per_s": 900.0,
+                         "analytics_bytes_per_s": 1.0e6}, tmp_path)
+    assert res.returncode == 0, res.stderr
+    for bad in ({"analytics_score_parity": False},
+                {"analytics_compressed_parity": False},
+                {"analytics_interference_pct": 4.2},
+                {"analytics_steady_recompiles": 2},
+                {"analytics_rollup_spill_parity": False},
+                {"conservation_analytics_violations": 1}):
+        res = _run(ok, ok | bad, tmp_path)
+        field = next(iter(bad))
+        assert res.returncode == 1, (bad, res.stdout, res.stderr)
+        assert f"GATE {field}" in res.stderr
+    dropped = dict(ok)
+    del dropped["analytics_score_parity"]
+    res = _run(ok, dropped, tmp_path)
+    assert res.returncode == 1
+    assert "GATE analytics_score_parity" in res.stderr
+    assert "ABSENT" in res.stderr
+
+
 def test_unreadable_input_is_usage_error(tmp_path):
     res = subprocess.run(
         [sys.executable, str(SCRIPT), str(tmp_path / "missing.json"),

@@ -61,6 +61,11 @@ def test_offline_modules_import_with_jax_blocked():
     # the shard heat tracker (ISSUE 18): heat/skew documents are
     # numpy + stdlib — the engine hands in plain host arrays
     targets.append("mod=sitewhere_tpu.utils.shardobs")
+    # the fleet-analytics job manager (ISSUE 19): module level is
+    # numpy + stdlib — jax, the window-fill op and the model stack
+    # import lazily inside the job thread, so the REST/RPC job surface
+    # and the conservation stage exist on accelerator-free boxes
+    targets.append("mod=sitewhere_tpu.models.analytics")
     res = subprocess.run(
         [sys.executable, "-c", _DRIVER, *targets],
         cwd=REPO, capture_output=True, text=True, timeout=120)
