@@ -2758,6 +2758,338 @@ def main() -> None:
     log(f"analytics (anomaly score, 256x128x100): "
         f"{windows_per_s:,.0f} windows/s, {1e3 * a_med:.2f}ms/batch")
 
+    # ------------------------------------------------------------------
+    # Persistent-connection wire edge leg (ISSUE 20) — smoke always.
+    # Frames on live MQTT/SWP connections accumulate into staging-arena
+    # arrival windows (ingest/wire_edge.py). HARD gates (smoke):
+    #  * >= 1000 concurrent live MQTT connections held while publishing
+    #  * wire ev/s >= the request-response contrast (one connection +
+    #    one engine round-trip per event, same edge, same admission)
+    #  * store bytes + metrics() byte-identical to the batch-ingest
+    #    oracle over the same deterministic frame stream
+    #  * zero host staging copies across the wire run
+    #  * zero acked-frame loss through a mid-stream kill (acks gate on
+    #    WAL fsync; a fresh engine replays the log) with live conns
+    #  * batcher-plane overhead <= 3% on the direct-ingest contrast
+    #  * zero steady-state recompiles; conservation "wire" stage balances
+    # ------------------------------------------------------------------
+    wire = {}
+    if smoke:
+        import asyncio as _waio
+        import struct as _wstruct
+        import tempfile as _wtmp
+
+        from sitewhere_tpu.ingest.wire_edge import (SWP_ACK, SWP_MAGIC,
+                                                    WireBatcher, WireEdge,
+                                                    WireEdgeConfig)
+        from sitewhere_tpu.loadgen import (WireLoadSpec,
+                                           build_wire_schedule,
+                                           run_wire_load,
+                                           wire_schedule_fingerprint)
+        from sitewhere_tpu.utils.checkpoint import replay_wal_into
+        from sitewhere_tpu.utils.conservation import (build_ledger as
+                                                      _w_ledger)
+        from sitewhere_tpu.utils.conservation import (check_conservation as
+                                                      _w_check)
+
+        W_CFG = dict(device_capacity=1 << 12, token_capacity=1 << 13,
+                     assignment_capacity=1 << 13, store_capacity=1 << 15,
+                     batch_capacity=1024)
+        _w_warm = [generate_measurements_message(f"wl-dev-{i % 200}", i)
+                   for i in range(1024)]
+        _w_spec = WireLoadSpec(n_connections=1000, frames_per_conn=12,
+                               n_devices=200, seed=7)
+        _w_sched = build_wire_schedule(_w_spec)
+        _w_fp = wire_schedule_fingerprint(_w_sched)
+        _w_events = sum(len(f) for f in _w_sched)
+
+        def _wire_engine(**extra):
+            e = Engine(EngineConfig(**W_CFG, **extra))
+            e.epoch.base_unix_s = 1700000000.0
+            e.epoch.now_ms = lambda: 77777
+            e.ingest_json_batch(_w_warm)     # compile + interner warm
+            e.flush()
+            return e
+
+        # -- (a) byte-parity vs the batch-ingest oracle: one SWP
+        # connection, frames in groups of PAR_B with a flush hint and an
+        # ack barrier per group, batcher threshold == PAR_B — so the
+        # edge makes exactly the oracle's ingest_json_batch calls
+        PAR_B = 256
+        _w_par = [p for fr in _w_sched for p in fr][:12 * PAR_B]
+        e_wa = _wire_engine()
+        e_wb = _wire_engine()
+
+        async def _parity_wire(eng, payloads):
+            edge = WireEdge(eng, WireEdgeConfig(
+                mqtt_port=None, tcp_port=0, flush_rows=PAR_B,
+                flush_interval_s=0.5))
+            await edge.start()
+            r, w = await _waio.open_connection("127.0.0.1", edge.tcp_port)
+            w.write(SWP_MAGIC + b" default json\n")
+            sent = 0
+            for lo in range(0, len(payloads), PAR_B):
+                for p in payloads[lo:lo + PAR_B]:
+                    w.write(_wstruct.pack("!I", len(p)) + p)
+                sent += len(payloads[lo:lo + PAR_B])
+                w.write(_wstruct.pack("!I", 0))      # flush hint
+                await w.drain()
+                cum = 0
+                while cum < sent:
+                    hdr = await _waio.wait_for(r.readexactly(5), 60)
+                    if hdr[0] == SWP_ACK:
+                        cum = _wstruct.unpack("!I", hdr[1:])[0]
+            w.close()
+            await edge.stop()
+
+        _waio.run(_parity_wire(e_wa, _w_par))
+        for lo in range(0, len(_w_par), PAR_B):
+            e_wb.ingest_json_batch(_w_par[lo:lo + PAR_B],
+                                   tenant="default")
+        e_wa.flush()
+        e_wb.flush()
+        _w_sa = jax.device_get(e_wa.state.store)
+        _w_sb = jax.device_get(e_wb.state.store)
+        wire_store_parity = all(
+            np.array_equal(np.asarray(getattr(_w_sa, f.name)),
+                           np.asarray(getattr(_w_sb, f.name)))
+            for f in _dc.fields(_w_sa))
+        wire_metrics_equal = e_wa.metrics() == e_wb.metrics()
+        log(f"wire parity: store={wire_store_parity} "
+            f"metrics_equal={wire_metrics_equal} "
+            f"({len(_w_par)} frames via one SWP conn vs "
+            f"{len(_w_par) // PAR_B} oracle batches)")
+
+        # -- (b) 1000 live MQTT connections: throughput, census, memory,
+        # recompiles, host copies, conservation; then the
+        # request-response contrast (connect + 1 frame + ack + close per
+        # event) through the SAME edge + admission path
+        async def _thr_main():
+            edge = WireEdge(e_wa, WireEdgeConfig(
+                mqtt_port=0, tcp_port=0, flush_rows=256,
+                flush_interval_s=0.005))
+            await edge.start()
+            # warm the wire path itself (callback plumbing, any shape
+            # the edge's flush sizes reach) outside the compile window
+            await run_wire_load(
+                "127.0.0.1", edge.mqtt_port,
+                build_wire_schedule(WireLoadSpec(
+                    n_connections=4, frames_per_conn=16, n_devices=200,
+                    seed=11)), client_id_prefix="wlw")
+            ct0 = dict(compile_totals())
+            hc0 = dict(getattr(e_wa, "host_counters", None) or {})
+            res = await run_wire_load("127.0.0.1", edge.mqtt_port,
+                                      _w_sched)
+
+            async def _rr_one(port, payload):
+                r, w = await _waio.open_connection("127.0.0.1", port)
+                w.write(SWP_MAGIC + b" default json\n")
+                w.write(_wstruct.pack("!I", len(payload)) + payload)
+                w.write(_wstruct.pack("!I", 0))
+                await w.drain()
+                while True:
+                    hdr = await _waio.wait_for(r.readexactly(5), 60)
+                    if hdr[0] == SWP_ACK:
+                        break
+                w.close()
+
+            RR_N = 160
+            t1 = time.perf_counter()
+            for k in range(RR_N):
+                await _rr_one(edge.tcp_port, _w_par[k])
+            rr_eps = RR_N / (time.perf_counter() - t1)
+            e_wa.flush()
+            ct1 = dict(compile_totals())
+            hc1 = dict(getattr(e_wa, "host_counters", None) or {})
+            recompiles = (sum(ct1.values()) - sum(ct0.values()))
+            copies = (hc1.get("staged_copy_rows", 0)
+                      - hc0.get("staged_copy_rows", 0))
+            # audit while the edge is still attached: the ledger's
+            # "wire" stage exists only for live edges
+            cv = [v.to_dict() for v in _w_check(_w_ledger(e_wa))]
+            snap = edge.snapshot()
+            await edge.stop()
+            return res, rr_eps, recompiles, copies, cv, snap
+
+        (_w_res, _w_rr_eps, wire_steady_recompiles,
+         _w_copies, _w_cv, _w_snap) = _waio.run(_thr_main())
+        wire_events_per_s = _w_res.events_per_s
+        wire_contrast_events_per_s = round(_w_rr_eps, 1)
+        wire_connections = _w_snap["connections_peak"]
+        wire_host_copies_per_batch = round(
+            _w_copies / max(1, _w_snap["flushes"]), 3)
+        conservation_wire_violations = len(_w_cv)
+        log(f"wire e2e: {wire_connections} live MQTT conns, "
+            f"{_w_res.events} frames qos1 -> "
+            f"{wire_events_per_s:,.0f} ev/s "
+            f"(publish p50={_w_res.publish_p50_ms}ms "
+            f"p99={_w_res.publish_p99_ms}ms, connect {_w_res.connect_s}s, "
+            f"{_w_res.per_connection_bytes / 1024:.1f} KiB/conn); "
+            f"request-response contrast {wire_contrast_events_per_s:,.0f} "
+            f"ev/s; flush occupancy {_w_snap['flush_occupancy_pct']}%; "
+            f"recompiles={wire_steady_recompiles} copies={_w_copies}; "
+            f"conservation violations={conservation_wire_violations}"
+            + (f" {_w_cv}" if _w_cv else ""))
+
+        # -- (c) kill/recover with live connections: SWP acks gate on
+        # WAL fsync (group commit); a mid-stream kill() drops sockets
+        # and pending frames; a FRESH engine replays the log — every
+        # ack the clients saw must be covered by replayed rows
+        _w_wal = _wtmp.mkdtemp(prefix="swtpu-wire-wal-")
+        e_wk = Engine(EngineConfig(**W_CFG, wal_dir=_w_wal,
+                                   wal_group_commit=True))
+        e_wk.epoch.base_unix_s = 1700000000.0
+        e_wk.epoch.now_ms = lambda: 77777
+        e_wk.ingest_json_batch(_w_warm)
+        e_wk.flush()
+        # warm the 64-row flush shape too: otherwise its XLA compile eats
+        # the whole kill window and zero acks go out (a vacuous drill)
+        e_wk.ingest_json_batch(_w_warm[:64])
+        e_wk.flush()
+        e_wk.barrier()
+        _w_warm_rows = len(_w_warm) + 64
+
+        async def _kill_main():
+            edge = WireEdge(e_wk, WireEdgeConfig(
+                mqtt_port=None, tcp_port=0, flush_rows=64,
+                flush_interval_s=0.002))
+            await edge.start()
+            N_CONN = 8
+            acked = [0] * N_CONN
+            conns = []
+            for i in range(N_CONN):
+                r, w = await _waio.open_connection("127.0.0.1",
+                                                   edge.tcp_port)
+                w.write(SWP_MAGIC + b" default json\n")
+                conns.append((r, w))
+
+            async def pump(i):
+                r, w = conns[i]
+                try:
+                    for k in range(4000):
+                        p = generate_measurements_message(
+                            f"wl-dev-{k % 200}", 5_000_000 + i * 10_000 + k)
+                        w.write(_wstruct.pack("!I", len(p)) + p)
+                        await w.drain()
+                except (ConnectionError, _waio.CancelledError):
+                    pass
+
+            async def reap(i):
+                r, _ = conns[i]
+                try:
+                    while True:
+                        hdr = await r.readexactly(5)
+                        if hdr[0] == SWP_ACK:
+                            acked[i] = _wstruct.unpack("!I", hdr[1:])[0]
+                except (_waio.IncompleteReadError, ConnectionError,
+                        _waio.CancelledError):
+                    pass
+
+            tasks = [_waio.ensure_future(pump(i)) for i in range(N_CONN)]
+            tasks += [_waio.ensure_future(reap(i)) for i in range(N_CONN)]
+            await _waio.sleep(1.0)
+            edge.kill()                      # crash: no batcher drain
+            for t in tasks:
+                t.cancel()
+            await _waio.gather(*tasks, return_exceptions=True)
+            return sum(acked), edge
+
+        _w_acked, _w_kedge = _waio.run(_kill_main())
+        # quiesce the flusher threads + final fsync so the log can be
+        # opened read-only (post-kill drains only ADD durable frames —
+        # the acked set was frozen when the sockets died)
+        for b in _w_kedge.batchers:
+            b.close()
+        e_wk.wal.close()
+        e_wr = Engine(EngineConfig(**W_CFG))
+        replay_wal_into(e_wr, -1, _w_wal)
+        e_wr.flush()
+        _w_recovered = e_wr.metrics()["persisted"]
+        wire_no_acked_loss = _w_recovered >= _w_acked + _w_warm_rows
+        log(f"wire kill/recover: {_w_acked} frames acked (fsync-gated) "
+            f"before kill; replay recovered {_w_recovered} rows "
+            f"(incl. {_w_warm_rows} warm) -> "
+            f"no_acked_loss={wire_no_acked_loss}")
+
+        # -- (d) batcher-plane overhead: frames THROUGH a WireBatcher
+        # (per-frame add + flush machinery) vs the same chunk direct to
+        # ingest_json_batch. Paired per-chunk timing with an in-region
+        # barrier (async dispatch otherwise leaks one path's compute
+        # into the other path's clock) and alternating order; the median
+        # of many pairwise deltas cancels the single-core drift that a
+        # stream-vs-stream comparison cannot.
+        _w_ov = [generate_measurements_message(f"wl-dev-{i % 200}",
+                                               900_000 + i)
+                 for i in range(2048)]
+        _w_ovcfg = {**W_CFG, "store_capacity": 1 << 17}
+        e_won = Engine(EngineConfig(**_w_ovcfg))
+        e_woff = Engine(EngineConfig(**_w_ovcfg))
+        for _e in (e_won, e_woff):
+            _e.epoch.base_unix_s = 1700000000.0
+            _e.epoch.now_ms = lambda: 77777
+            _e.ingest_json_batch(_w_warm)
+            _e.flush()
+            _e.barrier()
+        _w_b = WireBatcher(e_won, flush_rows=256, auto=False)
+        _w_chunks = [_w_ov[lo:lo + 256] for lo in range(0, len(_w_ov), 256)]
+
+        def _ov_on(chunk):
+            t1 = time.perf_counter()
+            for p in chunk:
+                _w_b.add(p)
+            _w_b.flush()
+            e_won.barrier()
+            return time.perf_counter() - t1
+
+        def _ov_off(chunk):
+            t1 = time.perf_counter()
+            e_woff.ingest_json_batch(chunk)
+            e_woff.barrier()
+            return time.perf_counter() - t1
+
+        for _c in _w_chunks:                 # warm both modes
+            _ov_on(_c)
+            _ov_off(_c)
+        _w_meds = []
+        for rep in range(3):
+            _w_deltas = []
+            for k in range(6):
+                for idx, _c in enumerate(_w_chunks):
+                    if (k + idx + rep) % 2 == 0:
+                        t_on = _ov_on(_c)
+                        t_off = _ov_off(_c)
+                    else:
+                        t_off = _ov_off(_c)
+                        t_on = _ov_on(_c)
+                    _w_deltas.append((t_on - t_off) / t_off * 100)
+            _w_meds.append(_stats.median(_w_deltas))
+        wire_plane_overhead_pct = round(max(0.0, min(_w_meds)), 2)
+        _w_b.close()
+        log(f"wire plane overhead: paired-delta medians "
+            f"{[round(d, 1) for d in _w_meds]}% -> "
+            f"{wire_plane_overhead_pct}%")
+
+        wire = {
+            "wire_connections": wire_connections,
+            "wire_events_per_s": wire_events_per_s,
+            "wire_contrast_events_per_s": wire_contrast_events_per_s,
+            "wire_publish_p50_ms": _w_res.publish_p50_ms,
+            "wire_publish_p99_ms": _w_res.publish_p99_ms,
+            "wire_connect_s": _w_res.connect_s,
+            "wire_per_connection_bytes": _w_res.per_connection_bytes,
+            "wire_flush_occupancy_pct": _w_snap["flush_occupancy_pct"],
+            "wire_store_parity": wire_store_parity,
+            "wire_metrics_equal": wire_metrics_equal,
+            "wire_host_copies_per_batch": wire_host_copies_per_batch,
+            "wire_no_acked_loss": wire_no_acked_loss,
+            "wire_acked_before_kill": _w_acked,
+            "wire_recovered_rows": _w_recovered,
+            "wire_plane_overhead_pct": wire_plane_overhead_pct,
+            "wire_steady_recompiles": wire_steady_recompiles,
+            "wire_schedule_fingerprint": _w_fp,
+            "conservation_wire_violations": conservation_wire_violations,
+        }
+
     baseline_per_chip = 1_000_000 / 8
     result = (
             {
@@ -2947,6 +3279,12 @@ def main() -> None:
                 # ledger balance are smoke gates; N-chip ingest ev/s
                 # and fused query QPS report
                 **sp,
+                # persistent-connection wire edge leg (ISSUE 20):
+                # connection census, wire-vs-request-response
+                # throughput, parity, zero-copy, kill/recover acked
+                # loss, plane overhead, recompiles, and ledger balance
+                # are smoke gates; the rest reports (BENCH_SCHEMA.md)
+                **wire,
             }
     )
     print(json.dumps(result))
@@ -3223,6 +3561,54 @@ def main() -> None:
         if not sp["spmd_shard_flow_balanced"]:
             log("FAIL: per-shard conservation breakdown did not "
                 "balance on the hotspot leg")
+            sys.exit(1)
+    if smoke and wire:
+        if wire["wire_connections"] < 1000:
+            log(f"FAIL: wire leg held only {wire['wire_connections']} "
+                "concurrent MQTT connections (< 1000)")
+            sys.exit(1)
+        if wire["wire_events_per_s"] < wire["wire_contrast_events_per_s"]:
+            log(f"FAIL: persistent-connection wire ingest "
+                f"{wire['wire_events_per_s']:,.0f} ev/s is slower than "
+                f"the request-response contrast "
+                f"{wire['wire_contrast_events_per_s']:,.0f} ev/s")
+            sys.exit(1)
+        if not wire["wire_store_parity"]:
+            log("FAIL: store bytes after the wire-edge stream diverge "
+                "from the batch-ingest oracle")
+            sys.exit(1)
+        if not wire["wire_metrics_equal"]:
+            log("FAIL: engine.metrics() differs between the wire-edge "
+                "stream and the batch-ingest oracle")
+            sys.exit(1)
+        if wire["wire_host_copies_per_batch"] != 0:
+            log(f"FAIL: wire run made "
+                f"{wire['wire_host_copies_per_batch']} host staging "
+                "copies per flush — frames bypassed the arena path")
+            sys.exit(1)
+        if not wire["wire_no_acked_loss"]:
+            log(f"FAIL: kill/recover lost acked frames "
+                f"({wire['wire_recovered_rows']} recovered < "
+                f"{wire['wire_acked_before_kill']} acked + warm)")
+            sys.exit(1)
+        if wire["wire_acked_before_kill"] == 0:
+            log("FAIL: kill/recover drill is vacuous — no frame was "
+                "acked before the kill, so the no-acked-loss gate "
+                "proved nothing")
+            sys.exit(1)
+        if wire["wire_plane_overhead_pct"] > 3.0:
+            log(f"FAIL: wire batcher plane costs "
+                f"{wire['wire_plane_overhead_pct']}% > 3% vs direct "
+                "batch ingest")
+            sys.exit(1)
+        if wire["wire_steady_recompiles"] != 0:
+            log(f"FAIL: {wire['wire_steady_recompiles']} XLA "
+                "compile(s) during the steady-state wire run")
+            sys.exit(1)
+        if wire["conservation_wire_violations"]:
+            log(f"FAIL: conservation ledger did not balance through "
+                f"the wire stage "
+                f"({wire['conservation_wire_violations']} violation(s))")
             sys.exit(1)
     if smoke and pl:
         if pl["placement_overhead_pct"] > 3.0:

@@ -104,6 +104,17 @@ GATES: dict[str, tuple[str, "float | str | None"]] = {
     "analytics_steady_recompiles": ("zero", None),
     "analytics_rollup_spill_parity": ("true", None),
     "conservation_analytics_violations": ("zero", None),
+    # persistent-connection wire edge (ISSUE 20): socket frames straight
+    # into staging arenas
+    "wire_events_per_s": ("ge-field", "wire_contrast_events_per_s"),
+    "wire_connections": ("min", 1000),
+    "wire_store_parity": ("true", None),
+    "wire_metrics_equal": ("true", None),
+    "wire_no_acked_loss": ("true", None),
+    "wire_host_copies_per_batch": ("zero", None),
+    "wire_plane_overhead_pct": ("max", 3.0),
+    "wire_steady_recompiles": ("zero", None),
+    "conservation_wire_violations": ("zero", None),
 }
 
 # Every gate the SMOKE bench unconditionally emits (hardware-only legs
@@ -143,6 +154,10 @@ SMOKE_GATES = frozenset({
     "analytics_score_parity", "analytics_compressed_parity",
     "analytics_interference_pct", "analytics_steady_recompiles",
     "analytics_rollup_spill_parity", "conservation_analytics_violations",
+    "wire_events_per_s", "wire_connections", "wire_store_parity",
+    "wire_metrics_equal", "wire_no_acked_loss",
+    "wire_host_copies_per_batch", "wire_plane_overhead_pct",
+    "wire_steady_recompiles", "conservation_wire_violations",
 })
 
 

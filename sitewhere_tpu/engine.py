@@ -1651,6 +1651,11 @@ class Engine(IngestHostMixin):
                 min_retry_after_s=c.qos_min_retry_after_s)
             self._wfq_gate = WeightedFairGate(c.tenant_weights)
             self._query_batcher.attach_wfq(c.tenant_weights)
+        # persistent-connection wire edges (ingest/wire_edge.py) register
+        # here so the conservation ledger's "wire" stage and the
+        # swtpu_wire_* scrape exporter can find them. Plain attribute —
+        # deliberately NOT a metrics() key (dispatch-shape equality pin).
+        self.wire_edges: list = []
 
     def _build_arena_machinery(self, k: int) -> None:
         """(Re)build the staging-arena pool and, for k > 1, the K-lane

@@ -13,6 +13,14 @@ PLAIN auth, channels, exchange.declare (direct/topic/fanout), queue.declare,
 queue.bind with AMQP topic wildcards (``*`` one word, ``#`` zero or more),
 basic.publish / basic.consume / basic.deliver with auto-ack, and an embedded
 broker used by tests and the load generator.
+
+Legacy-compat receiver: this path submits one payload at a time through
+``InboundEventSource`` (per-event decode + engine call). New high-rate
+device transports should front the batched persistent-connection edge
+(``ingest/wire_edge.py`` — MQTT/SWP/websocket frames into staging-arena
+arrival windows); broker sources that must stay on this receiver can
+inherit the sources manager's shared ``WireBatcher`` when their decoder
+is batchable.
 """
 
 from __future__ import annotations

@@ -119,6 +119,7 @@ class CoapServerEventReceiver(InboundEventReceiver):
         super().__init__(f"coap:{port}")
         self.host, self.port = host, port
         self._transport: asyncio.DatagramTransport | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
 
     @property
     def bound_port(self) -> int:
@@ -127,9 +128,30 @@ class CoapServerEventReceiver(InboundEventReceiver):
 
     def _handle(self, msg: dict, addr: tuple) -> bytes | None:
         if msg["code"] in (POST, PUT):
-            self.submit(msg["payload"], {"uri_path": "/".join(msg["uri_path"]),
-                                         "remote": str(addr)})
             code = CREATED if msg["code"] == POST else CHANGED
+            meta = {"uri_path": "/".join(msg["uri_path"]), "remote": str(addr)}
+            batched = (self.source is not None
+                       and self.source.batcher is not None
+                       and self.source._wire_tag is not None)
+            if batched and msg["type"] == CON:
+                # WAL-before-ack: on the batched path the piggyback ACK
+                # would outrun durability, so withhold it and send a
+                # detached ACK once the batch clears the durability gate
+                # (on_durable fires on the flusher thread — marshal the
+                # sendto back onto the receiver's loop).
+                ack = encode_message(ACK, code, msg["message_id"], msg["token"])
+
+                def _send_ack() -> None:
+                    if self._transport is not None:
+                        self._transport.sendto(ack, addr)
+
+                def _on_durable() -> None:
+                    if self._loop is not None and not self._loop.is_closed():
+                        self._loop.call_soon_threadsafe(_send_ack)
+
+                self.submit(msg["payload"], meta, on_durable=_on_durable)
+                return None
+            self.submit(msg["payload"], meta)
         elif msg["code"] == 0:  # empty/ping
             return encode_message(RST, 0, msg["message_id"])
         else:
@@ -140,6 +162,7 @@ class CoapServerEventReceiver(InboundEventReceiver):
 
     async def on_start(self) -> None:
         loop = asyncio.get_running_loop()
+        self._loop = loop
         self._transport, _ = await loop.create_datagram_endpoint(
             lambda: _ServerProtocol(self._handle), local_addr=(self.host, self.port)
         )

@@ -114,6 +114,11 @@ class JsonDeviceRequestDecoder:
     """Parse a single DeviceRequest envelope
     (reference: sources/decoder/json/JsonDeviceRequestDecoder.java)."""
 
+    # raw payloads in this format may skip host-side decode entirely and
+    # ride the engine's batched arena path (ingest_json_batch) — the
+    # wire-edge batched submit keys off this tag (ingest/wire_edge.py)
+    wire_tag = "json"
+
     def decode(self, payload: bytes, metadata: dict[str, Any]) -> list[DecodedRequest]:
         try:
             envelope = json.loads(payload)
@@ -327,6 +332,10 @@ def envelope_from_request(req: DecodedRequest) -> dict:
 class BinaryEventDecoder:
     """Decode the compact flat binary format (the reference's
     sources/decoder/protobuf/ProtobufDeviceEventDecoder slot)."""
+
+    # same format as encode_binary_request -> batchable via
+    # engine.ingest_binary_batch (see JsonDeviceRequestDecoder.wire_tag)
+    wire_tag = "binary"
 
     def decode(self, payload: bytes, metadata: dict[str, Any]) -> list[DecodedRequest]:
         try:

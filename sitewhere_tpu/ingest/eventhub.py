@@ -13,6 +13,13 @@ this image, so the *consumption semantics* are implemented here natively:
 ``EventProcessorHost`` (partition ownership split across hosts of a group,
 batch delivery, periodic checkpoint, resume), and the ingest receiver +
 outbound connector built on them.
+
+Legacy-compat receiver: delivery lands on the per-event
+``InboundEventSource`` path. New high-rate device transports should use
+the batched persistent-connection edge (``ingest/wire_edge.py``);
+sources kept on this receiver inherit the manager's shared
+``WireBatcher`` (batched arena submission) when their decoder declares
+a ``wire_tag``.
 """
 
 from __future__ import annotations

@@ -65,6 +65,40 @@ async def read_packet(reader: asyncio.StreamReader) -> tuple[int, int, bytes]:
     return h >> 4, h & 0x0F, body
 
 
+class FrameTooLarge(ValueError):
+    """Remaining-length exceeds the receiver's frame budget; the packet
+    body was deliberately NOT consumed (callers close the connection)."""
+
+
+async def read_packet_limited(reader: asyncio.StreamReader,
+                              max_bytes: int) -> tuple[int, int, bytes]:
+    """Server-side :func:`read_packet` with an oversized-frame guard: the
+    remaining-length varint is checked BEFORE the body read, so a hostile
+    or misconfigured client can never make the edge buffer an arbitrarily
+    large packet (ingest/wire_edge.py counts these as ``frames_invalid``)."""
+    (h,) = await reader.readexactly(1)
+    length = await read_varint(reader)
+    if length > max_bytes:
+        raise FrameTooLarge(f"remaining length {length} > {max_bytes}")
+    body = await reader.readexactly(length) if length else b""
+    return h >> 4, h & 0x0F, body
+
+
+def decode_connect(body: bytes) -> tuple[str, int]:
+    """Parse a CONNECT variable header + payload into
+    ``(client_id, keepalive_s)``; raises ``ValueError`` on malformed input
+    (the wire edge counts and disconnects)."""
+    nlen = int.from_bytes(body[:2], "big")
+    if body[2: 2 + nlen] != b"MQTT":
+        raise ValueError(f"bad protocol name {body[2: 2 + nlen]!r}")
+    off = 2 + nlen + 2          # name + level byte + connect flags
+    keepalive = int.from_bytes(body[off: off + 2], "big")
+    off += 2
+    idlen = int.from_bytes(body[off: off + 2], "big")
+    client_id = body[off + 2: off + 2 + idlen].decode()
+    return client_id, keepalive
+
+
 def encode_connect(client_id: str, keepalive: int = 60,
                    username: str | None = None, password: str | None = None) -> bytes:
     flags = 0x02  # clean session

@@ -40,9 +40,11 @@ class InboundEventReceiver(LifecycleComponent):
     def bind(self, source: "InboundEventSource") -> None:
         self.source = source
 
-    def submit(self, payload: bytes, metadata: dict[str, Any] | None = None) -> int:
+    def submit(self, payload: bytes, metadata: dict[str, Any] | None = None,
+               on_durable: Callable[[], Any] | None = None) -> int:
         assert self.source is not None, "receiver not bound to a source"
-        return self.source.on_encoded_event_received(payload, metadata or {})
+        return self.source.on_encoded_event_received(payload, metadata or {},
+                                                     on_durable=on_durable)
 
 
 class InboundEventSource(LifecycleComponent):
@@ -55,6 +57,7 @@ class InboundEventSource(LifecycleComponent):
         receivers: list[InboundEventReceiver] | None = None,
         deduplicator: Deduplicator | None = None,
         tenant: str = "default",
+        batcher=None,
     ):
         super().__init__(f"event-source:{source_id}")
         self.source_id = source_id
@@ -66,21 +69,56 @@ class InboundEventSource(LifecycleComponent):
         for r in self.receivers:
             r.bind(self)
             self.add_child(r)
+        # batched arena submission (ingest/wire_edge.WireBatcher): when
+        # the decoder declares a wire_tag the raw payload skips host-side
+        # decode and rides the engine's batch-ingest facade, one engine
+        # call per arrival window instead of one lock acquisition per
+        # event. A host-side deduplicator forces the per-payload path —
+        # dedup needs the decoded alternate id (the wire edge's own
+        # socket endpoints dedup by byte scan instead).
+        self.batcher = batcher
+        self._wire_tag = getattr(decoder, "wire_tag", None)
+        if batcher is not None and deduplicator is not None:
+            raise ValueError(
+                "batched submission and a host-side deduplicator are "
+                "mutually exclusive; drop one of them")
         # Prometheus-analog counters (InboundEventSource.java:50-59)
         self.decoded_count = 0
         self.failed_count = 0
         self.duplicate_count = 0
+        self.batched_count = 0
 
-    def on_encoded_event_received(self, payload: bytes, metadata: dict[str, Any]) -> int:
-        """Decode one raw payload and forward its requests; returns number of
-        requests forwarded."""
+    def on_encoded_event_received(self, payload: bytes, metadata: dict[str, Any],
+                                  on_durable: Callable[[], Any] | None = None) -> int:
+        """Forward one raw payload; returns number of requests forwarded.
+
+        Batched mode (``batcher`` set + batchable decoder): the payload is
+        appended to the shared arrival window by reference and decoded by
+        the engine's native scanner inside the staging arena — decode
+        failures are then counted by the engine's batch summary rather
+        than this source's ``failed_count``/dead letter.
+
+        ``on_durable`` fires once the payload's batch has cleared the WAL
+        durability gate (batched mode; it runs on the flusher thread — the
+        receiver marshals back to its own loop). On the per-payload path
+        the forward is synchronous, so the callback fires before return."""
         assert self.manager is not None, "source not attached to a manager"
+        if self.batcher is not None and self._wire_tag is not None:
+            if isinstance(payload, str):
+                payload = payload.encode()
+            self.batcher.add(payload, tenant=self.tenant,
+                             binary=self._wire_tag == "binary",
+                             on_durable=on_durable)
+            self.batched_count += 1
+            return 1
         metadata = {**metadata, "source_id": self.source_id}
         try:
             requests = self.decoder.decode(payload, metadata)
         except EventDecodeException as e:
             self.failed_count += 1
             self.manager.on_decode_failed(self.source_id, payload, metadata, e)
+            if on_durable is not None:
+                on_durable()
             return 0
         forwarded = 0
         for req in requests:
@@ -92,6 +130,8 @@ class InboundEventSource(LifecycleComponent):
             self.decoded_count += 1
             self.manager.on_decoded_request(self.source_id, req)
             forwarded += 1
+        if on_durable is not None:
+            on_durable()
         return forwarded
 
 
@@ -108,6 +148,7 @@ class EventSourcesManager(LifecycleComponent):
         on_event_request: Callable[[DecodedRequest], None],
         on_registration_request: Callable[[DecodedRequest], None] | None = None,
         dead_letter_capacity: int = 4096,
+        batcher=None,
     ):
         super().__init__("event-sources-manager")
         self.sources: dict[str, InboundEventSource] = {}
@@ -115,14 +156,29 @@ class EventSourcesManager(LifecycleComponent):
         self._on_register = on_registration_request
         self.failed_decodes: list[tuple[str, bytes, str]] = []
         self.dead_letter_capacity = dead_letter_capacity
+        # shared batched-submit accumulator (ingest/wire_edge.WireBatcher):
+        # newly added sources with a batchable decoder and no host-side
+        # deduplicator inherit it, so CoAP/polling/in-memory receivers pay
+        # one engine call per arrival window, not one per event
+        self.batcher = batcher
 
     def add_source(self, source: InboundEventSource) -> InboundEventSource:
         if source.source_id in self.sources:
             raise ValueError(f"duplicate source id {source.source_id!r}")
         self.sources[source.source_id] = source
         source.manager = self
+        if (self.batcher is not None and source.batcher is None
+                and source._wire_tag is not None
+                and source.deduplicator is None):
+            source.batcher = self.batcher
         self.add_child(source)
         return source
+
+    async def on_stop(self) -> None:
+        """Drain the shared arrival window so every accepted payload
+        reaches the engine before the sources report stopped."""
+        if self.batcher is not None:
+            self.batcher.flush()
 
     def on_decoded_request(self, source_id: str, req: DecodedRequest) -> None:
         if req.type is RequestType.REGISTER_DEVICE and self._on_register is not None:

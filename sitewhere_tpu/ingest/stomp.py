@@ -11,6 +11,13 @@ speaks OpenWire/JMS; the open text protocol it also ships is STOMP, so the
 TPU build implements STOMP 1.2 here — queue destinations get point-to-point
 round-robin delivery (JMS queue semantics, competing consumers), topic
 destinations get fan-out (JMS topic semantics).
+
+Legacy-compat receiver: frames submit one payload at a time through
+``InboundEventSource``. New high-rate device transports should front
+the batched persistent-connection edge (``ingest/wire_edge.py``);
+sources kept on this receiver inherit the manager's shared
+``WireBatcher`` (batched arena submission) when their decoder declares
+a ``wire_tag``.
 """
 
 from __future__ import annotations

@@ -17,6 +17,7 @@ from sitewhere_tpu.connectors.base import ConnectorHost, OutboundConnector
 from sitewhere_tpu.connectors.impl import SearchIndexConnector
 from sitewhere_tpu.engine import Engine, EngineConfig
 from sitewhere_tpu.ingest.sources import EventSourcesManager, InboundEventSource
+from sitewhere_tpu.ingest.wire_edge import WireEdge, WireEdgeConfig
 from sitewhere_tpu.instance.auth import JwtService, UserManagement
 from sitewhere_tpu.instance.tenants import TenantManagement
 from sitewhere_tpu.labels.manager import LabelGeneratorManager
@@ -52,6 +53,11 @@ class InstanceConfig:
                                        # stop(). 0 disables the thread —
                                        # GET /api/instance/conservation
                                        # still audits on demand
+    wire_edge: "WireEdgeConfig | None" = None
+                                       # persistent-connection listeners
+                                       # (ISSUE 20): MQTT/SWP/websocket
+                                       # sockets feeding staging arenas.
+                                       # None = request-response only
 
 
 class SiteWhereTpuInstance(LifecycleComponent):
@@ -73,6 +79,18 @@ class SiteWhereTpuInstance(LifecycleComponent):
             on_registration_request=self.engine.process,
         )
         self.add_child(self.event_sources)
+
+        # persistent-connection wire edge (ISSUE 20): socket listeners
+        # feeding staging arenas. The event-sources manager inherits the
+        # edge's first batcher, so CoAP/socket/polling receivers with a
+        # batchable decoder ride the SAME arrival windows as the live
+        # MQTT/SWP connections. Note batched sources bypass the stream-
+        # command peel-off (_route_device_request) — sources that need it
+        # must keep a host-side deduplicator or a non-batchable decoder.
+        self.wire_edge: WireEdge | None = None
+        if self.config.wire_edge is not None:
+            self.wire_edge = WireEdge(self.engine, self.config.wire_edge)
+            self.event_sources.batcher = self.wire_edge.batchers[0]
 
         # management services
         self.device_management = DeviceManagement(self.engine)
@@ -221,8 +239,14 @@ class SiteWhereTpuInstance(LifecycleComponent):
     async def on_start(self) -> None:
         if self.config.conservation_audit_s:
             self.conservation_auditor.start()
+        if self.wire_edge is not None:
+            await self.wire_edge.start()
 
     async def on_stop(self) -> None:
+        # children (event sources) have already stopped; draining the
+        # edge last flushes the shared arrival windows they fed
+        if self.wire_edge is not None:
+            await self.wire_edge.stop()
         self.conservation_auditor.stop()
         if self._scripts_tmpdir is not None:
             import shutil

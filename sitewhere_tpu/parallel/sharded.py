@@ -1710,8 +1710,19 @@ class SpmdEngine(Engine):
             row.update({lane: int(lanes[s, i])
                         for i, lane in enumerate(TENANT_COUNTER_LANES)})
             per.append(row)
-        return {"shards": self.n_shards, "counting": counting,
-                "perShard": per}
+        doc = {"shards": self.n_shards, "counting": counting,
+               "perShard": per}
+        # attached persistent-connection edges are the feeder stage of
+        # this flow — embed their aggregate so one scrape of the shard
+        # doc shows socket->arena->shard end to end (stays out of
+        # metrics(): dispatch-shape equality pin)
+        if getattr(self, "wire_edges", None):
+            from sitewhere_tpu.ingest.wire_edge import aggregate_wire_snapshot
+
+            wire = aggregate_wire_snapshot(self)
+            if wire is not None:
+                doc["wire"] = wire
+        return doc
 
     def harvest_shard_heat(self, now_s: float | None = None):
         """Scrape-seam heat harvest: device_get the unfolded counter

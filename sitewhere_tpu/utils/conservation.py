@@ -92,6 +92,18 @@ Conservation equations (the contract future PRs must keep balanced):
                         commits planned ALONGSIDE its sinks in one lock
                         block per batch, so there is no in-flight slack
                         term — the equation is exact at every audit)
+  wire-frames           frames_received == frames_admitted + frames_shed
+                        + frames_invalid + frames_duplicate (ISSUE 20:
+                        every frame a persistent connection delivers
+                        gets exactly one edge disposition; received is
+                        counted independently at frame arrival, so the
+                        equation can actually fail)
+  wire-rows             frames_admitted == rows_submitted +
+                        frames_stalled + pending (admitted frames land
+                        in the engine's batch-ingest facade — flowing
+                        into staging-balance from there — or are
+                        stall-shed with their acks withheld; the
+                        arrival-window backlog is the only legal slack)
 """
 
 from __future__ import annotations
@@ -109,6 +121,7 @@ EQUATIONS = (
     "edge-admission", "wal-durability", "forward-queue",
     "replication-feed", "archive-spill", "rules-harvest",
     "placement-handoff", "spmd-shard-flow", "analytics-windows",
+    "wire-frames", "wire-rows",
 )
 
 
@@ -260,6 +273,25 @@ def build_ledger(engine, rules_manager=None) -> dict:
                     # subtracts them from the edge shed total
                     "shed_noted": int(qos.shed_noted),
                     "shed_by_tenant": dict(qos.shed_by_tenant)}
+        # persistent-connection wire edge (ISSUE 20): disposition
+        # counters sampled from the attached edges' own snapshots. The
+        # edge/batcher locks are distinct from the engine lock, so a
+        # frame between its admission increment and its batcher append
+        # can transiently skew wire-rows — exactly the non-atomic-update
+        # race the auditor's two-consecutive-audit rule exists for; a
+        # quiescent edge balances exactly.
+        if getattr(eng, "wire_edges", None):
+            from sitewhere_tpu.ingest.wire_edge import (
+                aggregate_wire_snapshot)
+
+            ws = aggregate_wire_snapshot(eng)
+            if ws is not None:
+                stages["wire"] = {k: ws[k] for k in (
+                    "frames_received", "frames_admitted", "frames_shed",
+                    "frames_invalid", "frames_duplicate",
+                    "rows_submitted", "frames_stalled", "pending",
+                    "backpressure_events", "connections_live",
+                    "connections_peak")}
         ing = {"staged_rows": 0, "dispatched_rows": 0,
                "backlog_rows": _backlog_rows(eng), "counting": False}
         if led is not None:
@@ -586,6 +618,29 @@ def check_conservation(ledger: dict) -> list[Violation]:
                 f"{an.get('scored', 0)} + skipped_underfilled "
                 f"{an.get('skipped_underfilled', 0)} + cancelled "
                 f"{an.get('cancelled', 0)}", an["planned"], rhs)
+    wire = st.get("wire")
+    if wire:
+        rhs = (wire.get("frames_admitted", 0) + wire.get("frames_shed", 0)
+               + wire.get("frames_invalid", 0)
+               + wire.get("frames_duplicate", 0))
+        if wire.get("frames_received", 0) != rhs:
+            bad("wire-frames",
+                f"frames received {wire.get('frames_received', 0)} != "
+                f"admitted {wire.get('frames_admitted', 0)} + shed "
+                f"{wire.get('frames_shed', 0)} + invalid "
+                f"{wire.get('frames_invalid', 0)} + duplicate "
+                f"{wire.get('frames_duplicate', 0)}",
+                wire.get("frames_received", 0), rhs)
+        rhs = (wire.get("rows_submitted", 0)
+               + wire.get("frames_stalled", 0) + wire.get("pending", 0))
+        if wire.get("frames_admitted", 0) != rhs:
+            bad("wire-rows",
+                f"frames admitted {wire.get('frames_admitted', 0)} != "
+                f"rows_submitted {wire.get('rows_submitted', 0)} + "
+                f"stalled {wire.get('frames_stalled', 0)} + pending "
+                f"{wire.get('pending', 0)}",
+                wire.get("frames_admitted", 0), rhs,
+                slack=wire.get("pending", 0))
     return out
 
 

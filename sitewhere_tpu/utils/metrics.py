@@ -1011,6 +1011,62 @@ def export_engine_metrics(engine, registry: MetricsRegistry | None = None,
     export_observability_metrics(engine, reg)
     export_placement_metrics(engine, reg)
     export_spmd_metrics(engine, reg)
+    export_wire_metrics(engine, reg)
+
+
+def export_wire_metrics(engine, registry: MetricsRegistry | None = None) -> None:
+    """Scrape-time export of the persistent-connection wire edge (ISSUE
+    20): connection gauges, per-disposition frame totals, arrival-window
+    flush occupancy, and backpressure events. Sampled from the attached
+    edges' own counter snapshots — like every plane, these series are
+    deliberately NOT ``engine.metrics()`` keys (dispatch-shape equality
+    pin); an engine with no edge attached exports nothing."""
+    eng = getattr(engine, "local", engine)
+    if not getattr(eng, "wire_edges", None):
+        return
+    from sitewhere_tpu.ingest.wire_edge import aggregate_wire_snapshot
+
+    snap = aggregate_wire_snapshot(eng)
+    if snap is None:
+        return
+    reg = registry or REGISTRY
+    reg.gauge("swtpu_wire_connections_live",
+              "persistent connections currently attached to the wire "
+              "edge").set(snap["connections_live"])
+    reg.gauge("swtpu_wire_connections_peak",
+              "peak concurrent persistent connections").set(
+                  snap["connections_peak"])
+    reg.gauge("swtpu_wire_connections_opened_total",
+              "persistent connections accepted since edge start").set(
+                  snap["connections_opened"])
+    frames = reg.gauge("swtpu_wire_frames_total",
+                       "wire frames by edge disposition")
+    for disp in ("admitted", "shed", "invalid", "duplicate"):
+        frames.set(snap[f"frames_{disp}"], disposition=disp)
+    frames.set(snap["frames_received"], disposition="received")
+    reg.gauge("swtpu_wire_rows_submitted_total",
+              "frames handed to the batched arena-ingest path").set(
+                  snap["rows_submitted"])
+    reg.gauge("swtpu_wire_frames_stalled_total",
+              "admitted frames shed by arena stall (acks withheld)").set(
+                  snap["frames_stalled"])
+    reg.gauge("swtpu_wire_pending_frames",
+              "frames buffered in open arrival windows").set(
+                  snap["pending"])
+    reg.gauge("swtpu_wire_flushes_total",
+              "arrival-window flushes (size, deadline, or drain)").set(
+                  snap["flushes"])
+    reg.gauge("swtpu_wire_flush_occupancy_pct",
+              "mean flushed rows as % of the size threshold — low means "
+              "the deadline fires first (latency-bound windows)").set(
+                  snap["flush_occupancy_pct"])
+    reg.gauge("swtpu_wire_backpressure_total",
+              "protocol-level backpressure signals sent (PUBACK "
+              "withheld / SWP shed codes)").set(
+                  snap["backpressure_events"])
+    reg.gauge("swtpu_wire_keepalive_timeouts_total",
+              "connections dropped for keepalive silence").set(
+                  snap["keepalive_timeouts"])
 
 
 def export_observability_metrics(engine, registry: MetricsRegistry | None

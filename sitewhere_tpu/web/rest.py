@@ -396,6 +396,24 @@ def make_app(instance: SiteWhereTpuInstance) -> web.Application:
 
     r.add_get("/api/instance/spmd/heat", spmd_heat_doc)
 
+    async def wire_doc(request: web.Request):
+        """Persistent-connection wire-edge posture (ISSUE 20): aggregate
+        frame dispositions, batcher flush counters, connection census.
+        Admission for socket frames happens at the edge via the SAME
+        ``admit_or_raise`` path REST ingest uses (PR-9 rule: QoS at
+        edges, never inside the engine), so this doc and the REST shed
+        counters describe one admission plane. ``{"wire": false}`` when
+        no edge is attached. Off-loop — the snapshot sums per-batcher
+        counters under their locks."""
+        from sitewhere_tpu.ingest.wire_edge import aggregate_wire_snapshot
+
+        snap = await asyncio.to_thread(aggregate_wire_snapshot, inst.engine)
+        if snap is None:
+            return json_response({"wire": False})
+        return json_response({"wire": True, **snap})
+
+    r.add_get("/api/instance/wire", wire_doc)
+
     async def placement_doc(request: web.Request):
         """Elastic-placement posture (ISSUE 15): the installed map
         (epoch, slot assignment, active ranks), this rank's fences and
