@@ -41,12 +41,18 @@ Durability and backpressure contracts (the part that must not be wrong):
   off); SWP sends an explicit shed code with a Retry-After; websocket
   mirrors SWP. Replay/standby paths never pass through here, so durable
   events can never be shed (the engine-side invariant is preserved).
-* **At-most-once per alternateId across redeliveries.** QoS 1 redelivery
-  (PUBACK lost in transit) must not double-ingest. The edge keeps a
-  bounded alternate-id ring over ADMITTED frames (a byte-scan extraction,
-  no JSON decode — the zero-copy claim holds); a duplicate is not
-  re-ingested, and its ack rides the next durability point (the original
-  is durable by then or will be with it).
+* **At-most-once per (tenant, deviceToken, alternateId) across
+  redeliveries.** QoS 1 redelivery (PUBACK lost in transit) must not
+  double-ingest. The edge keeps a bounded ring over the dedup triples of
+  STAGED frames (byte-scan extraction, no JSON decode — the zero-copy
+  claim holds), keyed exactly like ``AlternateIdDeduplicator`` so
+  tenants/devices reusing an alternateId stay distinct. The ring commits
+  only at staging (``on_staged``), never at admission: a frame that
+  sheds or stalls after admission leaves no ring entry, so its
+  redelivery is re-admitted rather than acked as a duplicate of an
+  ingest that never happened (the ack-without-ingest hole). A true
+  duplicate is not re-ingested, and its ack rides the next durability
+  point (the original is durable by then or will be with it).
 
 Conservation terms (utils/conservation.py "wire" stage): every frame gets
 exactly one edge disposition —
@@ -93,15 +99,15 @@ SWP_SHED = 0x15         # admission shed / arena stall: resend after delay
 SWP_ERR = 0x19          # protocol error or oversized frame; closing
 
 
-def extract_alternate_id(payload: bytes) -> str | None:
-    """Best-effort ``alternateId`` extraction from a raw JSON payload via a
+def _scan_string_field(payload: bytes, key: bytes) -> str | None:
+    """Best-effort string-field extraction from a raw JSON payload via a
     byte scan — no decode, no copy of the payload. Returns None when the key
     is absent or anything about the value looks unusual (ambiguity must
     never block ingest; the engine-side decode is the arbiter)."""
-    idx = payload.find(b'"alternateId"')
+    idx = payload.find(key)
     if idx < 0:
         return None
-    i = idx + len(b'"alternateId"')
+    i = idx + len(key)
     n = len(payload)
     while i < n and payload[i] in b" \t\r\n":
         i += 1
@@ -132,26 +138,45 @@ def extract_alternate_id(payload: bytes) -> str | None:
     return None
 
 
+def extract_alternate_id(payload: bytes) -> str | None:
+    return _scan_string_field(payload, b'"alternateId"')
+
+
+def extract_device_token(payload: bytes) -> str | None:
+    return _scan_string_field(payload, b'"deviceToken"')
+
+
 class AltIdRing:
-    """Bounded FIFO membership ring over alternate ids of ADMITTED frames.
-    Mirrors ingest/dedup.AlternateIdDeduplicator but keyed by the raw id
-    string (the edge never builds a DecodedRequest)."""
+    """Bounded FIFO membership ring over the dedup keys of STAGED frames —
+    ``(tenant, device_token, alternate_id)``, the same triple
+    ingest/dedup.AlternateIdDeduplicator uses, byte-scanned rather than
+    built from a DecodedRequest. Keys enter the ring only once their frame
+    has actually staged (``on_staged``), never at admission: a frame that
+    sheds or stalls after admission left no trace here, so its redelivery
+    is admitted like a first offer instead of being acked as a duplicate
+    of an ingest that never happened.
+
+    Thread-safe: ``seen`` runs on the event-loop thread, ``add`` on the
+    batcher's flusher thread."""
 
     def __init__(self, capacity: int = 65536):
         self.capacity = capacity
-        self._seen: set[str] = set()
-        self._order: collections.deque[str] = collections.deque()
+        self._lock = threading.Lock()
+        self._seen: set = set()
+        self._order: collections.deque = collections.deque()
 
-    def seen(self, alt_id: str) -> bool:
-        return alt_id in self._seen
+    def seen(self, key) -> bool:
+        with self._lock:
+            return key in self._seen
 
-    def add(self, alt_id: str) -> None:
-        if alt_id in self._seen:
-            return
-        self._seen.add(alt_id)
-        self._order.append(alt_id)
-        while len(self._order) > self.capacity:
-            self._seen.discard(self._order.popleft())
+    def add(self, key) -> None:
+        with self._lock:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self._order.append(key)
+            while len(self._order) > self.capacity:
+                self._seen.discard(self._order.popleft())
 
 
 class WireBatcher:
@@ -178,7 +203,8 @@ class WireBatcher:
         self.flush_interval_s = float(flush_interval_s)
         self._clock = clock
         self._cond = threading.Condition(threading.Lock())
-        # pending: (payload, tenant, binary, on_durable, on_stall).
+        # pending: (payload, tenant, binary, on_durable, on_stall,
+        # on_staged).
         # A deque because the intake fast path appends WITHOUT the
         # condition lock: deque.append is a single atomic op under the
         # GIL, and the flusher drains by popleft-until-empty, so a frame
@@ -211,8 +237,14 @@ class WireBatcher:
     def add(self, payload: bytes, tenant: str = "default",
             binary: bool = False,
             on_durable: Callable[[], None] | None = None,
-            on_stall: Callable[[ShedError], None] | None = None) -> None:
+            on_stall: Callable[[ShedError], None] | None = None,
+            on_staged: Callable[[], None] | None = None) -> None:
         """Append one admitted frame to the current arrival window.
+
+        ``on_staged`` fires (flusher thread) the moment the frame's run
+        has successfully entered the engine — before the durability wait,
+        never on a shed/stalled run. It is the dedup-ring commit point:
+        ids recorded here belong to frames that really were ingested.
 
         Lock-free fast path: the deque append is atomic under the GIL,
         so mid-window frames never touch the condition lock. Only the
@@ -225,7 +257,7 @@ class WireBatcher:
         if self._closed:
             raise RuntimeError("wire batcher closed")
         q = self._pending
-        q.append((payload, tenant, binary, on_durable, on_stall))
+        q.append((payload, tenant, binary, on_durable, on_stall, on_staged))
         if not self._armed or len(q) >= self.flush_rows:
             with self._cond:
                 if not self._armed:
@@ -314,7 +346,7 @@ class WireBatcher:
             self._wait_durable()
             # acks ONLY for frames whose run actually staged — stalled
             # frames keep their acks withheld so the senders redeliver
-            for _, _, _, on_durable, _ in staged:
+            for _, _, _, on_durable, _, _ in staged:
                 if on_durable is not None:
                     self._safe_cb(on_durable)
             for cb in barriers:
@@ -347,6 +379,11 @@ class WireBatcher:
                 with self._cond:
                     self.rows_submitted += len(run)
                     self.flush_rows_sum += len(run)
+                # staged hooks fire only now: a frame that sheds/stalls
+                # above never reaches them (dedup-ring commit point)
+                for f in run:
+                    if f[5] is not None:
+                        self._safe_cb(f[5])
             except ShedError as e:
                 # arena-stall shed surfaced by the ingest path; the frames
                 # were never staged — withhold their acks so the senders
@@ -442,7 +479,8 @@ class _Conn:
     """Per-connection state shared by the protocol handlers."""
 
     __slots__ = ("writer", "proto", "tenant", "binary", "shard",
-                 "frames_in", "acked", "_ack_dirty", "qos2_parked", "alive")
+                 "frames_in", "acked", "_ack_dirty", "qos2_parked",
+                 "qos2_inflight", "alive")
 
     def __init__(self, writer, proto: str, shard: int):
         self.writer = writer
@@ -454,6 +492,10 @@ class _Conn:
         self.acked = 0              # SWP cumulative durable ack counter
         self._ack_dirty = False
         self.qos2_parked: dict[int, tuple[str, bytes]] = {}
+        # pids released by PUBREL whose ingest outcome is still pending
+        # (staging, or shed awaiting re-park) — a retransmitted PUBREL
+        # for one of these must NOT be treated as a completed duplicate
+        self.qos2_inflight: set[int] = set()
         self.alive = True
 
 
@@ -609,12 +651,18 @@ class WireEdge:
             self.frames_received += 1
             conn.frames_in += 1
         alt = extract_alternate_id(payload) if not binary else None
-        if alt is not None and self._dedup.seen(alt):
+        dedup_key = None
+        if alt is not None:
+            # the repo's established dedup triple (AlternateIdDeduplicator):
+            # two tenants/devices reusing the same alternateId are distinct
+            dedup_key = (tenant, extract_device_token(payload) or "", alt)
+        if dedup_key is not None and self._dedup.seen(dedup_key):
             with self._lock:
                 self.frames_duplicate += 1
-            # re-ack at the next durability point: the original admitted
-            # frame is covered by it (or already was), so the sender's
-            # lost ack can be regenerated without a second ingest
+            # the key is in the ring only if the original frame STAGED, so
+            # re-ack at the next durability point: that point covers the
+            # original, and the sender's lost ack is regenerated without a
+            # second ingest
             if on_durable is not None:
                 self.batchers[conn.shard].add_barrier(on_durable)
             return
@@ -629,11 +677,17 @@ class WireEdge:
             return
         with self._lock:
             self.frames_admitted += 1
-        if alt is not None:
-            self._dedup.add(alt)
+        # the dedup key commits only when the frame stages (flusher
+        # thread): a shed/stalled run leaves no ring entry, so the
+        # client's redelivery is re-admitted instead of being acked as
+        # a duplicate of an ingest that never happened
+        on_staged = None
+        if dedup_key is not None:
+            on_staged = (lambda ring=self._dedup, k=dedup_key: ring.add(k))
         self.batchers[conn.shard].add(payload, tenant, binary,
                                       on_durable=on_durable,
-                                      on_stall=self._stall_cb(conn, on_shed))
+                                      on_stall=self._stall_cb(conn, on_shed),
+                                      on_staged=on_staged)
 
     def _stall_cb(self, conn: _Conn, on_shed):
         if on_shed is None:
@@ -646,7 +700,12 @@ class WireEdge:
         return cb
 
     def _count_invalid(self) -> None:
+        # invalid frames never reach _on_frame, so they get BOTH their
+        # received and invalid increments here — every frame the edge saw
+        # has exactly one disposition and the wire-frames conservation
+        # equation balances even when malformed traffic arrives
         with self._lock:
+            self.frames_received += 1
             self.frames_invalid += 1
 
     def _call_on_loop(self, fn: Callable[[], None]) -> Callable[[], None]:
@@ -713,15 +772,18 @@ class WireEdge:
                 elif ptype == PUBREL:
                     pid = int.from_bytes(body[:2], "big")
                     parked = conn.qos2_parked.pop(pid, None)
-                    comp = self._mqtt_ack(conn, writer, PUBCOMP, pid)
-                    if parked is None:
-                        comp()   # duplicate PUBREL: just re-complete
+                    if parked is not None:
+                        self._qos2_release(conn, writer, pid, parked)
+                    elif pid in conn.qos2_inflight:
+                        # outcome pending (staging, or shed racing its
+                        # re-park): neither PUBCOMP nor a second ingest —
+                        # the client's next PUBREL retransmission sees
+                        # the settled state
+                        pass
                     else:
-                        tenant, payload = parked
-                        self._on_frame(
-                            conn, payload, tenant, binary=False,
-                            on_durable=self._call_on_loop(comp),
-                            on_shed=None)
+                        # true duplicate PUBREL (the frame completed and
+                        # its PUBCOMP was lost): just re-complete
+                        self._mqtt_ack(conn, writer, PUBCOMP, pid)()
                 elif ptype == PINGREQ:
                     writer.write(encode_packet(PINGRESP, 0, b""))
                     await writer.drain()
@@ -765,6 +827,44 @@ class WireEdge:
         self._on_frame(conn, payload, tenant, binary=False,
                        on_durable=on_durable,
                        on_shed=self._mqtt_shed(conn, writer))
+
+    def _qos2_release(self, conn: _Conn, writer, pid: int,
+                      parked: tuple[str, bytes]) -> None:
+        """Exactly-once second half: a PUBREL released the parked frame.
+        The pid is tracked in ``qos2_inflight`` until its outcome settles:
+
+        * staged + durable -> PUBCOMP, pid forgotten (later PUBRELs are
+          true duplicates and just re-complete);
+        * shed at admission or arena stall -> PUBCOMP withheld and the
+          payload goes BACK to the parked map, so the client's PUBREL
+          retransmission re-releases it through admission. A PUBCOMP can
+          therefore never complete a frame that was not ingested.
+        """
+        from sitewhere_tpu.ingest.mqtt import PUBCOMP
+
+        tenant, payload = parked
+        conn.qos2_inflight.add(pid)
+        comp = self._mqtt_ack(conn, writer, PUBCOMP, pid)
+
+        def done() -> None:
+            conn.qos2_inflight.discard(pid)
+            comp()
+
+        def reoffer(err: ShedError) -> None:
+            # admission shed runs on the loop thread, arena stall on the
+            # flusher thread — marshal so every qos2 map mutation happens
+            # on the loop thread (same thread as the PUBREL handler)
+            def _repark() -> None:
+                conn.qos2_inflight.discard(pid)
+                conn.qos2_parked.setdefault(pid, (tenant, payload))
+            try:
+                self._loop.call_soon_threadsafe(_repark)
+            except RuntimeError:
+                pass             # loop closed mid-teardown
+
+        self._on_frame(conn, payload, tenant, binary=False,
+                       on_durable=self._call_on_loop(done),
+                       on_shed=reoffer)
 
     def _mqtt_ack(self, conn: _Conn, writer, ptype: int, pid: int):
         from sitewhere_tpu.ingest.mqtt import encode_packet
@@ -934,6 +1034,7 @@ class WireEdge:
             "frames_stalled": stalled,
             "pending": pending,
             "flushes": flushes,
+            "flush_rows_sum": rows_sum,
             "flush_occupancy_pct": round(
                 100.0 * rows_sum / (flushes * self.cfg.flush_rows), 1)
             if flushes else 0.0,
@@ -966,14 +1067,31 @@ class _WsWriter:
 
 
 def aggregate_wire_snapshot(engine) -> dict[str, Any] | None:
-    """Sum the snapshots of every edge attached to ``engine`` — the shape
-    the conservation ledger, the REST status route, and the scrape exporter
-    share. None when no edge is (or ever was) attached."""
+    """Combine the snapshots of every edge attached to ``engine`` — the
+    shape the conservation ledger, the REST status route, and the scrape
+    exporter share. None when no edge is (or ever was) attached.
+
+    Counters sum; the two non-additive fields get their own rules:
+    ``connections_peak`` is a max (per-edge peaks are not concurrent),
+    and ``flush_occupancy_pct`` is recomputed as a flush-capacity-weighted
+    mean (total flushed rows over total flush capacity) — summing
+    percentages would report 160% for two edges at 80%."""
     edges = getattr(engine, "wire_edges", None)
     if not edges:
         return None
     total: dict[str, Any] = {}
+    rows_sum = cap_sum = 0
     for edge in list(edges):
-        for key, val in edge.snapshot().items():
-            total[key] = total.get(key, 0) + val
+        snap = edge.snapshot()
+        rows_sum += snap.get("flush_rows_sum", 0)
+        cap_sum += snap.get("flushes", 0) * edge.cfg.flush_rows
+        for key, val in snap.items():
+            if key == "connections_peak":
+                total[key] = max(total.get(key, 0), val)
+            elif key == "flush_occupancy_pct":
+                continue
+            else:
+                total[key] = total.get(key, 0) + val
+    total["flush_occupancy_pct"] = (
+        round(100.0 * rows_sum / cap_sum, 1) if cap_sum else 0.0)
     return total

@@ -671,6 +671,16 @@ def test_swp_socket_byte_identical_and_conservation():
                 assert code == SWP_ACK                       # the oracle
             b.ingest_json_batch(chunk)
         w.close()
+        # an invalid frame (bad handshake) must balance too: it counts as
+        # received AND invalid, not invalid-only (which would permanently
+        # violate wire-frames for every malformed client)
+        r2, w2 = await asyncio.open_connection("127.0.0.1", edge.tcp_port)
+        w2.write(b"NOTSWP default json\n")
+        await w2.drain()
+        code, _ = await _swp_rec(r2)
+        assert code == SWP_ERR
+        w2.close()
+        assert edge.snapshot()["frames_invalid"] == 1
         # conservation audits run while the edge is attached
         _settle(a)
         wire_violations.extend(check_conservation(build_ledger(a)))
@@ -739,6 +749,228 @@ def test_wire_scrape_series_only_with_edge_attached():
     assert aggregate_wire_snapshot(reg2_engine) is None
 
 
+def test_batcher_on_staged_fires_only_on_success():
+    """on_staged (the dedup-ring commit point) fires for staged frames
+    only — a shed run's hook never fires."""
+    eng = FakeEngine()
+    from sitewhere_tpu.utils.qos import ShedError
+
+    calls = {"n": 0}
+
+    def shed_once(payloads, tenant="default", **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ShedError("arena stall", tenant=tenant,
+                            retry_after_s=0.1, reason="stall")
+        eng.json_batches.append((list(payloads), tenant))
+        return {"rows": len(payloads)}
+    eng.ingest_json_batch = shed_once
+    b = WireBatcher(eng, flush_rows=64, auto=False)
+    staged = []
+    b.add(b"s0", on_staged=lambda: staged.append(0))
+    b.flush()
+    assert staged == []                 # stalled: no commit
+    b.add(b"s0", on_staged=lambda: staged.append(1))
+    b.flush()
+    assert staged == [1]                # staged: committed
+    b.close()
+
+
+def test_shed_frame_leaves_no_dedup_entry_redelivery_reingested():
+    """A frame shed at admission must NOT poison the dedup ring: the
+    client's redelivery (same alternateId) is re-admitted and ingested,
+    never acked as a duplicate of an ingest that didn't happen."""
+    eng = FakeEngine()
+    eng.qos = _DenyAll()
+    snap = {}
+
+    async def run():
+        edge = WireEdge(eng, WireEdgeConfig(
+            mqtt_port=None, tcp_port=0, flush_rows=1,
+            flush_interval_s=0.01))
+        await edge.start()
+        try:
+            r, w = await _swp_connect(edge.tcp_port)
+            p = _alt_payload("shed-1")
+            w.write(struct.pack("!I", len(p)) + p)
+            await w.drain()
+            code, _ = await _swp_rec(r)
+            assert code == SWP_SHED
+            eng.qos = None              # pressure clears; client resends
+            w.write(struct.pack("!I", len(p)) + p)
+            await w.drain()
+            code, acked = await _swp_rec(r)
+            assert code == SWP_ACK and acked == 1
+            w.close()
+            snap.update(edge.snapshot())
+        finally:
+            await edge.stop()
+
+    asyncio.run(run())
+    assert eng.json_batches == [([_alt_payload("shed-1")], "default")]
+    assert snap["frames_shed"] == 1
+    assert snap["frames_admitted"] == 1
+    assert snap["frames_duplicate"] == 0
+
+
+def test_stalled_frame_leaves_no_dedup_entry_redelivery_reingested():
+    """Same ack-without-ingest hole via the other path: admitted but the
+    run STALLS (arena shed inside the engine call). The redelivery must
+    ingest; the ring committed nothing for the stalled frame."""
+    from sitewhere_tpu.utils.qos import ShedError
+
+    eng = FakeEngine()
+    calls = {"n": 0}
+
+    def stall_once(payloads, tenant="default", **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ShedError("arena stall", tenant=tenant,
+                            retry_after_s=0.05, reason="stall")
+        eng.json_batches.append((list(payloads), tenant))
+        return {"rows": len(payloads)}
+    eng.ingest_json_batch = stall_once
+    snap = {}
+
+    async def run():
+        edge = WireEdge(eng, WireEdgeConfig(
+            mqtt_port=None, tcp_port=0, flush_rows=1,
+            flush_interval_s=0.01))
+        await edge.start()
+        try:
+            r, w = await _swp_connect(edge.tcp_port)
+            p = _alt_payload("stall-1")
+            w.write(struct.pack("!I", len(p)) + p)
+            await w.drain()
+            code, _ = await _swp_rec(r)
+            assert code == SWP_SHED     # stall surfaced, ack withheld
+            w.write(struct.pack("!I", len(p)) + p)
+            await w.drain()
+            code, acked = await _swp_rec(r)
+            assert code == SWP_ACK and acked == 1
+            w.close()
+            snap.update(edge.snapshot())
+        finally:
+            await edge.stop()
+
+    asyncio.run(run())
+    assert eng.json_batches == [([_alt_payload("stall-1")], "default")]
+    assert snap["frames_stalled"] == 1
+    assert snap["frames_duplicate"] == 0
+    assert snap["frames_admitted"] == 2     # both offers were admitted
+
+
+def test_dedup_key_scoped_by_tenant_and_device():
+    """The ring keys by (tenant, deviceToken, alternateId) — the repo's
+    established dedup triple. An alternateId reused across tenants or
+    devices is NOT a duplicate; only the full triple dedups."""
+    eng = FakeEngine()
+    snap = {}
+
+    def _pay(dev, alt):
+        return json.dumps({
+            "deviceToken": dev, "type": "DeviceMeasurement",
+            "request": {"name": "temp", "value": 1.0, "eventDate": 1_000,
+                        "alternateId": alt},
+        }).encode()
+
+    async def run():
+        edge = WireEdge(eng, _edge_cfg())
+        await edge.start()
+        try:
+            r, w = await _mqtt_connect(edge.mqtt_port)
+            offers = [
+                ("swtpu/t1/events", _pay("wd-0", "seq-1")),   # ingests
+                ("swtpu/t2/events", _pay("wd-0", "seq-1")),   # other tenant
+                ("swtpu/t1/events", _pay("wd-1", "seq-1")),   # other device
+                ("swtpu/t1/events", _pay("wd-0", "seq-1")),   # true dup
+            ]
+            for pid, (topic, payload) in enumerate(offers, start=1):
+                w.write(encode_publish(topic, payload, qos=1,
+                                       packet_id=pid))
+                await w.drain()
+                ptype, _, body = await asyncio.wait_for(read_packet(r), 10)
+                assert ptype == PUBACK
+                assert int.from_bytes(body[:2], "big") == pid
+            w.close()
+            snap.update(edge.snapshot())
+        finally:
+            await edge.stop()
+
+    asyncio.run(run())
+    assert snap["frames_admitted"] == 3
+    assert snap["frames_duplicate"] == 1
+    assert [t for _, t in eng.json_batches] == ["t1", "t2", "t1"]
+
+
+def test_mqtt_qos2_shed_release_withholds_pubcomp_until_ingest():
+    """QoS 2 exactly-once under shed: a PUBREL whose released frame is
+    shed must NOT complete on the client's PUBREL retransmission — the
+    payload re-parks, PUBCOMP stays withheld until a release actually
+    stages. PUBCOMP therefore implies ingest."""
+    eng = FakeEngine()
+    eng.qos = _DenyAll()
+
+    async def run():
+        edge = WireEdge(eng, _edge_cfg())
+        await edge.start()
+        try:
+            r, w = await _mqtt_connect(edge.mqtt_port)
+            w.write(encode_publish("swtpu/default/events", _payload(2),
+                                   qos=2, packet_id=11))
+            await w.drain()
+            ptype, _, _ = await asyncio.wait_for(read_packet(r), 10)
+            assert ptype == PUBREC
+            rel = encode_packet(PUBREL, 2, (11).to_bytes(2, "big"))
+            # release is shed twice; neither may produce a PUBCOMP
+            for _ in range(2):
+                w.write(rel)
+                await w.drain()
+                with pytest.raises(asyncio.TimeoutError):
+                    await asyncio.wait_for(read_packet(r), 0.3)
+            eng.qos = None          # pressure clears
+            w.write(rel)
+            await w.drain()
+            ptype, _, body = await asyncio.wait_for(read_packet(r), 10)
+            assert ptype == PUBCOMP
+            assert int.from_bytes(body[:2], "big") == 11
+            # pid settled: one more PUBREL is a true duplicate -> re-comp
+            w.write(rel)
+            await w.drain()
+            ptype, _, _ = await asyncio.wait_for(read_packet(r), 10)
+            assert ptype == PUBCOMP
+            w.close()
+        finally:
+            await edge.stop()
+
+    asyncio.run(run())
+    # exactly ONE ingest despite four PUBRELs
+    assert eng.json_batches == [([_payload(2)], "default")]
+
+
+def test_aggregate_multi_edge_peak_and_occupancy():
+    """Multi-edge aggregation: counters sum, but connections_peak is a
+    max and flush occupancy a capacity-weighted mean — two edges at 80%
+    report 80%, not 160%."""
+    eng = FakeEngine()
+    cfg = WireEdgeConfig(mqtt_port=None, tcp_port=None, flush_rows=100)
+    e1, e2 = WireEdge(eng, cfg), WireEdge(eng, cfg)
+    eng.wire_edges = [e1, e2]
+    for edge, peak, flushes, rows in ((e1, 5, 10, 800), (e2, 3, 10, 800)):
+        edge.connections_peak = peak
+        edge.frames_received = edge.frames_admitted = rows
+        b = edge.batchers[0]
+        b.flushes_drain = flushes
+        b.flush_rows_sum = b.rows_submitted = rows
+    total = aggregate_wire_snapshot(eng)
+    assert total["connections_peak"] == 5           # max, not 8
+    assert total["flush_occupancy_pct"] == 80.0     # weighted, not 160
+    assert total["frames_received"] == 1600          # counters still sum
+    assert total["flushes"] == 20
+    for e in (e1, e2):
+        e.batchers[0].close()
+
+
 def test_wire_snapshot_disposition_balance():
     """Every disposition path in one session: the snapshot's own terms
     satisfy the wire-frames equation the ledger checks."""
@@ -753,12 +985,17 @@ def test_wire_snapshot_disposition_balance():
             dup = _alt_payload("bal-1")
             w.write(encode_publish("swtpu/default/events", dup, qos=1,
                                    packet_id=1))
+            await w.drain()
+            # PUBACK implies the frame staged (ring committed) — only
+            # then is a redelivery classified duplicate
+            ptype, _, _ = await asyncio.wait_for(read_packet(r), 10)
+            assert ptype == PUBACK
             w.write(encode_publish("swtpu/default/events", dup, qos=1,
                                    packet_id=2))          # duplicate
             w.write(encode_publish("swtpu/default/events", _payload(3),
                                    qos=1, packet_id=3))   # admitted
             await w.drain()
-            for _ in range(3):
+            for _ in range(2):
                 ptype, _, _ = await asyncio.wait_for(read_packet(r), 10)
                 assert ptype == PUBACK
             w.write(encode_packet(DISCONNECT, 0, b""))
